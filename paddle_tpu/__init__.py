@@ -1,0 +1,98 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+Capability parity with fluid-era PaddlePaddle (see /root/repo/SURVEY.md),
+re-designed for TPU: jax/XLA for compute, pjit + named mesh axes for
+distribution, Pallas for custom kernels. The public surface mirrors the
+reference's ``paddle`` package so models port with an import swap.
+"""
+from __future__ import annotations
+
+from . import core
+from .core import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    Parameter,
+    Place,
+    TPUPlace,
+    Tensor,
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    get_device,
+    get_flags,
+    int8,
+    int16,
+    int32,
+    int64,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    is_grad_enabled,
+    no_grad,
+    enable_grad,
+    seed,
+    set_default_dtype,
+    set_device,
+    set_flags,
+    set_grad_enabled,
+    to_tensor,
+    uint8,
+)
+from .core.rng import get_rng_state, set_rng_state  # noqa: F401
+from .core.tensor import enable_grad as _enable_grad  # noqa: F401
+
+from . import tensor  # noqa: E402  (attaches Tensor methods)
+from .tensor import *  # noqa: E402,F401,F403
+
+from . import autograd  # noqa: E402
+from .autograd import grad  # noqa: E402,F401
+
+# Subsystems below are imported lazily-by-layer as they land; each block is
+# appended when its module exists so the package is importable mid-build.
+from . import nn  # noqa: E402
+from .nn.layer_base import Layer  # noqa: E402,F401
+from . import optimizer  # noqa: E402
+from . import io  # noqa: E402
+from . import metric  # noqa: E402
+from . import amp  # noqa: E402
+from . import jit  # noqa: E402
+from .framework.io import save, load  # noqa: E402,F401
+from . import framework  # noqa: E402
+from . import static  # noqa: E402
+from . import distributed  # noqa: E402
+from . import vision  # noqa: E402
+from . import text  # noqa: E402
+from . import utils  # noqa: E402
+from . import hapi  # noqa: E402
+from .hapi import Model  # noqa: E402,F401
+from .hapi.summary import summary  # noqa: E402,F401
+
+
+# dygraph-compat helpers
+def disable_static(place=None):
+    """Eager mode is the default (parity shim)."""
+    return None
+
+
+def enable_static():
+    from .static import _enable_static_mode
+
+    _enable_static_mode()
+
+
+def disable_signal_handler():
+    return None
+
+
+def in_dynamic_mode() -> bool:
+    from .static import _in_static_mode
+
+    return not _in_static_mode()
+
+
+__version__ = "0.1.0"

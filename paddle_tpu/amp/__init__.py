@@ -1,0 +1,14 @@
+"""AMP — parity with python/paddle/amp/ (auto_cast + GradScaler) and the
+reference's per-op auto-cast engine (imperative/amp_auto_cast.cc) + AMP ops
+(operators/amp/check_finite_and_unscale_op, update_loss_scaling_op).
+
+TPU-first: bfloat16 is the default low precision (no loss scaling needed);
+float16 + dynamic loss scaling is kept for API/behavior parity.
+"""
+from .auto_cast import amp_guard, auto_cast, amp_state, white_list, black_list, decorate
+from .grad_scaler import AmpScaler, GradScaler
+
+__all__ = [
+    "auto_cast", "amp_guard", "GradScaler", "AmpScaler", "decorate",
+    "white_list", "black_list",
+]

@@ -1,0 +1,104 @@
+"""auto_cast — parity with dygraph/amp/auto_cast.py:91 and the white/black
+lists in imperative/amp_auto_cast.cc.
+
+Mechanism: a thread-local amp state consulted by the compute-bound
+functionals (linear/conv/matmul/attention): inputs are cast to the low-p
+dtype on white-listed ops; black-listed ops (softmax/norms/log/exp...) force
+float32. Because XLA fuses casts into the surrounding kernels, this costs
+nothing at runtime on TPU.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+
+from ..core import dtype as dtype_mod
+
+# ops that run in low precision (matmul-class, conv-class)
+white_list = {"conv2d", "conv1d", "conv3d", "matmul", "linear", "mul", "einsum",
+              "bmm", "attention"}
+# ops that must stay fp32 (reductions / transcendental-heavy)
+black_list = {
+    "exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "cross_entropy", "layer_norm", "batch_norm", "group_norm", "instance_norm",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = np.dtype("float16")
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+def _should_cast(op_name: str) -> bool:
+    if not _state.enabled:
+        return False
+    if op_name in _state.custom_black:
+        return False
+    if _state.level == "O2":
+        return op_name not in black_list and op_name not in _state.custom_black
+    return op_name in white_list or op_name in _state.custom_white
+
+
+def maybe_cast_inputs(op_name, *raws):
+    """Called by compute functionals on raw jax arrays."""
+    import jax.numpy as jnp
+
+    if not _should_cast(op_name):
+        return raws
+    d = _state.dtype
+    out = []
+    for r in raws:
+        if hasattr(r, "dtype") and jnp.issubdtype(r.dtype, jnp.floating):
+            out.append(r.astype(d))
+        else:
+            out.append(r)
+    return tuple(out)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="float16"):
+    prev = (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+            _state.custom_black)
+    _state.enabled = bool(enable)
+    _state.dtype = dtype_mod.convert_dtype(dtype)
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+         _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="float16",
+             master_weight=None, save_dtype=None):
+    """Pure-low-precision mode: cast model parameters (parity with
+    paddle.amp.decorate / contrib/mixed_precision/decorator.py:437)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m._convert_dtype(dtype_mod.convert_dtype(dtype))
+            m._casted_by_pure_fp16 = True
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers

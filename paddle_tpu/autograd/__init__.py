@@ -1,0 +1,18 @@
+"""Autograd public API — parity with python/paddle/autograd/ in the
+reference (py_layer.py:192, backward_mode.py, functional double-grad)."""
+from __future__ import annotations
+
+from ..core.tensor import no_grad, enable_grad, set_grad_enabled, is_grad_enabled
+from .py_layer import PyLayer, PyLayerContext
+from .functional import grad, backward
+
+__all__ = [
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+    "PyLayer",
+    "PyLayerContext",
+    "grad",
+    "backward",
+]

@@ -1,0 +1,85 @@
+"""Functional autograd — paddle.grad / paddle.autograd.backward parity
+(/root/reference/python/paddle/fluid/dygraph/base.py grad(),
+imperative/partial_grad_engine.cc for the partial-graph engine)."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, backward as _tensor_backward, wrap_raw
+from ..core.tensor import Node
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        _tensor_backward(t, g, retain_graph=retain_graph)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """Compute grads of ``outputs`` w.r.t. ``inputs`` without touching
+    ``.grad`` of other leaves (PartialGradEngine parity)."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+
+    # snapshot leaf grads so we can restore (grad() must not pollute .grad)
+    all_leaves = _collect_leaves(outputs)
+    saved = {id(t): t.grad for t in all_leaves}
+    retain = bool(retain_graph) if retain_graph is not None else create_graph
+    for t in inputs:
+        t._retain_grads = True
+        t.grad = None
+    gouts = grad_outputs or [None] * len(outputs)
+    for o, g in zip(outputs, gouts):
+        # always retain during the sweep; the graph is freed by GC when the
+        # output tensors die (create_graph/double-grad: TODO round 2)
+        _tensor_backward(o, g, retain_graph=True)
+    results = []
+    for t in inputs:
+        if t.grad is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    f"input tensor {t.name} is unreachable from outputs; pass "
+                    "allow_unused=True to get None instead"
+                )
+            results.append(None)
+        else:
+            results.append(t.grad)
+        t.grad = None
+        t._retain_grads = False
+    for t in all_leaves:
+        if id(t) in saved:
+            t.grad = saved[id(t)]
+    return results
+
+
+def _collect_leaves(outputs) -> List[Tensor]:
+    leaves = []
+    seen = set()
+    stack = [o._node for o in outputs if o._node is not None]
+    seen_nodes = set()
+    while stack:
+        node = stack.pop()
+        if id(node) in seen_nodes:
+            continue
+        seen_nodes.add(id(node))
+        for inp in node.inputs:
+            if inp._node is None:
+                if id(inp) not in seen:
+                    seen.add(id(inp))
+                    leaves.append(inp)
+            else:
+                stack.append(inp._node)
+    return leaves

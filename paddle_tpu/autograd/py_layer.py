@@ -1,0 +1,72 @@
+"""Custom autograd ops — parity with paddle.autograd.PyLayer
+(/root/reference/python/paddle/autograd/py_layer.py:192,
+/root/reference/paddle/fluid/imperative/py_layer_fwd.h).
+
+A PyLayer subclass supplies ``forward`` and ``backward`` static methods over
+Tensors; the forward result is wired into the eager autograd DAG with the
+user's backward as the pullback.
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+from ..core.tensor import Node, Tensor, no_grad, is_grad_enabled, wrap_raw
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.extra = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    @staticmethod
+    def forward(ctx: PyLayerContext, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx: PyLayerContext, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        out_list: List[Tensor] = list(outs) if multi else [outs]
+
+        tensor_inputs = [
+            a for a in list(args) + list(kwargs.values())
+            if isinstance(a, Tensor) and not a.stop_gradient
+        ]
+        if not (is_grad_enabled() and tensor_inputs):
+            return outs
+
+        def vjp_fn(cotangents):
+            cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+            grad_in = cls.backward(ctx, *[wrap_raw(c) for c in cts])
+            grad_list = list(grad_in) if isinstance(grad_in, (tuple, list)) else [grad_in]
+            raws = []
+            for g in grad_list:
+                raws.append(g._value if isinstance(g, Tensor) else g)
+            # align to tensor_inputs count
+            return tuple(raws[: len(tensor_inputs)])
+
+        node = Node(
+            tensor_inputs,
+            vjp_fn,
+            [(o._value.shape, o._value.dtype) for o in out_list],
+            name=cls.__name__,
+        )
+        for i, o in enumerate(out_list):
+            o.stop_gradient = False
+            o._node = node
+            o._idx = i
+        return outs if multi else out_list[0]

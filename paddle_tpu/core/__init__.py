@@ -1,0 +1,52 @@
+"""Core runtime: tensor, autograd, dtype, place, flags, rng, errors."""
+import jax as _jax
+
+# int64/float64 must exist for API parity with the reference (python ints
+# create int64 tensors, framework.py to_tensor semantics). All internal ops
+# pass explicit dtypes so the x64 default does not leak into compute.
+_jax.config.update("jax_enable_x64", True)
+
+from . import dtype, enforce, flags, place, rng, tensor  # noqa: E402,F401
+from .dtype import (  # noqa: E402,F401
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    convert_dtype,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .enforce import *  # noqa: E402,F401,F403
+from .flags import get_flags, set_flags  # noqa: E402,F401
+from .place import (  # noqa: E402,F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .rng import get_rng_state_tracker, seed  # noqa: E402,F401
+from .tensor import (  # noqa: E402,F401
+    Parameter,
+    Tensor,
+    apply_op,
+    backward,
+    enable_grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+    to_tensor,
+    wrap_raw,
+)

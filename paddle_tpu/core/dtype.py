@@ -1,0 +1,108 @@
+"""Dtype model for the TPU-native framework.
+
+Mirrors the capability of the reference's VarType dtype enum
+(/root/reference/paddle/fluid/framework/framework.proto:106) but maps directly
+onto numpy/JAX dtypes — on TPU, bfloat16 is first-class and the MXU prefers
+bf16/f32, so the default policy favors float32 with easy bf16 casting.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+# Canonical name -> numpy dtype. bfloat16 comes from ml_dtypes (jax's backing).
+_NAME_TO_DTYPE = {
+    "bool": np.dtype(np.bool_),
+    "uint8": np.dtype(np.uint8),
+    "int8": np.dtype(np.int8),
+    "int16": np.dtype(np.int16),
+    "int32": np.dtype(np.int32),
+    "int64": np.dtype(np.int64),
+    "float16": np.dtype(np.float16),
+    "bfloat16": np.dtype(ml_dtypes.bfloat16),
+    "float32": np.dtype(np.float32),
+    "float64": np.dtype(np.float64),
+    "complex64": np.dtype(np.complex64),
+    "complex128": np.dtype(np.complex128),
+    "float8_e4m3fn": np.dtype(ml_dtypes.float8_e4m3fn),
+    "float8_e5m2": np.dtype(ml_dtypes.float8_e5m2),
+}
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bf16": "bfloat16",
+    "fp16": "float16",
+    "fp32": "float32",
+    "fp64": "float64",
+}
+
+bool_ = _NAME_TO_DTYPE["bool"]
+uint8 = _NAME_TO_DTYPE["uint8"]
+int8 = _NAME_TO_DTYPE["int8"]
+int16 = _NAME_TO_DTYPE["int16"]
+int32 = _NAME_TO_DTYPE["int32"]
+int64 = _NAME_TO_DTYPE["int64"]
+float16 = _NAME_TO_DTYPE["float16"]
+bfloat16 = _NAME_TO_DTYPE["bfloat16"]
+float32 = _NAME_TO_DTYPE["float32"]
+float64 = _NAME_TO_DTYPE["float64"]
+complex64 = _NAME_TO_DTYPE["complex64"]
+complex128 = _NAME_TO_DTYPE["complex128"]
+float8_e4m3fn = _NAME_TO_DTYPE["float8_e4m3fn"]
+float8_e5m2 = _NAME_TO_DTYPE["float8_e5m2"]
+
+_default_dtype = float32
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize any user-supplied dtype spec to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name in _NAME_TO_DTYPE:
+            return _NAME_TO_DTYPE[name]
+        return np.dtype(name)
+    if isinstance(dtype, np.dtype):
+        return dtype
+    # jnp.float32-style scalar types, python types, ml_dtypes types
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return convert_dtype(dtype).name
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if d.name not in ("float16", "bfloat16", "float32", "float64"):
+        raise TypeError(
+            "set_default_dtype only supports float16/bfloat16/float32/float64, "
+            f"got {d.name}"
+        )
+    _default_dtype = d
+
+
+def get_default_dtype() -> np.dtype:
+    return _default_dtype
+
+
+def is_floating_point(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def is_complex(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.complexfloating)

@@ -1,0 +1,100 @@
+"""Process-level flag registry.
+
+Mirrors the reference's gflags runtime config + python bridge
+(/root/reference/paddle/fluid/platform/flags.cc,
+/root/reference/paddle/fluid/pybind/global_value_getter_setter.cc): flags are
+settable via environment variables ``FLAGS_<name>`` and via
+``set_flags``/``get_flags``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "type", "help", "on_change")
+
+    def __init__(self, name, default, help="", on_change=None):
+        self.name = name
+        self.default = default
+        self.type = type(default)
+        self.help = help
+        self.on_change = on_change
+        self.value = self._from_env(default)
+
+    def _from_env(self, default):
+        raw = os.environ.get(f"FLAGS_{self.name}")
+        if raw is None:
+            return default
+        return _coerce(raw, self.type)
+
+
+def _coerce(raw: str, typ) -> Any:
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(raw)
+    if typ is float:
+        return float(raw)
+    return raw
+
+
+_registry: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default, help: str = "", on_change: Callable | None = None):
+    if name in _registry:
+        return _registry[name]
+    f = _Flag(name, default, help, on_change)
+    _registry[name] = f
+    return f
+
+
+def get_flags(names):
+    single = isinstance(names, str)
+    if single:
+        names = [names]
+    out = {}
+    for n in names:
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _registry:
+            raise KeyError(f"unknown flag {n!r}")
+        out[f"FLAGS_{key}"] = _registry[key].value
+    return out
+
+
+def set_flags(flags: Dict[str, Any]):
+    for n, v in flags.items():
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _registry:
+            raise KeyError(f"unknown flag {n!r}")
+        f = _registry[key]
+        if isinstance(v, str) and f.type is not str:
+            v = _coerce(v, f.type)
+        f.value = f.type(v) if f.type is not type(None) else v
+        if f.on_change is not None:
+            f.on_change(f.value)
+
+
+def flag_value(name: str):
+    return _registry[name].value
+
+
+# ---------------------------------------------------------------------------
+# Core flags (parity set from platform/flags.cc; TPU-relevant subset + ours)
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "check every op output for NaN/Inf (debug)")
+define_flag("benchmark", False, "sync after each op and time (debug/benchmark mode)")
+define_flag("eager_delete_tensor_gb", 0.0, "compat no-op: XLA owns memory planning")
+define_flag("fraction_of_gpu_memory_to_use", 0.92, "compat no-op on TPU")
+define_flag("selected_tpus", "", "restrict visible TPU chips, comma-separated ids")
+define_flag("paddle_num_threads", 1, "host-side intra-op threads (compat)")
+define_flag("use_pinned_memory", True, "compat: host staging buffers")
+define_flag("cudnn_deterministic", False, "compat: request deterministic kernels")
+define_flag("tpu_deterministic_ops", False, "request deterministic XLA reductions")
+define_flag("call_stack_level", 1, "error message verbosity level")
+define_flag("print_op_timings", False, "print per-op timings in eager mode")
+define_flag("allocator_strategy", "auto_growth", "compat: XLA/TPU owns allocation")
+define_flag("enable_eager_jit_cache", True, "cache jitted callables for hot eager ops")
+define_flag("log_level", 0, "VLOG-style verbosity for framework internals")

@@ -1,0 +1,131 @@
+"""Random state management.
+
+The reference keeps stateful per-device generators plus a named
+``RNGStatesTracker`` for tensor parallelism
+(/root/reference/python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py:23).
+JAX RNG is functional (threaded keys), so this module provides:
+
+- a process-global stateful generator facade (``seed``/``next_key``) for the
+  eager API, implemented by splitting a root key;
+- ``RNGStatesTracker`` with named seed domains — tensor-parallel layers need
+  *identical* streams for replicated init and *distinct* streams per model
+  shard (e.g. dropout inside a TP region);
+- pure helpers to derive keys for use inside jitted/staged code.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """Stateful facade over a functional JAX PRNG key chain."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        return jax.random.key_data(self._key)
+
+    def set_state(self, state):
+        self._key = jax.random.wrap_key_data(np.asarray(state))
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(s: int):
+    """Global seed (parity with paddle.seed)."""
+    _default_generator.manual_seed(s)
+    return _default_generator
+
+
+def next_key():
+    return _default_generator.next_key()
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)
+
+
+class RNGStatesTracker:
+    """Named RNG streams for tensor-parallel regions.
+
+    Parity with the reference's RNGStatesTracker (random.py:23,68): model
+    shards register a named seed domain, and ``rng_state(name)`` temporarily
+    switches the global generator onto that domain so dropout masks differ (or
+    match) across TP ranks by construction.
+    """
+
+    def __init__(self):
+        self._states = {}
+
+    def reset(self):
+        self._states.clear()
+
+    def add(self, name: str, seed_: int):
+        if name in self._states:
+            raise ValueError(f"rng state {name!r} already exists")
+        self._states[name] = Generator(seed_)
+
+    def get_states_tracker(self):
+        return {n: g.get_state() for n, g in self._states.items()}
+
+    def set_states_tracker(self, states):
+        for n, s in states.items():
+            self._states.setdefault(n, Generator(0)).set_state(s)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "model_parallel_rng"):
+        if name not in self._states:
+            raise ValueError(f"rng state {name!r} was not added")
+        global _default_generator
+        prev = _default_generator
+        _default_generator = self._states[name]
+        try:
+            yield
+        finally:
+            _default_generator = prev
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed_: int = 0, mp_rank: int = 0):
+    """Seed the global + named TP domains (parity random.py:68)."""
+    global_seed = 100 + seed_
+    local_seed = seed_ + 1024 + mp_rank * 100
+    _tracker.reset()
+    seed(global_seed)
+    _tracker.add("model_parallel_rng", local_seed)
+    _tracker.add("global_seed", global_seed)

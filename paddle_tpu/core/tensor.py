@@ -1,0 +1,780 @@
+"""Eager Tensor facade and define-by-run autograd over JAX.
+
+Capability parity with the reference's imperative engine — VarBase + Tracer +
+BasicEngine (/root/reference/paddle/fluid/imperative/tracer.cc:133,
+/root/reference/paddle/fluid/imperative/basic_engine.cc:305) — redesigned for
+XLA: instead of a per-op kernel dispatch with hand-written grad ops, every
+eager op runs through ``jax.vjp``, which both executes the forward on-device
+and captures a pullback closure. ``Tensor.backward()`` walks the resulting
+DAG of pullbacks in reverse topological order.
+
+The DAG is held by strong references from output tensors to their producer
+``Node`` (and from nodes to input tensors), so Python GC frees the graph as
+soon as the forward outputs go out of scope — no global tape, no leak in
+inference loops.
+
+For hot training loops, the same layer/op code can be staged: tracing runs
+this module's ops with JAX tracers inside ``jax.jit`` (see paddle_tpu.jit),
+where autograd recording is disabled and ``jax.grad`` differentiates the
+whole step — that is the path that reaches MXU-peak performance.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtype_mod
+from . import place as place_mod
+from . import rng as rng_mod
+from .enforce import InvalidArgumentError, enforce
+from .flags import flag_value
+
+__all__ = [
+    "Tensor",
+    "Parameter",
+    "to_tensor",
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+    "apply_op",
+    "wrap_raw",
+]
+
+
+# ---------------------------------------------------------------------------
+# grad mode
+# ---------------------------------------------------------------------------
+class _GradMode(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_grad_mode = _GradMode()
+
+
+def is_grad_enabled() -> bool:
+    return _grad_mode.enabled
+
+
+def set_grad_enabled(mode: bool):
+    class _Ctx:
+        def __init__(self, mode):
+            self._mode = bool(mode)
+            self._prev = _grad_mode.enabled
+            _grad_mode.enabled = self._mode
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            _grad_mode.enabled = self._prev
+            return False
+
+    return _Ctx(mode)
+
+
+@contextlib.contextmanager
+def no_grad():
+    prev = _grad_mode.enabled
+    _grad_mode.enabled = False
+    try:
+        yield
+    finally:
+        _grad_mode.enabled = prev
+
+
+def no_grad_decorator(fn):
+    def wrapper(*a, **k):
+        with no_grad():
+            return fn(*a, **k)
+
+    wrapper.__name__ = getattr(fn, "__name__", "no_grad_fn")
+    return wrapper
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = _grad_mode.enabled
+    _grad_mode.enabled = True
+    try:
+        yield
+    finally:
+        _grad_mode.enabled = prev
+
+
+# ---------------------------------------------------------------------------
+# autograd DAG node
+# ---------------------------------------------------------------------------
+class Node:
+    """One recorded eager op: inputs, pullback, and output metadata."""
+
+    __slots__ = ("inputs", "vjp_fn", "out_avals", "out_grads", "n_outs", "name")
+
+    def __init__(self, inputs, vjp_fn, out_avals, name=""):
+        self.inputs: List[Tensor] = inputs
+        self.vjp_fn = vjp_fn
+        self.out_avals = out_avals  # list of (shape, dtype)
+        self.out_grads: Optional[List[Any]] = None
+        self.n_outs = len(out_avals)
+        self.name = name
+
+    def seed_zero_grads(self):
+        if self.out_grads is None:
+            self.out_grads = [None] * self.n_outs
+
+    def accumulate(self, idx, g):
+        self.seed_zero_grads()
+        if self.out_grads[idx] is None:
+            self.out_grads[idx] = g
+        else:
+            self.out_grads[idx] = self.out_grads[idx] + g
+
+
+# ---------------------------------------------------------------------------
+# Tensor
+# ---------------------------------------------------------------------------
+class Tensor:
+    """Imperative tensor wrapping a ``jax.Array`` (or a JAX tracer when the
+    surrounding code is being staged by ``paddle_tpu.jit``)."""
+
+    # populated by paddle_tpu.tensor via _register_tensor_method
+    __slots__ = (
+        "_value",
+        "_node",
+        "_idx",
+        "stop_gradient",
+        "grad",
+        "name",
+        "persistable",
+        "_retain_grads",
+        "_grad_hooks",
+        "__weakref__",
+    )
+
+    _next_id = [0]
+
+    def __init__(self, value, stop_gradient=True, name=None):
+        self._value = value
+        self._node: Optional[Node] = None
+        self._idx = 0
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self.persistable = False
+        self._retain_grads = False
+        self._grad_hooks: List[Callable] = []
+        if name is None:
+            Tensor._next_id[0] += 1
+            name = f"generated_tensor_{Tensor._next_id[0]}"
+        self.name = name
+
+    # -- basic properties ----------------------------------------------------
+    @property
+    def shape(self) -> list:
+        return list(self._value.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    ndimension = ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype)
+
+    @property
+    def place(self):
+        try:
+            dev = self._value.devices() if hasattr(self._value, "devices") else None
+            if dev:
+                d = next(iter(dev))
+                return (
+                    place_mod.TPUPlace(d.id)
+                    if d.platform == "tpu"
+                    else place_mod.CPUPlace()
+                )
+        except Exception:
+            pass
+        return place_mod._default_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    @property
+    def T(self):
+        from .. import tensor as T
+
+        return T.transpose(self, list(range(self.ndim))[::-1])
+
+    def numel(self) -> int:
+        return self.size
+
+    def dim(self) -> int:
+        return self.ndim
+
+    # -- conversion ----------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        enforce(
+            self.size == 1,
+            "The truth value of a Tensor with more than one element is ambiguous",
+        )
+        return bool(self.numpy().item())
+
+    def __len__(self):
+        enforce(self.ndim > 0, "len() of a 0-d tensor")
+        return self.shape[0]
+
+    def __repr__(self):
+        val = np.asarray(self._value) if not _is_tracer(self._value) else self._value
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"stop_gradient={self.stop_gradient},\n       {val})"
+        )
+
+    # -- autograd ------------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        backward(self, grad_tensor, retain_graph)
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def register_hook(self, hook: Callable):
+        """Register a gradient hook (parity imperative/hooks.h). The hook
+        receives the grad Tensor and may return a replacement."""
+        self._grad_hooks.append(hook)
+
+        class _Remover:
+            def __init__(self, hooks, h):
+                self._hooks, self._h = hooks, h
+
+            def remove(self):
+                if self._h in self._hooks:
+                    self._hooks.remove(self._h)
+
+        return _Remover(self._grad_hooks, hook)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        return apply_op(lambda x: x + jnp.zeros((), x.dtype), self)
+
+    # -- dtype / device ------------------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        d = dtype_mod.convert_dtype(dtype)
+        return apply_op(lambda x: x.astype(d), self)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def cpu(self):
+        return Tensor(
+            jax.device_put(self._value, jax.devices("cpu")[0]),
+            stop_gradient=self.stop_gradient,
+        )
+
+    def tpu(self, device_id=0):
+        return Tensor(
+            jax.device_put(self._value, place_mod.TPUPlace(device_id).jax_device()),
+            stop_gradient=self.stop_gradient,
+        )
+
+    cuda = tpu
+
+    def pin_memory(self):
+        return self.cpu()
+
+    def to(self, *args, **kwargs):
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a.split(":")[0] in ("cpu", "tpu", "gpu"):
+                out = out.cpu() if a.startswith("cpu") else out.tpu()
+            elif isinstance(a, place_mod.Place):
+                out = Tensor(
+                    jax.device_put(out._value, a.jax_device()),
+                    stop_gradient=out.stop_gradient,
+                )
+            else:
+                out = out.astype(a)
+        return out
+
+    # -- in-place value assignment (imperative semantics) --------------------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        arr = jnp.asarray(value, dtype=self._value.dtype)
+        enforce(
+            tuple(arr.shape) == tuple(self._value.shape),
+            f"set_value shape mismatch {arr.shape} vs {self._value.shape}",
+        )
+        self._value = arr
+
+    def copy_(self, other, *a):
+        self.set_value(other)
+        return self
+
+    def fill_(self, v):
+        self._value = jnp.full_like(self._value, v)
+        return self
+
+    def zero_(self):
+        return self.fill_(0)
+
+    def _rebind(self, new: "Tensor"):
+        """Point this python object at a new graph value (setitem etc.)."""
+        self._value = new._value
+        self._node = new._node
+        self._idx = new._idx
+        self.stop_gradient = new.stop_gradient
+
+    # -- indexing ------------------------------------------------------------
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return apply_op(lambda x: x[idx], self)
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        if isinstance(value, Tensor):
+            new = apply_op(
+                lambda x, v: x.at[idx].set(v.astype(x.dtype)), self, value
+            )
+        else:
+            new = apply_op(lambda x: x.at[idx].set(value), self)
+        self._rebind(new)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- python numeric protocol (rich surface attached by paddle_tpu.tensor)
+    def __neg__(self):
+        return apply_op(jnp.negative, self)
+
+    def __abs__(self):
+        return apply_op(jnp.abs, self)
+
+    def __add__(self, o):
+        return _binop(jnp.add, self, o)
+
+    def __radd__(self, o):
+        return _binop(jnp.add, o, self)
+
+    def __sub__(self, o):
+        return _binop(jnp.subtract, self, o)
+
+    def __rsub__(self, o):
+        return _binop(jnp.subtract, o, self)
+
+    def __mul__(self, o):
+        return _binop(jnp.multiply, self, o)
+
+    def __rmul__(self, o):
+        return _binop(jnp.multiply, o, self)
+
+    def __truediv__(self, o):
+        return _binop(jnp.true_divide, self, o)
+
+    def __rtruediv__(self, o):
+        return _binop(jnp.true_divide, o, self)
+
+    def __floordiv__(self, o):
+        return _binop(jnp.floor_divide, self, o)
+
+    def __rfloordiv__(self, o):
+        return _binop(jnp.floor_divide, o, self)
+
+    def __mod__(self, o):
+        return _binop(jnp.mod, self, o)
+
+    def __rmod__(self, o):
+        return _binop(jnp.mod, o, self)
+
+    def __pow__(self, o):
+        return _binop(jnp.power, self, o)
+
+    def __rpow__(self, o):
+        return _binop(jnp.power, o, self)
+
+    def __matmul__(self, o):
+        return _binop(jnp.matmul, self, o)
+
+    def __rmatmul__(self, o):
+        return _binop(jnp.matmul, o, self)
+
+    def __eq__(self, o):
+        return _binop(jnp.equal, self, o)
+
+    def __ne__(self, o):
+        return _binop(jnp.not_equal, self, o)
+
+    def __lt__(self, o):
+        return _binop(jnp.less, self, o)
+
+    def __le__(self, o):
+        return _binop(jnp.less_equal, self, o)
+
+    def __gt__(self, o):
+        return _binop(jnp.greater, self, o)
+
+    def __ge__(self, o):
+        return _binop(jnp.greater_equal, self, o)
+
+    def __invert__(self):
+        return apply_op(jnp.logical_not, self)
+
+    def __and__(self, o):
+        return _binop(_and_like, self, o)
+
+    def __or__(self, o):
+        return _binop(_or_like, self, o)
+
+    def __xor__(self, o):
+        return _binop(_xor_like, self, o)
+
+    def __hash__(self):
+        return id(self)
+
+
+def _and_like(a, b):
+    if a.dtype == np.bool_:
+        return jnp.logical_and(a, b)
+    return jnp.bitwise_and(a, b)
+
+
+def _or_like(a, b):
+    if a.dtype == np.bool_:
+        return jnp.logical_or(a, b)
+    return jnp.bitwise_or(a, b)
+
+
+def _xor_like(a, b):
+    if a.dtype == np.bool_:
+        return jnp.logical_xor(a, b)
+    return jnp.bitwise_xor(a, b)
+
+
+class Parameter(Tensor):
+    """Trainable tensor — parity with ParamBase
+    (/root/reference/python/paddle/fluid/framework.py:5727)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "is_distributed")
+
+    def __init__(self, value, trainable=True, name=None):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+        self.persistable = True
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+# ---------------------------------------------------------------------------
+# op application: the eager hot path
+# ---------------------------------------------------------------------------
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return [_unwrap_index(i) for i in idx]
+    if isinstance(idx, slice):
+        return slice(
+            _unwrap_index(idx.start), _unwrap_index(idx.stop), _unwrap_index(idx.step)
+        )
+    return idx
+
+
+def wrap_raw(value, stop_gradient=True) -> Tensor:
+    return Tensor(value, stop_gradient=stop_gradient)
+
+
+def _differentiable(x) -> bool:
+    return isinstance(x, Tensor) and not x.stop_gradient
+
+
+def _float_like(aval_dtype) -> bool:
+    return jnp.issubdtype(aval_dtype, jnp.floating) or jnp.issubdtype(
+        aval_dtype, jnp.complexfloating
+    )
+
+
+# Hook installed by paddle_tpu.static.program_guard: when set, every op is
+# also appended to the active Program's SSA trace (the ProgramDesc-equivalent).
+_op_recorder: Optional[Callable] = None
+
+
+def apply_op(fn: Callable, *args, multi_out: bool = False, op_name: str = ""):
+    """Run ``fn`` over raw arrays; record a pullback node when needed.
+
+    ``args`` may mix Tensors and raw values; only floating-point Tensor inputs
+    with ``stop_gradient=False`` participate in differentiation.
+    """
+    raws = [a._value if isinstance(a, Tensor) else a for a in args]
+    record = _grad_mode.enabled and any(
+        _differentiable(a) and _float_like(a._value.dtype) for a in args
+    )
+    if not record:
+        out = fn(*raws)
+        if flag_value("check_nan_inf"):
+            _check_nan_inf(out, op_name or getattr(fn, "__name__", "op"))
+        if multi_out:
+            outs = tuple(wrap_raw(o) for o in out)
+        else:
+            outs = wrap_raw(out)
+        if _op_recorder is not None:
+            _op_recorder(
+                fn, args, outs if multi_out else (outs,), multi_out,
+                op_name or getattr(fn, "__name__", "op"),
+            )
+        return outs
+
+    diff_pos = [
+        i
+        for i, a in enumerate(args)
+        if _differentiable(a) and _float_like(a._value.dtype)
+    ]
+    diff_raws = [raws[i] for i in diff_pos]
+
+    def f(*diff):
+        full = list(raws)
+        for p, v in zip(diff_pos, diff):
+            full[p] = v
+        return fn(*full)
+
+    out, vjp_fn = jax.vjp(f, *diff_raws)
+    if flag_value("check_nan_inf"):
+        _check_nan_inf(out, op_name or getattr(fn, "__name__", "op"))
+    outs = out if multi_out else (out,)
+    node = Node(
+        [args[i] for i in diff_pos],
+        vjp_fn,
+        [(o.shape, o.dtype) for o in outs],
+        name=op_name or getattr(fn, "__name__", "op"),
+    )
+    wrapped = []
+    for i, o in enumerate(outs):
+        t = Tensor(o, stop_gradient=not _float_like(o.dtype))
+        if not t.stop_gradient:
+            t._node = node
+            t._idx = i
+        wrapped.append(t)
+    if _op_recorder is not None:
+        _op_recorder(
+            fn, args, tuple(wrapped), multi_out,
+            op_name or getattr(fn, "__name__", "op"),
+        )
+    return tuple(wrapped) if multi_out else wrapped[0]
+
+
+def _binop(fn, a, b):
+    return apply_op(fn, *_promote_pair(a, b))
+
+
+def _promote_pair(a, b):
+    """Align python scalars to the tensor operand's dtype family so that
+    e.g. float_tensor + 2 stays in the tensor dtype (paddle semantics),
+    instead of numpy-style promotion to a wider type."""
+    if isinstance(a, Tensor) and not isinstance(b, Tensor):
+        if isinstance(b, (bool, int, float)) and _float_like(a._value.dtype):
+            b = jnp.asarray(b, dtype=a._value.dtype)
+        elif isinstance(b, (bool, int)) and jnp.issubdtype(
+            a._value.dtype, jnp.integer
+        ):
+            b = jnp.asarray(b, dtype=a._value.dtype)
+    elif isinstance(b, Tensor) and not isinstance(a, Tensor):
+        if isinstance(a, (bool, int, float)) and _float_like(b._value.dtype):
+            a = jnp.asarray(a, dtype=b._value.dtype)
+        elif isinstance(a, (bool, int)) and jnp.issubdtype(
+            b._value.dtype, jnp.integer
+        ):
+            a = jnp.asarray(a, dtype=b._value.dtype)
+    return a, b
+
+
+def _check_nan_inf(out, name):
+    """FLAGS_check_nan_inf runtime sanitizer — parity with the reference's
+    nan_inf_utils (framework/details/nan_inf_utils_detail.cc)."""
+    leaves = jax.tree_util.tree_leaves(out)
+    for leaf in leaves:
+        if hasattr(leaf, "dtype") and _float_like(leaf.dtype) and not _is_tracer(leaf):
+            if not bool(jnp.all(jnp.isfinite(leaf))):
+                raise FloatingPointError(f"NaN or Inf found in output of op {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# backward engine
+# ---------------------------------------------------------------------------
+def _topo_nodes(root: Node) -> List[Node]:
+    """Iterative DFS postorder => reverse is a valid reverse-topo sweep."""
+    seen = set()
+    order: List[Node] = []
+    stack: List[tuple] = [(root, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for inp in node.inputs:
+            if inp._node is not None and id(inp._node) not in seen:
+                stack.append((inp._node, False))
+    return order
+
+
+def backward(tensor: Tensor, grad_tensor=None, retain_graph=False):
+    """Reverse-mode sweep — parity with BasicEngine::Execute
+    (imperative/basic_engine.cc:305)."""
+    if grad_tensor is None:
+        seed = jnp.ones(tensor._value.shape, tensor._value.dtype)
+    else:
+        seed = grad_tensor._value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    if tensor._node is None:
+        if not tensor.stop_gradient:
+            _accum_leaf(tensor, seed)
+        return
+
+    order = _topo_nodes(tensor._node)
+    tensor._node.seed_zero_grads()
+    tensor._node.accumulate(tensor._idx, seed)
+
+    for node in reversed(order):
+        if node.out_grads is None or all(g is None for g in node.out_grads):
+            node.out_grads = None
+            continue
+        cotangents = [
+            g if g is not None else jnp.zeros(shape, dtype)
+            for g, (shape, dtype) in zip(node.out_grads, node.out_avals)
+        ]
+        ct = tuple(cotangents) if node.n_outs > 1 else cotangents[0]
+        in_grads = node.vjp_fn(ct)
+        for inp, g in zip(node.inputs, in_grads):
+            if g is None or inp.stop_gradient:
+                continue
+            if getattr(g, "dtype", None) is not None and g.dtype == jax.dtypes.float0:
+                continue
+            for hook in inp._grad_hooks:
+                res = hook(wrap_raw(g))
+                if res is not None:
+                    g = res._value if isinstance(res, Tensor) else res
+            if inp._node is not None:
+                inp._node.accumulate(inp._idx, g)
+                if inp._retain_grads:
+                    _accum_leaf(inp, g)
+            else:
+                _accum_leaf(inp, g)
+        node.out_grads = None
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals eagerly
+
+    if not retain_graph:
+        # Drop graph edges so memory is reclaimed; mirrors the reference's
+        # retain_graph=False default behavior.
+        for node in order:
+            node.inputs = []
+
+
+def _accum_leaf(t: Tensor, g):
+    if t.grad is None:
+        t.grad = wrap_raw(g)
+    else:
+        t.grad = wrap_raw(t.grad._value + g)
+
+
+# ---------------------------------------------------------------------------
+# to_tensor
+# ---------------------------------------------------------------------------
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """Parity with paddle.to_tensor: python ints -> int64, floats -> default
+    float dtype; numpy arrays keep their dtype unless ``dtype`` is given."""
+    d = dtype_mod.convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        arr = data._value
+        if d is not None and arr.dtype != d:
+            arr = arr.astype(d)
+        out = Tensor(arr, stop_gradient=stop_gradient)
+        return out
+    if isinstance(data, (bool, int, float, complex)) or (
+        isinstance(data, (list, tuple)) and _all_py_scalars(data)
+    ):
+        npd = np.asarray(data)
+        if d is None:
+            if npd.dtype == np.float64:
+                d = dtype_mod.get_default_dtype()
+            elif npd.dtype == np.int64:
+                d = np.dtype(np.int64)
+        npd = npd.astype(d) if d is not None else npd
+        data = npd
+    elif isinstance(data, np.ndarray):
+        if d is not None and data.dtype != d:
+            data = data.astype(d)
+    dev = place_mod._place_from_any(place).jax_device() if place is not None else None
+    arr = jnp.asarray(data, dtype=d)
+    if dev is not None:
+        arr = jax.device_put(arr, dev)
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def _all_py_scalars(seq) -> bool:
+    for x in seq:
+        if isinstance(x, (list, tuple)):
+            if not _all_py_scalars(x):
+                return False
+        elif not isinstance(x, (bool, int, float, complex)):
+            return False
+    return True

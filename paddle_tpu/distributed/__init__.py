@@ -1,0 +1,48 @@
+"""paddle_tpu.distributed — collectives, env, fleet (parity with
+python/paddle/distributed/, SURVEY.md §2 #64-80)."""
+from .communication import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    alltoall,
+    barrier,
+    broadcast,
+    get_group,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    wait,
+)
+from .parallel import (  # noqa: F401
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+)
+
+from . import fleet  # noqa: F401
+from .fleet import mesh_utils  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Parity with paddle.distributed.spawn (spawn.py:321): launch ``nprocs``
+    local worker processes running ``func``. On a TPU host, multi-process
+    spawn is only used for CPU-mesh simulation tests; real multi-chip scale
+    goes through the mesh + pjit instead."""
+    import multiprocessing as mp
+
+    if nprocs == -1:
+        nprocs = 1
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=func, args=args, daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+    return procs

@@ -1,0 +1,287 @@
+"""Functional collectives — parity with
+python/paddle/distributed/collective.py:157-1294 and the c_* collective op
+set (operators/collective/).
+
+TPU-native dual path:
+- **staged** (inside jit/shard_map over a Mesh): lowers to ``lax.psum /
+  all_gather / ppermute`` on a named mesh axis — XLA emits ICI collectives
+  and overlaps them with compute (replaces NCCLCommContext rings; the
+  ``group`` argument maps to a mesh-axis name the way ``ring_id`` mapped to a
+  communicator).
+- **eager multi-host**: ``multihost_utils`` process-level collectives over
+  DCN (replaces Gloo CPU collectives, platform/gloo_context.cc).
+Single-process eager calls are identities, matching a world of size 1.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply_op, to_tensor, wrap_raw
+from .parallel import get_world_size
+
+__all__ = [
+    "ReduceOp", "all_reduce", "all_gather", "broadcast", "reduce", "scatter",
+    "alltoall", "reduce_scatter", "barrier", "send", "recv", "wait",
+    "new_group", "get_group", "split_group",
+]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A named communication group = a mesh axis (TPU) — replaces ring_id."""
+
+    def __init__(self, ranks=None, axis_name=None, id=0):
+        self.ranks = ranks or []
+        self.axis_name = axis_name
+        self.id = id
+
+    @property
+    def nranks(self):
+        if self.axis_name is not None:
+            from .fleet.mesh_utils import axis_size
+
+            n = axis_size(self.axis_name)
+            if n is not None:
+                return n
+        return len(self.ranks) if self.ranks else get_world_size()
+
+    @property
+    def rank(self):
+        from .parallel import get_rank
+
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+
+_groups = {0: Group(id=0)}
+_next_gid = [1]
+
+
+def new_group(ranks=None, backend=None, axis_name=None):
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    g = Group(ranks=ranks, axis_name=axis_name, id=gid)
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid=0):
+    return _groups.get(gid)
+
+
+def split_group(*a, **k):
+    raise NotImplementedError
+
+
+def _axis_of(group) -> Optional[str]:
+    if group is None:
+        return None
+    if isinstance(group, str):
+        return group
+    return group.axis_name
+
+
+def _in_trace(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _reduce_fn(op):
+    return {
+        ReduceOp.SUM: jax.lax.psum,
+        ReduceOp.MAX: jax.lax.pmax,
+        ReduceOp.MIN: jax.lax.pmin,
+        ReduceOp.AVG: jax.lax.pmean,
+    }[op]
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place allreduce over the group's mesh axis."""
+    axis = _axis_of(group)
+    raw = tensor._value if isinstance(tensor, Tensor) else tensor
+    if _in_trace(raw) and axis is not None:
+        out = _reduce_fn(op)(raw, axis)
+    elif get_world_size() > 1:
+        from jax.experimental import multihost_utils
+
+        stacked = multihost_utils.process_allgather(np.asarray(raw))
+        red = {
+            ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max, ReduceOp.MIN: np.min,
+            ReduceOp.PROD: np.prod, ReduceOp.AVG: np.mean,
+        }[op]
+        out = jnp.asarray(red(stacked, axis=0))
+    else:
+        out = raw
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return tensor
+    return out
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    axis_name = _axis_of(group)
+    raw = tensor._value if isinstance(tensor, Tensor) else tensor
+    if _in_trace(raw) and axis_name is not None:
+        out = jax.lax.all_gather(raw, axis_name)
+        parts = [out[i] for i in range(out.shape[0])]
+    elif get_world_size() > 1:
+        from jax.experimental import multihost_utils
+
+        stacked = multihost_utils.process_allgather(np.asarray(raw))
+        parts = [jnp.asarray(stacked[i]) for i in range(stacked.shape[0])]
+    else:
+        parts = [raw]
+    if tensor_list is not None and isinstance(tensor_list, list):
+        tensor_list.extend(wrap_raw(p) for p in parts)
+        return tensor_list
+    return [wrap_raw(p) for p in parts]
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    axis_name = _axis_of(group)
+    inputs = tensor_or_tensor_list
+    if isinstance(inputs, (list, tuple)):
+        raw = jnp.concatenate(
+            [t._value if isinstance(t, Tensor) else t for t in inputs], axis=0
+        )
+    else:
+        raw = inputs._value if isinstance(inputs, Tensor) else inputs
+    if _in_trace(raw) and axis_name is not None:
+        out = jax.lax.psum_scatter(raw, axis_name, scatter_dimension=0, tiled=True)
+    elif get_world_size() > 1:
+        from jax.experimental import multihost_utils
+        from .parallel import get_rank
+
+        stacked = multihost_utils.process_allgather(np.asarray(raw))
+        total = stacked.sum(axis=0)
+        n = get_world_size()
+        shard = np.split(total, n, axis=0)[get_rank()]
+        out = jnp.asarray(shard)
+    else:
+        out = raw
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return tensor
+    return wrap_raw(out)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    axis_name = _axis_of(group)
+    raw = tensor._value if isinstance(tensor, Tensor) else tensor
+    if _in_trace(raw) and axis_name is not None:
+        # select src's value on every member of the axis
+        idx = jax.lax.axis_index(axis_name)
+        out = jax.lax.psum(jnp.where(idx == src, raw, jnp.zeros_like(raw)), axis_name)
+    elif get_world_size() > 1:
+        from jax.experimental import multihost_utils
+
+        out = jnp.asarray(
+            multihost_utils.broadcast_one_to_all(
+                np.asarray(raw), is_source=(jax.process_index() == src)
+            )
+        )
+    else:
+        out = raw
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return tensor
+    return out
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # implemented as allreduce (result valid on dst; identical elsewhere)
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    from .parallel import get_rank, get_world_size as ws
+
+    if tensor_list is None:
+        return tensor
+    if ws() <= 1:
+        part = tensor_list[0]
+        tensor._value = part._value if isinstance(part, Tensor) else part
+        return tensor
+    src_stack = np.stack([np.asarray(t._value if isinstance(t, Tensor) else t)
+                          for t in tensor_list])
+    from jax.experimental import multihost_utils
+
+    all_ = multihost_utils.broadcast_one_to_all(
+        src_stack, is_source=(jax.process_index() == src)
+    )
+    tensor._value = jnp.asarray(all_[get_rank()])
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    axis_name = _axis_of(group)
+    raws = [t._value if isinstance(t, Tensor) else t for t in in_tensor_list]
+    if raws and _in_trace(raws[0]) and axis_name is not None:
+        x = jnp.stack(raws, axis=0)
+        out = jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=False)
+        parts = [out[i] for i in range(out.shape[0])]
+    elif get_world_size() > 1:
+        from jax.experimental import multihost_utils
+        from .parallel import get_rank
+
+        stacked = multihost_utils.process_allgather(np.stack([np.asarray(r) for r in raws]))
+        # stacked: [world, world, ...]; rank r receives stacked[s][r] for all s
+        parts = [jnp.asarray(stacked[s][get_rank()]) for s in range(stacked.shape[0])]
+    else:
+        parts = raws
+    wrapped = [wrap_raw(p) for p in parts]
+    if out_tensor_list is not None and isinstance(out_tensor_list, list):
+        out_tensor_list.extend(wrapped)
+        return out_tensor_list
+    return wrapped
+
+
+def barrier(group=None):
+    if get_world_size() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send — staged path only (ppermute inside shard_map pipelines);
+    eager multi-host p2p is emulated via gather (documented limitation)."""
+    raw = tensor._value if isinstance(tensor, Tensor) else tensor
+    axis_name = _axis_of(group)
+    if _in_trace(raw) and axis_name is not None:
+        from .parallel import get_rank
+
+        return jax.lax.ppermute(raw, axis_name, [(get_rank(), dst)])
+    raise NotImplementedError(
+        "eager cross-process send/recv: use the pipeline engine (shard_map) "
+        "or pass a mesh-axis group inside jit"
+    )
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "eager cross-process send/recv: use the pipeline engine (shard_map) "
+        "or pass a mesh-axis group inside jit"
+    )
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Stream sync parity (c_sync_calc_stream): block until value ready."""
+    raw = tensor._value if isinstance(tensor, Tensor) else tensor
+    if hasattr(raw, "block_until_ready"):
+        raw.block_until_ready()
+    return tensor

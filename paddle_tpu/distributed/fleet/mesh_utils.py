@@ -1,0 +1,66 @@
+"""Global device-mesh management.
+
+The TPU-native replacement for the reference's communicator bookkeeping
+(NCCLCommContext ring_id→comm map, platform/collective_helper.h:68): one
+process-global ``jax.sharding.Mesh`` whose named axes (dp/mp/pp/sharding/sp)
+are what c_* ops called rings.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "init_mesh", "get_mesh", "set_mesh", "axis_size", "named_sharding",
+    "replicated", "data_sharding",
+]
+
+_mesh: Optional[Mesh] = None
+
+
+def init_mesh(shape: Sequence[int] = None, axis_names: Sequence[str] = ("dp",),
+              devices=None) -> Mesh:
+    """Create and install the global mesh. Default: all devices on one 'dp'
+    axis. Axis sizes with -1 are inferred."""
+    global _mesh
+    devs = np.array(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = [len(devs)]
+    shape = list(shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = len(devs) // known
+    _mesh = Mesh(devs.reshape(shape), tuple(axis_names))
+    return _mesh
+
+
+def set_mesh(mesh: Mesh):
+    global _mesh
+    _mesh = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _mesh
+
+
+def axis_size(axis_name: str) -> Optional[int]:
+    if _mesh is None or axis_name not in _mesh.axis_names:
+        return None
+    return _mesh.shape[axis_name]
+
+
+def named_sharding(*spec) -> NamedSharding:
+    assert _mesh is not None, "call init_mesh() first"
+    return NamedSharding(_mesh, PartitionSpec(*spec))
+
+
+def replicated() -> NamedSharding:
+    return named_sharding()
+
+
+def data_sharding(axis="dp") -> NamedSharding:
+    """Batch-dim sharding over the data axis."""
+    return named_sharding(axis)

@@ -1,0 +1,15 @@
+"""fleet.meta_parallel — parity with
+python/paddle/distributed/fleet/meta_parallel/."""
+from .parallel_layers.mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .parallel_layers.pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .parallel_layers.random import (  # noqa: F401
+    RNGStatesTracker,
+    get_rng_state_tracker,
+    model_parallel_random_seed,
+)
+from .pipeline_parallel import PipelineParallel  # noqa: F401

@@ -1,0 +1,144 @@
+"""Pipeline layer segmentation — parity with
+fleet/meta_parallel/parallel_layers/pp_layers.py:61,112 (LayerDesc,
+SharedLayerDesc, PipelineLayer): describes the model as a flat list of layer
+descriptors that the pipeline engine partitions into stages.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.nn.layer_base import Layer
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+        enforce(
+            isinstance(layer_cls, type) and issubclass(layer_cls, Layer),
+            f"LayerDesc expects a Layer subclass, got {layer_cls}",
+        )
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Holds the full layer list; ``self._start/_end`` select this stage's
+    segment. With pp_degree=1 (or under the GSPMD pipeline engine, which wants
+    the whole model) all layers are local."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, recompute_ctx=None,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._recompute_interval = recompute_interval
+        if topology is not None:
+            self._num_stages = topology.get_dim("pipe")
+            from paddle_tpu.distributed._topology_holder import current_hcg
+
+            hcg = current_hcg()
+            self._stage_id = hcg.get_stage_id() if hcg else 0
+        else:
+            self._num_stages = num_stages or 1
+            self._stage_id = 0
+        self._segment(seg_method)
+        self._build()
+
+    # -- segmentation (parity pp_layers.py:112 segment methods) -------------
+    def _segment(self, method):
+        n = len(self._layers_desc)
+        stages = self._num_stages
+        if method == "uniform" or stages == 1:
+            bounds = [round(i * n / stages) for i in range(stages + 1)]
+        elif method.startswith("layer:"):
+            # split evenly by count of named layer class
+            name = method.split(":", 1)[1]
+            idxs = [
+                i for i, d in enumerate(self._layers_desc)
+                if (d.layer_cls.__name__ if isinstance(d, LayerDesc)
+                    else type(d).__name__) == name
+            ]
+            per = len(idxs) / stages
+            bounds = [0]
+            for s in range(1, stages):
+                bounds.append(idxs[round(s * per)] if idxs else round(s * n / stages))
+            bounds.append(n)
+        else:
+            bounds = [round(i * n / stages) for i in range(stages + 1)]
+        self.segment_parts = bounds
+        self._start = bounds[self._stage_id]
+        self._end = bounds[self._stage_id + 1]
+
+    def _build(self):
+        self.run_function: List = []
+        self._shared = {}
+        for i in range(self._start, self._end):
+            desc = self._layers_desc[i]
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name not in self._shared:
+                    self._shared[desc.layer_name] = desc.build_layer()
+                layer = self._shared[desc.layer_name]
+                self.add_sublayer(str(i), layer)
+                if desc.forward_func is None:
+                    self.run_function.append(layer)
+                else:
+                    fwd = desc.forward_func
+
+                    def bound(x, _l=layer, _f=fwd):
+                        return _f(_l, x)
+
+                    self.run_function.append(bound)
+            elif isinstance(desc, LayerDesc):
+                layer = desc.build_layer()
+                self.add_sublayer(str(i), layer)
+                self.run_function.append(layer)
+            elif isinstance(desc, Layer):
+                self.add_sublayer(str(i), desc)
+                self.run_function.append(desc)
+            elif callable(desc):
+                self.run_function.append(desc)
+            else:
+                raise TypeError(f"unsupported pipeline segment entry {desc!r}")
+
+    def get_stage_from_index(self, layer_idx):
+        for s in range(self._num_stages):
+            if self.segment_parts[s] <= layer_idx < self.segment_parts[s + 1]:
+                return s
+        return self._num_stages - 1
+
+    def forward(self, input):
+        out = input
+        for i, fn in enumerate(self.run_function):
+            if (
+                self._recompute_interval > 0
+                and self.training
+                and i % self._recompute_interval == 0
+            ):
+                from paddle_tpu.distributed.fleet.utils import recompute
+
+                out = recompute(fn, out)
+            else:
+                out = fn(out)
+        return out

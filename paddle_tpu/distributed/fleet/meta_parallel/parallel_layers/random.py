@@ -1,0 +1,10 @@
+"""TP RNG state tracking — parity with
+fleet/meta_parallel/parallel_layers/random.py:23,68 (RNGStatesTracker +
+model_parallel_random_seed + get_rng_state_tracker)."""
+from paddle_tpu.core.rng import (  # noqa: F401
+    RNGStatesTracker,
+    get_rng_state_tracker,
+    model_parallel_random_seed,
+)
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker", "model_parallel_random_seed"]

@@ -1,0 +1,161 @@
+"""4D hybrid-parallel process topology — parity with
+python/paddle/distributed/fleet/base/topology.py:35,111 (CommunicateTopology +
+HybridCommunicateGroup).
+
+TPU-native: the topology IS the device mesh. Axes (dp, pp, sharding, mp[, sp])
+become named mesh axes; "communication groups" become axis names handed to
+collectives instead of NCCL ring ids.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..communication import Group, new_group
+from . import mesh_utils
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = np.arange(int(np.prod(dims))).reshape(dims)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs):
+        coords = [kwargs[name] for name in self._parallel_names]
+        return int(self.coordinate[tuple(coords)])
+
+    def get_coord(self, rank):
+        idx = np.argwhere(self.coordinate == rank)[0]
+        return tuple(int(i) for i in idx)
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        sl = [slice(None)] * len(self._dims)
+        sl[axis] = index
+        return sorted(int(r) for r in self.coordinate[tuple(sl)].reshape(-1))
+
+    def get_comm_list(self, axis_name):
+        """All groups along ``axis_name``: one list of ranks per combination
+        of the other axes."""
+        axis = self._parallel_names.index(axis_name)
+        other = [d for i, d in enumerate(self._dims) if i != axis]
+        out = []
+        for coords in itertools.product(*[range(d) for d in other]):
+            sl = list(coords)
+            sl.insert(axis, slice(None))
+            out.append([int(r) for r in self.coordinate[tuple(sl)].reshape(-1)])
+        return out
+
+
+class HybridCommunicateGroup:
+    """Per-process view of the 4D topology. On TPU the local "rank" is the
+    process index; each parallel axis maps to a mesh axis name:
+    data→'dp', pipe→'pp', sharding→'sharding', model→'mp'."""
+
+    _axis_name_map = {"data": "dp", "pipe": "pp", "sharding": "sharding", "model": "mp"}
+
+    def __init__(self, topology: CommunicateTopology, global_rank=0):
+        self._topo = topology
+        self.global_rank = int(global_rank)
+        self.nranks = topology.world_size()
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._mp_degree = topology.get_dim("model")
+        coord = topology.get_coord(self.global_rank)
+        names = topology.get_hybrid_group_names()
+        self._coord = dict(zip(names, coord))
+        # mesh-axis-named groups
+        self._groups: Dict[str, Group] = {
+            name: new_group(
+                ranks=topology.get_axis_list(name, 0),
+                axis_name=self._axis_name_map[name],
+            )
+            for name in names
+        }
+
+    # -- degrees / ranks -----------------------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_data_parallel_rank(self):
+        return self._coord["data"]
+
+    def get_model_parallel_rank(self):
+        return self._coord["model"]
+
+    def get_stage_id(self):
+        return self._coord["pipe"]
+
+    def get_sharding_parallel_rank(self):
+        return self._coord["sharding"]
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # -- groups (axis names drive the collectives) ---------------------------
+    def get_data_parallel_group(self):
+        return self._groups["data"]
+
+    def get_model_parallel_group(self):
+        return self._groups["model"]
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pipe"]
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_check_parallel_group(self):
+        return self._groups["data"]
+
+    def get_data_parallel_group_src_rank(self):
+        return self._topo.get_axis_list("data", 0)[0]
+
+    def get_model_parallel_group_src_rank(self):
+        return self._topo.get_axis_list("model", 0)[0]
+
+    # -- pipeline helpers ----------------------------------------------------
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return None
+
+    def topology(self):
+        return self._topo
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        coord = dict(self._coord)
+        coord["pipe"] = stage_id
+        coord.update(kwargs)
+        return self._topo.get_rank(**coord)

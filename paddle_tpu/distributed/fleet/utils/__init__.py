@@ -1,0 +1,2 @@
+"""fleet.utils — recompute + fs helpers (parity fleet/utils/)."""
+from .recompute import recompute  # noqa: F401

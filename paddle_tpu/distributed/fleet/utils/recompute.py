@@ -1,0 +1,74 @@
+"""Activation recomputation — parity with fleet/utils/recompute.py:63,162
+(RecomputeFunction PyLayer + MP RNG state preservation).
+
+Eager path: forward runs under no_grad (activations are NOT kept); backward
+replays the forward with gradients enabled — classic checkpointing. RNG
+states (global + TP tracker) are snapshotted so dropout masks replay
+identically. Staged path: ``paddle_tpu.jit`` maps this onto ``jax.checkpoint``
+(XLA-native remat) which is strictly better on TPU — see
+jit/functionalize.py.
+"""
+from __future__ import annotations
+
+from paddle_tpu.autograd.py_layer import PyLayer
+from paddle_tpu.core import rng as rng_mod
+from paddle_tpu.core.tensor import Tensor, enable_grad, no_grad
+
+__all__ = ["recompute"]
+
+
+class RecomputeFunction(PyLayer):
+    @staticmethod
+    def forward(ctx, run_function, preserve_rng_state, *args):
+        ctx.run_function = run_function
+        ctx.preserve_rng_state = preserve_rng_state
+        if preserve_rng_state:
+            ctx.fwd_rng = rng_mod.get_rng_state()
+            ctx.fwd_tracker = rng_mod.get_rng_state_tracker().get_states_tracker()
+        ctx.inputs = args
+        with no_grad():
+            outputs = run_function(*args)
+        return outputs
+
+    @staticmethod
+    def backward(ctx, *grads):
+        from paddle_tpu.autograd.functional import grad as grad_fn
+
+        detached = []
+        for a in ctx.inputs:
+            if isinstance(a, Tensor):
+                d = a.detach()
+                d.stop_gradient = a.stop_gradient
+                detached.append(d)
+            else:
+                detached.append(a)
+        if ctx.preserve_rng_state:
+            saved_rng = rng_mod.get_rng_state()
+            saved_tracker = rng_mod.get_rng_state_tracker().get_states_tracker()
+            rng_mod.set_rng_state(ctx.fwd_rng)
+            rng_mod.get_rng_state_tracker().set_states_tracker(ctx.fwd_tracker)
+        try:
+            with enable_grad():
+                outputs = ctx.run_function(*detached)
+        finally:
+            if ctx.preserve_rng_state:
+                rng_mod.set_rng_state(saved_rng)
+                rng_mod.get_rng_state_tracker().set_states_tracker(saved_tracker)
+        out_list = list(outputs) if isinstance(outputs, (tuple, list)) else [outputs]
+        diff_inputs = [d for d in detached if isinstance(d, Tensor) and not d.stop_gradient]
+        input_grads = grad_fn(
+            [o for o in out_list if isinstance(o, Tensor) and not o.stop_gradient],
+            diff_inputs,
+            grad_outputs=[g for o, g in zip(out_list, grads)
+                          if isinstance(o, Tensor) and not o.stop_gradient],
+            allow_unused=True,
+        )
+        return tuple(input_grads)
+
+
+def recompute(function, *args, **kwargs):
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    if kwargs:
+        raise TypeError(f"unexpected kwargs to recompute: {list(kwargs)}")
+    return RecomputeFunction.apply(function, preserve, *args)

@@ -1,0 +1,86 @@
+"""Process/environment management for distributed runs.
+
+Parity with python/paddle/distributed/parallel.py (init_parallel_env:60,
+ParallelEnv) — TPU-native: rendezvous is ``jax.distributed.initialize`` (XLA
+coordination service) instead of the reference's hand-rolled TCP broadcast of
+NCCL ids (platform/gen_comm_id_helper.cc:286).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "ParallelEnv"]
+
+_initialized = False
+
+
+def init_parallel_env():
+    """Initialize multi-host coordination when launched by the fleet launcher
+    (env: PADDLE_TRAINER_ENDPOINTS / PADDLE_TRAINER_ID or JAX-native
+    COORDINATOR_ADDRESS). Single-process runs are a no-op."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("COORDINATOR_ADDRESS")
+    nprocs = os.environ.get("PADDLE_TRAINERS_NUM") or os.environ.get("NUM_PROCESSES")
+    pid = os.environ.get("PADDLE_TRAINER_ID") or os.environ.get("PROCESS_ID")
+    if coord is None and os.environ.get("PADDLE_TRAINER_ENDPOINTS"):
+        eps = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+        coord = eps[0]
+        nprocs = nprocs or str(len(eps))
+    if coord is not None and nprocs is not None and int(nprocs) > 1:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(nprocs),
+            process_id=int(pid or 0),
+        )
+    _initialized = True
+    return ParallelEnv()
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    try:
+        return jax.process_count()
+    except RuntimeError:
+        return 1
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_RANK_IN_NODE", get_rank()))
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def current_endpoint(self):
+        eps = self.trainer_endpoints
+        return eps[self.rank] if eps and self.rank < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")

@@ -1,0 +1,5 @@
+"""Framework helpers — parity with python/paddle/framework/."""
+from . import io  # noqa: F401
+from .io import load, save  # noqa: F401
+from ..core.rng import get_rng_state, seed, set_rng_state  # noqa: F401
+from ..core.tensor import Parameter  # noqa: F401

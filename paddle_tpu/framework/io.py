@@ -1,0 +1,78 @@
+"""paddle.save / paddle.load — parity with
+python/paddle/framework/io.py:494,665 in the reference: pickle a (nested)
+state_dict of numpy-converted tensors to a single file. Sharded/distributed
+checkpoints use paddle_tpu.incubate.checkpoint (orbax-backed) instead.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    """Pickle payload holding numpy data + tensor metadata."""
+
+    def __init__(self, t: Tensor):
+        self.data = t.numpy()
+        self.name = t.name
+        self.stop_gradient = t.stop_gradient
+        self.is_parameter = isinstance(t, Parameter)
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.data
+        t = (
+            Parameter(_np_to_jax(obj.data), name=obj.name)
+            if obj.is_parameter
+            else Tensor(_np_to_jax(obj.data), stop_gradient=obj.stop_gradient, name=obj.name)
+        )
+        return t
+    if isinstance(obj, dict):
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+def _np_to_jax(arr):
+    import jax.numpy as jnp
+
+    return jnp.asarray(arr)
+
+
+def save(obj, path, protocol=_PROTOCOL, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload = _to_saveable(obj)
+    with open(path, "wb") as f:
+        pickle.dump(payload, f, protocol=protocol)
+
+
+def load(path, **configs):
+    return_numpy = configs.get("return_numpy", False)
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    return _from_saveable(payload, return_numpy)

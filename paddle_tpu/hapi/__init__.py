@@ -1,0 +1,4 @@
+"""paddle_tpu.hapi — high-level Model API (parity python/paddle/hapi/)."""
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401
+from .summary import summary  # noqa: F401

@@ -1,0 +1,248 @@
+"""hapi Model — Keras-style fit/evaluate/predict, parity with
+python/paddle/hapi/model.py:876,1519 (Model + DynamicGraphAdapter).
+
+TPU-first: ``prepare`` stages the whole train step through
+paddle_tpu.jit.TrainStep (one XLA program per step) instead of per-op eager
+dispatch; metrics run host-side on fetched outputs like the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, no_grad, to_tensor
+from ..metric import Metric
+from ..nn.layer_base import Layer
+from . import callbacks as callbacks_mod
+
+__all__ = ["Model"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        self._train_step = None  # rebuilt lazily
+        return self
+
+    def _ensure_train_step(self):
+        if self._train_step is None and self._optimizer is not None and self._loss is not None:
+            from ..jit.train_step import TrainStep
+
+            loss_layer = self._loss
+
+            def loss_fn(out, *labels):
+                return loss_layer(Tensor(out) if not isinstance(out, Tensor) else out,
+                                  *[Tensor(l) for l in labels])
+
+            self._train_step = TrainStep(self.network, loss_fn, self._optimizer)
+        return self._train_step
+
+    # ------------------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) else [labels]
+        step = self._ensure_train_step()
+        loss = step(tuple(inputs), tuple(labels or ()))
+        metrics_out = []
+        if self._metrics:
+            step.sync_to_layer()
+            with no_grad():
+                self.network.eval()
+                outs = self.network(*inputs)
+                self.network.train()
+            for m in self._metrics:
+                res = m.update(m.compute(outs, *labels)) if labels else None
+                metrics_out.append(res)
+            step.refresh_from_layer()
+        return (float(loss.numpy()), metrics_out) if metrics_out else float(loss.numpy())
+
+    def eval_batch(self, inputs, labels=None):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) else [labels]
+        self.network.eval()
+        with no_grad():
+            outs = self.network(*inputs)
+            loss = self._loss(outs, *labels) if self._loss and labels else None
+        self.network.train()
+        metrics_out = []
+        for m in self._metrics:
+            metrics_out.append(m.update(m.compute(outs, *labels)))
+        return (float(loss.numpy()) if loss is not None else None), metrics_out
+
+    def predict_batch(self, inputs):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self.network.eval()
+        with no_grad():
+            outs = self.network(*inputs)
+        self.network.train()
+        return outs
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+
+        loader = train_data if not isinstance(train_data, Dataset) else DataLoader(
+            train_data, batch_size=batch_size, shuffle=shuffle,
+            drop_last=drop_last, num_workers=num_workers,
+        )
+        eval_loader = None
+        if eval_data is not None:
+            eval_loader = eval_data if not isinstance(eval_data, Dataset) else DataLoader(
+                eval_data, batch_size=batch_size, num_workers=num_workers,
+            )
+        cbks = callbacks_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs, verbose=verbose,
+            log_freq=log_freq, save_dir=save_dir, save_freq=save_freq,
+            metrics=["loss"] + [n for m in self._metrics for n in _as_list(m.name())],
+        )
+        cbks.on_begin("train")
+        it_count = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step_i, batch in enumerate(loader):
+                inputs, labels = _split_batch(batch)
+                cbks.on_batch_begin("train", step_i, logs)
+                out = self.train_batch(inputs, labels)
+                loss_v, metr = out if isinstance(out, tuple) else (out, [])
+                logs = {"loss": loss_v, "step": step_i}
+                for m in self._metrics:
+                    for n, v in zip(_as_list(m.name()), _as_list(m.accumulate())):
+                        logs[n] = v
+                cbks.on_batch_end("train", step_i, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    break
+            if self._train_step is not None:
+                self._train_step.sync_to_layer()
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if isinstance(self._optimizer, object) and hasattr(self._optimizer, "_learning_rate"):
+                lr = self._optimizer._learning_rate
+                if hasattr(lr, "step"):
+                    lr.step()
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+        cbks.on_end("train", logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        from ..io import DataLoader, Dataset
+
+        if self._train_step is not None:
+            self._train_step.sync_to_layer()
+        loader = eval_data if not isinstance(eval_data, Dataset) else DataLoader(
+            eval_data, batch_size=batch_size, num_workers=num_workers,
+        )
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for i, batch in enumerate(loader):
+            inputs, labels = _split_batch(batch)
+            loss_v, _ = self.eval_batch(inputs, labels)
+            if loss_v is not None:
+                losses.append(loss_v)
+            if num_iters is not None and i + 1 >= num_iters:
+                break
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            for n, v in zip(_as_list(m.name()), _as_list(m.accumulate())):
+                logs[n] = v
+        if verbose:
+            print("Eval:", logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+
+        if self._train_step is not None:
+            self._train_step.sync_to_layer()
+        loader = test_data if not isinstance(test_data, Dataset) else DataLoader(
+            test_data, batch_size=batch_size, num_workers=num_workers,
+        )
+        outputs = []
+        for batch in loader:
+            # labeled datasets: drop the trailing label like fit/evaluate do
+            inputs, _ = _split_batch(batch)
+            outs = self.predict_batch(inputs)
+            outputs.append(outs)
+        if stack_outputs and outputs:
+            first = outputs[0]
+            if isinstance(first, Tensor):
+                return [np.concatenate([o.numpy() for o in outputs])]
+            return [
+                np.concatenate([o[i].numpy() for o in outputs])
+                for i in range(len(first))
+            ]
+        return outputs
+
+    # ------------------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save
+
+        if self._train_step is not None:
+            self._train_step.sync_to_layer()
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load
+        import os
+
+        state = load(path + ".pdparams") if not path.endswith(".pdparams") else load(path)
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(load(opt_path))
+        if self._train_step is not None:
+            self._train_step.refresh_from_layer()
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as summary_fn
+
+        return summary_fn(self.network, input_size, dtypes=dtype)
+
+
+def _as_list(v):
+    return v if isinstance(v, (list, tuple)) else [v]
+
+
+def _split_batch(batch, has_labels=True):
+    if isinstance(batch, (list, tuple)):
+        if len(batch) >= 2 and has_labels:
+            *ins, lab = batch
+            if len(ins) == 1:
+                return [ins[0]], [lab]
+            return list(ins), [lab]
+        return list(batch), []
+    return [batch], []

@@ -1,0 +1,69 @@
+"""Model summary — parity with python/paddle/hapi/model_summary.py."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["summary"]
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print per-layer output shapes and parameter counts; returns totals."""
+    from .. import tensor as T
+
+    hooks = []
+    rows = []
+
+    def register(layer, name):
+        def hook(l, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+            shape = list(out.shape) if isinstance(out, Tensor) else "?"
+            n_params = sum(int(np.prod(p.shape)) for p in l._parameters.values() if p is not None)
+            rows.append((name or type(l).__name__, str(shape), n_params))
+
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    for name, sub in net.named_sublayers():
+        if not sub._sub_layers:  # leaves only
+            register(sub, name)
+
+    if input is not None:
+        x = input if isinstance(input, (list, tuple)) else [input]
+    else:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = [input_size] if isinstance(input_size, tuple) else list(input_size)
+        sizes = [list(s) for s in (sizes if isinstance(sizes[0], (list, tuple)) else [sizes])]
+        x = [
+            T.zeros([1 if (d is None or d == -1) else d for d in s],
+                    dtypes if isinstance(dtypes, str) else "float32")
+            for s in sizes
+        ]
+    was_training = net.training
+    net.eval()
+    try:
+        net(*x)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(
+        int(np.prod(p.shape)) for p in net.parameters() if p.trainable
+    )
+    width = 70
+    print("-" * width)
+    print(f"{'Layer (type)':35s} {'Output Shape':20s} {'Param #':>12s}")
+    print("=" * width)
+    for name, shape, n in rows:
+        print(f"{name:35.35s} {shape:20.20s} {n:12,d}")
+    print("=" * width)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print("-" * width)
+    return {"total_params": total, "trainable_params": trainable}

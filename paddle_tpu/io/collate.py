@@ -1,0 +1,37 @@
+"""Batch collation — parity with fluid/dataloader/collate.py."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["default_collate_fn", "default_convert_fn"]
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch, axis=0)
+    if isinstance(sample, Tensor):
+        return np.stack([s.numpy() for s in batch], axis=0)
+    if isinstance(sample, numbers.Number):
+        return np.array(batch)
+    if isinstance(sample, (str, bytes)):
+        return batch
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        return [default_collate_fn(list(items)) for items in zip(*batch)]
+    raise TypeError(f"batch data must be numeric/ndarray/dict/list, got {type(sample)}")
+
+
+def default_convert_fn(batch):
+    if isinstance(batch, (Tensor, np.ndarray)):
+        return batch
+    if isinstance(batch, dict):
+        return {k: default_convert_fn(v) for k, v in batch.items()}
+    if isinstance(batch, (list, tuple)):
+        return [default_convert_fn(b) for b in batch]
+    return batch

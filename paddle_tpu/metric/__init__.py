@@ -1,0 +1,185 @@
+"""Metrics — parity with python/paddle/metric/metrics.py (Metric base,
+Accuracy, Precision, Recall, Auc) and the functional ``paddle.metric.accuracy``."""
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor, wrap_raw
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (parity operators/metrics/accuracy_op)."""
+    import jax.numpy as jnp
+
+    pred = input.numpy() if isinstance(input, Tensor) else np.asarray(input)
+    lbl = label.numpy() if isinstance(label, Tensor) else np.asarray(label)
+    topk = np.argsort(-pred, axis=-1)[..., :k]
+    if lbl.ndim == topk.ndim - 1:
+        lbl = lbl[..., None]
+    correct_mask = (topk == lbl).any(axis=-1)
+    acc = correct_mask.mean(dtype=np.float32)
+    return wrap_raw(jnp.asarray(np.float32(acc)))
+
+
+class Metric(abc.ABC):
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def reset(self):
+        ...
+
+    @abc.abstractmethod
+    def update(self, *args):
+        ...
+
+    @abc.abstractmethod
+    def accumulate(self):
+        ...
+
+    @abc.abstractmethod
+    def name(self):
+        ...
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None, *args, **kwargs):
+        super().__init__()
+        self.topk = topk if isinstance(topk, (tuple, list)) else (topk,)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred_np = pred.numpy() if isinstance(pred, Tensor) else np.asarray(pred)
+        lbl = label.numpy() if isinstance(label, Tensor) else np.asarray(label)
+        topk_idx = np.argsort(-pred_np, axis=-1)[..., : self.maxk]
+        if lbl.ndim == 1 or (lbl.ndim == topk_idx.ndim and lbl.shape[-1] == 1):
+            lbl2 = lbl.reshape(-1, 1)
+        else:
+            lbl2 = np.argmax(lbl, axis=-1).reshape(-1, 1)
+        correct = (topk_idx.reshape(lbl2.shape[0], -1) == lbl2).astype(np.float32)
+        return wrap_raw(np.ascontiguousarray(correct))
+
+    def update(self, correct, *args):
+        c = correct.numpy() if isinstance(correct, Tensor) else np.asarray(correct)
+        accs = []
+        for k in self.topk:
+            num = c[:, :k].sum()
+            self.total[self.topk.index(k)] += num
+            self.count[self.topk.index(k)] += c.shape[0]
+            accs.append(num / c.shape[0])
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name="precision", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = preds.numpy() if isinstance(preds, Tensor) else np.asarray(preds)
+        l = labels.numpy() if isinstance(labels, Tensor) else np.asarray(labels)
+        pred_bin = (p > 0.5).astype(np.int32).reshape(-1)
+        l = l.reshape(-1).astype(np.int32)
+        self.tp += int(((pred_bin == 1) & (l == 1)).sum())
+        self.fp += int(((pred_bin == 1) & (l == 0)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name="recall", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = preds.numpy() if isinstance(preds, Tensor) else np.asarray(preds)
+        l = labels.numpy() if isinstance(labels, Tensor) else np.asarray(labels)
+        pred_bin = (p > 0.5).astype(np.int32).reshape(-1)
+        l = l.reshape(-1).astype(np.int32)
+        self.tp += int(((pred_bin == 1) & (l == 1)).sum())
+        self.fn += int(((pred_bin == 0) & (l == 1)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc", *args, **kwargs):
+        super().__init__()
+        self._num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = preds.numpy() if isinstance(preds, Tensor) else np.asarray(preds)
+        l = labels.numpy() if isinstance(labels, Tensor) else np.asarray(labels)
+        if p.ndim == 2 and p.shape[1] == 2:
+            p = p[:, 1]
+        p = p.reshape(-1)
+        l = l.reshape(-1)
+        idx = np.clip((p * self._num_thresholds).astype(np.int64), 0, self._num_thresholds)
+        for i, lab in zip(idx, l):
+            if lab:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def reset(self):
+        self._stat_pos = np.zeros(self._num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(self._num_thresholds + 1, np.int64)
+
+    def accumulate(self):
+        tot_pos = 0.0
+        tot_neg = 0.0
+        auc = 0.0
+        for i in range(self._num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            auc += (new_neg - tot_neg) * (new_pos + tot_pos) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        denom = tot_pos * tot_neg
+        return float(auc / denom) if denom else 0.0
+
+    def name(self):
+        return self._name

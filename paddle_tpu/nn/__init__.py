@@ -1,0 +1,24 @@
+"""paddle_tpu.nn — layers, functional, initializers.
+
+Parity surface with python/paddle/nn/ in the reference (~21k LoC layer zoo),
+implemented over jax/XLA (see SURVEY.md §2 #55-57).
+"""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer_base import Layer  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+
+from .layer.activation import *  # noqa: F401,F403
+from .layer.common import *  # noqa: F401,F403
+from .layer.container import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
+
+from . import clip  # noqa: F401
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+
+from .utils import weight_norm, remove_weight_norm, spectral_norm  # noqa: F401

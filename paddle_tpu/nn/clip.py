@@ -1,0 +1,83 @@
+"""Gradient clipping — parity with python/paddle/fluid/clip.py
+(ClipGradByGlobalNorm etc. used by optimizers' grad_clip argument)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, wrap_raw
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, wrap_raw(jnp.clip(g._value, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(g._value.astype(jnp.float32) ** 2))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, wrap_raw((g._value * scale).astype(g._value.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _clip(self, params_grads):
+        sq = 0.0
+        any_clip = False
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                continue
+            any_clip = True
+            sq = sq + jnp.sum(g._value.astype(jnp.float32) ** 2)
+        if not any_clip:
+            return params_grads
+        global_norm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, wrap_raw((g._value * scale).astype(g._value.dtype))))
+        return out
+
+
+# functional forms used by the jitted train-step compiler
+def clip_grads_global_norm_raw(grads, clip_norm):
+    """Pure pytree version for staged training steps."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(grads)
+    sq = sum(jnp.sum(l.astype(jnp.float32) ** 2) for l in leaves)
+    gn = jnp.sqrt(sq)
+    scale = clip_norm / jnp.maximum(gn, clip_norm)
+    return jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype), grads)

@@ -1,0 +1,229 @@
+"""Activation functionals — parity with python/paddle/nn/functional/activation.py.
+XLA fuses these into adjacent matmuls/convs, replacing the reference's fused
+activation CUDA kernels (operators/fused/fused_bn_activation_op.cu etc.).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply_op, to_tensor
+
+__all__ = [
+    "relu", "relu_", "relu6", "elu", "selu", "celu", "gelu", "sigmoid",
+    "hardsigmoid", "hardswish", "hardtanh", "hardshrink", "leaky_relu",
+    "log_sigmoid", "log_softmax", "maxout", "mish", "prelu", "rrelu",
+    "silu", "swish", "softmax", "softmax_", "softplus", "softshrink",
+    "softsign", "tanh", "tanh_", "tanhshrink", "thresholded_relu", "glu",
+    "gumbel_softmax",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def relu(x, name=None):
+    return apply_op(jax.nn.relu, _t(x))
+
+
+def relu_(x, name=None):
+    x._rebind(relu(x))
+    return x
+
+
+def relu6(x, name=None):
+    return apply_op(jax.nn.relu6, _t(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op(lambda a: jax.nn.elu(a, alpha), _t(x))
+
+
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+    name=None,
+):
+    return apply_op(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), _t(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op(lambda a: jax.nn.celu(a, alpha), _t(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op(lambda a: jax.nn.gelu(a, approximate=approximate), _t(x))
+
+
+def sigmoid(x, name=None):
+    return apply_op(jax.nn.sigmoid, _t(x))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), _t(x))
+
+
+def hardswish(x, name=None):
+    return apply_op(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, _t(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op(lambda a: jnp.clip(a, min, max), _t(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), _t(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(lambda a: jax.nn.leaky_relu(a, negative_slope), _t(x))
+
+
+def log_sigmoid(x, name=None):
+    return apply_op(jax.nn.log_sigmoid, _t(x))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as dtype_mod
+
+    d = dtype_mod.convert_dtype(dtype)
+
+    def f(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return apply_op(f, _t(x))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        shape = list(a.shape)
+        c = shape[axis]
+        shape[axis : axis + 1] = [c // groups, groups]
+        return jnp.max(a.reshape(shape), axis=axis + 1)
+
+    return apply_op(f, _t(x))
+
+
+def mish(x, name=None):
+    return apply_op(lambda a: a * jnp.tanh(jax.nn.softplus(a)), _t(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        shape = [1] * a.ndim
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+
+    return apply_op(f, _t(x), _t(weight))
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=True, name=None):
+    from ...core import rng as rng_mod
+
+    x = _t(x)
+    if training:
+        key = rng_mod.next_key()
+        slope = jax.random.uniform(
+            key, tuple(x.shape), x._value.dtype, lower, upper
+        )
+        return apply_op(lambda a: jnp.where(a >= 0, a, slope * a), x)
+    mid = (lower + upper) / 2.0
+    return apply_op(lambda a: jnp.where(a >= 0, a, mid * a), x)
+
+
+def silu(x, name=None):
+    return apply_op(jax.nn.silu, _t(x))
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as dtype_mod
+
+    d = dtype_mod.convert_dtype(dtype)
+
+    def f(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.softmax(a, axis=axis)
+
+    return apply_op(f, _t(x))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    x._rebind(softmax(x, axis, dtype))
+    return x
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return apply_op(
+        lambda a: jnp.where(a * beta > threshold, a, jnp.log1p(jnp.exp(beta * a)) / beta),
+        _t(x),
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda a: jnp.where(
+            a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)
+        ),
+        _t(x),
+    )
+
+
+def softsign(x, name=None):
+    return apply_op(jax.nn.soft_sign, _t(x))
+
+
+def tanh(x, name=None):
+    return apply_op(jnp.tanh, _t(x))
+
+
+def tanh_(x, name=None):
+    x._rebind(tanh(x))
+    return x
+
+
+def tanhshrink(x, name=None):
+    return apply_op(lambda a: a - jnp.tanh(a), _t(x))
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply_op(lambda a: jnp.where(a > threshold, a, 0.0), _t(x))
+
+
+def glu(x, axis=-1, name=None):
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+
+    return apply_op(f, _t(x))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import rng as rng_mod
+
+    x = _t(x)
+    key = rng_mod.next_key()
+    g = jax.random.gumbel(key, tuple(x.shape), x._value.dtype)
+
+    def f(a):
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            return y_hard - jax.lax.stop_gradient(y) + y
+        return y
+
+    return apply_op(f, x)

@@ -1,0 +1,196 @@
+"""Convolution functionals — parity with python/paddle/nn/functional/conv.py.
+
+All convs lower to ``jax.lax.conv_general_dilated``, which XLA maps onto the
+MXU (replacing the reference's cuDNN dispatch in operators/conv_op.cc /
+conv_cudnn_op.cu). Weight layout follows paddle: [out_c, in_c/groups, *k].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.enforce import InvalidArgumentError, enforce
+from ...core.tensor import Tensor, apply_op, to_tensor
+
+__all__ = [
+    "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _norm_tuple(v, n, name):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    v = tuple(int(i) for i in v)
+    enforce(len(v) == n, f"{name} must have {n} elements, got {len(v)}")
+    return v
+
+
+def _norm_padding(padding, n):
+    """Returns jax-style padding: string or [(lo, hi)] * n."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # NCHW-style per-dim padding incl. batch/channel dims: strip to spatial
+        sp = [p for p in padding if tuple(p) != (0, 0)] or padding[-n:]
+        return [tuple(int(i) for i in p) for p in padding[-n:]]
+    raise InvalidArgumentError(f"cannot interpret conv padding {padding!r}")
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _norm_tuple(stride, n, "stride")
+    dilation = _norm_tuple(dilation, n, "dilation")
+    pad = _norm_padding(padding, n)
+    dn = _dim_numbers(n, channel_last)
+
+    def f(a, w, *rest):
+        from ...amp.auto_cast import maybe_cast_inputs
+
+        a, w = maybe_cast_inputs(f"conv{n}d", a, w)
+        if channel_last:
+            # paddle weights are always [O, I/g, *k]; jax channel-last wants [*k, I/g, O]
+            w = jnp.moveaxis(w, (0, 1), (-1, -2))
+        out = jax.lax.conv_general_dilated(
+            a,
+            w,
+            window_strides=stride,
+            padding=pad,
+            rhs_dilation=dilation,
+            feature_group_count=groups,
+            dimension_numbers=dn,
+        )
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape).astype(out.dtype)
+        return out
+
+    args = (_t(x), weight) + ((bias,) if bias is not None else ())
+    return apply_op(f, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, fmt)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(
+    x, weight, bias, stride, padding, output_padding, dilation, groups, n,
+    data_format, output_size,
+):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _norm_tuple(stride, n, "stride")
+    dilation = _norm_tuple(dilation, n, "dilation")
+    out_pad = _norm_tuple(output_padding, n, "output_padding")
+    pad = _norm_padding(padding, n)
+    dn = _dim_numbers(n, channel_last)
+
+    def f(a, w, *rest):
+        # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+        # grad-of-conv formulation: lhs_dilation=stride implements fractional
+        # stride; padding is adjusted per standard transpose-conv algebra.
+        if isinstance(pad, str):
+            if pad == "SAME":
+                raise InvalidArgumentError("SAME padding unsupported for conv_transpose")
+            base_pad = [(0, 0)] * n
+        else:
+            base_pad = pad
+        k = w.shape[2:]
+        eff_k = [dilation[i] * (k[i] - 1) + 1 for i in range(n)]
+        tpad = [
+            (
+                eff_k[i] - 1 - base_pad[i][0],
+                eff_k[i] - 1 - base_pad[i][1] + out_pad[i],
+            )
+            for i in range(n)
+        ]
+        # weight: [I, O/g, *k] -> flip spatial, swap I/O -> [O/g*g? ...]
+        w_flip = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            # [I, O/g, *k] with I = g * (I/g): split groups into output dim
+            i_c, og = w_flip.shape[0], w_flip.shape[1]
+            w_flip = w_flip.reshape((groups, i_c // groups, og) + k)
+            w_flip = jnp.moveaxis(w_flip, 2, 1)  # [g, O/g, I/g, *k]
+            w_t = w_flip.reshape((groups * og, i_c // groups) + k)
+        else:
+            w_t = jnp.swapaxes(w_flip, 0, 1)
+        if channel_last:
+            w_t = jnp.moveaxis(w_t, (0, 1), (-1, -2))
+        out = jax.lax.conv_general_dilated(
+            a,
+            w_t,
+            window_strides=(1,) * n,
+            padding=tpad,
+            lhs_dilation=stride,
+            rhs_dilation=dilation,
+            feature_group_count=groups,
+            dimension_numbers=dn,
+        )
+        if output_size is not None:
+            tgt = [int(s) for s in output_size]
+            sl = [slice(None)] * out.ndim
+            axes = range(2, 2 + n) if not channel_last else range(1, 1 + n)
+            for i, ax in enumerate(axes):
+                sl[ax] = slice(0, tgt[i])
+            out = out[tuple(sl)]
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = (_t(x), weight) + ((bias,) if bias is not None else ())
+    return apply_op(f, *args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, fmt, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size)

@@ -1,0 +1,307 @@
+"""Loss functionals — parity with python/paddle/nn/functional/loss.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.enforce import InvalidArgumentError, enforce
+from ...core.tensor import Tensor, apply_op, to_tensor
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+    "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "kl_div", "smooth_l1_loss", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "square_error_cost", "log_loss", "sigmoid_focal_loss",
+    "triplet_margin_loss", "ctc_loss",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    if reduction == "none":
+        return out
+    raise InvalidArgumentError(f"unknown reduction {reduction!r}")
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    """Fused logits→softmax→NLL — replaces the reference's
+    softmax_with_cross_entropy CUDA kernel (operators/softmax_with_cross_entropy_op.cu);
+    XLA fuses the log-softmax with the gather."""
+    input = _t(input)
+    label = _t(label)
+    w = weight
+
+    def f(logits, lbl, *wa):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+            jnp.clip(logits, 1e-30, None)
+        )
+        if soft_label:
+            soft = lbl
+            if label_smoothing > 0.0:
+                k = logits.shape[axis]
+                soft = soft * (1.0 - label_smoothing) + label_smoothing / k
+            loss = -jnp.sum(soft * logp, axis=axis)
+            if wa:
+                loss = loss * jnp.sum(soft * wa[0], axis=axis)
+            return loss
+        lbl_i = lbl.astype(jnp.int32)
+        squeeze = lbl_i.ndim == logp.ndim and lbl_i.shape[axis] == 1
+        if squeeze:
+            lbl_i = jnp.squeeze(lbl_i, axis=axis)
+        if label_smoothing > 0.0:
+            k = logp.shape[axis]
+            onehot = jax.nn.one_hot(lbl_i, k, axis=axis, dtype=logp.dtype)
+            soft = onehot * (1.0 - label_smoothing) + label_smoothing / k
+            loss = -jnp.sum(soft * logp, axis=axis)
+        else:
+            lbl_exp = jnp.expand_dims(lbl_i, axis)
+            picked = jnp.take_along_axis(logp, jnp.clip(lbl_exp, 0, None), axis=axis)
+            loss = -jnp.squeeze(picked, axis=axis)
+        mask = (lbl_i != ignore_index).astype(logp.dtype)
+        loss = loss * mask
+        if wa:
+            loss = loss * jnp.take(wa[0], jnp.clip(lbl_i, 0, None))
+        return loss, mask
+
+    def g(logits, lbl, *wa):
+        res = f(logits, lbl, *wa)
+        loss, mask = res if isinstance(res, tuple) else (res, None)
+        if reduction == "none":
+            return loss
+        if reduction == "sum":
+            return jnp.sum(loss)
+        if soft_label or mask is None:
+            return jnp.mean(loss)
+        # hard labels: mean over non-ignored positions
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    args = [input, label.detach() if not soft_label else label]
+    if w is not None:
+        args.append(w)
+    return apply_op(g, *args)
+
+
+def softmax_with_cross_entropy(
+    logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True,
+    return_softmax=False, axis=-1,
+):
+    loss = cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        reduction="none", axis=axis,
+    )
+    from .activation import softmax as softmax_fn
+
+    loss = loss.unsqueeze(axis) if not soft_label else loss
+    if return_softmax:
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce((a - b) ** 2, reduction), _t(input), _t(label))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.abs(a - b), reduction), _t(input), _t(label))
+
+
+def square_error_cost(input, label):
+    return apply_op(lambda a, b: (a - b) ** 2, _t(input), _t(label))
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def f(logp, lbl, *wa):
+        lbl_i = lbl.astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(jnp.clip(lbl_i, 0, None), 1), axis=1)
+        loss = -jnp.squeeze(picked, axis=1)
+        mask = (lbl_i != ignore_index).astype(logp.dtype)
+        wgt = mask
+        if wa:
+            wgt = wgt * jnp.take(wa[0], jnp.clip(lbl_i, 0, None))
+        loss = loss * wgt
+        if reduction == "none":
+            return loss
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(wgt), 1e-12)
+
+    args = [_t(input), _t(label).detach()]
+    if weight is not None:
+        args.append(weight)
+    return apply_op(f, *args)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, t, *wa):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(t * jnp.log(p) + (1.0 - t) * jnp.log(1.0 - p))
+        if wa:
+            loss = loss * wa[0]
+        return _reduce(loss, reduction)
+
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply_op(f, *args)
+
+
+def binary_cross_entropy_with_logits(
+    logit, label, weight=None, reduction="mean", pos_weight=None, name=None
+):
+    def f(z, t, *extra):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]
+            i += 1
+        if pos_weight is not None:
+            pw = extra[i]
+        # numerically stable: max(z,0) - z*t + log(1+exp(-|z|)), with pos_weight
+        log_sig = jax.nn.log_sigmoid(z)
+        log_sig_neg = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            loss = -(pw * t * log_sig + (1.0 - t) * log_sig_neg)
+        else:
+            loss = -(t * log_sig + (1.0 - t) * log_sig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    args = [_t(logit), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    if pos_weight is not None:
+        args.append(_t(pos_weight))
+    return apply_op(f, *args)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def f(logp, t):
+        loss = t * (jnp.log(jnp.clip(t, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply_op(f, _t(input), _t(label))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply_op(f, _t(input), _t(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply_op(
+        lambda a, b, t: _reduce(jnp.maximum(-t * (a - b) + margin, 0.0), reduction),
+        _t(input), _t(other), _t(label),
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply_op(
+        lambda a, t: _reduce(
+            jnp.where(t == 1.0, a, jnp.maximum(margin - a, 0.0)), reduction
+        ),
+        _t(input), _t(label),
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, t):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12
+        )
+        loss = jnp.where(t == 1, 1.0 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(loss, reduction)
+
+    return apply_op(f, _t(input1), _t(input2), _t(label))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_op(
+        lambda p, t: -t * jnp.log(p + epsilon) - (1.0 - t) * jnp.log(1.0 - p + epsilon),
+        _t(input), _t(label),
+    )
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, t, *nrm):
+        p = jax.nn.sigmoid(z)
+        ce = -(t * jax.nn.log_sigmoid(z) + (1 - t) * jax.nn.log_sigmoid(-z))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if nrm:
+            loss = loss / nrm[0]
+        return _reduce(loss, reduction)
+
+    args = [_t(logit), _t(label)]
+    if normalizer is not None:
+        args.append(_t(normalizer))
+    return apply_op(f, *args)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, axis=-1) ** (1.0 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, axis=-1) ** (1.0 / p)
+        if swap:
+            dsn = jnp.sum(jnp.abs(pos - neg) ** p, axis=-1) ** (1.0 / p)
+            dn = jnp.minimum(dn, dsn)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply_op(f, _t(input), _t(positive), _t(negative))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via optax's implementation (replaces the reference's warpctc vendored
+    dep, cmake/external/warpctc.cmake)."""
+    import optax
+
+    def f(lp, lbl, il, ll):
+        # paddle layout: [T, B, C] logits; optax expects [B, T, C]
+        logits = jnp.transpose(lp, (1, 0, 2))
+        b, t, c = logits.shape
+        logit_pad = (jnp.arange(t)[None, :] >= il[:, None]).astype(logits.dtype)
+        lbl_b = lbl if lbl.ndim == 2 else lbl.reshape(b, -1)
+        lbl_pad = (
+            jnp.arange(lbl_b.shape[1])[None, :] >= ll[:, None]
+        ).astype(logits.dtype)
+        loss = optax.ctc_loss(logits, logit_pad, lbl_b, lbl_pad, blank_id=blank)
+        if reduction == "none":
+            return loss
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return jnp.mean(loss / jnp.maximum(ll.astype(loss.dtype), 1.0))
+
+    return apply_op(
+        f, _t(log_probs), _t(labels).detach(), _t(input_lengths).detach(),
+        _t(label_lengths).detach(),
+    )

@@ -1,0 +1,179 @@
+"""Normalization functionals — parity with python/paddle/nn/functional/norm.py.
+Replaces the reference's cuDNN batch-norm kernels (operators/batch_norm_op.cu)
+with jnp reductions XLA fuses; running stats updated imperatively on the layer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply_op, to_tensor
+
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm", "local_response_norm"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-05,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    x = _t(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        # compute batch stats; update running stats imperatively (momentum
+        # semantics match the reference: r = m*r + (1-m)*batch)
+        mean = apply_op(lambda a: jnp.mean(a, axis=reduce_axes), x)
+        var = apply_op(lambda a: jnp.var(a, axis=reduce_axes), x)
+        if running_mean is not None:
+            running_mean._value = (
+                momentum * running_mean._value + (1.0 - momentum) * mean._value
+            )
+            running_var._value = (
+                momentum * running_var._value + (1.0 - momentum) * var._value
+            )
+    else:
+        mean, var = running_mean, running_var
+
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+
+    def f(a, m, v, *wb):
+        m = m.reshape(shape)
+        v = v.reshape(shape)
+        out = (a - m) * jax.lax.rsqrt(v + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x, mean, var]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply_op(f, *args)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    x = _t(x)
+    if isinstance(normalized_shape, (int, np.integer)):
+        normalized_shape = (int(normalized_shape),)
+    n_norm = len(tuple(normalized_shape))
+    axes = tuple(range(x.ndim - n_norm, x.ndim))
+
+    def f(a, *wb):
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [x] + [w for w in (weight, bias) if w is not None]
+    return apply_op(f, *args)
+
+
+def instance_norm(
+    x, running_mean=None, running_var=None, weight=None, bias=None,
+    use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None,
+):
+    x = _t(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    spatial_axes = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else tuple(
+        i for i in range(1, x.ndim - 1)
+    )
+
+    def f(a, *wb):
+        mean = jnp.mean(a, axis=spatial_axes, keepdims=True)
+        var = jnp.var(a, axis=spatial_axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x] + [w for w in (weight, bias) if w is not None]
+    return apply_op(f, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = _t(x)
+    channel_last = not data_format.startswith("NC")
+
+    def f(a, *wb):
+        if channel_last:
+            a_m = jnp.moveaxis(a, -1, 1)
+        else:
+            a_m = a
+        n, c = a_m.shape[:2]
+        rest = a_m.shape[2:]
+        g = a_m.reshape((n, num_groups, c // num_groups) + rest)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a_m.shape)
+        shape = [1] * a_m.ndim
+        shape[1] = c
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x] + [w for w in (weight, bias) if w is not None]
+    return apply_op(f, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def f(a):
+        channel_last = not data_format.startswith("NC")
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)
+        sq = a * a
+        c = a.shape[1]
+        half = size // 2
+        pad_width = [(0, 0)] * a.ndim
+        pad_width[1] = (half, size - half - 1)
+        padded = jnp.pad(sq, pad_width)
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            acc = acc + jax.lax.slice_in_dim(padded, i, i + c, axis=1)
+        out = a / (k + alpha * acc) ** beta
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply_op(f, _t(x))

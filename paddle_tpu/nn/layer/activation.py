@@ -1,0 +1,74 @@
+"""Activation layers — parity with python/paddle/nn/layer/activation.py."""
+from __future__ import annotations
+
+from .. import functional as F
+from .. import initializer as I
+from ..layer_base import Layer
+
+__all__ = [
+    "ReLU", "ReLU6", "ELU", "SELU", "CELU", "GELU", "Sigmoid", "Hardsigmoid",
+    "Hardswish", "Hardtanh", "Hardshrink", "LeakyReLU", "LogSigmoid",
+    "LogSoftmax", "Maxout", "Mish", "PReLU", "RReLU", "Silu", "Swish",
+    "Softmax", "Softplus", "Softshrink", "Softsign", "Tanh", "Tanhshrink",
+    "ThresholdedReLU", "GLU",
+]
+
+
+def _simple(name, fn_name, params=()):
+    def __init__(self, *args, **kwargs):
+        Layer.__init__(self)
+        for i, p in enumerate(params):
+            val = args[i] if i < len(args) else kwargs.get(p[0], p[1])
+            setattr(self, p[0], val)
+
+    def forward(self, x):
+        fn = getattr(F, fn_name)
+        return fn(x, *[getattr(self, p[0]) for p in params])
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+ReLU = _simple("ReLU", "relu")
+ReLU6 = _simple("ReLU6", "relu6")
+ELU = _simple("ELU", "elu", [("alpha", 1.0)])
+SELU = _simple(
+    "SELU", "selu",
+    [("scale", 1.0507009873554804934193349852946),
+     ("alpha", 1.6732632423543772848170429916717)],
+)
+CELU = _simple("CELU", "celu", [("alpha", 1.0)])
+GELU = _simple("GELU", "gelu", [("approximate", False)])
+Sigmoid = _simple("Sigmoid", "sigmoid")
+Hardsigmoid = _simple("Hardsigmoid", "hardsigmoid")
+Hardswish = _simple("Hardswish", "hardswish")
+Hardtanh = _simple("Hardtanh", "hardtanh", [("min", -1.0), ("max", 1.0)])
+Hardshrink = _simple("Hardshrink", "hardshrink", [("threshold", 0.5)])
+LeakyReLU = _simple("LeakyReLU", "leaky_relu", [("negative_slope", 0.01)])
+LogSigmoid = _simple("LogSigmoid", "log_sigmoid")
+LogSoftmax = _simple("LogSoftmax", "log_softmax", [("axis", -1)])
+Maxout = _simple("Maxout", "maxout", [("groups", 2), ("axis", 1)])
+Mish = _simple("Mish", "mish")
+RReLU = _simple("RReLU", "rrelu", [("lower", 0.125), ("upper", 1.0 / 3.0)])
+Silu = _simple("Silu", "silu")
+Swish = _simple("Swish", "swish")
+Softmax = _simple("Softmax", "softmax", [("axis", -1)])
+Softplus = _simple("Softplus", "softplus", [("beta", 1), ("threshold", 20)])
+Softshrink = _simple("Softshrink", "softshrink", [("threshold", 0.5)])
+Softsign = _simple("Softsign", "softsign")
+Tanh = _simple("Tanh", "tanh")
+Tanhshrink = _simple("Tanhshrink", "tanhshrink")
+ThresholdedReLU = _simple("ThresholdedReLU", "thresholded_relu", [("threshold", 1.0)])
+GLU = _simple("GLU", "glu", [("axis", -1)])
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr, default_initializer=I.Constant(init)
+        )
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)

@@ -1,0 +1,414 @@
+"""Recurrent layers — parity with python/paddle/nn/layer/rnn.py.
+
+TPU-first design: the time loop is a ``jax.lax.scan`` (compiles to a single
+fused XLA While with MXU matmuls per step) instead of the reference's
+per-timestep kernel launches / fused_lstm CUDA kernels
+(operators/fused/fusion_lstm_op.cc, operators/rnn_op.h).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtype as dtype_mod
+from ...core.tensor import Tensor, apply_op, to_tensor, wrap_raw
+from .. import functional as F
+from .. import initializer as I
+from ..layer_base import Layer
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+    "SimpleRNN", "LSTM", "GRU",
+]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0,
+                           batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        from ...tensor import full
+
+        shape = shape or self.state_shape
+        if isinstance(shape[0], (list, tuple)):
+            return tuple(
+                full([batch] + list(s), init_value, dtype or "float32") for s in shape
+            )
+        return full([batch] + list(shape), init_value, dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               attr=weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               attr=weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size], attr=bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size], attr=bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+
+        h = apply_op(f, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               attr=weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               attr=weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], attr=bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], attr=bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        h, c = states
+
+        def f(x, hp, cp, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hp @ wh.T + bh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fg = jax.nn.sigmoid(fg)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            cn = fg * cp + i * g
+            hn = o * jnp.tanh(cn)
+            return hn, cn
+
+        hn, cn = apply_op(f, inputs, h, c, self.weight_ih, self.weight_hh,
+                          self.bias_ih, self.bias_hh, multi_out=True)
+        return hn, (hn, cn)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               attr=weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               attr=weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size], attr=bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size], attr=bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, hp, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = hp @ wh.T + bh
+            ir, iz, inw = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(inw + r * hn)
+            return (1.0 - z) * n + z * hp
+
+        h = apply_op(f, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class RNN(Layer):
+    """Wraps a cell into a layer that runs over the time axis with lax.scan."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        outs = []
+        # eager reference loop (per-step) keeps autograd simple and correct;
+        # the jit path stages this whole loop into one XLA while via tracing.
+        x = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        T = x.shape[0]
+        states = initial_states
+        step_range = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        for t in step_range:
+            out, states = self.cell(x[t], states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from ...tensor.manipulation import stack
+
+        y = stack(outs, axis=0)
+        if not self.time_major:
+            y = y.transpose([1, 0, 2])
+        return y, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        s_fw, s_bw = (initial_states if initial_states is not None else (None, None))
+        y_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        y_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        from ...tensor.manipulation import concat
+
+        return concat([y_fw, y_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional recurrent network over lax.scan.
+
+    The full multi-layer scan runs as ONE traced computation per call —
+    weights are closed over per layer, and each layer is a scan, so XLA sees
+    a static nest of whiles it can pipeline.
+    """
+
+    _mode = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.num_directions = 2 if direction in ("bidirect", "bidirectional") else 1
+        if self._mode == "LSTM":
+            g = 4
+        elif self._mode == "GRU":
+            g = 3
+        else:
+            g = 1
+        self._gate_mult = g
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                suffix = "_reverse" if d == 1 else ""
+                in_size = input_size if layer == 0 else hidden_size * self.num_directions
+                self.add_parameter(
+                    f"weight_ih_l{layer}{suffix}",
+                    self.create_parameter([g * hidden_size, in_size],
+                                          attr=weight_ih_attr, default_initializer=u),
+                )
+                self.add_parameter(
+                    f"weight_hh_l{layer}{suffix}",
+                    self.create_parameter([g * hidden_size, hidden_size],
+                                          attr=weight_hh_attr, default_initializer=u),
+                )
+                self.add_parameter(
+                    f"bias_ih_l{layer}{suffix}",
+                    self.create_parameter([g * hidden_size], attr=bias_ih_attr,
+                                          is_bias=True, default_initializer=u),
+                )
+                self.add_parameter(
+                    f"bias_hh_l{layer}{suffix}",
+                    self.create_parameter([g * hidden_size], attr=bias_hh_attr,
+                                          is_bias=True, default_initializer=u),
+                )
+
+    def _step(self, mode, activation):
+        if mode == "LSTM":
+            def step(carry, xt, wi, wh, bi, bh):
+                hp, cp = carry
+                gates = xt @ wi.T + bi + hp @ wh.T + bh
+                i, fg, gq, o = jnp.split(gates, 4, axis=-1)
+                i = jax.nn.sigmoid(i)
+                fg = jax.nn.sigmoid(fg)
+                gq = jnp.tanh(gq)
+                o = jax.nn.sigmoid(o)
+                cn = fg * cp + i * gq
+                hn = o * jnp.tanh(cn)
+                return (hn, cn), hn
+        elif mode == "GRU":
+            def step(carry, xt, wi, wh, bi, bh):
+                hp = carry[0]
+                gi = xt @ wi.T + bi
+                gh = hp @ wh.T + bh
+                ir, iz, inw = jnp.split(gi, 3, axis=-1)
+                hr, hz, hn_ = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                n = jnp.tanh(inw + r * hn_)
+                hn = (1.0 - z) * n + z * hp
+                return (hn,), hn
+        else:
+            act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+            def step(carry, xt, wi, wh, bi, bh):
+                hp = carry[0]
+                hn = act(xt @ wi.T + bi + hp @ wh.T + bh)
+                return (hn,), hn
+
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self._mode
+        nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
+        time_major = self.time_major
+        dropout = self.dropout if self.training else 0.0
+        step = self._step(mode, self.activation)
+        weights = []
+        for layer in range(nl):
+            for d in range(nd):
+                sfx = "_reverse" if d == 1 else ""
+                weights.extend([
+                    getattr(self, f"weight_ih_l{layer}{sfx}"),
+                    getattr(self, f"weight_hh_l{layer}{sfx}"),
+                    getattr(self, f"bias_ih_l{layer}{sfx}"),
+                    getattr(self, f"bias_hh_l{layer}{sfx}"),
+                ])
+
+        # dropout masks sampled outside the traced fn (stateful RNG)
+        masks = []
+        if dropout > 0.0 and nl > 1:
+            from ...core import rng as rng_mod
+
+            x_shape = inputs.shape
+            batch = x_shape[1] if time_major else x_shape[0]
+            for _ in range(nl - 1):
+                key = rng_mod.next_key()
+                masks.append(
+                    jax.random.bernoulli(key, 1.0 - dropout, (batch, hs * nd)).astype(
+                        np.float32
+                    )
+                    / (1.0 - dropout)
+                )
+
+        has_init = initial_states is not None
+        init_raws = []
+        if has_init:
+            if mode == "LSTM":
+                h0, c0 = initial_states
+                init_raws = [h0, c0]
+            else:
+                init_raws = [initial_states]
+
+        def run(x, *flat):
+            wlist = flat[: 4 * nl * nd]
+            inits = flat[4 * nl * nd:]
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)
+            batch = x.shape[1]
+            hs_list, cs_list = [], []
+            for layer in range(nl):
+                outs_dirs = []
+                for d in range(nd):
+                    wi, wh, bi, bh = wlist[(layer * nd + d) * 4: (layer * nd + d) * 4 + 4]
+                    if inits:
+                        if mode == "LSTM":
+                            h0_all, c0_all = inits
+                            carry = (h0_all[layer * nd + d], c0_all[layer * nd + d])
+                        else:
+                            carry = (inits[0][layer * nd + d],)
+                    else:
+                        z = jnp.zeros((batch, hs), x.dtype)
+                        carry = (z, z) if mode == "LSTM" else (z,)
+                    seq = jnp.flip(x, 0) if d == 1 else x
+
+                    def body(c, xt):
+                        c2, y = step(c, xt, wi, wh, bi, bh)
+                        return c2, y
+
+                    carry_f, ys = jax.lax.scan(body, carry, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    outs_dirs.append(ys)
+                    hs_list.append(carry_f[0])
+                    if mode == "LSTM":
+                        cs_list.append(carry_f[1])
+                x = jnp.concatenate(outs_dirs, axis=-1) if nd == 2 else outs_dirs[0]
+                if dropout > 0.0 and layer < nl - 1:
+                    x = x * masks[layer][None, :, :]
+            y = x if time_major else jnp.swapaxes(x, 0, 1)
+            h_final = jnp.stack(hs_list, axis=0)
+            if mode == "LSTM":
+                c_final = jnp.stack(cs_list, axis=0)
+                return y, h_final, c_final
+            return y, h_final
+
+        outs = apply_op(run, inputs, *weights, *init_raws, multi_out=True)
+        if mode == "LSTM":
+            y, h, c = outs
+            return y, (h, c)
+        y, h = outs
+        return y, h
+
+
+class SimpleRNN(_RNNBase):
+    _mode = "RNN_TANH"
+
+
+class LSTM(_RNNBase):
+    _mode = "LSTM"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, "tanh", weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr)
+
+
+class GRU(_RNNBase):
+    _mode = "GRU"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, "tanh", weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr)

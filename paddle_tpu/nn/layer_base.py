@@ -1,0 +1,359 @@
+"""Layer: the imperative module system.
+
+Parity with the reference's dygraph Layer
+(/root/reference/python/paddle/fluid/dygraph/layers.py:80,264,313): named
+parameters/buffers/sublayers, forward pre/post hooks, state_dict round-trip,
+train/eval modes. TPU-first difference: a Layer is also a *pytree of
+parameters* — ``paddle_tpu.jit`` can functionalize any Layer into
+``(apply_fn, params)`` for pjit compilation without touching user code.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.enforce import InvalidArgumentError, enforce
+from ..core.tensor import Parameter, Tensor, to_tensor
+from . import initializer as I
+from .param_attr import ParamAttr
+
+__all__ = ["Layer"]
+
+
+class HookRemoveHelper:
+    _next_id = [0]
+
+    def __init__(self, hooks):
+        self._hooks = hooks
+        HookRemoveHelper._next_id[0] += 1
+        self._id = HookRemoveHelper._next_id[0]
+        hooks[self._id] = None  # placeholder replaced by caller
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtype_mod.convert_dtype(dtype)
+        self._parameters: "collections.OrderedDict[str, Parameter]" = collections.OrderedDict()
+        self._sub_layers: "collections.OrderedDict[str, Layer]" = collections.OrderedDict()
+        self._buffers: "collections.OrderedDict[str, Tensor]" = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._casted_by_pure_fp16 = False
+        self._name_scope = name_scope or type(self).__name__.lower()
+
+    # ------------------------------------------------------------------ params
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+    ) -> Optional[Parameter]:
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtype_mod.convert_dtype(dtype) or self._dtype
+        init = attr.initializer or I._resolve(default_initializer, is_bias)
+        value = init(shape, dtype)
+        p = Parameter(value, trainable=attr.trainable, name=attr.name)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def create_tensor(self, name=None, persistable=None, dtype=None):
+        from ..tensor import zeros
+
+        return zeros([1], dtype or self._dtype)
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is None:
+            self._parameters[name] = None
+            return None
+        enforce(
+            isinstance(parameter, Parameter),
+            f"add_parameter expects Parameter, got {type(parameter)}",
+        )
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor], persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # ------------------------------------------------------------------ hooks
+    def register_forward_pre_hook(self, hook: Callable):
+        helper = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[helper._id] = hook
+        return helper
+
+    def register_forward_post_hook(self, hook: Callable):
+        helper = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[helper._id] = hook
+        return helper
+
+    # ------------------------------------------------------------------ modes
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def apply(self, fn: Callable):
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    def full_name(self):
+        return self._name_scope
+
+    # ------------------------------------------------------------------ walk
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(
+        self, prefix="", include_sublayers=True
+    ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer, lp in self._walk(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{lp}{pname}" if lp else pname), p
+
+    def buffers(self, include_sublayers=True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(
+        self, prefix="", include_sublayers=True
+    ) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        for name, layer, lp in self._walk(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{lp}{bname}" if lp else bname), b
+
+    def _walk(self, prefix, include_sublayers):
+        """Yields (name, layer, layer_prefix)."""
+        yield "", self, prefix and prefix + "."
+        if include_sublayers:
+            for name, sub in self.named_sublayers(prefix=prefix):
+                yield name, sub, name + "."
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        seen = set()
+        for name, l in self._sub_layers.items():
+            if l is not None and id(l) not in seen:
+                seen.add(id(l))
+                yield name, l
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        out = [l for _, l in self.named_sublayers(include_self=include_self)]
+        return out
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None or id(sub) in layers_set:
+                continue
+            layers_set.add(id(sub))
+            subprefix = f"{prefix}.{name}" if prefix else name
+            yield subprefix, sub
+            yield from sub.named_sublayers(prefix=subprefix, layers_set=layers_set)
+
+    # ------------------------------------------------------------------ state
+    def state_dict(
+        self, destination=None, include_sublayers=True, structured_name_prefix="",
+        use_hook=True,
+    ) -> Dict[str, Tensor]:
+        dest = collections.OrderedDict() if destination is None else destination
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            dest[structured_name_prefix + name] = p
+        for name, b in self.named_buffers(include_sublayers=include_sublayers):
+            short = name.rsplit(".", 1)[-1]
+            if short in self._non_persistable_buffer_names:
+                continue
+            dest[structured_name_prefix + name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        missing, unexpected = [], []
+        own = self.state_dict()
+        matched = set()
+        for k, v in state_dict.items():
+            if k in own:
+                target = own[k]
+                arr = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+                if list(arr.shape) != list(target.shape):
+                    raise InvalidArgumentError(
+                        f"shape mismatch for {k}: checkpoint {list(arr.shape)} vs "
+                        f"parameter {list(target.shape)}"
+                    )
+                target.set_value(arr.astype(target.dtype))
+                matched.add(k)
+            else:
+                unexpected.append(k)
+        missing = [k for k in own if k not in matched]
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # ------------------------------------------------------------------ dtype/device moves
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._convert_dtype(dtype_mod.convert_dtype(dtype))
+        return self
+
+    def astype(self, dtype):
+        self._convert_dtype(dtype_mod.convert_dtype(dtype))
+        return self
+
+    def float(self):
+        return self.astype("float32")
+
+    def half(self):
+        return self.astype("float16")
+
+    def bfloat16(self):
+        return self.astype("bfloat16")
+
+    def _convert_dtype(self, d, only_floating=True):
+        for layer in [self] + self.sublayers():
+            layer._dtype = d
+            for name, p in layer._parameters.items():
+                if p is not None and (not only_floating or dtype_mod.is_floating_point(p.dtype)):
+                    p._value = p._value.astype(d)
+            for name, b in layer._buffers.items():
+                if b is not None and (not only_floating or dtype_mod.is_floating_point(b.dtype)):
+                    b._value = b._value.astype(d)
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # ------------------------------------------------------------------ call
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    # ------------------------------------------------------------------ attr routing
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        bufs = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            _remove_from(name, subs, bufs)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if subs is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            _remove_from(name, params, bufs)
+            subs[name] = value
+        elif params is not None and name in params:
+            if value is None:
+                params[name] = None
+            elif isinstance(value, Tensor):
+                params[name].set_value(value)
+            else:
+                raise TypeError(f"cannot assign {type(value)} to parameter {name!r}")
+        elif bufs is not None and name in bufs:
+            bufs[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + list(self._sub_layers) + list(self._buffers)
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            mod_str = repr(sub)
+            mod_str = _addindent(mod_str, 2)
+            lines.append(f"({name}): {mod_str}")
+        main = type(self).__name__ + "("
+        if extra:
+            main += extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+
+def _remove_from(name, *dicts):
+    for d in dicts:
+        if d is not None and name in d:
+            del d[name]
+
+
+def _addindent(s, n):
+    lines = s.split("\n")
+    if len(lines) == 1:
+        return s
+    pad = " " * n
+    return lines[0] + "\n" + "\n".join(pad + l for l in lines[1:])

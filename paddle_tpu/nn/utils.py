@@ -1,0 +1,93 @@
+"""nn.utils — weight_norm / spectral_norm wrappers, parity with
+python/paddle/nn/utils/ in the reference."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor, apply_op
+from .layer_base import Layer
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm"]
+
+
+def _norm_except(w, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(w * w))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+
+
+def weight_norm(layer: Layer, name="weight", dim=0):
+    """Reparametrize layer.weight = g * v / ||v||; recomputed each forward
+    via a pre-hook (parity with paddle.nn.utils.weight_norm)."""
+    w = getattr(layer, name)
+    arr = w._value
+    norm = _norm_except(arr, dim)
+    g = Parameter(norm.reshape(-1) if dim is not None else norm.reshape(()))
+    v = Parameter(arr)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    del layer._parameters[name]
+
+    def pre_hook(l, inputs):
+        vv, gg = getattr(l, name + "_v"), getattr(l, name + "_g")
+
+        def compute(v_raw, g_raw):
+            n = _norm_except(v_raw, dim)
+            gshape = n.shape if dim is not None else ()
+            return v_raw / n * g_raw.reshape(gshape)
+
+        w_t = apply_op(compute, vv, gg)
+        object.__setattr__(l, name, w_t)
+        return None
+
+    handle = layer.register_forward_pre_hook(pre_hook)
+    layer._weight_norm_handle = handle
+    layer._weight_norm_cfg = (name, dim)
+    # materialize once so .weight exists before the first call
+    pre_hook(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name="weight"):
+    handle = getattr(layer, "_weight_norm_handle", None)
+    if handle is not None:
+        handle.remove()
+    name_, dim = getattr(layer, "_weight_norm_cfg", (name, 0))
+    v = layer._parameters.pop(name + "_v")
+    g = layer._parameters.pop(name + "_g")
+
+    arr = v._value
+    n = _norm_except(arr, dim)
+    gshape = n.shape if dim is not None else ()
+    w = Parameter(arr / n * g._value.reshape(gshape))
+    if hasattr(layer, name):
+        try:
+            object.__delattr__(layer, name)
+        except AttributeError:
+            pass
+    layer.add_parameter(name, w)
+    return layer
+
+
+def spectral_norm(layer: Layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    from .layer.norm import SpectralNorm as _SN
+
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = _SN(list(w.shape), dim=dim, power_iters=n_power_iterations, eps=eps)
+    layer.add_sublayer(name + "_sn", sn)
+    v = Parameter(w._value)
+    layer.add_parameter(name + "_orig", v)
+    del layer._parameters[name]
+
+    def pre_hook(l, inputs):
+        w_t = sn(getattr(l, name + "_orig"))
+        object.__setattr__(l, name, w_t)
+        return None
+
+    layer.register_forward_pre_hook(pre_hook)
+    pre_hook(layer, ())
+    return layer

@@ -1,0 +1,20 @@
+"""paddle_tpu.optimizer — parity with python/paddle/optimizer/."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    LarsMomentum,
+    Momentum,
+    Optimizer,
+    RMSProp,
+)
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "AdamW", "Adamax",
+    "Adadelta", "RMSProp", "Lamb", "LarsMomentum", "lr",
+]

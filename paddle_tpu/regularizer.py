@@ -1,0 +1,19 @@
+"""Regularizers — parity with python/paddle/regularizer.py (L1Decay/L2Decay
+appended to gradients by the optimizer, reference fluid/regularizer.py)."""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+        self._coeff = self.coeff
+        self._l1 = True
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+        self._coeff = self.coeff
+        self._l1 = False

@@ -1,0 +1,129 @@
+"""paddle_tpu.static — Program/Executor declarative mode (parity
+python/paddle/static + fluid Program APIs; SURVEY.md §2 #49-52)."""
+from __future__ import annotations
+
+from .executor import Executor, global_scope, scope_guard  # noqa: F401
+from .program import (  # noqa: F401
+    InputSpec,
+    Program,
+    data,
+    default_main_program,
+    default_startup_program,
+    name_scope,
+    program_guard,
+    _disable_static_mode,
+    _enable_static_mode,
+    _in_static_mode,
+    current_program,
+)
+from . import nn  # noqa: F401
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None):
+    """Parity with fluid/backward.py:1363 — in the trace design the backward
+    program is produced by jax.grad at compile time; this records intent and
+    returns (param, grad placeholder) pairs."""
+    prog = current_program() or default_main_program()
+    params = parameter_list or prog.all_parameters()
+    out = []
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    for p in params:
+        g = Tensor(jnp.zeros_like(p._value), name=p.name + "@GRAD")
+        prog._grad_map[id(p)] = g
+        out.append((p, g))
+    prog._appended_backward = True
+    return out
+
+
+class CompiledProgram:
+    """Parity with fluid/compiler.py:88 CompiledProgram.with_data_parallel.
+    On TPU, data parallelism is a sharding of the feed batch over the 'dp'
+    mesh axis; the same jitted program runs SPMD (no SSA-graph clone)."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy
+        self._dp = False
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._dp = True
+        return self
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class BuildStrategy:
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = False
+        self.memory_optimize = True
+        self.enable_inplace = True
+
+
+class ParallelExecutor:
+    """Compat facade: multi-device execution is pjit over the mesh."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 build_strategy=None, exec_strategy=None, **kw):
+        self._program = main_program
+
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+
+    return [CPUPlace()]
+
+
+def cuda_places(device_ids=None):
+    import jax
+
+    from ..core.place import TPUPlace
+
+    ids = device_ids if device_ids is not None else range(len(jax.devices()))
+    return [TPUPlace(i) for i in ids]
+
+
+tpu_places = cuda_places
+
+
+def device_guard(device=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def g():
+        yield
+
+    return g()
+
+
+def set_program_state(program, state_dict):
+    for p in program.all_parameters():
+        if p.name in state_dict:
+            p.set_value(state_dict[p.name])
+
+
+def save(program, model_path, protocol=4):
+    from ..framework.io import save as _save
+
+    state = {p.name: p for p in program.all_parameters()}
+    _save(state, model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..framework.io import load as _load
+
+    state = _load(model_path + ".pdparams")
+    set_program_state(program, state)

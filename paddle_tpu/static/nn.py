@@ -1,0 +1,78 @@
+"""paddle.static.nn — functional layers that auto-create parameters inside a
+Program (parity with python/paddle/static/nn/, fluid layer_helper pattern)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Parameter
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.param_attr import ParamAttr
+from ..core import dtype as dtype_mod
+
+__all__ = ["fc", "conv2d", "batch_norm", "embedding"]
+
+
+def _make_param(shape, attr, is_bias, dtype="float32"):
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    init = attr.initializer or (I.Constant(0.0) if is_bias else I.XavierUniform())
+    p = Parameter(init(shape, dtype_mod.convert_dtype(dtype)), name=attr.name)
+    p.optimize_attr["learning_rate"] = attr.learning_rate
+    p.regularizer = attr.regularizer
+    return p
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from ..tensor.manipulation import flatten
+
+    if num_flatten_dims > 1 or x.ndim > 2:
+        x = flatten(x, start_axis=num_flatten_dims, stop_axis=-1) if x.ndim > num_flatten_dims + 1 else x
+    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    w = _make_param([in_dim, size], weight_attr, False)
+    b = _make_param([size], bias_attr, True)
+    out = F.linear(x if x.ndim == num_flatten_dims + 1 else flatten(x, num_flatten_dims, -1), w, b)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    ksize = [filter_size] * 2 if isinstance(filter_size, int) else list(filter_size)
+    in_c = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    w = _make_param([num_filters, in_c // groups] + ksize, param_attr, False)
+    b = _make_param([num_filters], bias_attr, True)
+    out = F.conv2d(input, w, b, stride, padding, dilation, groups, data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-05, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False, name=None,
+               moving_mean_name=None, moving_variance_name=None, **kw):
+    from ..core.tensor import wrap_raw
+    import jax.numpy as jnp
+
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = _make_param([c], param_attr, False)
+    if scale is not None and param_attr is None:
+        scale.set_value(np.ones([c], np.float32))
+    bias = _make_param([c], bias_attr, True)
+    mean = wrap_raw(jnp.zeros([c], jnp.float32))
+    var = wrap_raw(jnp.ones([c], jnp.float32))
+    out = F.batch_norm(input, mean, var, scale, bias, training=not is_test,
+                       momentum=momentum, epsilon=epsilon, data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
+              dtype="float32"):
+    w = _make_param(list(size), param_attr, False, dtype)
+    return F.embedding(input, w, padding_idx=padding_idx, sparse=is_sparse)

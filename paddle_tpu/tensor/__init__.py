@@ -1,0 +1,75 @@
+"""paddle_tpu.tensor — the functional tensor op namespace.
+
+Mirrors the reference's python/paddle/tensor package; all ops are
+differentiable wrappers over jax.numpy (see paddle_tpu.core.tensor.apply_op).
+This module also attaches the op surface onto Tensor as methods, the way the
+reference monkey-patches its math ops onto Variable/VarBase.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor, to_tensor
+
+from .attribute import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+from . import attribute, creation, linalg, logic, manipulation, math, random, search, stat
+
+# ---------------------------------------------------------------------------
+# Attach functional ops as Tensor methods (paddle-style method surface).
+# ---------------------------------------------------------------------------
+_METHOD_SOURCES = [math, manipulation, linalg, logic, search, stat, creation, attribute, random]
+
+_SKIP = {
+    # not methods in paddle, or name-clashes with core attrs/builtins
+    "to_tensor", "zeros", "ones", "full", "empty", "arange", "linspace",
+    "logspace", "eye", "meshgrid", "assign", "rand", "randn", "randint",
+    "randperm", "uniform", "normal", "standard_normal", "tril_indices",
+    "triu_indices", "one_hot", "is_tensor", "shape", "scatter_nd",
+    "broadcast_shape", "poisson",
+}
+
+
+def _attach_methods():
+    for mod in _METHOD_SOURCES:
+        for name in getattr(mod, "__all__", []):
+            if name in _SKIP:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn):
+                continue
+            if hasattr(Tensor, name) and name not in ("where",):
+                continue
+
+            def make_method(f):
+                def method(self, *args, **kwargs):
+                    return f(self, *args, **kwargs)
+
+                method.__name__ = f.__name__
+                method.__doc__ = f.__doc__
+                return method
+
+            setattr(Tensor, name, make_method(fn))
+
+
+_attach_methods()
+
+# a few paddle method aliases
+Tensor.mm = lambda self, y, name=None: math.matmul(self, y)
+Tensor.rank = lambda self: attribute.rank(self)
+Tensor.add_ = lambda self, y: (self._rebind(math.add(self, y)), self)[1]
+Tensor.subtract_ = lambda self, y: (self._rebind(math.subtract(self, y)), self)[1]
+Tensor.clip_ = lambda self, min=None, max=None: (
+    self._rebind(math.clip(self, min, max)),
+    self,
+)[1]
+Tensor.scale_ = lambda self, scale=1.0, bias=0.0, bias_after_scale=True, act=None: (
+    self._rebind(math.scale(self, scale, bias, bias_after_scale, act)),
+    self,
+)[1]

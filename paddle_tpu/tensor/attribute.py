@@ -1,0 +1,54 @@
+"""Tensor attribute ops — parity with python/paddle/tensor/attribute.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor, apply_op, to_tensor, wrap_raw
+
+__all__ = [
+    "shape", "rank", "is_floating_point", "is_integer", "is_complex", "real",
+    "imag", "conj", "einsum",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def shape(input):
+    return wrap_raw(jnp.asarray(np.asarray(_t(input).shape, dtype=np.int32)))
+
+
+def rank(input):
+    return wrap_raw(jnp.asarray(np.int32(_t(input).ndim)))
+
+
+def is_floating_point(x):
+    return dtype_mod.is_floating_point(_t(x).dtype)
+
+
+def is_integer(x):
+    return dtype_mod.is_integer(_t(x).dtype)
+
+
+def is_complex(x):
+    return dtype_mod.is_complex(_t(x).dtype)
+
+
+def real(x, name=None):
+    return apply_op(jnp.real, _t(x))
+
+
+def imag(x, name=None):
+    return apply_op(jnp.imag, _t(x))
+
+
+def conj(x, name=None):
+    return apply_op(jnp.conj, _t(x))
+
+
+def einsum(equation, *operands):
+    tensors = [_t(o) for o in operands]
+    return apply_op(lambda *xs: jnp.einsum(equation, *xs), *tensors)

@@ -1,0 +1,186 @@
+"""Tensor creation ops — parity surface with python/paddle/tensor/creation.py
+in the reference. All creation APIs take explicit dtypes (default float32) so
+TPU compute stays in narrow types regardless of the x64 config.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core import rng as rng_mod
+from ..core.tensor import Tensor, apply_op, to_tensor, wrap_raw
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye", "diag",
+    "diagflat", "tril", "triu", "meshgrid", "assign", "clone", "numel",
+    "complex", "tril_indices", "triu_indices", "one_hot",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else dtype_mod.get_default_dtype()
+    return d
+
+
+def zeros(shape, dtype=None, name=None):
+    return wrap_raw(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return wrap_raw(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = (
+            "int64" if isinstance(fill_value, (int, np.integer))
+            and not isinstance(fill_value, bool) else None
+        )
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+    return wrap_raw(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply_op(lambda a: jnp.zeros_like(a, dtype=dtype_mod.convert_dtype(dtype)), _stopped(x))
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply_op(lambda a: jnp.ones_like(a, dtype=dtype_mod.convert_dtype(dtype)), _stopped(x))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply_op(
+        lambda a: jnp.full_like(a, fill_value, dtype=dtype_mod.convert_dtype(dtype)),
+        _stopped(x),
+    )
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def _stopped(x):
+    if isinstance(x, Tensor):
+        return x.detach()
+    return to_tensor(x)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, float):
+            dtype = dtype or dtype_mod.get_default_dtype()
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    return wrap_raw(jnp.arange(start, end, step, dtype=_dt(dtype, np.dtype(np.int64))))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item() if isinstance(num, Tensor) else num)
+    return wrap_raw(jnp.linspace(start, stop, num, dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return wrap_raw(
+        jnp.logspace(float(start), float(stop), int(num), base=float(base), dtype=_dt(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return wrap_raw(jnp.eye(int(num_rows), num_columns and int(num_columns), dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+
+    def f(a):
+        if a.ndim == 1 and padding_value != 0:
+            n = a.shape[0] + abs(offset)
+            mask = jnp.eye(n, k=offset, dtype=bool)
+            return jnp.where(mask, jnp.diag(a, k=offset), jnp.asarray(padding_value, a.dtype))
+        return jnp.diag(a, k=offset)
+
+    return apply_op(f, x)
+
+
+def diagflat(x, offset=0, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    return apply_op(lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op(lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op(lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return wrap_raw(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype, np.dtype(np.int64))))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return wrap_raw(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype, np.dtype(np.int64))))
+
+
+def meshgrid(*args, **kwargs):
+    args = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    tensors = [to_tensor(a) if not isinstance(a, Tensor) else a for a in args]
+    return apply_op(lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), *tensors, multi_out=True)
+
+
+def assign(x, output=None):
+    src = x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+    out = apply_op(lambda a: a + jnp.zeros((), a.dtype), src)
+    if output is not None:
+        output._rebind(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def numel(x, name=None):
+    return wrap_raw(jnp.asarray(x.size, dtype=np.int64))
+
+
+def complex(real, imag, name=None):
+    return apply_op(jax.lax.complex, real, imag)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op(
+        lambda a: jax.nn.one_hot(a, num_classes, dtype=dtype_mod.get_default_dtype()),
+        x,
+    )

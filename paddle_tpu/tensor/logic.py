@@ -1,0 +1,104 @@
+"""Comparison / logical ops — parity with python/paddle/tensor/logic.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply_op, to_tensor, _binop
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose", "logical_and",
+    "logical_or", "logical_xor", "logical_not", "is_empty", "is_tensor",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def equal(x, y, name=None):
+    return _binop(jnp.equal, x, y)
+
+
+def not_equal(x, y, name=None):
+    return _binop(jnp.not_equal, x, y)
+
+
+def greater_than(x, y, name=None):
+    return _binop(jnp.greater, x, y)
+
+
+def greater_equal(x, y, name=None):
+    return _binop(jnp.greater_equal, x, y)
+
+
+def less_than(x, y, name=None):
+    return _binop(jnp.less, x, y)
+
+
+def less_equal(x, y, name=None):
+    return _binop(jnp.less_equal, x, y)
+
+
+def equal_all(x, y, name=None):
+    return apply_op(lambda a, b: jnp.array_equal(a, b), _t(x), _t(y))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        _t(x),
+        _t(y),
+    )
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        _t(x),
+        _t(y),
+    )
+
+
+def logical_and(x, y, out=None, name=None):
+    return _binop(jnp.logical_and, x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _binop(jnp.logical_or, x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _binop(jnp.logical_xor, x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return apply_op(jnp.logical_not, _t(x))
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return _binop(jnp.bitwise_and, x, y)
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return _binop(jnp.bitwise_or, x, y)
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return _binop(jnp.bitwise_xor, x, y)
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply_op(jnp.bitwise_not, _t(x))
+
+
+def is_empty(x, name=None):
+    from ..core.tensor import wrap_raw
+
+    return wrap_raw(jnp.asarray(_t(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

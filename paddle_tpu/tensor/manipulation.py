@@ -1,0 +1,500 @@
+"""Shape/layout manipulation ops — parity with
+python/paddle/tensor/manipulation.py in the reference. Static shapes are kept
+wherever possible so XLA can tile onto the MXU; data-dependent-shape ops
+(nonzero/unique/masked_select) are eager-only and documented as such.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.enforce import InvalidArgumentError, enforce
+from ..core.tensor import Tensor, apply_op, to_tensor, wrap_raw
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "flatten", "squeeze", "squeeze_",
+    "unsqueeze", "unsqueeze_", "concat", "stack", "split", "chunk", "tile",
+    "expand", "expand_as", "broadcast_to", "gather", "gather_nd", "scatter",
+    "scatter_", "scatter_nd", "scatter_nd_add", "slice", "strided_slice",
+    "index_select", "masked_select", "where", "roll", "flip", "rot90",
+    "unbind", "unique", "unique_consecutive", "pad", "repeat_interleave",
+    "take_along_axis", "put_along_axis", "moveaxis", "swapaxes", "unstack",
+    "flip", "cast", "crop", "tensordot", "as_complex", "as_real", "tolist",
+    "nonzero", "index_sample", "masked_fill", "shard_index",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _int_list(v):
+    if isinstance(v, Tensor):
+        return [int(i) for i in np.atleast_1d(v.numpy())]
+    if isinstance(v, (int, np.integer)):
+        return [int(v)]
+    return [int(i._value) if isinstance(i, Tensor) else int(i) for i in v]
+
+
+def cast(x, dtype):
+    return _t(x).astype(dtype)
+
+
+def reshape(x, shape, name=None):
+    return apply_op(lambda a: jnp.reshape(a, tuple(_int_list(shape))), _t(x))
+
+
+def reshape_(x, shape, name=None):
+    x._rebind(reshape(x, shape))
+    return x
+
+
+def transpose(x, perm, name=None):
+    return apply_op(lambda a: jnp.transpose(a, tuple(_int_list(perm))), _t(x))
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op(
+        lambda a: jnp.moveaxis(a, tuple(_int_list(source)), tuple(_int_list(destination))),
+        _t(x),
+    )
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op(lambda a: jnp.swapaxes(a, int(axis0), int(axis1)), _t(x))
+
+
+transpose_ = swapaxes
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = _t(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+
+    def f(a):
+        shape = list(a.shape)
+        newshape = shape[:s] + [-1 if np.prod(shape[s : e + 1]) else 0] + shape[e + 1 :]
+        newshape = shape[:s] + [int(np.prod(shape[s : e + 1]))] + shape[e + 1 :]
+        return jnp.reshape(a, tuple(newshape))
+
+    return apply_op(f, x)
+
+
+def squeeze(x, axis=None, name=None):
+    x = _t(x)
+
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = tuple(ax % a.ndim for ax in _int_list(axis) if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+
+    return apply_op(f, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    x._rebind(squeeze(x, axis))
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    return apply_op(lambda a: jnp.expand_dims(a, tuple(_int_list(axis))), _t(x))
+
+
+def unsqueeze_(x, axis, name=None):
+    x._rebind(unsqueeze(x, axis))
+    return x
+
+
+def concat(x, axis=0, name=None):
+    tensors = [_t(v) for v in x]
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op(lambda *xs: jnp.concatenate(xs, axis=ax), *tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = [_t(v) for v in x]
+    return apply_op(lambda *xs: jnp.stack(xs, axis=int(axis)), *tensors)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _t(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        enforce(dim % num_or_sections == 0, f"cannot split axis of {dim} into {num_or_sections}")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = _int_list(num_or_sections)
+        if any(s == -1 for s in sizes):
+            known = sum(s for s in sizes if s != -1)
+            sizes = [s if s != -1 else dim - known for s in sizes]
+    offsets = np.cumsum([0] + sizes)
+
+    def f(a):
+        return tuple(
+            jax.lax.slice_in_dim(a, int(offsets[i]), int(offsets[i + 1]), axis=ax)
+            for i in range(len(sizes))
+        )
+
+    return list(apply_op(f, x, multi_out=True))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = _t(x)
+    n = x.shape[axis]
+
+    def f(a):
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis))
+
+    return list(apply_op(f, x, multi_out=True))
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    return apply_op(lambda a: jnp.tile(a, tuple(_int_list(repeat_times))), _t(x))
+
+
+def expand(x, shape, name=None):
+    x = _t(x)
+    target = _int_list(shape)
+
+    def f(a):
+        tgt = list(target)
+        src = list(a.shape)
+        for i in range(1, len(src) + 1):
+            if tgt[-i] == -1:
+                tgt[-i] = src[-i]
+        return jnp.broadcast_to(a, tuple(tgt))
+
+    return apply_op(f, x)
+
+
+def expand_as(x, y, name=None):
+    return apply_op(lambda a, b: jnp.broadcast_to(a, b.shape), _t(x), _t(y).detach())
+
+
+def broadcast_to(x, shape, name=None):
+    return apply_op(lambda a: jnp.broadcast_to(a, tuple(_int_list(shape))), _t(x))
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+
+    def f(a, idx):
+        return jnp.take(a, idx.reshape(-1), axis=ax)
+
+    return apply_op(f, _t(x), _t(index))
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        out = a[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+
+    return apply_op(f, _t(x), _t(index))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    if overwrite:
+        return apply_op(
+            lambda a, idx, upd: a.at[idx.reshape(-1)].set(upd), _t(x), _t(index), _t(updates)
+        )
+
+    def f_add(a, idx, upd):
+        # paddle overwrite=False: rows named by index are zeroed then summed
+        idx = idx.reshape(-1)
+        base = a.at[idx].set(0)
+        return base.at[idx].add(upd)
+
+    return apply_op(f_add, _t(x), _t(index), _t(updates))
+
+
+def scatter_(x, index, updates, overwrite=True):
+    x._rebind(scatter(x, index, updates, overwrite))
+    return x
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def f(idx, upd):
+        zeros = jnp.zeros(tuple(_int_list(shape)), upd.dtype)
+        return zeros.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+
+    return apply_op(f, _t(index), _t(updates))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, upd):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+
+    return apply_op(f, _t(x), _t(index), _t(updates))
+
+
+def slice(x, axes, starts, ends, name=None):
+    axes = _int_list(axes)
+    starts = _int_list(starts)
+    ends = _int_list(ends)
+
+    def f(a):
+        idx = [builtins_slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins_slice(s, e)
+        return a[tuple(idx)]
+
+    return apply_op(f, _t(x))
+
+
+builtins_slice = builtins.slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes = _int_list(axes)
+    starts = _int_list(starts)
+    ends = _int_list(ends)
+    strides = _int_list(strides)
+
+    def f(a):
+        idx = [builtins_slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins_slice(s, e, st)
+        return a[tuple(idx)]
+
+    return apply_op(f, _t(x))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = _t(x)
+    shp = _int_list(shape) if shape is not None else x.shape
+    offs = _int_list(offsets) if offsets is not None else [0] * x.ndim
+    shp = [x.shape[i] - offs[i] if s == -1 else s for i, s in enumerate(shp)]
+
+    def f(a):
+        return jax.lax.dynamic_slice(a, offs, shp)
+
+    return apply_op(f, x)
+
+
+def index_select(x, index, axis=0, name=None):
+    def f(a, idx):
+        return jnp.take(a, idx.reshape(-1), axis=int(axis))
+
+    return apply_op(f, _t(x), _t(index))
+
+
+def index_sample(x, index):
+    def f(a, idx):
+        return jnp.take_along_axis(a, idx, axis=1)
+
+    return apply_op(f, _t(x), _t(index))
+
+
+def masked_select(x, mask, name=None):
+    # data-dependent output shape: eager only
+    a = _t(x).numpy()
+    m = _t(mask).numpy()
+    return wrap_raw(jnp.asarray(a[np.broadcast_to(m, a.shape)]))
+
+
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        return apply_op(
+            lambda a, m, v: jnp.where(m, v.astype(a.dtype), a), _t(x), _t(mask), value
+        )
+    return apply_op(lambda a, m: jnp.where(m, jnp.asarray(value, a.dtype), a), _t(x), _t(mask))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    cond = _t(condition)
+    xt, yt = x, y
+    if not isinstance(xt, Tensor) and not isinstance(yt, Tensor):
+        return apply_op(lambda c: jnp.where(c, xt, yt), cond)
+    if not isinstance(xt, Tensor):
+        return apply_op(lambda c, b: jnp.where(c, jnp.asarray(xt, b.dtype), b), cond, yt)
+    if not isinstance(yt, Tensor):
+        return apply_op(lambda c, a: jnp.where(c, a, jnp.asarray(yt, a.dtype)), cond, xt)
+    return apply_op(lambda c, a, b: jnp.where(c, a, b), cond, xt, yt)
+
+
+def nonzero(x, as_tuple=False):
+    arr = _t(x).numpy()
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(wrap_raw(jnp.asarray(i[:, None], dtype=np.int64)) for i in nz)
+    return wrap_raw(jnp.asarray(np.stack(nz, axis=1), dtype=np.int64))
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _int_list(shifts)
+    ax = _int_list(axis) if axis is not None else None
+    sh = sh[0] if len(sh) == 1 and ax is None else sh
+
+    def f(a):
+        if ax is None:
+            return jnp.roll(a, sh)
+        return jnp.roll(a, tuple(_int_list(shifts)), axis=tuple(ax))
+
+    return apply_op(f, _t(x))
+
+
+def flip(x, axis, name=None):
+    return apply_op(lambda a: jnp.flip(a, tuple(_int_list(axis))), _t(x))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), _t(x))
+
+
+def unique(
+    x,
+    return_index=False,
+    return_inverse=False,
+    return_counts=False,
+    axis=None,
+    dtype="int64",
+    name=None,
+):
+    arr = _t(x).numpy()
+    out = np.unique(
+        arr, return_index=return_index, return_inverse=return_inverse,
+        return_counts=return_counts, axis=axis,
+    )
+    if not (return_index or return_inverse or return_counts):
+        return wrap_raw(jnp.asarray(out))
+    outs = [wrap_raw(jnp.asarray(out[0]))]
+    for extra in out[1:]:
+        outs.append(wrap_raw(jnp.asarray(extra.astype(np.int64))))
+    return tuple(outs)
+
+
+def unique_consecutive(
+    x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None
+):
+    arr = _t(x).numpy()
+    if axis is None:
+        arr = arr.reshape(-1)
+        change = np.ones(arr.shape[0], bool)
+        change[1:] = arr[1:] != arr[:-1]
+    else:
+        raise NotImplementedError("unique_consecutive with axis is not supported yet")
+    vals = arr[change]
+    outs = [wrap_raw(jnp.asarray(vals))]
+    if return_inverse:
+        inv = np.cumsum(change) - 1
+        outs.append(wrap_raw(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(change)
+        counts = np.diff(np.append(idx, arr.shape[0]))
+        outs.append(wrap_raw(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = _t(x)
+    p = _int_list(pad)
+
+    def f(a):
+        nd = a.ndim
+        if len(p) == 2 * nd:
+            width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle semantics: pad pairs apply to trailing dims from the LAST
+            # inward — [left, right, top, bottom] pads W then H on NCHW.
+            npairs = len(p) // 2
+            width = [(0, 0)] * nd
+            if data_format.startswith("NC"):
+                dims = [nd - 1 - j for j in range(npairs)]
+            else:
+                dims = [nd - 2 - j for j in range(npairs)]
+            for j, d in enumerate(dims):
+                width[d] = (p[2 * j], p[2 * j + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, width, mode=jmode, constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+
+    return apply_op(f, x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = repeats.numpy()
+        arr = _t(x).numpy()
+        return wrap_raw(jnp.asarray(np.repeat(arr, reps, axis=axis)))
+    return apply_op(lambda a: jnp.repeat(a, int(repeats), axis=axis), _t(x))
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    def f(a, idx):
+        # paddle broadcasts indices along non-axis dims
+        tgt = list(a.shape)
+        tgt[axis] = idx.shape[axis]
+        idx = jnp.broadcast_to(idx, tuple(tgt))
+        return jnp.take_along_axis(a, idx, axis=axis)
+
+    return apply_op(f, _t(arr), _t(indices))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def f(a, idx, v):
+        v = jnp.broadcast_to(jnp.asarray(v, a.dtype), idx.shape)
+        dims = [builtins_slice(None)] * a.ndim
+        grids = jnp.indices(idx.shape)
+        index_tuple = tuple(
+            idx if d == axis else grids[d] for d in range(a.ndim)
+        )
+        if reduce == "assign":
+            return a.at[index_tuple].set(v)
+        if reduce == "add":
+            return a.at[index_tuple].add(v)
+        if reduce == "multiply" or reduce == "mul":
+            return a.at[index_tuple].multiply(v)
+        raise InvalidArgumentError(f"unknown reduce mode {reduce!r}")
+
+    if isinstance(values, Tensor):
+        return apply_op(f, _t(arr), _t(indices), values)
+    return apply_op(lambda a, idx: f(a, idx, values), _t(arr), _t(indices))
+
+
+def tensordot(x, y, axes=2, name=None):
+    def conv_axes(axes):
+        if isinstance(axes, Tensor):
+            return axes.tolist()
+        return axes
+
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=conv_axes(axes)), _t(x), _t(y))
+
+
+def as_complex(x, name=None):
+    return apply_op(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), _t(x))
+
+
+def as_real(x, name=None):
+    return apply_op(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), _t(x))
+
+
+def tolist(x):
+    return _t(x).tolist()
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Parity with paddle.shard_index (used by distributed embedding)."""
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def f(a):
+        in_shard = (a // shard_size) == shard_id
+        return jnp.where(in_shard, a % shard_size, ignore_value)
+
+    return apply_op(f, _t(input))

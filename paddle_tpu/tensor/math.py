@@ -1,0 +1,449 @@
+"""Elementwise/reduction math ops — parity with python/paddle/tensor/math.py.
+
+Every op is a thin differentiable wrapper over jax.numpy; XLA fuses chains of
+these into single TPU kernels, replacing the reference's hand-written fused
+CUDA kernels (/root/reference/paddle/fluid/operators/elementwise/,
+reduce_ops/).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor, apply_op, to_tensor, _binop, _promote_pair
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "sqrt", "rsqrt", "exp", "expm1", "log", "log2", "log10", "log1p",
+    "abs", "ceil", "floor", "round", "trunc", "sin", "cos", "tan", "asin",
+    "acos", "atan", "atan2", "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
+    "sigmoid", "square", "reciprocal", "sign", "neg", "maximum", "minimum",
+    "fmax", "fmin", "sum", "nansum", "mean", "nanmean", "max", "min", "amax",
+    "amin", "prod", "cumsum", "cumprod", "cummax", "cummin", "clip", "erf",
+    "erfinv", "lerp", "isnan", "isinf", "isfinite", "nan_to_num", "logsumexp",
+    "all", "any", "matmul", "mm", "bmm", "inner", "outer", "dot", "addmm",
+    "logit", "multiply_", "add_n", "kron", "diff", "rad2deg", "deg2rad",
+    "gcd", "lcm", "frac", "angle", "heaviside", "trace", "digamma", "lgamma",
+    "stanh", "softplus", "increment", "scale", "count_nonzero", "broadcast_shape",
+    "log_softmax_",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy()
+        return tuple(int(v) for v in np.atleast_1d(a))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(v) for v in axis)
+    return int(axis)
+
+
+# -- binary -----------------------------------------------------------------
+def add(x, y, name=None):
+    return _binop(jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    return _binop(jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    return _binop(jnp.multiply, x, y)
+
+
+def divide(x, y, name=None):
+    return _binop(jnp.true_divide, x, y)
+
+
+def floor_divide(x, y, name=None):
+    return _binop(jnp.floor_divide, x, y)
+
+
+def mod(x, y, name=None):
+    return _binop(jnp.mod, x, y)
+
+
+remainder = mod
+floor_mod = mod
+
+
+def pow(x, y, name=None):
+    return _binop(jnp.power, x, y)
+
+
+def maximum(x, y, name=None):
+    return _binop(jnp.maximum, x, y)
+
+
+def minimum(x, y, name=None):
+    return _binop(jnp.minimum, x, y)
+
+
+def fmax(x, y, name=None):
+    return _binop(jnp.fmax, x, y)
+
+
+def fmin(x, y, name=None):
+    return _binop(jnp.fmin, x, y)
+
+
+def atan2(x, y, name=None):
+    return _binop(jnp.arctan2, x, y)
+
+
+def gcd(x, y, name=None):
+    return _binop(jnp.gcd, x, y)
+
+
+def lcm(x, y, name=None):
+    return _binop(jnp.lcm, x, y)
+
+
+def heaviside(x, y, name=None):
+    return _binop(jnp.heaviside, x, y)
+
+
+def kron(x, y, name=None):
+    return _binop(jnp.kron, x, y)
+
+
+# -- unary ------------------------------------------------------------------
+def _unary(fn):
+    def op(x, name=None):
+        return apply_op(fn, _t(x))
+
+    return op
+
+
+sqrt = _unary(jnp.sqrt)
+rsqrt = _unary(lambda a: jax.lax.rsqrt(a))
+exp = _unary(jnp.exp)
+expm1 = _unary(jnp.expm1)
+log = _unary(jnp.log)
+log2 = _unary(jnp.log2)
+log10 = _unary(jnp.log10)
+log1p = _unary(jnp.log1p)
+abs = _unary(jnp.abs)
+ceil = _unary(jnp.ceil)
+floor = _unary(jnp.floor)
+round = _unary(jnp.round)
+trunc = _unary(jnp.trunc)
+sin = _unary(jnp.sin)
+cos = _unary(jnp.cos)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+acos = _unary(jnp.arccos)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+cosh = _unary(jnp.cosh)
+tanh = _unary(jnp.tanh)
+asinh = _unary(jnp.arcsinh)
+acosh = _unary(jnp.arccosh)
+atanh = _unary(jnp.arctanh)
+sigmoid = _unary(jax.nn.sigmoid)
+square = _unary(jnp.square)
+reciprocal = _unary(lambda a: 1.0 / a)
+sign = _unary(jnp.sign)
+neg = _unary(jnp.negative)
+erf = _unary(jax.lax.erf)
+erfinv = _unary(jax.lax.erf_inv)
+digamma = _unary(jax.scipy.special.digamma)
+lgamma = _unary(jax.scipy.special.gammaln)
+isnan = _unary(jnp.isnan)
+isinf = _unary(jnp.isinf)
+isfinite = _unary(jnp.isfinite)
+frac = _unary(lambda a: a - jnp.trunc(a))
+angle = _unary(jnp.angle)
+rad2deg = _unary(jnp.rad2deg)
+deg2rad = _unary(jnp.deg2rad)
+
+
+def logit(x, eps=None, name=None):
+    def f(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a / (1.0 - a))
+
+    return apply_op(f, _t(x))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op(lambda a: scale_b * jnp.tanh(scale_a * a), _t(x))
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return apply_op(
+        lambda a: jnp.where(
+            a * beta > threshold, a, jnp.log1p(jnp.exp(beta * a)) / beta
+        ),
+        _t(x),
+    )
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), _t(x))
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply_op(lambda a, b, w: a + w * (b - a), _t(x), _t(y), weight)
+    return apply_op(lambda a, b: a + weight * (b - a), _t(x), _t(y))
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) and min.size == 1 else min
+    hi = max.item() if isinstance(max, Tensor) and max.size == 1 else max
+    return apply_op(lambda a: jnp.clip(a, lo, hi), _t(x))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def f(a):
+        out = a * scale + bias if bias_after_scale else (a + bias) * scale
+        return out
+
+    out = apply_op(f, _t(x))
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def increment(x, value=1.0, name=None):
+    new = apply_op(lambda a: a + jnp.asarray(value, a.dtype), x)
+    x._rebind(new)
+    return x
+
+
+# -- reductions -------------------------------------------------------------
+def _reduction(fn):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        d = dtype_mod.convert_dtype(dtype)
+
+        def f(a):
+            if d is not None:
+                a = a.astype(d)
+            return fn(a, axis=_ax(axis), keepdims=keepdim)
+
+        return apply_op(f, _t(x))
+
+    return op
+
+
+sum = _reduction(jnp.sum)
+nansum = _reduction(jnp.nansum)
+mean = _reduction(jnp.mean)
+nanmean = _reduction(jnp.nanmean)
+prod = _reduction(jnp.prod)
+amax = _reduction(jnp.max)
+amin = _reduction(jnp.min)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.max(a, axis=_ax(axis), keepdims=keepdim), _t(x))
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.min(a, axis=_ax(axis), keepdims=keepdim), _t(x))
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.all(a, axis=_ax(axis), keepdims=keepdim), _t(x))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.any(a, axis=_ax(axis), keepdims=keepdim), _t(x))
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jax.scipy.special.logsumexp(a, axis=_ax(axis), keepdims=keepdim),
+        _t(x),
+    )
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.count_nonzero(a, axis=_ax(axis), keepdims=keepdim).astype(np.int64),
+        _t(x),
+    )
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype)
+
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = int(axis)
+        if d is not None:
+            a = a.astype(d)
+        return jnp.cumsum(a, axis=ax)
+
+    return apply_op(f, _t(x))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype)
+
+    def f(a):
+        if d is not None:
+            a = a.astype(d)
+        return jnp.cumprod(a, axis=int(dim) if dim is not None else None)
+
+    return apply_op(f, _t(x))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        ax = 0 if axis is None else int(axis)
+        if axis is None:
+            a = a.reshape(-1)
+        vals = jax.lax.associative_scan(jnp.maximum, a, axis=ax)
+        return vals
+
+    vals = apply_op(f, _t(x))
+    arr = _t(x).numpy() if not isinstance(x, Tensor) else x.numpy()
+    if axis is None:
+        arr = arr.reshape(-1)
+        ax = 0
+    else:
+        ax = int(axis)
+    run = np.maximum.accumulate(arr, axis=ax)
+    idx = np.where(arr == run, np.arange(arr.shape[ax]).reshape([-1 if i == (ax % arr.ndim) else 1 for i in range(arr.ndim)]), 0)
+    idx = np.maximum.accumulate(idx, axis=ax)
+    from ..core.tensor import wrap_raw
+
+    return vals, wrap_raw(jnp.asarray(idx, dtype=np.int64))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        ax = 0 if axis is None else int(axis)
+        if axis is None:
+            a = a.reshape(-1)
+        return jax.lax.associative_scan(jnp.minimum, a, axis=ax)
+
+    vals = apply_op(f, _t(x))
+    arr = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        ax = 0
+    else:
+        ax = int(axis)
+    run = np.minimum.accumulate(arr, axis=ax)
+    idx = np.where(arr == run, np.arange(arr.shape[ax]).reshape([-1 if i == (ax % arr.ndim) else 1 for i in range(arr.ndim)]), 0)
+    idx = np.maximum.accumulate(idx, axis=ax)
+    from ..core.tensor import wrap_raw
+
+    return vals, wrap_raw(jnp.asarray(idx, dtype=np.int64))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [_t(x)]
+    pre = prepend if isinstance(prepend, Tensor) else None
+    app = append if isinstance(append, Tensor) else None
+
+    def f(a, *extra):
+        i = 0
+        p = None
+        ap = None
+        if pre is not None:
+            p = extra[i]
+            i += 1
+        if app is not None:
+            ap = extra[i]
+        return jnp.diff(a, n=n, axis=axis, prepend=p, append=ap)
+
+    if pre is not None:
+        args.append(pre)
+    if app is not None:
+        args.append(app)
+    return apply_op(f, *args)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), _t(x))
+
+
+# -- matmul family ----------------------------------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        from ..amp.auto_cast import maybe_cast_inputs
+
+        a, b = maybe_cast_inputs("matmul", a, b)
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply_op(f, _t(x), _t(y))
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return apply_op(jnp.matmul, _t(x), _t(y))
+
+
+def inner(x, y, name=None):
+    return apply_op(jnp.inner, _t(x), _t(y))
+
+
+def outer(x, y, name=None):
+    return apply_op(lambda a, b: jnp.outer(a, b), _t(x), _t(y))
+
+
+def dot(x, y, name=None):
+    return apply_op(lambda a, b: jnp.sum(a * b, axis=-1), _t(x), _t(y))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(
+        lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), _t(input), _t(x), _t(y)
+    )
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    tensors = [_t(i) for i in inputs]
+
+    def f(*xs):
+        out = xs[0]
+        for v in xs[1:]:
+            out = out + v
+        return out
+
+    return apply_op(f, *tensors)
+
+
+def multiply_(x, y):
+    new = _binop(jnp.multiply, x, y)
+    x._rebind(new)
+    return x
+
+
+def log_softmax_(x, axis=-1):
+    new = apply_op(lambda a: jax.nn.log_softmax(a, axis=axis), _t(x))
+    if isinstance(x, Tensor):
+        x._rebind(new)
+        return x
+    return new
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

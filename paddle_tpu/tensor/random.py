@@ -1,0 +1,146 @@
+"""Random sampling ops — parity with python/paddle/tensor/random.py.
+
+Stateful API surface over functional JAX PRNG: each call draws a fresh subkey
+from the process generator (paddle_tpu.core.rng), so eager behavior matches
+the reference's stateful generators while staged code can use the pure
+``*_p`` helpers with explicit keys.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core import rng as rng_mod
+from ..core.tensor import Tensor, to_tensor, wrap_raw
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "uniform", "normal",
+    "standard_normal", "randperm", "bernoulli", "multinomial", "poisson",
+    "uniform_", "normal_", "exponential_",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    return d if d is not None else dtype_mod.get_default_dtype()
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    key = rng_mod.next_key()
+    return wrap_raw(jax.random.normal(key, _shape(shape), dtype=_dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            np.shape(m) if not hasattr(m, "shape") else m.shape,
+            np.shape(s) if not hasattr(s, "shape") else s.shape,
+        )
+        key = rng_mod.next_key()
+        return wrap_raw(
+            jax.random.normal(key, shp, dtype=dtype_mod.get_default_dtype()) * s + m
+        )
+    shp = _shape(shape) if shape is not None else ()
+    key = rng_mod.next_key()
+    out = jax.random.normal(key, shp, dtype=dtype_mod.get_default_dtype()) * std + mean
+    return wrap_raw(out)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else rng_mod.next_key()
+    return wrap_raw(
+        jax.random.uniform(key, _shape(shape), dtype=_dt(dtype), minval=min, maxval=max)
+    )
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = dtype_mod.convert_dtype(dtype) or np.dtype(np.int64)
+    key = rng_mod.next_key()
+    return wrap_raw(jax.random.randint(key, _shape(shape), low, high, dtype=d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) or x.dtype
+    return randint(low, high, tuple(x.shape), d)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = rng_mod.next_key()
+    return wrap_raw(
+        jax.random.permutation(key, jnp.arange(n, dtype=dtype_mod.convert_dtype(dtype)))
+    )
+
+
+def bernoulli(x, name=None):
+    key = rng_mod.next_key()
+    p = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return wrap_raw(
+        jax.random.bernoulli(key, p.astype(np.float32), p.shape).astype(p.dtype)
+    )
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    p = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    key = rng_mod.next_key()
+    logits = jnp.log(jnp.clip(p, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1, shape=(
+            (num_samples,) + p.shape[:-1] if p.ndim > 1 else (num_samples,)
+        ))
+        out = jnp.moveaxis(out, 0, -1) if p.ndim > 1 else out
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, p.shape, dtype=logits.dtype)
+        out = jnp.argsort(-(logits + g), axis=-1)[..., :num_samples]
+    return wrap_raw(out.astype(np.int64))
+
+
+def poisson(x, name=None):
+    p = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    key = rng_mod.next_key()
+    return wrap_raw(jax.random.poisson(key, p, dtype=np.int64).astype(p.dtype))
+
+
+# -- in-place variants (mutate the wrapper, imperative-style) ----------------
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._value = jax.random.uniform(
+        rng_mod.next_key(), tuple(x.shape), dtype=x._value.dtype, minval=min, maxval=max
+    )
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._value = (
+        jax.random.normal(rng_mod.next_key(), tuple(x.shape), dtype=x._value.dtype) * std
+        + mean
+    )
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._value = jax.random.exponential(
+        rng_mod.next_key(), tuple(x.shape), dtype=x._value.dtype
+    ) / lam
+    return x

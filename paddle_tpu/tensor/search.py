@@ -1,0 +1,122 @@
+"""Search/sort ops — parity with python/paddle/tensor/search.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply_op, to_tensor, wrap_raw
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "searchsorted", "kthvalue",
+    "mode", "index_sample", "masked_select", "where", "nonzero",
+]
+
+from .manipulation import index_sample, masked_select, nonzero, where  # re-export
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        if axis is None:
+            out = jnp.argmax(a.reshape(-1))
+            return out.reshape((1,) * a.ndim) if keepdim else out
+        out = jnp.argmax(a, axis=int(axis))
+        return jnp.expand_dims(out, int(axis)) if keepdim else out
+
+    return apply_op(lambda a: f(a).astype(np.int64), _t(x))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        if axis is None:
+            out = jnp.argmin(a.reshape(-1))
+            return out.reshape((1,) * a.ndim) if keepdim else out
+        out = jnp.argmin(a, axis=int(axis))
+        return jnp.expand_dims(out, int(axis)) if keepdim else out
+
+    return apply_op(lambda a: f(a).astype(np.int64), _t(x))
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, descending=descending, stable=True)
+        return idx.astype(np.int64)
+
+    return apply_op(f, _t(x))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        out = jnp.sort(a, axis=axis, stable=True, descending=descending)
+        return out
+
+    return apply_op(f, _t(x))
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    x = _t(x)
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+    ax = -1 if axis is None else int(axis)
+
+    def f(a):
+        a_m = jnp.moveaxis(a, ax, -1)
+        vals, idx = jax.lax.top_k(a_m if largest else -a_m, kk)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(np.int64), -1, ax)
+
+    return apply_op(f, x, multi_out=True)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def f(seq, v):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jax.vmap(lambda s, q: jnp.searchsorted(s, q, side=side))(
+                seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape)
+        return out.astype(np.int32 if out_int32 else np.int64)
+
+    return apply_op(f, _t(sorted_sequence).detach(), _t(values).detach())
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        a_m = jnp.moveaxis(a, axis, -1)
+        s = jnp.sort(a_m, axis=-1)
+        si = jnp.argsort(a_m, axis=-1, stable=True)
+        vals = s[..., k - 1]
+        idx = si[..., k - 1].astype(np.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+
+    return apply_op(f, _t(x), multi_out=True)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    arr = _t(x).numpy()
+    arr_m = np.moveaxis(arr, axis, -1)
+    flat = arr_m.reshape(-1, arr_m.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=arr.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        # ties resolve to the largest value (uniq is sorted ascending)
+        best = uniq[len(counts) - 1 - np.argmax(counts[::-1])]
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    shape = arr_m.shape[:-1]
+    v = vals.reshape(shape)
+    ix = idxs.reshape(shape)
+    if keepdim:
+        v = np.expand_dims(v, axis)
+        ix = np.expand_dims(ix, axis)
+    return wrap_raw(jnp.asarray(v)), wrap_raw(jnp.asarray(ix))

@@ -1,0 +1,79 @@
+"""Statistics ops — parity with python/paddle/tensor/stat.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply_op, to_tensor
+
+__all__ = ["mean", "std", "var", "median", "nanmedian", "quantile", "nanquantile", "numel"]
+
+from .creation import numel  # re-export
+from .math import mean  # re-export
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(v) for v in axis)
+    return int(axis)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.std(a, axis=_ax(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        _t(x),
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.var(a, axis=_ax(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        _t(x),
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def f(a):
+        if mode == "min" and axis is not None:
+            # paddle mode='min': lower of the two middle values
+            sorted_a = jnp.sort(a, axis=axis)
+            n = a.shape[axis]
+            idx = (n - 1) // 2
+            out = jnp.take(sorted_a, idx, axis=axis)
+            return jnp.expand_dims(out, axis) if keepdim else out
+        return jnp.median(a, axis=_ax(axis), keepdims=keepdim)
+
+    return apply_op(f, _t(x))
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.nanmedian(a, axis=_ax(axis), keepdims=keepdim), _t(x))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qq = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+
+    def f(a):
+        return jnp.quantile(
+            a.astype(jnp.float64 if a.dtype == np.float64 else jnp.float32),
+            qq, axis=_ax(axis), keepdims=keepdim, method=interpolation,
+        )
+
+    return apply_op(f, _t(x))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qq = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+
+    def f(a):
+        return jnp.nanquantile(
+            a.astype(jnp.float32), qq, axis=_ax(axis), keepdims=keepdim, method=interpolation
+        )
+
+    return apply_op(f, _t(x))

@@ -1,0 +1,26 @@
+"""paddle_tpu.utils — profiler, unique_name, deprecated shims (parity
+python/paddle/utils/)."""
+from . import profiler  # noqa: F401
+from . import unique_name  # noqa: F401
+from . import download  # noqa: F401
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or f"module {module_name} is required") from e
+
+
+def run_check():
+    """Parity with paddle.utils.run_check: verify the device works."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((8, 8))
+    y = (x @ x).block_until_ready()
+    dev = jax.devices()[0]
+    print(f"paddle_tpu works on {dev.platform}:{dev.id} ({dev.device_kind})")
+    return True

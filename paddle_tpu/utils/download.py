@@ -1,0 +1,22 @@
+"""Download shim — the build environment has zero egress; files must exist
+locally (parity surface for python/paddle/utils/download.py)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    cand = os.path.join(
+        os.path.expanduser("~/.cache/paddle_tpu/weights"), os.path.basename(url)
+    )
+    if os.path.exists(cand):
+        return cand
+    raise RuntimeError(
+        f"no network access in this environment; place the file at {cand} "
+        f"manually (wanted {url})"
+    )
+
+
+get_path_from_url = get_weights_path_from_url

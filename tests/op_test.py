@@ -1,0 +1,52 @@
+"""OpTest-style helpers — the reference's test pyramid base
+(tests/unittests/op_test.py:255,1061,1372): ops are validated against numpy
+golden outputs, and analytic gradients against numeric finite differences.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def check_output(fn, np_fn, inputs, rtol=1e-5, atol=1e-6):
+    """fn: paddle op over Tensors; np_fn: numpy reference over ndarrays."""
+    tensors = [paddle.to_tensor(x) for x in inputs]
+    out = fn(*tensors)
+    ref = np_fn(*inputs)
+    if isinstance(out, (tuple, list)):
+        for o, r in zip(out, ref):
+            np.testing.assert_allclose(o.numpy(), r, rtol=rtol, atol=atol)
+    else:
+        np.testing.assert_allclose(out.numpy(), ref, rtol=rtol, atol=atol)
+    return out
+
+
+def check_grad(fn, inputs, grad_index=0, eps=1e-3, rtol=1e-2, atol=1e-3,
+               reduce_to_scalar=True):
+    """Analytic (tape) gradient vs central finite differences."""
+    tensors = [paddle.to_tensor(x, stop_gradient=False) for x in inputs]
+    out = fn(*tensors)
+    loss = out.sum() if reduce_to_scalar else out
+    loss.backward()
+    analytic = tensors[grad_index].grad.numpy()
+
+    x0 = np.asarray(inputs[grad_index], np.float64)
+    numeric = np.zeros_like(x0)
+    flat = x0.reshape(-1)
+    num_flat = numeric.reshape(-1)
+    for i in range(flat.size):
+        xp = flat.copy()
+        xp[i] += eps
+        xm = flat.copy()
+        xm[i] -= eps
+        args_p = list(inputs)
+        args_p[grad_index] = xp.reshape(x0.shape).astype(inputs[grad_index].dtype)
+        args_m = list(inputs)
+        args_m[grad_index] = xm.reshape(x0.shape).astype(inputs[grad_index].dtype)
+        with paddle.no_grad():
+            fp = float(fn(*[paddle.to_tensor(a) for a in args_p]).sum().numpy())
+            fm = float(fn(*[paddle.to_tensor(a) for a in args_m]).sum().numpy())
+        num_flat[i] = (fp - fm) / (2 * eps)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)

@@ -1,0 +1,322 @@
+"""nn layer tests — golden numpy comparisons + Layer system behavior
+(reference: tests/unittests/test_layers.py, test_imperative_* suites)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def _rand(*shape):
+    return np.random.rand(*shape).astype(np.float32)
+
+
+class TestLayerSystem:
+    def test_parameters_and_state_dict(self):
+        l = nn.Linear(4, 3)
+        names = [n for n, _ in l.named_parameters()]
+        assert names == ["weight", "bias"]
+        sd = l.state_dict()
+        assert set(sd) == {"weight", "bias"}
+
+    def test_nested_state_dict(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 4)
+                self.fc2 = nn.Linear(4, 2)
+
+            def forward(self, x):
+                return self.fc2(self.fc1(x))
+
+        net = Net()
+        sd = net.state_dict()
+        assert set(sd) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+        # round trip
+        net2 = Net()
+        net2.set_state_dict(sd)
+        np.testing.assert_array_equal(net2.fc1.weight.numpy(), net.fc1.weight.numpy())
+
+    def test_train_eval_mode(self):
+        net = nn.Sequential(nn.Linear(3, 3), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_forward_hooks(self):
+        l = nn.Linear(2, 2)
+        calls = []
+        h = l.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        l(paddle.ones([1, 2]))
+        assert calls
+        h.remove()
+        l(paddle.ones([1, 2]))
+        assert len(calls) == 1
+
+    def test_apply_and_children(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.ReLU())
+        seen = []
+        net.apply(lambda l: seen.append(type(l).__name__))
+        assert "Linear" in seen and "Sequential" in seen
+
+    def test_astype(self):
+        l = nn.Linear(2, 2)
+        l.astype("bfloat16")
+        assert l.weight.dtype == paddle.bfloat16
+
+
+class TestBasicLayers:
+    def test_linear_golden(self):
+        l = nn.Linear(4, 3)
+        x = _rand(2, 4)
+        ref = x @ l.weight.numpy() + l.bias.numpy()
+        np.testing.assert_allclose(l(paddle.to_tensor(x)).numpy(), ref, rtol=1e-5)
+
+    def test_embedding(self):
+        e = nn.Embedding(10, 4)
+        idx = np.array([[1, 2], [3, 4]])
+        out = e(paddle.to_tensor(idx))
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy(), e.weight.numpy()[idx], rtol=1e-6)
+
+    def test_embedding_grad_rowwise(self):
+        e = nn.Embedding(5, 3)
+        idx = paddle.to_tensor(np.array([0, 0, 2]))
+        e(idx).sum().backward()
+        g = e.weight.grad.numpy()
+        assert g[0].sum() == pytest.approx(6.0)  # row 0 hit twice
+        assert g[1].sum() == 0
+
+    def test_conv2d_golden_vs_scipy(self):
+        from scipy.signal import correlate2d
+
+        conv = nn.Conv2D(1, 1, 3, padding=0, bias_attr=False)
+        x = _rand(1, 1, 6, 6)
+        w = conv.weight.numpy()[0, 0]
+        ref = correlate2d(x[0, 0], w, mode="valid")
+        out = conv(paddle.to_tensor(x)).numpy()[0, 0]
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_shapes(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        assert conv(paddle.to_tensor(_rand(2, 3, 8, 8))).shape == [2, 8, 4, 4]
+
+    def test_conv2d_groups(self):
+        conv = nn.Conv2D(4, 4, 3, padding=1, groups=4)
+        assert conv(paddle.to_tensor(_rand(1, 4, 5, 5))).shape == [1, 4, 5, 5]
+
+    def test_conv_transpose_shape(self):
+        deconv = nn.Conv2DTranspose(4, 2, 2, stride=2)
+        assert deconv(paddle.to_tensor(_rand(1, 4, 3, 3))).shape == [1, 2, 6, 6]
+
+    def test_conv_transpose_inverts_conv_shape(self):
+        x = _rand(1, 1, 4, 4)
+        out = F.conv2d_transpose(
+            paddle.to_tensor(x), paddle.to_tensor(_rand(1, 1, 3, 3)),
+            stride=1, padding=0,
+        )
+        assert out.shape == [1, 1, 6, 6]
+
+    def test_maxpool_avgpool(self):
+        x = _rand(1, 1, 4, 4)
+        mp = F.max_pool2d(paddle.to_tensor(x), 2, 2).numpy()
+        ref = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(mp, ref)
+        ap = F.avg_pool2d(paddle.to_tensor(x), 2, 2).numpy()
+        np.testing.assert_allclose(ap, x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5)),
+                                   rtol=1e-6)
+
+    def test_adaptive_pool(self):
+        x = _rand(1, 2, 7, 7)
+        out = F.adaptive_avg_pool2d(paddle.to_tensor(x), 1)
+        np.testing.assert_allclose(
+            out.numpy().reshape(2), x.mean(axis=(2, 3)).reshape(2), rtol=1e-5
+        )
+
+    def test_batchnorm_train_normalizes(self):
+        bn = nn.BatchNorm2D(3)
+        x = _rand(8, 3, 4, 4) * 5 + 2
+        out = bn(paddle.to_tensor(x)).numpy()
+        assert abs(out.mean()) < 1e-4
+        assert abs(out.std() - 1.0) < 1e-2
+        # running stats updated
+        assert not np.allclose(bn._mean.numpy(), 0)
+
+    def test_batchnorm_eval_uses_running(self):
+        bn = nn.BatchNorm1D(4)
+        bn.eval()
+        x = _rand(10, 4)
+        out = bn(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, x / np.sqrt(1 + 1e-5), rtol=1e-4)
+
+    def test_layernorm_golden(self):
+        ln = nn.LayerNorm(6)
+        x = _rand(3, 6)
+        ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(ln(paddle.to_tensor(x)).numpy(), ref, rtol=1e-4)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        out = gn(paddle.to_tensor(_rand(2, 4, 3, 3)))
+        assert out.shape == [2, 4, 3, 3]
+
+    def test_dropout_train_eval(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([1000])
+        train_out = d(x).numpy()
+        assert (train_out == 0).sum() > 300
+        np.testing.assert_allclose(train_out.mean(), 1.0, rtol=0.15)
+        d.eval()
+        np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+    def test_activations_golden(self):
+        x = _rand(4, 4) * 2 - 1
+        np.testing.assert_allclose(F.relu(paddle.to_tensor(x)).numpy(),
+                                   np.maximum(x, 0), rtol=1e-6)
+        np.testing.assert_allclose(
+            F.sigmoid(paddle.to_tensor(x)).numpy(), 1 / (1 + np.exp(-x)), rtol=1e-5
+        )
+        sm = F.softmax(paddle.to_tensor(x), axis=-1).numpy()
+        e = np.exp(x - x.max(-1, keepdims=True))
+        np.testing.assert_allclose(sm, e / e.sum(-1, keepdims=True), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.leaky_relu(paddle.to_tensor(x), 0.1).numpy(),
+            np.where(x > 0, x, 0.1 * x), rtol=1e-5,
+        )
+
+
+class TestLosses:
+    def test_cross_entropy_golden(self):
+        logits = _rand(4, 5)
+        labels = np.array([0, 2, 4, 1])
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_cross_entropy_2d_label(self):
+        logits = _rand(4, 5)
+        labels = np.array([[0], [2], [4], [1]])
+        out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        assert out.shape == []or out.shape == [1]
+
+    def test_cross_entropy_soft_label(self):
+        logits = _rand(3, 4)
+        soft = np.full((3, 4), 0.25, np.float32)
+        out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(soft),
+                              soft_label=True)
+        logp = np.log(np.exp(logits - logits.max(-1, keepdims=True)) /
+                      np.exp(logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True))
+        np.testing.assert_allclose(out.numpy(), (-(soft * logp).sum(-1)).mean(), rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = _rand(4, 5)
+        labels = np.array([0, -100, 2, -100])
+        out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                              ignore_index=-100)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[[0, 2], [0, 2]]).mean()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_mse_l1(self):
+        x, y = _rand(3, 4), _rand(3, 4)
+        np.testing.assert_allclose(
+            F.mse_loss(paddle.to_tensor(x), paddle.to_tensor(y)).numpy(),
+            ((x - y) ** 2).mean(), rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            F.l1_loss(paddle.to_tensor(x), paddle.to_tensor(y)).numpy(),
+            np.abs(x - y).mean(), rtol=1e-5,
+        )
+
+    def test_bce_with_logits(self):
+        z, t = _rand(4) * 2 - 1, (np.random.rand(4) > 0.5).astype(np.float32)
+        ref = np.mean(np.maximum(z, 0) - z * t + np.log1p(np.exp(-np.abs(z))))
+        out = F.binary_cross_entropy_with_logits(paddle.to_tensor(z), paddle.to_tensor(t))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_kl_smooth_nll(self):
+        logp = np.log(np.full((2, 3), 1 / 3, np.float32))
+        t = np.array([[0.2, 0.3, 0.5], [0.1, 0.8, 0.1]], np.float32)
+        out = F.kl_div(paddle.to_tensor(logp), paddle.to_tensor(t), reduction="sum")
+        ref = (t * (np.log(t) - logp)).sum()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4)
+
+
+class TestRNN:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(8, 16, num_layers=2)
+        x = paddle.to_tensor(_rand(4, 5, 8))  # [batch, time, feat]
+        y, (h, c) = lstm(x)
+        assert y.shape == [4, 5, 16]
+        assert h.shape == [2, 4, 16]
+        assert c.shape == [2, 4, 16]
+
+    def test_bilstm(self):
+        lstm = nn.LSTM(8, 16, direction="bidirect")
+        y, (h, c) = lstm(paddle.to_tensor(_rand(2, 5, 8)))
+        assert y.shape == [2, 5, 32]
+        assert h.shape == [2, 2, 16]
+
+    def test_gru_simple(self):
+        gru = nn.GRU(4, 8)
+        y, h = gru(paddle.to_tensor(_rand(2, 3, 4)))
+        assert y.shape == [2, 3, 8]
+        assert h.shape == [1, 2, 8]
+        rnn = nn.SimpleRNN(4, 8)
+        y, h = rnn(paddle.to_tensor(_rand(2, 3, 4)))
+        assert y.shape == [2, 3, 8]
+
+    def test_lstm_grad_flows(self):
+        lstm = nn.LSTM(4, 6)
+        x = paddle.to_tensor(_rand(2, 3, 4), stop_gradient=False)
+        y, _ = lstm(x)
+        y.sum().backward()
+        assert x.grad is not None
+        assert lstm.weight_ih_l0.grad is not None
+
+    def test_lstmcell_matches_lstm_single_step(self):
+        cell = nn.LSTMCell(4, 6)
+        x = _rand(2, 4)
+        out, (h, c) = cell(paddle.to_tensor(x))
+        assert out.shape == [2, 6]
+
+
+class TestTransformer:
+    def test_mha_shapes(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(_rand(2, 5, 16))
+        out = mha(x, x, x)
+        assert out.shape == [2, 5, 16]
+
+    def test_mha_mask(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        x = paddle.to_tensor(_rand(1, 4, 8))
+        mask = paddle.to_tensor(np.triu(np.full((4, 4), -1e9, np.float32), 1))
+        out = mha(x, x, x, attn_mask=mask)
+        assert out.shape == [1, 4, 8]
+
+    def test_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        out = enc(paddle.to_tensor(_rand(2, 6, 16)))
+        assert out.shape == [2, 6, 16]
+
+    def test_full_transformer(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32,
+                               dropout=0.0)
+        src = paddle.to_tensor(_rand(2, 5, 16))
+        tgt = paddle.to_tensor(_rand(2, 3, 16))
+        out = model(src, tgt)
+        assert out.shape == [2, 3, 16]
+
+    def test_encoder_grad(self):
+        layer = nn.TransformerEncoderLayer(8, 2, 16, dropout=0.0)
+        x = paddle.to_tensor(_rand(1, 4, 8), stop_gradient=False)
+        layer(x).sum().backward()
+        assert x.grad is not None

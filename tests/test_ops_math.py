@@ -1,0 +1,284 @@
+"""Math/manipulation op golden tests vs numpy (+ numeric grad spot checks) —
+OpTest pattern (op_test.py:255,1061,1372)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+
+def _rand(*shape):
+    return np.random.rand(*shape).astype(np.float32)
+
+
+class TestElementwise:
+    @pytest.mark.parametrize("op,np_op", [
+        ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+        ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+    ])
+    def test_binary(self, op, np_op):
+        x, y = _rand(3, 4) + 0.5, _rand(3, 4) + 0.5
+        check_output(getattr(paddle, op), np_op, [x, y])
+
+    @pytest.mark.parametrize("op,np_op", [
+        ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt), ("tanh", np.tanh),
+        ("sin", np.sin), ("cos", np.cos), ("abs", np.abs), ("floor", np.floor),
+        ("ceil", np.ceil), ("square", np.square), ("log1p", np.log1p),
+        ("expm1", np.expm1),
+    ])
+    def test_unary(self, op, np_op):
+        x = _rand(4, 5) + 0.5
+        check_output(getattr(paddle, op), np_op, [x])
+
+    def test_broadcast(self):
+        check_output(paddle.add, np.add, [_rand(3, 1, 5), _rand(4, 1)])
+
+    def test_grad_mul(self):
+        check_grad(lambda a, b: a * b, [_rand(3, 3), _rand(3, 3)])
+
+    def test_grad_exp(self):
+        check_grad(paddle.exp, [_rand(2, 3)])
+
+    def test_grad_tanh(self):
+        check_grad(paddle.tanh, [_rand(2, 3)])
+
+    def test_pow(self):
+        check_output(lambda x: paddle.pow(x, 3.0), lambda x: x**3, [_rand(3, 3) + 1])
+
+    def test_clip(self):
+        check_output(lambda x: paddle.clip(x, 0.3, 0.7),
+                     lambda x: np.clip(x, 0.3, 0.7), [_rand(4, 4)])
+
+    def test_rsqrt(self):
+        check_output(paddle.rsqrt, lambda x: 1 / np.sqrt(x), [_rand(3) + 0.5])
+
+
+class TestReductions:
+    def test_sum_axis(self):
+        check_output(lambda x: paddle.sum(x, axis=1), lambda x: x.sum(1), [_rand(3, 4)])
+
+    def test_sum_keepdim(self):
+        check_output(lambda x: paddle.sum(x, axis=0, keepdim=True),
+                     lambda x: x.sum(0, keepdims=True), [_rand(3, 4)])
+
+    def test_mean_all(self):
+        check_output(paddle.mean, np.mean, [_rand(5, 5)])
+
+    def test_max_min_prod(self):
+        x = _rand(3, 4)
+        check_output(lambda t: paddle.max(t, axis=1), lambda a: a.max(1), [x])
+        check_output(lambda t: paddle.min(t, axis=0), lambda a: a.min(0), [x])
+        check_output(lambda t: paddle.prod(t, axis=1), lambda a: a.prod(1), [x])
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp
+
+        check_output(lambda t: paddle.logsumexp(t, axis=1),
+                     lambda a: logsumexp(a, axis=1), [_rand(3, 4)])
+
+    def test_cumsum(self):
+        check_output(lambda t: paddle.cumsum(t, axis=1),
+                     lambda a: np.cumsum(a, 1), [_rand(3, 4)])
+
+    def test_grad_mean(self):
+        check_grad(paddle.mean, [_rand(3, 3)])
+
+    def test_std_var(self):
+        x = _rand(4, 5)
+        check_output(lambda t: paddle.std(t, axis=1),
+                     lambda a: a.std(1, ddof=1), [x], rtol=1e-4)
+        check_output(lambda t: paddle.var(t, axis=1),
+                     lambda a: a.var(1, ddof=1), [x], rtol=1e-4)
+
+
+class TestMatmul:
+    def test_matmul_2d(self):
+        check_output(paddle.matmul, np.matmul, [_rand(3, 4), _rand(4, 5)])
+
+    def test_matmul_batched(self):
+        check_output(paddle.matmul, np.matmul, [_rand(2, 3, 4), _rand(2, 4, 5)])
+
+    def test_matmul_transpose(self):
+        x, y = _rand(4, 3), _rand(4, 5)
+        check_output(lambda a, b: paddle.matmul(a, b, transpose_x=True),
+                     lambda a, b: a.T @ b, [x, y])
+
+    def test_grad(self):
+        check_grad(paddle.matmul, [_rand(3, 4), _rand(4, 2)], grad_index=0)
+        check_grad(paddle.matmul, [_rand(3, 4), _rand(4, 2)], grad_index=1)
+
+    def test_einsum(self):
+        check_output(lambda a, b: paddle.einsum("ij,jk->ik", a, b),
+                     lambda a, b: a @ b, [_rand(3, 4), _rand(4, 5)])
+
+
+class TestManipulation:
+    def test_reshape(self):
+        check_output(lambda x: paddle.reshape(x, [4, 3]),
+                     lambda a: a.reshape(4, 3), [_rand(3, 4)])
+
+    def test_transpose(self):
+        check_output(lambda x: paddle.transpose(x, [1, 0, 2]),
+                     lambda a: a.transpose(1, 0, 2), [_rand(2, 3, 4)])
+
+    def test_concat_stack(self):
+        x, y = _rand(2, 3), _rand(2, 3)
+        out = paddle.concat([paddle.to_tensor(x), paddle.to_tensor(y)], axis=0)
+        np.testing.assert_allclose(out.numpy(), np.concatenate([x, y], 0))
+        out = paddle.stack([paddle.to_tensor(x), paddle.to_tensor(y)], axis=1)
+        np.testing.assert_allclose(out.numpy(), np.stack([x, y], 1))
+
+    def test_split(self):
+        x = _rand(6, 4)
+        parts = paddle.split(paddle.to_tensor(x), 3, axis=0)
+        assert len(parts) == 3
+        np.testing.assert_allclose(parts[1].numpy(), x[2:4])
+        parts = paddle.split(paddle.to_tensor(x), [1, 2, -1], axis=0)
+        assert parts[2].shape == [3, 4]
+
+    def test_squeeze_unsqueeze(self):
+        x = _rand(1, 3, 1, 4)
+        assert paddle.squeeze(paddle.to_tensor(x)).shape == [3, 4]
+        assert paddle.unsqueeze(paddle.to_tensor(_rand(3)), 0).shape == [1, 3]
+
+    def test_gather(self):
+        x = _rand(5, 3)
+        idx = np.array([0, 2, 4])
+        out = paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx), axis=0)
+        np.testing.assert_allclose(out.numpy(), x[idx])
+
+    def test_where(self):
+        c = np.array([True, False, True])
+        x, y = _rand(3), _rand(3)
+        out = paddle.where(paddle.to_tensor(c), paddle.to_tensor(x), paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), np.where(c, x, y))
+
+    def test_tile_expand(self):
+        x = _rand(1, 3)
+        assert paddle.tile(paddle.to_tensor(x), [2, 2]).shape == [2, 6]
+        assert paddle.expand(paddle.to_tensor(x), [4, 3]).shape == [4, 3]
+
+    def test_flip_roll(self):
+        x = _rand(3, 4)
+        np.testing.assert_allclose(
+            paddle.flip(paddle.to_tensor(x), [0]).numpy(), x[::-1]
+        )
+        np.testing.assert_allclose(
+            paddle.roll(paddle.to_tensor(x), 1).numpy(), np.roll(x, 1)
+        )
+
+    def test_pad(self):
+        x = _rand(2, 3, 4, 4)
+        out = paddle.nn.functional.pad(paddle.to_tensor(x), [1, 1, 2, 2])
+        assert out.shape == [2, 3, 8, 6]
+
+    def test_take_along_axis(self):
+        x = _rand(3, 5)
+        idx = np.argsort(x, axis=1)[:, :2]
+        out = paddle.take_along_axis(paddle.to_tensor(x), paddle.to_tensor(idx), 1)
+        np.testing.assert_allclose(out.numpy(), np.take_along_axis(x, idx, 1))
+
+    def test_grad_through_reshape_slice(self):
+        x = paddle.to_tensor(_rand(4, 4), stop_gradient=False)
+        y = paddle.reshape(x, [16])[:8].sum()
+        y.backward()
+        expected = np.zeros(16, np.float32)
+        expected[:8] = 1
+        np.testing.assert_allclose(x.grad.numpy().reshape(-1), expected)
+
+
+class TestSearchSort:
+    def test_argmax_argmin(self):
+        x = _rand(3, 5)
+        assert (paddle.argmax(paddle.to_tensor(x), axis=1).numpy() == x.argmax(1)).all()
+        assert (paddle.argmin(paddle.to_tensor(x), axis=0).numpy() == x.argmin(0)).all()
+
+    def test_sort_argsort(self):
+        x = _rand(4, 6)
+        np.testing.assert_allclose(
+            paddle.sort(paddle.to_tensor(x), axis=1).numpy(), np.sort(x, 1)
+        )
+        assert (
+            paddle.argsort(paddle.to_tensor(x), axis=1).numpy() == np.argsort(x, 1, kind="stable")
+        ).all()
+
+    def test_topk(self):
+        x = _rand(3, 10)
+        vals, idx = paddle.topk(paddle.to_tensor(x), 3, axis=1)
+        ref = np.sort(x, 1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+    def test_nonzero_unique(self):
+        x = np.array([0, 1, 0, 2, 2])
+        nz = paddle.nonzero(paddle.to_tensor(x))
+        np.testing.assert_array_equal(nz.numpy().reshape(-1), [1, 3, 4])
+        u = paddle.unique(paddle.to_tensor(x))
+        np.testing.assert_array_equal(u.numpy(), [0, 1, 2])
+
+
+class TestLinalg:
+    def test_norm(self):
+        x = _rand(3, 4)
+        np.testing.assert_allclose(
+            paddle.norm(paddle.to_tensor(x)).numpy(), np.linalg.norm(x), rtol=1e-5
+        )
+
+    def test_inv_det_solve(self):
+        x = _rand(3, 3) + np.eye(3, dtype=np.float32) * 3
+        np.testing.assert_allclose(
+            paddle.linalg.inv(paddle.to_tensor(x)).numpy(), np.linalg.inv(x), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            paddle.linalg.det(paddle.to_tensor(x)).numpy(), np.linalg.det(x), rtol=1e-4
+        )
+        b = _rand(3, 2)
+        np.testing.assert_allclose(
+            paddle.linalg.solve(paddle.to_tensor(x), paddle.to_tensor(b)).numpy(),
+            np.linalg.solve(x, b), rtol=1e-4,
+        )
+
+    def test_cholesky_qr_svd(self):
+        a = _rand(4, 4)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        l = paddle.linalg.cholesky(paddle.to_tensor(spd)).numpy()
+        np.testing.assert_allclose(l @ l.T, spd, rtol=1e-4, atol=1e-4)
+        q, r = paddle.linalg.qr(paddle.to_tensor(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-4, atol=1e-4)
+        u, s, vt = paddle.linalg.svd(paddle.to_tensor(a))
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()) @ vt.numpy(), a, rtol=1e-4, atol=1e-4
+        )
+
+
+class TestRandomCreation:
+    def test_shapes_and_ranges(self):
+        assert paddle.rand([3, 4]).shape == [3, 4]
+        assert paddle.randn([2, 2]).shape == [2, 2]
+        r = paddle.randint(0, 10, [100])
+        assert r.dtype == np.int64
+        assert (r.numpy() >= 0).all() and (r.numpy() < 10).all()
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+
+    def test_seed_determinism(self):
+        paddle.seed(7)
+        a = paddle.rand([5]).numpy()
+        paddle.seed(7)
+        b = paddle.rand([5]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_creation(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        assert paddle.arange(5).dtype == np.int64
+        np.testing.assert_allclose(
+            paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6
+        )
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+        np.testing.assert_array_equal(
+            paddle.full([2, 2], 7).numpy(), np.full((2, 2), 7)
+        )
+        x = paddle.ones([2, 3])
+        assert paddle.zeros_like(x).shape == [2, 3]
+        np.testing.assert_array_equal(
+            paddle.tril(paddle.ones([3, 3])).numpy(), np.tril(np.ones((3, 3)))
+        )

@@ -1,0 +1,159 @@
+"""Core Tensor + autograd tests (reference pattern: imperative basics,
+tests/unittests/test_var_base.py / test_imperative_basic.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+class TestTensorBasics:
+    def test_to_tensor_scalars(self):
+        t = paddle.to_tensor(3)
+        assert t.dtype == np.int64
+        t = paddle.to_tensor(3.5)
+        assert t.dtype == np.float32
+        assert t.item() == pytest.approx(3.5)
+
+    def test_to_tensor_numpy_keeps_dtype(self):
+        x = np.arange(6, dtype=np.float64).reshape(2, 3)
+        t = paddle.to_tensor(x)
+        assert t.dtype == np.float64
+        np.testing.assert_array_equal(t.numpy(), x)
+
+    def test_shape_props(self):
+        t = paddle.ones([2, 3, 4])
+        assert t.shape == [2, 3, 4]
+        assert t.ndim == 3
+        assert t.size == 24
+        assert len(t) == 2
+
+    def test_astype(self):
+        t = paddle.ones([2], "float32").astype("int32")
+        assert t.dtype == np.int32
+
+    def test_indexing(self):
+        t = paddle.to_tensor(np.arange(12).reshape(3, 4))
+        np.testing.assert_array_equal(t[1].numpy(), np.arange(4) + 4)
+        np.testing.assert_array_equal(t[:, 1].numpy(), [1, 5, 9])
+
+    def test_setitem(self):
+        t = paddle.zeros([3, 3])
+        t[1] = 5.0
+        assert t.numpy()[1].tolist() == [5.0, 5.0, 5.0]
+
+    def test_arith_scalar_keeps_dtype(self):
+        t = paddle.ones([2], "float32") + 2
+        assert t.dtype == np.float32
+        t = paddle.ones([2], "float32") * 2.5
+        assert t.dtype == np.float32
+
+    def test_default_dtype(self):
+        paddle.set_default_dtype("float64")
+        try:
+            assert paddle.ones([1]).dtype == np.float64
+        finally:
+            paddle.set_default_dtype("float32")
+
+    def test_clone_detach(self):
+        t = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        c = t.clone()
+        assert not c.stop_gradient
+        d = t.detach()
+        assert d.stop_gradient
+
+
+class TestAutograd:
+    def test_simple_backward(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+    def test_chain(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = x * x * x
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 12.0, rtol=1e-6)
+
+    def test_broadcast_grad(self):
+        x = paddle.to_tensor(np.ones((3, 4), np.float32), stop_gradient=False)
+        b = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+        ((x + b) ** 2).sum().backward()
+        assert list(b.grad.shape) == [4]
+        np.testing.assert_allclose(b.grad.numpy(), np.full(4, 12.0))
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).detach()
+        z = y * 3
+        z.backward()
+        assert x.grad is None
+
+    def test_backward_with_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+        y = x * 3
+        y.backward(paddle.to_tensor([1.0, 2.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 6.0])
+
+    def test_matmul_grad(self):
+        a = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32), stop_gradient=False)
+        b = paddle.to_tensor(np.random.rand(4, 5).astype(np.float32), stop_gradient=False)
+        paddle.matmul(a, b).sum().backward()
+        np.testing.assert_allclose(
+            a.grad.numpy(), (np.ones((3, 5)) @ b.numpy().T), rtol=1e-5
+        )
+
+    def test_register_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        seen = []
+        x.register_hook(lambda g: seen.append(g.numpy().copy()))
+        (x * 4).backward()
+        assert seen and seen[0][0] == 4.0
+
+    def test_grad_api(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [6.0])
+        # .grad not polluted
+        assert x.grad is None
+
+    def test_multi_output_op_grad(self):
+        x = paddle.to_tensor(np.arange(6, np.float32).astype(np.float32) if False
+                             else np.arange(6, dtype=np.float32), stop_gradient=False)
+        parts = paddle.split(x, 2)
+        (parts[0].sum() * 2 + parts[1].sum() * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2, 2, 2, 3, 3, 3])
+
+
+class TestPyLayer:
+    def test_custom_pylayer(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, g):
+                return g * 2
+
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = Double.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
