@@ -702,6 +702,12 @@ def backward(tensor: Tensor, grad_tensor=None, retain_graph=False):
             for g, (shape, dtype) in zip(node.out_grads, node.out_avals)
         ]
         ct = tuple(cotangents) if node.n_outs > 1 else cotangents[0]
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "trying to backward through a graph that has already been "
+                "freed; call backward(retain_graph=True) if you need to "
+                "backward through it a second time"
+            )
         in_grads = node.vjp_fn(ct)
         for inp, g in zip(node.inputs, in_grads):
             if g is None or inp.stop_gradient:

@@ -128,12 +128,24 @@ class _MultiProcessIter:
         if not self._iterable and self._rcvd_idx >= len(self._batches):
             self._shutdown()
             raise StopIteration
+        waited = 0.0
         while self._rcvd_idx not in self._reorder:
             try:
-                batch_id, err, data = self._out_queue.get(timeout=120.0)
+                batch_id, err, data = self._out_queue.get(timeout=2.0)
             except queue.Empty:
-                self._shutdown()
-                raise RuntimeError("DataLoader worker timed out")
+                waited += 2.0
+                dead = [w.pid for w in self._workers if not w.is_alive()]
+                if dead:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker(s) {dead} exited unexpectedly. "
+                        "Note: workers start via spawn — datasets must be "
+                        "importable (defined in a module, not __main__/REPL)."
+                    )
+                if waited >= (self._loader.timeout or 120.0):
+                    self._shutdown()
+                    raise RuntimeError("DataLoader worker timed out")
+                continue
             self._reorder[batch_id] = (err, data)
         err, data = self._reorder.pop(self._rcvd_idx)
         self._rcvd_idx += 1
