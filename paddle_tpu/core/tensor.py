@@ -500,7 +500,8 @@ class Parameter(Tensor):
     """Trainable tensor — parity with ParamBase
     (/root/reference/python/paddle/fluid/framework.py:5727)."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "is_distributed")
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "is_distributed", "tp_spec")
 
     def __init__(self, value, trainable=True, name=None):
         super().__init__(value, stop_gradient=not trainable, name=name)
@@ -509,6 +510,9 @@ class Parameter(Tensor):
         self.regularizer = None
         self.need_clip = True
         self.is_distributed = False
+        # tensor-parallel PartitionSpec axes, e.g. (None, "mp") — consumed by
+        # the fleet engine's sharding propagation
+        self.tp_spec = None
         self.persistable = True
 
     def __repr__(self):

@@ -1,0 +1,291 @@
+"""Attention kernels — the TPU-native replacement for the reference's fused
+attention CUDA kernels (operators/fused/multihead_matmul_op.cu,
+fused_attention) plus net-new long-context support (ring/context parallelism,
+absent in the reference — SURVEY.md §5 'Long-context: Absent').
+
+Three tiers, one API:
+- ``blockwise_attention``: online-softmax scan over K blocks (FlashAttention
+  recurrence in pure lax) — O(seq) memory, differentiable, runs anywhere.
+- ``flash_attention``: Pallas TPU kernel for the forward (MXU-tiled, VMEM
+  blocked), custom_vjp whose backward recomputes via the blockwise path.
+- ``ring_attention``: sequence-parallel attention inside shard_map — K/V
+  shards rotate around the 'sp' mesh axis via ppermute (ICI neighbor
+  transfers) while each device keeps running softmax stats for its Q shard.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "blockwise_attention", "flash_attention", "ring_attention",
+    "dot_product_attention",
+]
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Blockwise online-softmax attention (lax-level flash recurrence)
+# ---------------------------------------------------------------------------
+def _block_scan_attention(q, k, v, causal, q_offset, kv_offset, block_k, bias=None):
+    """q: [Lq, d]; k/v: [Lk, d]. Online softmax over k blocks.
+
+    ``q_offset``/``kv_offset`` are global position offsets (for ring /
+    sharded causal masking)."""
+    Lq, d = q.shape
+    Lk = k.shape[0]
+    scale = 1.0 / math.sqrt(d)
+    nblocks = max((Lk + block_k - 1) // block_k, 1)
+    pad = nblocks * block_k - Lk
+    if pad:
+        k = jnp.pad(k, ((0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, pad), (0, 0)))
+        if bias is not None:
+            bias = jnp.pad(bias, ((0, 0), (0, pad)), constant_values=_NEG_INF)
+    kb = k.reshape(nblocks, block_k, d)
+    vb = v.reshape(nblocks, block_k, d)
+    bb = bias.reshape(Lq, nblocks, block_k).swapaxes(0, 1) if bias is not None else None
+
+    q_pos = q_offset + jnp.arange(Lq)
+
+    def body(carry, blk):
+        acc, m, l = carry
+        if bb is not None:
+            kblk, vblk, bblk, bi = blk
+        else:
+            kblk, vblk, bi = blk
+            bblk = None
+        s = (q.astype(jnp.float32) @ kblk.astype(jnp.float32).T) * scale  # [Lq, bk]
+        k_pos = kv_offset + bi * block_k + jnp.arange(block_k)
+        valid = k_pos < (kv_offset + Lk)
+        mask = jnp.broadcast_to(valid[None, :], s.shape)
+        if causal:
+            mask = mask & (k_pos[None, :] <= q_pos[:, None])
+        if bblk is not None:
+            s = s + bblk
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + p.sum(axis=-1)
+        acc_new = acc * correction[:, None] + p @ vblk.astype(jnp.float32)
+        return (acc_new, m_new, l_new), None
+
+    acc0 = jnp.zeros((Lq, d), jnp.float32)
+    m0 = jnp.full((Lq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Lq,), jnp.float32)
+    idx = jnp.arange(nblocks)
+    xs = (kb, vb, bb, idx) if bb is not None else (kb, vb, idx)
+    (acc, m, l), _ = jax.lax.scan(body, (acc0, m0, l0), xs)
+    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    return out.astype(q.dtype), m + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def blockwise_attention(q, k, v, causal=False, block_k=512, bias=None,
+                        q_offset=0, kv_offset=0):
+    """q,k,v: [batch, heads, len, dim]. Returns [batch, heads, len, dim]."""
+
+    def per_head(qh, kh, vh, bh):
+        out, _ = _block_scan_attention(qh, kh, vh, causal, q_offset, kv_offset,
+                                       block_k, bh)
+        return out
+
+    if bias is not None:
+        # bias broadcastable to [b, h, lq, lk]
+        b_full = jnp.broadcast_to(bias, q.shape[:2] + (q.shape[2], k.shape[2]))
+        fn = jax.vmap(jax.vmap(per_head))
+        return fn(q, k, v, b_full)
+    fn = jax.vmap(jax.vmap(lambda a, b, c: per_head(a, b, c, None)))
+    return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU flash-attention forward
+# ---------------------------------------------------------------------------
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, sm_scale,
+                      seq_len):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+    block_q, d = q.shape
+    qi = pl.program_id(1)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    nk = seq_len // block_k
+
+    def body(i, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    if causal:
+        # only scan k blocks up to (and including) this q block's diagonal
+        upper = jnp.minimum((qi + 1) * block_q // block_k + 1, nk)
+    else:
+        upper = nk
+    acc, m, l = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, causal, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    b, h, L, d = q.shape
+    sm_scale = 1.0 / math.sqrt(d)
+    bh = b * h
+    q3 = q.reshape(bh, L, d)
+    k3 = k.reshape(bh, L, d)
+    v3 = v.reshape(bh, L, d)
+    grid = (bh, L // block_q)
+    out = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, block_k=block_k, causal=causal,
+                          sm_scale=sm_scale, seq_len=L),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, L, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, L, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, L, d), q.dtype),
+    )(q3, k3, v3)
+    return out.reshape(b, h, L, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal=False, block_q=256, block_k=256):
+    """Pallas-accelerated attention; falls back to blockwise when shapes or
+    platform don't fit the kernel. [b, h, l, d] layout."""
+    return _flash_attention_impl(q, k, v, causal, block_q, block_k)
+
+
+def _flash_attention_impl(q, k, v, causal, block_q, block_k):
+    L = q.shape[2]
+    d = q.shape[3]
+    on_tpu = jax.default_backend() == "tpu"
+    fits = (L % block_q == 0 and L % block_k == 0 and d % 128 == 0
+            and k.shape[2] == L)
+    if on_tpu and fits:
+        return _flash_fwd_pallas(q, k, v, causal, block_q, block_k)
+    return blockwise_attention(q, k, v, causal=causal, block_k=block_k)
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
+    out = _flash_attention_impl(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, res, g):
+    q, k, v = res
+    # recompute-based backward through the blockwise recurrence
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
+                                               block_k=block_k),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (sequence/context parallelism over a mesh axis)
+# ---------------------------------------------------------------------------
+def ring_attention(q, k, v, axis_name, causal=False, block_k=512):
+    """Attention where q/k/v are sequence-sharded over ``axis_name``.
+
+    Must be called inside shard_map/pjit with ``axis_name`` in scope. Each
+    step every device computes blockwise attention between its local Q shard
+    and the K/V shard currently resident, folds the result into running
+    online-softmax statistics, then rotates K/V one hop around the ring
+    (lax.ppermute → ICI neighbor copy, overlapping with the next compute).
+    Differentiable end-to-end: jax reverses the permutes in the backward.
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, h, L_local, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def local_block(qh, kh, vh, q_off, kv_off):
+        # returns (unnormalized acc, m, l) for one head
+        Lq = qh.shape[0]
+        Lk = kh.shape[0]
+        s = (qh.astype(jnp.float32) @ kh.astype(jnp.float32).T) * scale
+        q_pos = q_off + jnp.arange(Lq)
+        k_pos = kv_off + jnp.arange(Lk)
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]
+            s = jnp.where(mask, s, _NEG_INF)
+        m = s.max(axis=-1)
+        p = jnp.exp(s - m[:, None])
+        l = p.sum(axis=-1)
+        acc = p @ vh.astype(jnp.float32)
+        return acc, m, l
+
+    vblock = jax.vmap(jax.vmap(local_block, in_axes=(0, 0, 0, None, None)),
+                      in_axes=(0, 0, 0, None, None))
+
+    def step(carry, i):
+        acc, m, l, kc, vc = carry
+        src_idx = (my_idx - i) % axis_size  # whose shard we currently hold
+        a_i, m_i, l_i = vblock(q, kc, vc, my_idx * L_local, src_idx * L_local)
+        m_new = jnp.maximum(m, m_i)
+        c_old = jnp.exp(m - m_new)
+        c_new = jnp.exp(m_i - m_new)
+        acc = acc * c_old[..., None] + a_i * c_new[..., None]
+        l = l * c_old + l_i * c_new
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (acc, m_new, l, kc, vc), None
+
+    acc0 = jnp.zeros((b, h, L_local, d), jnp.float32)
+    m0 = jnp.full((b, h, L_local), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, L_local), jnp.float32)
+    (acc, m, l, _, _), _ = jax.lax.scan(
+        step, (acc0, m0, l0, k, v), jnp.arange(axis_size)
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Public dispatch
+# ---------------------------------------------------------------------------
+def dot_product_attention(q, k, v, causal=False, bias=None, sp_axis=None,
+                          use_flash=True):
+    """[b, h, l, d] attention dispatch: ring (sp sharded) > pallas flash >
+    blockwise > plain, by context."""
+    if sp_axis is not None:
+        return ring_attention(q, k, v, sp_axis, causal=causal)
+    if bias is not None:
+        return blockwise_attention(q, k, v, causal=causal, bias=bias)
+    if use_flash:
+        return flash_attention(q, k, v, causal)
+    return blockwise_attention(q, k, v, causal=causal)

@@ -1,0 +1,130 @@
+"""Fused elementwise kernels (Pallas) — counterpart of the reference's
+operators/fused/ CUDA tier (fused_bn_activation_op.cu, fused_adam, layer-norm
+kernels). XLA already fuses most elementwise chains into matmul epilogues;
+these Pallas versions exist for the cases XLA splits (multi-tensor adam over
+a flat buffer, layernorm over very wide rows) and as the template for future
+custom kernels. All have jnp fallbacks and are numerically interchangeable.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fused_layer_norm", "fused_softmax_bias", "fused_adam_step"]
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# layer norm
+# ---------------------------------------------------------------------------
+def _ln_kernel(x_ref, w_ref, b_ref, o_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean((x - mean) ** 2, axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    o_ref[...] = (y * w_ref[...].astype(jnp.float32)
+                  + b_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def fused_layer_norm(x, weight, bias, eps=1e-5, block_rows=256):
+    """x: [..., hidden]; weight/bias: [hidden]."""
+    hidden = x.shape[-1]
+    lead = x.shape[:-1]
+    rows = 1
+    for s in lead:
+        rows *= s
+    if not _on_tpu() or rows % block_rows != 0 or hidden % 128 != 0:
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return ((x - mean) * jax.lax.rsqrt(var + eps) * weight + bias).astype(x.dtype)
+
+    from jax.experimental import pallas as pl
+
+    x2 = x.reshape(rows, hidden)
+    out = pl.pallas_call(
+        functools.partial(_ln_kernel, eps=eps),
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((hidden,), lambda i: (0,)),
+            pl.BlockSpec((hidden,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, hidden), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, hidden), x.dtype),
+    )(x2, weight, bias)
+    return out.reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# softmax(+bias) over the last axis
+# ---------------------------------------------------------------------------
+def fused_softmax_bias(x, bias=None, axis=-1):
+    if bias is not None:
+        x = x + bias
+    return jax.nn.softmax(x, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor adam over a flat parameter buffer
+# ---------------------------------------------------------------------------
+def _adam_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, t_ref,
+                 po_ref, mo_ref, vo_ref, *, b1, b2, eps):
+    p = p_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    m = m_ref[...]
+    v = v_ref[...]
+    lr = lr_ref[0]
+    t = t_ref[0]
+    m_new = b1 * m + (1.0 - b1) * g
+    v_new = b2 * v + (1.0 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1.0 - b2**t) / (1.0 - b1**t)
+    po_ref[...] = (p - lr_t * m_new / (jnp.sqrt(v_new) + eps)).astype(po_ref.dtype)
+    mo_ref[...] = m_new
+    vo_ref[...] = v_new
+
+
+def fused_adam_step(param_flat, grad_flat, m_flat, v_flat, lr, step,
+                    beta1=0.9, beta2=0.999, eps=1e-8, block=1 << 16):
+    """Single fused pass over flat (concatenated) param/grad/state buffers —
+    the multi-tensor-apply pattern of the reference's fused adam."""
+    n = param_flat.shape[0]
+    if not _on_tpu() or n % block != 0:
+        m_new = beta1 * m_flat + (1 - beta1) * grad_flat
+        v_new = beta2 * v_flat + (1 - beta2) * grad_flat * grad_flat
+        lr_t = lr * jnp.sqrt(1 - beta2**step) / (1 - beta1**step)
+        p_new = param_flat - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+        return p_new.astype(param_flat.dtype), m_new, v_new
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid = (n // block,)
+    p_new, m_new, v_new = pl.pallas_call(
+        functools.partial(_adam_kernel, b1=beta1, b2=beta2, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n,), param_flat.dtype),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+        ],
+    )(param_flat, grad_flat, m_flat, v_flat,
+      jnp.asarray([lr], jnp.float32), jnp.asarray([step], jnp.float32))
+    return p_new, m_new, v_new

@@ -1,0 +1,8 @@
+from .gpt import (  # noqa: F401
+    GPT,
+    GPTConfig,
+    GPTForCausalLM,
+    gpt2_medium,
+    gpt2_small,
+    gpt2_tiny,
+)

@@ -1,0 +1,269 @@
+"""GPT family — the flagship LM (driver configs #4/#5: GPT-2 345M sharding,
+ERNIE-style pp+tp). API parity with the reference ecosystem's GPT
+implementations built on fleet.meta_parallel (mp_layers.py usage pattern);
+TPU-first internals: fused QKV projections (one MXU matmul), Pallas/blockwise
+flash attention, params carry tp_spec so the fleet engine shards them over
+the 'mp'/'sp' mesh axes, and the uniform block stack exposes a functional
+form the pipeline engine can scan over stages.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor, apply_op
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.ops.attention import dot_product_attention
+
+__all__ = ["GPTConfig", "GPT", "GPTForCausalLM", "gpt2_small", "gpt2_medium",
+           "gpt2_tiny"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304  # padded to a multiple of 128 for the MXU
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position_embeddings: int = 1024
+    intermediate_size: int = 0  # 0 -> 4*hidden
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_epsilon: float = 1e-5
+    use_flash_attention: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size == 0:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_heads
+        self.head_dim = h // config.num_heads
+        std = config.initializer_range
+        # fused qkv: one [h, 3h] matmul feeds the MXU better than 3 separate
+        self.qkv = nn.Linear(h, 3 * h, weight_attr=nn.ParamAttr(
+            initializer=I.Normal(0.0, std)))
+        self.proj = nn.Linear(h, h, weight_attr=nn.ParamAttr(
+            initializer=I.Normal(0.0, std / math.sqrt(2 * config.num_layers))))
+        # TP: qkv column-parallel (heads split), proj row-parallel
+        self.qkv.weight.tp_spec = (None, "mp")
+        self.qkv.bias.tp_spec = ("mp",)
+        self.proj.weight.tp_spec = ("mp", None)
+        self.attn_dropout_p = config.attention_dropout
+        self.dropout = nn.Dropout(config.hidden_dropout)
+        self.use_flash = config.use_flash_attention
+
+    def forward(self, x, attn_mask=None):
+        nh, hd = self.num_heads, self.head_dim
+        qkv = self.qkv(x)
+        use_flash = self.use_flash
+
+        def attend(t):
+            b, l, _ = t.shape
+            q, k, v = jnp.split(t, 3, axis=-1)
+            q = q.reshape(b, l, nh, hd).transpose(0, 2, 1, 3)
+            k = k.reshape(b, l, nh, hd).transpose(0, 2, 1, 3)
+            v = v.reshape(b, l, nh, hd).transpose(0, 2, 1, 3)
+            o = dot_product_attention(q, k, v, causal=True, use_flash=use_flash)
+            return o.transpose(0, 2, 1, 3).reshape(b, l, nh * hd)
+
+        out = apply_op(attend, qkv)
+        return self.dropout(self.proj(out))
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        std = config.initializer_range
+        self.fc = nn.Linear(config.hidden_size, config.intermediate_size,
+                            weight_attr=nn.ParamAttr(initializer=I.Normal(0.0, std)))
+        self.proj = nn.Linear(config.intermediate_size, config.hidden_size,
+                              weight_attr=nn.ParamAttr(
+                                  initializer=I.Normal(
+                                      0.0, std / math.sqrt(2 * config.num_layers))))
+        self.fc.weight.tp_spec = (None, "mp")
+        self.fc.bias.tp_spec = ("mp",)
+        self.proj.weight.tp_spec = ("mp", None)
+        self.dropout = nn.Dropout(config.hidden_dropout)
+
+    def forward(self, x):
+        return self.dropout(self.proj(F.gelu(self.fc(x), approximate=True)))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+
+    def forward(self, x, attn_mask=None):
+        x = x + self.attn(self.ln_1(x), attn_mask)
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPT(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        std = config.initializer_range
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=I.Normal(0.0, std)))
+        self.wpe = nn.Embedding(config.max_position_embeddings, config.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=I.Normal(0.0, std)))
+        # vocab-parallel embedding rows over mp
+        self.wte.weight.tp_spec = ("mp", None)
+        self.drop = nn.Dropout(config.hidden_dropout)
+        self.h = nn.LayerList([GPTBlock(config) for _ in range(config.num_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, attn_mask=None):
+        b, l = input_ids.shape
+        from paddle_tpu.tensor import arange
+
+        pos = arange(l, dtype="int64")
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for block in self.h:
+            x = block(x, attn_mask)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    """LM head tied to wte (standard GPT-2 weight tying)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPT(config)
+
+    def forward(self, input_ids, labels=None):
+        h = self.gpt(input_ids)
+        logits = F.linear(h, _transposed(self.gpt.wte.weight))
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]),
+                labels.reshape([-1]),
+            )
+            return loss
+        return logits
+
+    def loss_fn(self, logits, labels):
+        return F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]), labels.reshape([-1])
+        )
+
+
+def _transposed(w: Tensor) -> Tensor:
+    return apply_op(lambda a: a.T, w)
+
+
+# ---------------------------------------------------------------------------
+# Pure functional forms for the pipeline / sp engines
+# ---------------------------------------------------------------------------
+def gpt_functional_fns(config: GPTConfig, sp_axis=None):
+    """Pure-jnp (embed_fn, block_fn, head_loss_fn) matching the Layer math
+    (dropout-free; use hidden_dropout=0 for exact parity). Used by
+    fleet.pipeline_engine (pp over stacked blocks) and the sp ring-attention
+    path (sp_axis set → attention rotates K/V around the 'sp' mesh axis)."""
+    nh = config.num_heads
+    hd = config.hidden_size // nh
+    eps = config.layer_norm_epsilon
+
+    def ln(x, w, b):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mean) * jax.lax.rsqrt(var + eps) * w + b
+
+    def embed_fn(p, tokens):
+        l = tokens.shape[-1]
+        if sp_axis is not None:
+            # tokens are sequence-sharded: positions offset by shard index
+            off = jax.lax.axis_index(sp_axis) * l
+        else:
+            off = 0
+        pos = off + jnp.arange(l)
+        return p["wte"][tokens] + p["wpe"][pos]
+
+    def block_fn(p, h):
+        x = ln(h, p["ln_1.weight"], p["ln_1.bias"])
+        qkv = x @ p["attn.qkv.weight"] + p["attn.qkv.bias"]
+        b, l, _ = qkv.shape
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, l, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, l, nh, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, l, nh, hd).transpose(0, 2, 1, 3)
+        o = dot_product_attention(q, k, v, causal=True, sp_axis=sp_axis,
+                                  use_flash=config.use_flash_attention)
+        o = o.transpose(0, 2, 1, 3).reshape(b, l, nh * hd)
+        h = h + o @ p["attn.proj.weight"] + p["attn.proj.bias"]
+        x = ln(h, p["ln_2.weight"], p["ln_2.bias"])
+        x = jax.nn.gelu(x @ p["mlp.fc.weight"] + p["mlp.fc.bias"], approximate=True)
+        h = h + x @ p["mlp.proj.weight"] + p["mlp.proj.bias"]
+        return h
+
+    def head_loss_fn(p, h, labels):
+        x = ln(h, p["ln_f.weight"], p["ln_f.bias"])
+        logits = x @ p["wte"].T
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)
+        loss = -picked.mean()
+        if sp_axis is not None:
+            loss = jax.lax.pmean(loss, sp_axis)
+        return loss.astype(jnp.float32)
+
+    return embed_fn, block_fn, head_loss_fn
+
+
+def gpt_split_params(model: "GPTForCausalLM"):
+    """Split a GPTForCausalLM's params into (embed, stacked blocks, head)
+    pytrees for the pipeline engine. Block params are stacked over layers."""
+    from paddle_tpu.jit.functionalize import get_params
+
+    params = get_params(model)
+    n_layers = model.config.num_layers
+    embed = {"wte": params["gpt.wte.weight"], "wpe": params["gpt.wpe.weight"]}
+    keys = sorted(
+        {k.split(".", 3)[3] for k in params if k.startswith("gpt.h.0.")}
+    )
+    blocks = {
+        key: jnp.stack([params[f"gpt.h.{i}.{key}"] for i in range(n_layers)])
+        for key in keys
+    }
+    head = {
+        "ln_f.weight": params["gpt.ln_f.weight"],
+        "ln_f.bias": params["gpt.ln_f.bias"],
+        # pipeline mode unties the LM head (its own copy; the reference's
+        # Megatron-style tied-embedding grad allreduce between first/last
+        # stage is a round-2 item). Copy also keeps donation buffers unique.
+        "wte": jnp.array(params["gpt.wte.weight"]),
+    }
+    return embed, blocks, head
+
+
+def gpt2_tiny(**kw):
+    return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=4, num_heads=4,
+                     max_position_embeddings=256, hidden_dropout=0.0,
+                     attention_dropout=0.0, **kw)
+
+
+def gpt2_small(**kw):
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+
+def gpt2_medium(**kw):
+    """GPT-2 345M (driver config #4)."""
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)

@@ -1,0 +1,316 @@
+"""Distributed engine tests on the virtual 8-device CPU mesh — the
+reference's methodology (test_dist_base.py:682: distributed run must match
+the single-process run) adapted to SPMD: every parallelism strategy must
+reproduce the single-device numerics.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.fleet.engine import ParallelTrainStep
+from paddle_tpu.jit.train_step import TrainStep
+from paddle_tpu.text.models.gpt import (
+    GPTForCausalLM,
+    gpt2_tiny,
+    gpt_functional_fns,
+    gpt_split_params,
+)
+
+VOCAB = 512
+
+
+def tiny_model(seed=0, num_layers=2):
+    paddle.seed(seed)
+    cfg = gpt2_tiny()
+    cfg.vocab_size = VOCAB
+    cfg.hidden_size = 64
+    cfg.num_layers = num_layers
+    cfg.num_heads = 4
+    cfg.max_position_embeddings = 32
+    cfg.use_flash_attention = False
+    return GPTForCausalLM(cfg), cfg
+
+
+def batch(bs=8, seq=16, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randint(0, VOCAB, size=(bs, seq)).astype(np.int64)
+    y = rng.randint(0, VOCAB, size=(bs, seq)).astype(np.int64)
+    return x, y
+
+
+def mesh_of(shape, names):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+def run_steps(step, n=3, bs=8, seq=16):
+    losses = []
+    for i in range(n):
+        x, y = batch(bs, seq, seed=i)
+        loss = step((paddle.to_tensor(x),), (paddle.to_tensor(y),))
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+class TestDataParallel:
+    def test_dp8_matches_single(self):
+        model1, cfg = tiny_model(seed=3)
+        opt1 = optimizer.SGD(0.1, parameters=model1.parameters())
+        base = TrainStep(model1, lambda out, y: out if isinstance(out, paddle.Tensor) else paddle.Tensor(out), opt1)
+        # model forward returns loss directly when labels passed; use loss_fn
+        # style instead: logits + loss
+        model1, cfg = tiny_model(seed=3)
+        opt1 = optimizer.SGD(0.1, parameters=model1.parameters())
+        loss_fn = lambda out, y: model1.loss_fn(
+            out if isinstance(out, paddle.Tensor) else paddle.Tensor(out),
+            y if isinstance(y, paddle.Tensor) else paddle.Tensor(y))
+        base = TrainStep(model1, loss_fn, opt1)
+        ref_losses = run_steps(base)
+
+        model2, _ = tiny_model(seed=3)
+        opt2 = optimizer.SGD(0.1, parameters=model2.parameters())
+        loss_fn2 = lambda out, y: model2.loss_fn(
+            out if isinstance(out, paddle.Tensor) else paddle.Tensor(out),
+            y if isinstance(y, paddle.Tensor) else paddle.Tensor(y))
+        mesh = mesh_of((8,), ("dp",))
+        dp = ParallelTrainStep(model2, loss_fn2, opt2, mesh)
+        dp_losses = run_steps(dp)
+        np.testing.assert_allclose(ref_losses, dp_losses, rtol=2e-4)
+
+    def test_param_values_match_after_training(self):
+        model1, _ = tiny_model(seed=5)
+        opt1 = optimizer.SGD(0.1, parameters=model1.parameters())
+        lf1 = lambda o, y: model1.loss_fn(paddle.Tensor(o) if not isinstance(o, paddle.Tensor) else o,
+                                          paddle.Tensor(y) if not isinstance(y, paddle.Tensor) else y)
+        base = TrainStep(model1, lf1, opt1)
+        run_steps(base, n=2)
+        base.sync_to_layer()
+
+        model2, _ = tiny_model(seed=5)
+        opt2 = optimizer.SGD(0.1, parameters=model2.parameters())
+        lf2 = lambda o, y: model2.loss_fn(paddle.Tensor(o) if not isinstance(o, paddle.Tensor) else o,
+                                          paddle.Tensor(y) if not isinstance(y, paddle.Tensor) else y)
+        mesh = mesh_of((8,), ("dp",))
+        dp = ParallelTrainStep(model2, lf2, opt2, mesh)
+        run_steps(dp, n=2)
+        dp.sync_to_layer()
+        w1 = model1.gpt.wte.weight.numpy()
+        w2 = model2.gpt.wte.weight.numpy()
+        np.testing.assert_allclose(w1, w2, rtol=1e-3, atol=1e-5)
+
+
+class TestTensorParallel:
+    def test_dp_mp_matches_single(self):
+        model1, _ = tiny_model(seed=7)
+        opt1 = optimizer.SGD(0.1, parameters=model1.parameters())
+        lf1 = lambda o, y: model1.loss_fn(paddle.Tensor(o) if not isinstance(o, paddle.Tensor) else o,
+                                          paddle.Tensor(y) if not isinstance(y, paddle.Tensor) else y)
+        ref = run_steps(TrainStep(model1, lf1, opt1))
+
+        model2, _ = tiny_model(seed=7)
+        opt2 = optimizer.SGD(0.1, parameters=model2.parameters())
+        lf2 = lambda o, y: model2.loss_fn(paddle.Tensor(o) if not isinstance(o, paddle.Tensor) else o,
+                                          paddle.Tensor(y) if not isinstance(y, paddle.Tensor) else y)
+        mesh = mesh_of((4, 2), ("dp", "mp"))
+        tp = ParallelTrainStep(model2, lf2, opt2, mesh)
+        # qkv weights must actually be mp-sharded
+        spec = tp.param_specs["gpt.h.0.attn.qkv.weight"]
+        assert "mp" in str(spec)
+        tp_losses = run_steps(tp)
+        np.testing.assert_allclose(ref, tp_losses, rtol=2e-4)
+
+
+class TestZeroSharding:
+    @pytest.mark.parametrize("stage", [1, 3])
+    def test_zero_matches_single(self, stage):
+        model1, _ = tiny_model(seed=9)
+        opt1 = optimizer.Adam(1e-3, parameters=model1.parameters())
+        lf1 = lambda o, y: model1.loss_fn(paddle.Tensor(o) if not isinstance(o, paddle.Tensor) else o,
+                                          paddle.Tensor(y) if not isinstance(y, paddle.Tensor) else y)
+        ref = run_steps(TrainStep(model1, lf1, opt1))
+
+        model2, _ = tiny_model(seed=9)
+        opt2 = optimizer.Adam(1e-3, parameters=model2.parameters())
+        lf2 = lambda o, y: model2.loss_fn(paddle.Tensor(o) if not isinstance(o, paddle.Tensor) else o,
+                                          paddle.Tensor(y) if not isinstance(y, paddle.Tensor) else y)
+        mesh = mesh_of((2, 4), ("dp", "sharding"))
+        z = ParallelTrainStep(model2, lf2, opt2, mesh, zero_stage=stage)
+        z_losses = run_steps(z)
+        np.testing.assert_allclose(ref, z_losses, rtol=3e-4)
+
+    def test_zero3_actually_shards_params(self):
+        model, _ = tiny_model()
+        opt = optimizer.Adam(1e-3, parameters=model.parameters())
+        lf = lambda o, y: model.loss_fn(paddle.Tensor(o), paddle.Tensor(y))
+        mesh = mesh_of((1, 8), ("dp", "sharding"))
+        z = ParallelTrainStep(model, lf, opt, mesh, zero_stage=3)
+        sharded = [n for n, s in z.param_specs.items() if "sharding" in str(s)]
+        assert len(sharded) > 10, f"expected most params sharded, got {sharded}"
+
+
+class TestRecomputeAndBf16:
+    def test_recompute_matches(self):
+        model1, _ = tiny_model(seed=11)
+        opt1 = optimizer.SGD(0.1, parameters=model1.parameters())
+        lf1 = lambda o, y: model1.loss_fn(paddle.Tensor(o) if not isinstance(o, paddle.Tensor) else o,
+                                          paddle.Tensor(y) if not isinstance(y, paddle.Tensor) else y)
+        ref = run_steps(TrainStep(model1, lf1, opt1))
+
+        model2, _ = tiny_model(seed=11)
+        opt2 = optimizer.SGD(0.1, parameters=model2.parameters())
+        lf2 = lambda o, y: model2.loss_fn(paddle.Tensor(o) if not isinstance(o, paddle.Tensor) else o,
+                                          paddle.Tensor(y) if not isinstance(y, paddle.Tensor) else y)
+        mesh = mesh_of((8,), ("dp",))
+        rc = ParallelTrainStep(model2, lf2, opt2, mesh, recompute=True)
+        np.testing.assert_allclose(ref, run_steps(rc), rtol=2e-4)
+
+    def test_bf16_compute_trains(self):
+        model, _ = tiny_model(seed=13)
+        opt = optimizer.SGD(0.1, parameters=model.parameters())
+        lf = lambda o, y: model.loss_fn(paddle.Tensor(o) if not isinstance(o, paddle.Tensor) else o,
+                                        paddle.Tensor(y) if not isinstance(y, paddle.Tensor) else y)
+        mesh = mesh_of((8,), ("dp",))
+        step = ParallelTrainStep(model, lf, opt, mesh, compute_dtype=jnp.bfloat16)
+        x, y = batch(8, 16, seed=0)
+        losses = [
+            float(step((paddle.to_tensor(x),), (paddle.to_tensor(y),)).numpy())
+            for _ in range(6)
+        ]
+        assert losses[-1] < losses[0]  # same batch repeatedly => must improve
+        # master weights stay fp32
+        assert str(list(step._params.values())[0].dtype) == "float32"
+
+
+class TestPipeline:
+    def _pipeline_losses(self, pp, dp, num_micro=4, n_steps=2):
+        from paddle_tpu.distributed.fleet.pipeline_engine import PipelineTrainStep
+
+        model, cfg = tiny_model(seed=21, num_layers=4)
+        embed_fn, block_fn, head_loss_fn = gpt_functional_fns(cfg)
+        embed, blocks, head = gpt_split_params(model)
+        opt = optimizer.SGD(0.1, parameters=model.parameters())
+        mesh = mesh_of((pp, dp), ("pp", "dp"))
+        bs, seq = 8, 16
+        h_sd = jax.ShapeDtypeStruct((bs // dp, seq, cfg.hidden_size), jnp.float32)
+        # engine takes global microbatched arrays [num_micro, bs, seq]
+        step = PipelineTrainStep(
+            embed_fn, block_fn, head_loss_fn, opt, mesh, embed, blocks, head,
+            num_micro, jax.ShapeDtypeStruct((bs, seq, cfg.hidden_size), jnp.float32),
+            recompute=False,
+        )
+        losses = []
+        for i in range(n_steps):
+            x, y = batch(bs * num_micro, seq, seed=100 + i)
+            xm = x.reshape(num_micro, bs, seq)
+            ym = y.reshape(num_micro, bs, seq)
+            losses.append(float(step(xm, ym).numpy()))
+        return losses
+
+    def test_pp4_matches_pp1(self):
+        ref = self._pipeline_losses(pp=1, dp=1)
+        out = self._pipeline_losses(pp=4, dp=1)
+        np.testing.assert_allclose(ref, out, rtol=2e-4)
+
+    def test_pp2_dp2_matches_pp1(self):
+        ref = self._pipeline_losses(pp=1, dp=1)
+        out = self._pipeline_losses(pp=2, dp=2)
+        np.testing.assert_allclose(ref, out, rtol=2e-4)
+
+
+class TestRingAttention:
+    def test_ring_matches_full(self):
+        from paddle_tpu.ops.attention import blockwise_attention, ring_attention
+
+        rng = np.random.RandomState(0)
+        b, h, L, d = 2, 2, 32, 16
+        q = rng.rand(b, h, L, d).astype(np.float32)
+        k = rng.rand(b, h, L, d).astype(np.float32)
+        v = rng.rand(b, h, L, d).astype(np.float32)
+        full = np.asarray(blockwise_attention(jnp.asarray(q), jnp.asarray(k),
+                                              jnp.asarray(v), causal=True))
+        mesh = mesh_of((4,), ("sp",))
+        ring = jax.jit(jax.shard_map(
+            lambda q_, k_, v_: ring_attention(q_, k_, v_, "sp", causal=True),
+            mesh=mesh,
+            in_specs=(P(None, None, "sp"), P(None, None, "sp"), P(None, None, "sp")),
+            out_specs=P(None, None, "sp"),
+            check_vma=False,
+        ))(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        np.testing.assert_allclose(np.asarray(ring), full, rtol=2e-4, atol=1e-5)
+
+    def test_ring_attention_grad(self):
+        from paddle_tpu.ops.attention import blockwise_attention, ring_attention
+
+        rng = np.random.RandomState(1)
+        b, h, L, d = 1, 2, 16, 8
+        q = jnp.asarray(rng.rand(b, h, L, d).astype(np.float32))
+        k = jnp.asarray(rng.rand(b, h, L, d).astype(np.float32))
+        v = jnp.asarray(rng.rand(b, h, L, d).astype(np.float32))
+        mesh = mesh_of((4,), ("sp",))
+
+        def ring_loss(q_, k_, v_):
+            f = jax.shard_map(
+                lambda a, b_, c: ring_attention(a, b_, c, "sp", causal=True),
+                mesh=mesh,
+                in_specs=(P(None, None, "sp"),) * 3,
+                out_specs=P(None, None, "sp"),
+                check_vma=False,
+            )
+            return jnp.sum(f(q_, k_, v_) ** 2)
+
+        def full_loss(q_, k_, v_):
+            return jnp.sum(blockwise_attention(q_, k_, v_, causal=True) ** 2)
+
+        g_ring = jax.grad(ring_loss)(q, k, v)
+        g_full = jax.grad(full_loss)(q, k, v)
+        np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_full),
+                                   rtol=5e-3, atol=1e-4)
+
+
+class TestFlashAttention:
+    def test_blockwise_matches_plain(self):
+        rng = np.random.RandomState(2)
+        b, h, L, d = 2, 3, 33, 16  # odd length exercises padding
+        q = jnp.asarray(rng.rand(b, h, L, d).astype(np.float32))
+        k = jnp.asarray(rng.rand(b, h, L, d).astype(np.float32))
+        v = jnp.asarray(rng.rand(b, h, L, d).astype(np.float32))
+        from paddle_tpu.ops.attention import blockwise_attention
+
+        out = blockwise_attention(q, k, v, causal=True, block_k=16)
+        # plain reference
+        s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+        mask = np.tril(np.ones((L, L), bool))
+        s = np.where(mask, s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=1e-5)
+
+    def test_blockwise_grad_matches_plain(self):
+        rng = np.random.RandomState(3)
+        b, h, L, d = 1, 2, 16, 8
+        q = jnp.asarray(rng.rand(b, h, L, d).astype(np.float32))
+        k = jnp.asarray(rng.rand(b, h, L, d).astype(np.float32))
+        v = jnp.asarray(rng.rand(b, h, L, d).astype(np.float32))
+        from paddle_tpu.ops.attention import blockwise_attention
+
+        def plain(q_, k_, v_):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q_, k_) / np.sqrt(d)
+            mask = jnp.tril(jnp.ones((L, L), bool))
+            s = jnp.where(mask, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, v_) ** 2)
+
+        def blocked(q_, k_, v_):
+            return jnp.sum(blockwise_attention(q_, k_, v_, causal=True, block_k=8) ** 2)
+
+        for i in range(3):
+            g1 = jax.grad(plain, argnums=i)(q, k, v)
+            g2 = jax.grad(blocked, argnums=i)(q, k, v)
+            np.testing.assert_allclose(np.asarray(g2), np.asarray(g1), rtol=5e-3,
+                                       atol=1e-4)
