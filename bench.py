@@ -1,0 +1,78 @@
+"""Headline benchmark — GPT-2 345M training throughput, tokens/sec/chip.
+
+Driver config #4 (BASELINE.json): GPT-2 345M under the fleet engine
+(bf16 compute, recompute, Adam). Runs on whatever jax.default_backend()
+is — one real TPU chip under the driver; falls back to a tiny config on
+CPU so the script stays runnable anywhere.
+
+Baseline: the reference publishes no absolute numbers (BASELINE.md), so
+vs_baseline is measured against the driver's north star — 90% of an
+A100-NCCL chip. A100 bf16 peak 312 TFLOP/s at a typical 45% training
+MFU ≈ 140 TFLOP/s; GPT-2 345M costs ~6*345e6 FLOPs/token → ~68k
+tokens/sec/chip, 90% of which is 61k.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_TOKENS_PER_SEC = 61_000.0
+
+
+def main():
+    import paddle_tpu as paddle
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.fleet.engine import ParallelTrainStep
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        config = GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                           max_position_embeddings=1024, hidden_dropout=0.0,
+                           attention_dropout=0.0)
+        batch, seq, iters = 8, 1024, 10
+    else:  # smoke mode off-TPU
+        config = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=4,
+                           num_heads=4, max_position_embeddings=256,
+                           hidden_dropout=0.0, attention_dropout=0.0,
+                           use_flash_attention=False)
+        batch, seq, iters = 4, 128, 3
+
+    paddle.seed(0)
+    model = GPTForCausalLM(config)
+    opt = paddle.optimizer.Adam(learning_rate=1e-4,
+                                parameters=model.parameters())
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    step = ParallelTrainStep(
+        model, loss_fn=model.loss_fn, optimizer=opt, mesh=mesh,
+        recompute=True, compute_dtype=jnp.bfloat16,
+    )
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, config.vocab_size, (batch, seq)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1).astype(np.int32)
+
+    loss = step((ids,), (labels,))  # compile + warmup
+    float(loss.numpy())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step((ids,), (labels,))
+    float(loss.numpy())
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    print(json.dumps({
+        "metric": "gpt2_345m_train_tokens_per_sec_per_chip" if on_tpu
+        else "gpt2_tiny_train_tokens_per_sec_cpu_smoke",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Shared-memory batch transport for the multiprocess DataLoader.
+
+Replaces the reference's mmap shared-memory tensor path
+(memory/allocation/mmap_allocator.h + fluid/dataloader/dataloader_iter.py's
+_convert_to_tensor-over-shm) with one native ring buffer
+(paddle_tpu/native/src/shm_ring.cc): workers pickle the batch with
+protocol 5 and append the raw array buffers out-of-band, so the numpy
+payload is a single memcpy into the ring on each side — no per-tensor
+mmap files, no pipe serialization.
+
+Record layout: [u64 batch_id][u8 status][u32 npickle][pickle]
+               repeat: [u64 buf_len][buf bytes]
+status: 0=ok 1=worker error (payload = pickled (repr, traceback))
+        2=StopIteration sentinel (iterable datasets)
+"""
+from __future__ import annotations
+
+import pickle
+import struct
+
+_HDR = struct.Struct("<QBI")
+
+OK, ERROR, STOP = 0, 1, 2
+
+
+def pack(batch_id: int, status: int, payload) -> bytes:
+    buffers = []
+    body = pickle.dumps(payload, protocol=5, buffer_callback=buffers.append)
+    parts = [_HDR.pack(batch_id, status, len(body)), body]
+    for b in buffers:
+        raw = b.raw()
+        parts.append(struct.pack("<Q", raw.nbytes))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def unpack(data: bytes):
+    batch_id, status, npickle = _HDR.unpack_from(data, 0)
+    off = _HDR.size
+    body = data[off:off + npickle]
+    off += npickle
+    buffers = []
+    view = memoryview(data)
+    while off < len(data):
+        (blen,) = struct.unpack_from("<Q", data, off)
+        off += 8
+        buffers.append(view[off:off + blen])
+        off += blen
+    payload = pickle.loads(body, buffers=buffers)
+    return batch_id, status, payload

@@ -14,6 +14,7 @@ from __future__ import annotations
 import atexit
 import itertools
 import multiprocessing as mp
+import os
 import queue
 import threading
 import traceback
@@ -44,8 +45,37 @@ def get_worker_info():
 
 
 def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_id,
-                 num_workers, worker_init_fn, iterable):
+                 num_workers, worker_init_fn, iterable, ring_name=None):
     _worker_info.info = WorkerInfo(worker_id, num_workers, dataset)
+    ring = None
+    if ring_name is not None:
+        try:
+            from paddle_tpu.native import ShmRing
+
+            ring = ShmRing(ring_name)
+        except Exception:
+            ring = None  # fall back to the queue transport
+
+    def emit(batch_id, err, data, tb=None):
+        if ring is not None:
+            from . import _shm_transport as T
+
+            if isinstance(err, StopIteration):
+                rec = T.pack(batch_id, T.STOP, None)
+            elif err is not None:
+                try:  # ship the real exception when picklable (queue parity)
+                    rec = T.pack(batch_id, T.ERROR, (err, tb))
+                except Exception:
+                    rec = T.pack(batch_id, T.ERROR, (repr(err), tb))
+            else:
+                rec = T.pack(batch_id, T.OK, data)
+            try:
+                if ring.push(rec):
+                    return
+            except ValueError:  # batch larger than the ring: fall through
+                pass
+        out_queue.put((batch_id, err, data if err is None else tb))
+
     try:
         if worker_init_fn is not None:
             worker_init_fn(worker_id)
@@ -59,9 +89,9 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_id,
                 batch_id, batch_size = msg
                 samples = list(itertools.islice(it, batch_size))
                 if not samples:
-                    out_queue.put((batch_id, StopIteration(), None))
+                    emit(batch_id, StopIteration(), None)
                     continue
-                out_queue.put((batch_id, None, collate_fn(samples)))
+                emit(batch_id, None, collate_fn(samples))
         else:
             while True:
                 msg = index_queue.get()
@@ -70,11 +100,14 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_id,
                 batch_id, indices = msg
                 try:
                     samples = [dataset[i] for i in indices]
-                    out_queue.put((batch_id, None, collate_fn(samples)))
+                    emit(batch_id, None, collate_fn(samples))
                 except Exception as e:  # propagate to parent
-                    out_queue.put((batch_id, e, traceback.format_exc()))
+                    emit(batch_id, e, None, traceback.format_exc())
     except KeyboardInterrupt:
         pass
+    finally:
+        if ring is not None:
+            ring.release()
 
 
 class _MultiProcessIter:
@@ -93,13 +126,28 @@ class _MultiProcessIter:
         self._rcvd_idx = 0
         self._reorder = {}
         self._done = False
+        # shared-memory ring transport (native); queue is the fallback and
+        # the overflow path for records larger than the ring
+        self._ring = None
+        ring_name = None
+        if getattr(loader, "use_shared_memory", True):
+            try:
+                from paddle_tpu.native import ShmRing
+
+                ring_name = f"/pt_dl_{os.getpid()}_{id(self) & 0xFFFFFF:x}"
+                self._ring = ShmRing(ring_name, capacity=loader.shm_capacity,
+                                     create=True)
+            except Exception:
+                self._ring = None
+                ring_name = None
         for w in range(self._num_workers):
             iq = ctx.Queue()
             self._index_queues.append(iq)
             p = ctx.Process(
                 target=_worker_loop,
                 args=(loader.dataset, iq, self._out_queue, loader.collate_fn, w,
-                      self._num_workers, loader.worker_init_fn, self._iterable),
+                      self._num_workers, loader.worker_init_fn, self._iterable,
+                      ring_name),
                 daemon=True,
             )
             p.start()
@@ -121,6 +169,46 @@ class _MultiProcessIter:
         self._index_queues[w].put((self._send_idx, self._batches[self._send_idx]))
         self._send_idx += 1
 
+    def _recv_one(self, timeout_s: float) -> bool:
+        """Receive one record into the reorder buffer. False on timeout."""
+        if self._ring is not None:
+            # drain any queue-overflow records first (non-blocking)
+            drained = False
+            try:
+                while True:
+                    batch_id, err, data = self._out_queue.get_nowait()
+                    self._reorder[batch_id] = (err, data)
+                    drained = True
+            except queue.Empty:
+                pass
+            if drained:
+                return True
+            try:
+                rec = self._ring.pop_timed(int(timeout_s * 1000))
+            except TimeoutError:
+                return False
+            if rec is None:  # ring closed
+                return False
+            from . import _shm_transport as T
+
+            batch_id, status, payload = T.unpack(rec)
+            if status == T.STOP:
+                self._reorder[batch_id] = (StopIteration(), None)
+            elif status == T.ERROR:
+                err, tb = payload
+                if not isinstance(err, BaseException):
+                    err = RuntimeError(err)
+                self._reorder[batch_id] = (err, tb)
+            else:
+                self._reorder[batch_id] = (None, payload)
+            return True
+        try:
+            batch_id, err, data = self._out_queue.get(timeout=timeout_s)
+        except queue.Empty:
+            return False
+        self._reorder[batch_id] = (err, data)
+        return True
+
     def __iter__(self):
         return self
 
@@ -130,9 +218,7 @@ class _MultiProcessIter:
             raise StopIteration
         waited = 0.0
         while self._rcvd_idx not in self._reorder:
-            try:
-                batch_id, err, data = self._out_queue.get(timeout=2.0)
-            except queue.Empty:
+            if not self._recv_one(timeout_s=2.0):
                 waited += 2.0
                 dead = [w.pid for w in self._workers if not w.is_alive()]
                 if dead:
@@ -145,8 +231,6 @@ class _MultiProcessIter:
                 if waited >= (self._loader.timeout or 120.0):
                     self._shutdown()
                     raise RuntimeError("DataLoader worker timed out")
-                continue
-            self._reorder[batch_id] = (err, data)
         err, data = self._reorder.pop(self._rcvd_idx)
         self._rcvd_idx += 1
         if isinstance(err, StopIteration):
@@ -167,10 +251,24 @@ class _MultiProcessIter:
                 iq.put(None)
             except Exception:
                 pass
+        # close the ring BEFORE joining: a worker blocked in ring.push must
+        # see closed (push returns False) to reach its index-queue sentinel
+        if self._ring is not None:
+            try:
+                self._ring.close()
+            except Exception:
+                pass
         for p in self._workers:
             p.join(timeout=2.0)
             if p.is_alive():
                 p.terminate()
+        if self._ring is not None:
+            try:
+                self._ring.close()
+                self._ring.release()
+            except Exception:
+                pass
+            self._ring = None
 
 
 def _to_tensors(batch, return_list=True):
@@ -190,7 +288,8 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False,
+                 shm_capacity=64 << 20):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
@@ -198,6 +297,8 @@ class DataLoader:
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
+        self.use_shared_memory = use_shared_memory
+        self.shm_capacity = shm_capacity
         self._is_iterable_ds = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler

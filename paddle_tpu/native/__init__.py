@@ -1,0 +1,198 @@
+"""paddle_tpu.native — host-side C++ runtime (ctypes bindings).
+
+The TPU-native runtime keeps device memory/kernels inside XLA, but the host
+side of the framework is native C++ like the reference's
+(memory/allocation/*, mmap_allocator.h, data_feed.cc, distributed/service/*):
+
+- ``Arena``    — auto-growth best-fit host allocator (src/arena.cc).
+- ``ShmRing``  — POSIX shared-memory ring for multiprocess DataLoader
+  batch transport (src/shm_ring.cc).
+
+The library builds lazily on first use (``make`` in this directory, g++
+required); every consumer has a pure-Python fallback, so a missing toolchain
+degrades gracefully.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "build", "libpaddle_tpu_native.so")
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def ensure_built():
+    """Build (if needed) and load the native library; None if unavailable."""
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or _stale():
+            try:
+                subprocess.run(
+                    ["make", "-s", "-j4"], cwd=_HERE, check=True,
+                    capture_output=True, timeout=120,
+                )
+            except Exception:
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        _declare(lib)
+        _lib = lib
+        return _lib
+
+
+def _stale():
+    try:
+        lib_m = os.path.getmtime(_LIB_PATH)
+        src = os.path.join(_HERE, "src")
+        return any(
+            os.path.getmtime(os.path.join(src, f)) > lib_m
+            for f in os.listdir(src)
+        )
+    except OSError:
+        return True
+
+
+def _declare(lib):
+    lib.pt_arena_create.restype = ctypes.c_void_p
+    lib.pt_arena_create.argtypes = [ctypes.c_size_t]
+    lib.pt_arena_destroy.argtypes = [ctypes.c_void_p]
+    lib.pt_arena_alloc.restype = ctypes.c_void_p
+    lib.pt_arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.pt_arena_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.pt_arena_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_size_t)]
+
+    lib.pt_ring_open.restype = ctypes.c_void_p
+    lib.pt_ring_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int]
+    lib.pt_ring_push.restype = ctypes.c_int
+    lib.pt_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.pt_ring_next_size.restype = ctypes.c_int64
+    lib.pt_ring_next_size.argtypes = [ctypes.c_void_p]
+    lib.pt_ring_pop.restype = ctypes.c_int64
+    lib.pt_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+    lib.pt_ring_pop_timed.restype = ctypes.c_int64
+    lib.pt_ring_pop_timed.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64,
+    ]
+    lib.pt_ring_close.argtypes = [ctypes.c_void_p]
+    lib.pt_ring_closed.restype = ctypes.c_int
+    lib.pt_ring_closed.argtypes = [ctypes.c_void_p]
+    lib.pt_ring_release.argtypes = [ctypes.c_void_p]
+
+
+def available() -> bool:
+    return ensure_built() is not None
+
+
+class Arena:
+    """Host staging allocator (reference: AllocatorFacade/auto-growth)."""
+
+    def __init__(self, chunk_size: int = 1 << 22):
+        lib = ensure_built()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.pt_arena_create(chunk_size)
+        if not self._h:
+            raise MemoryError("pt_arena_create failed")
+
+    def alloc(self, n: int) -> int:
+        p = self._lib.pt_arena_alloc(self._h, n)
+        if not p:
+            raise MemoryError(f"arena alloc of {n} bytes failed")
+        return p
+
+    def free(self, ptr: int):
+        self._lib.pt_arena_free(self._h, ptr)
+
+    def stats(self):
+        buf = (ctypes.c_size_t * 3)()
+        self._lib.pt_arena_stats(self._h, buf)
+        return {"allocated": buf[0], "reserved": buf[1], "peak": buf[2]}
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.pt_arena_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class ShmRing:
+    """Named shared-memory record ring (reference: mmap_allocator + queue)."""
+
+    def __init__(self, name: str, capacity: int = 0, create: bool = False):
+        lib = ensure_built()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.name = name
+        self._h = lib.pt_ring_open(name.encode(), capacity, 1 if create else 0)
+        if not self._h:
+            raise OSError(f"shm ring open failed: {name}")
+
+    def push(self, data: bytes) -> bool:
+        """False once the ring is closed. Raises if the record can't fit."""
+        rc = self._lib.pt_ring_push(self._h, data, len(data))
+        if rc == -2:
+            raise ValueError("record larger than ring capacity")
+        return rc == 0
+
+    def pop(self) -> bytes | None:
+        """Next record; None once closed and drained. Blocks otherwise."""
+        n = self._lib.pt_ring_next_size(self._h)
+        if n < 0:
+            return None
+        buf = ctypes.create_string_buffer(int(n))
+        got = self._lib.pt_ring_pop(self._h, buf, n)
+        if got < 0:
+            return None
+        return buf.raw[:got]
+
+    def pop_timed(self, timeout_ms: int):
+        """Next record; None once closed+drained; raises TimeoutError."""
+        # peek size with a short wait, then do the real timed pop
+        buf = ctypes.create_string_buffer(1 << 16)
+        got = self._lib.pt_ring_pop_timed(self._h, buf, len(buf), timeout_ms)
+        if got == -3:
+            raise TimeoutError
+        if got == -1:
+            return None
+        if got == -2:  # record bigger than the probe buffer: size then pop
+            n = self._lib.pt_ring_next_size(self._h)
+            if n < 0:
+                return None
+            big = ctypes.create_string_buffer(int(n))
+            got = self._lib.pt_ring_pop(self._h, big, n)
+            if got < 0:
+                return None
+            return big.raw[:got]
+        return buf.raw[:got]
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.pt_ring_close(self._h)
+
+    def release(self):
+        if getattr(self, "_h", None):
+            self._lib.pt_ring_release(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass

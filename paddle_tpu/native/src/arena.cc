@@ -1,0 +1,162 @@
+// Host memory arena — the TPU-native runtime's answer to the reference's
+// allocator stack (memory/allocation/allocator_facade.h:32,
+// auto_growth_best_fit_allocator.h, memory/detail/buddy_allocator.h).
+//
+// On TPU the device allocator belongs to XLA (BFC inside the runtime), so the
+// native layer owns what XLA does not: *host* staging memory for the input
+// pipeline. Design: auto-growth chunked best-fit with address-ordered
+// coalescing — chunks are mmap'd (so free() can MADV_DONTNEED back to the
+// OS), blocks carry size/free headers, a free-list keyed by size implements
+// best-fit, and adjacent free blocks merge on release. Thread-safe. Stats
+// mirror the reference's allocator counters (allocated/reserved/peak).
+#include <sys/mman.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+
+namespace {
+
+constexpr size_t kAlign = 64;  // cacheline; also good for numpy views
+constexpr size_t kMinChunk = 1 << 20;
+
+inline size_t align_up(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+// alignas(kAlign) keeps every payload 64B-aligned: chunks are page-aligned,
+// block sizes are multiples of kAlign, and the header occupies exactly kAlign.
+struct alignas(kAlign) Block {
+  size_t size;       // payload bytes
+  bool free;
+  Block* prev_addr;  // address-ordered neighbors within the chunk
+  Block* next_addr;
+  char* payload() { return reinterpret_cast<char*>(this) + sizeof(Block); }
+  static Block* of_payload(void* p) {
+    return reinterpret_cast<Block*>(static_cast<char*>(p) - sizeof(Block));
+  }
+};
+
+struct Arena {
+  std::mutex mu;
+  // best-fit: free blocks keyed by size (multimap → first fit among equals)
+  std::multimap<size_t, Block*> free_blocks;
+  size_t reserved = 0;   // total mmap'd
+  size_t allocated = 0;  // live payload bytes
+  size_t peak = 0;
+  size_t chunk_size;
+
+  explicit Arena(size_t chunk) : chunk_size(chunk < kMinChunk ? kMinChunk : chunk) {}
+
+  Block* grow(size_t need) {
+    size_t sz = chunk_size;
+    while (sz < need + sizeof(Block)) sz *= 2;
+    void* mem = mmap(nullptr, sz, PROT_READ | PROT_WRITE,
+                     MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (mem == MAP_FAILED) return nullptr;
+    reserved += sz;
+    Block* b = static_cast<Block*>(mem);
+    b->size = sz - sizeof(Block);
+    b->free = true;
+    b->prev_addr = nullptr;
+    b->next_addr = nullptr;
+    return b;
+  }
+
+  void insert_free(Block* b) { free_blocks.emplace(b->size, b); }
+
+  void erase_free(Block* b) {
+    auto range = free_blocks.equal_range(b->size);
+    for (auto it = range.first; it != range.second; ++it) {
+      if (it->second == b) {
+        free_blocks.erase(it);
+        return;
+      }
+    }
+  }
+
+  void* alloc(size_t n) {
+    n = align_up(n ? n : kAlign);
+    std::lock_guard<std::mutex> g(mu);
+    auto it = free_blocks.lower_bound(n);
+    Block* b;
+    if (it == free_blocks.end()) {
+      b = grow(n);
+      if (!b) return nullptr;
+    } else {
+      b = it->second;
+      free_blocks.erase(it);
+    }
+    // split if the remainder can hold a useful block
+    if (b->size >= n + sizeof(Block) + kAlign) {
+      Block* rest = reinterpret_cast<Block*>(b->payload() + n);
+      rest->size = b->size - n - sizeof(Block);
+      rest->free = true;
+      rest->prev_addr = b;
+      rest->next_addr = b->next_addr;
+      if (rest->next_addr) rest->next_addr->prev_addr = rest;
+      b->next_addr = rest;
+      b->size = n;
+      insert_free(rest);
+    }
+    b->free = false;
+    allocated += b->size;
+    if (allocated > peak) peak = allocated;
+    return b->payload();
+  }
+
+  void release(void* p) {
+    if (!p) return;
+    std::lock_guard<std::mutex> g(mu);
+    Block* b = Block::of_payload(p);
+    allocated -= b->size;
+    b->free = true;
+    // coalesce with address neighbors
+    Block* nxt = b->next_addr;
+    if (nxt && nxt->free) {
+      erase_free(nxt);
+      b->size += sizeof(Block) + nxt->size;
+      b->next_addr = nxt->next_addr;
+      if (b->next_addr) b->next_addr->prev_addr = b;
+    }
+    Block* prv = b->prev_addr;
+    if (prv && prv->free) {
+      erase_free(prv);
+      prv->size += sizeof(Block) + b->size;
+      prv->next_addr = b->next_addr;
+      if (prv->next_addr) prv->next_addr->prev_addr = prv;
+      b = prv;
+    }
+    insert_free(b);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_arena_create(size_t chunk_size) {
+  return new (std::nothrow) Arena(chunk_size);
+}
+
+void pt_arena_destroy(void* arena) { delete static_cast<Arena*>(arena); }
+
+void* pt_arena_alloc(void* arena, size_t n) {
+  return static_cast<Arena*>(arena)->alloc(n);
+}
+
+void pt_arena_free(void* arena, void* p) {
+  static_cast<Arena*>(arena)->release(p);
+}
+
+// stats[0]=allocated, stats[1]=reserved, stats[2]=peak
+void pt_arena_stats(void* arena, size_t* stats) {
+  Arena* a = static_cast<Arena*>(arena);
+  std::lock_guard<std::mutex> g(a->mu);
+  stats[0] = a->allocated;
+  stats[1] = a->reserved;
+  stats[2] = a->peak;
+}
+
+}  // extern "C"

@@ -1,0 +1,273 @@
+// Shared-memory ring buffer — worker→trainer batch transport for the
+// multiprocess DataLoader. TPU-native equivalent of the reference's
+// mmap_allocator.h shared-memory tensors + blocking queue
+// (memory/allocation/mmap_allocator.h, fluid/dataloader/dataloader_iter.py):
+// instead of per-tensor mmap files plus a pickle queue, one fixed-size POSIX
+// shm ring carries length-prefixed records (the serialized batch), with a
+// process-shared mutex/condvar pair for blocking push/pop. Zero copies on
+// the consumer side beyond the single ring→numpy memcpy.
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <new>
+
+namespace {
+
+struct RingHeader {
+  uint64_t capacity;   // data bytes
+  uint64_t head;       // write offset (monotonic)
+  uint64_t tail;       // read offset (monotonic)
+  uint32_t closed;
+  pthread_mutex_t mu;
+  pthread_cond_t not_full;
+  pthread_cond_t not_empty;
+};
+
+struct Ring {
+  RingHeader* hdr;
+  char* data;
+  size_t map_size;
+  int fd;
+  char name[256];
+  bool owner;
+};
+
+constexpr uint64_t kRecHdr = 8;  // u64 length prefix
+
+inline uint64_t used(RingHeader* h) { return h->head - h->tail; }
+
+void write_bytes(Ring* r, uint64_t off, const void* src, uint64_t n) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t pos = off % cap;
+  uint64_t first = n < cap - pos ? n : cap - pos;
+  memcpy(r->data + pos, src, first);
+  if (n > first) memcpy(r->data, static_cast<const char*>(src) + first, n - first);
+}
+
+void read_bytes(Ring* r, uint64_t off, void* dst, uint64_t n) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t pos = off % cap;
+  uint64_t first = n < cap - pos ? n : cap - pos;
+  memcpy(dst, r->data + pos, first);
+  if (n > first) memcpy(static_cast<char*>(dst) + first, r->data, n - first);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (owner=1) or attach (owner=0) a named shm ring. Returns handle.
+void* pt_ring_open(const char* name, uint64_t capacity, int owner) {
+  size_t map_size = sizeof(RingHeader) + capacity;
+  int fd;
+  if (owner) {
+    shm_unlink(name);  // stale segment from a crashed run
+    fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    if (ftruncate(fd, (off_t)map_size) != 0) {
+      close(fd);
+      shm_unlink(name);
+      return nullptr;
+    }
+  } else {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    struct stat st;
+    if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(RingHeader)) {
+      close(fd);
+      return nullptr;
+    }
+    map_size = st.st_size;
+  }
+  void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    if (owner) shm_unlink(name);
+    return nullptr;
+  }
+  Ring* r = new (std::nothrow) Ring();
+  if (!r) return nullptr;
+  r->hdr = static_cast<RingHeader*>(mem);
+  r->data = static_cast<char*>(mem) + sizeof(RingHeader);
+  r->map_size = map_size;
+  r->fd = fd;
+  r->owner = owner != 0;
+  snprintf(r->name, sizeof(r->name), "%s", name);
+  if (owner) {
+    r->hdr->capacity = map_size - sizeof(RingHeader);
+    r->hdr->head = r->hdr->tail = 0;
+    r->hdr->closed = 0;
+    pthread_mutexattr_t ma;
+    pthread_mutexattr_init(&ma);
+    pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&r->hdr->mu, &ma);
+    pthread_condattr_t ca;
+    pthread_condattr_init(&ca);
+    pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+    pthread_cond_init(&r->hdr->not_full, &ca);
+    pthread_cond_init(&r->hdr->not_empty, &ca);
+  }
+  return r;
+}
+
+static int ring_lock(RingHeader* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) {  // a worker died holding the lock; recover
+    pthread_mutex_consistent(&h->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+// cond waits on a robust mutex can also hand us a dead owner's lock
+static int ring_wait(pthread_cond_t* cv, RingHeader* h) {
+  int rc = pthread_cond_wait(cv, &h->mu);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+static int ring_wait_timed(pthread_cond_t* cv, RingHeader* h,
+                           const struct timespec* ts) {
+  int rc = pthread_cond_timedwait(cv, &h->mu, ts);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+// Push one record. Blocks while full. Returns 0 ok, -1 closed, -2 too large.
+int pt_ring_push(void* ring, const void* buf, uint64_t n) {
+  Ring* r = static_cast<Ring*>(ring);
+  RingHeader* h = r->hdr;
+  if (kRecHdr + n > h->capacity) return -2;
+  if (ring_lock(h) != 0) return -1;
+  while (!h->closed && used(h) + kRecHdr + n > h->capacity) {
+    ring_wait(&h->not_full, h);
+  }
+  if (h->closed) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  write_bytes(r, h->head, &n, kRecHdr);
+  write_bytes(r, h->head + kRecHdr, buf, n);
+  h->head += kRecHdr + n;
+  pthread_cond_signal(&h->not_empty);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// Size of the next record, blocking until one arrives.
+// Returns >=0 size, -1 closed-and-drained.
+int64_t pt_ring_next_size(void* ring) {
+  Ring* r = static_cast<Ring*>(ring);
+  RingHeader* h = r->hdr;
+  if (ring_lock(h) != 0) return -1;
+  while (!h->closed && used(h) < kRecHdr) {
+    ring_wait(&h->not_empty, h);
+  }
+  if (used(h) < kRecHdr) {  // closed and drained
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  uint64_t n;
+  read_bytes(r, h->tail, &n, kRecHdr);
+  pthread_mutex_unlock(&h->mu);
+  return (int64_t)n;
+}
+
+// Pop the next record into buf (must be >= its size; call next_size first).
+// Returns record size, or -1 closed-and-drained.
+int64_t pt_ring_pop(void* ring, void* buf, uint64_t bufcap) {
+  Ring* r = static_cast<Ring*>(ring);
+  RingHeader* h = r->hdr;
+  if (ring_lock(h) != 0) return -1;
+  while (!h->closed && used(h) < kRecHdr) {
+    ring_wait(&h->not_empty, h);
+  }
+  if (used(h) < kRecHdr) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  uint64_t n;
+  read_bytes(r, h->tail, &n, kRecHdr);
+  if (n > bufcap) {
+    pthread_mutex_unlock(&h->mu);
+    return -2;
+  }
+  read_bytes(r, h->tail + kRecHdr, buf, n);
+  h->tail += kRecHdr + n;
+  pthread_cond_signal(&h->not_full);
+  pthread_mutex_unlock(&h->mu);
+  return (int64_t)n;
+}
+
+// Timed pop: like pt_ring_pop but gives up after timeout_ms with -3.
+int64_t pt_ring_pop_timed(void* ring, void* buf, uint64_t bufcap,
+                          int64_t timeout_ms) {
+  Ring* r = static_cast<Ring*>(ring);
+  RingHeader* h = r->hdr;
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_sec += timeout_ms / 1000;
+  ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts.tv_nsec >= 1000000000L) {
+    ts.tv_sec += 1;
+    ts.tv_nsec -= 1000000000L;
+  }
+  if (ring_lock(h) != 0) return -1;
+  while (!h->closed && used(h) < kRecHdr) {
+    if (ring_wait_timed(&h->not_empty, h, &ts) == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -3;
+    }
+  }
+  if (used(h) < kRecHdr) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  uint64_t n;
+  read_bytes(r, h->tail, &n, kRecHdr);
+  if (n > bufcap) {
+    pthread_mutex_unlock(&h->mu);
+    return -2;
+  }
+  read_bytes(r, h->tail + kRecHdr, buf, n);
+  h->tail += kRecHdr + n;
+  pthread_cond_signal(&h->not_full);
+  pthread_mutex_unlock(&h->mu);
+  return (int64_t)n;
+}
+
+// Mark closed: producers stop, consumers drain then get -1.
+void pt_ring_close(void* ring) {
+  Ring* r = static_cast<Ring*>(ring);
+  if (ring_lock(r->hdr) != 0) return;
+  r->hdr->closed = 1;
+  pthread_cond_broadcast(&r->hdr->not_empty);
+  pthread_cond_broadcast(&r->hdr->not_full);
+  pthread_mutex_unlock(&r->hdr->mu);
+}
+
+int pt_ring_closed(void* ring) { return static_cast<Ring*>(ring)->hdr->closed; }
+
+void pt_ring_release(void* ring) {
+  Ring* r = static_cast<Ring*>(ring);
+  munmap(r->hdr, r->map_size);
+  close(r->fd);
+  if (r->owner) shm_unlink(r->name);
+  delete r;
+}
+
+}  // extern "C"
