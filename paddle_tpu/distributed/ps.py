@@ -1,0 +1,254 @@
+"""Parameter-server training — Python API over the native PS core.
+
+Parity with the reference's pscore stack: PsServer/PsClient wrap
+paddle_tpu/native/src/ps.cc (the brpc_ps_server/brpc_ps_client equivalent,
+distributed/service/brpc_ps_server.h, communicator.h); ``SparseEmbedding``
+plays the role of distributed_lookup_table / VocabParallelEmbedding-over-PS:
+pull rows for the batch's ids, compute on TPU, push the sparse grads back.
+``AsyncCommunicator`` mirrors communicator.h's batched async push mode.
+A server in a background thread of the same process gives the reference's
+PsLocalClient single-process mock for tests.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+import queue as _queue
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["PsServer", "PsClient", "SparseEmbedding", "AsyncCommunicator",
+           "OPT_SGD", "OPT_ADAGRAD", "OPT_ADAM"]
+
+OPT_SGD, OPT_ADAGRAD, OPT_ADAM = 0, 1, 2
+
+
+def _lib():
+    from paddle_tpu import native
+
+    lib = native.ensure_built()
+    if lib is None:
+        raise RuntimeError("parameter server requires the native library")
+    return lib
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+class PsServer:
+    """One PS shard. Register tables, then start(); runs until a client
+    calls shutdown."""
+
+    def __init__(self, port: int = 0, n_workers: int = 1):
+        self._lib = _lib()
+        self._h = self._lib.pt_ps_server_create(port, n_workers)
+        if not self._h:
+            raise OSError(f"PS server bind failed on port {port}")
+        self._started = False
+
+    @property
+    def port(self) -> int:
+        return self._lib.pt_ps_server_port(self._h)
+
+    def add_dense_table(self, table_id: int, size: int,
+                        init: Optional[np.ndarray] = None,
+                        optimizer: int = OPT_SGD, lr: float = 0.01):
+        init_p = None
+        if init is not None:
+            init = np.ascontiguousarray(init, dtype=np.float32).ravel()
+            assert init.size == size
+            init_p = _f32p(init)
+        self._lib.pt_ps_add_dense_table(self._h, table_id, size, init_p,
+                                        optimizer, lr)
+
+    def add_sparse_table(self, table_id: int, dim: int,
+                         optimizer: int = OPT_SGD, lr: float = 0.01,
+                         init_range: float = 0.01, seed: int = 1234):
+        self._lib.pt_ps_add_sparse_table(self._h, table_id, dim, optimizer,
+                                         lr, init_range, seed)
+
+    def start(self):
+        self._lib.pt_ps_server_start(self._h)
+        self._started = True
+
+    def stopped(self) -> bool:
+        return bool(self._lib.pt_ps_server_stopped(self._h))
+
+    def destroy(self):
+        if getattr(self, "_h", None):
+            self._lib.pt_ps_server_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass
+
+
+class PsClient:
+    """Worker-side connection to one PS shard."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._lib = _lib()
+        self._h = self._lib.pt_ps_connect(host.encode(), port)
+        if not self._h:
+            raise ConnectionError(f"PS connect failed: {host}:{port}")
+        self._mu = threading.Lock()  # one in-flight request per connection
+
+    def pull_dense(self, table_id: int, size: int) -> np.ndarray:
+        out = np.empty(size, np.float32)
+        with self._mu:
+            rc = self._lib.pt_ps_pull_dense(self._h, table_id, _f32p(out), size)
+        if rc != 0:
+            raise RuntimeError(f"pull_dense failed (table {table_id})")
+        return out
+
+    def push_dense_grad(self, table_id: int, grad: np.ndarray):
+        grad = np.ascontiguousarray(grad, np.float32).ravel()
+        with self._mu:
+            rc = self._lib.pt_ps_push_dense(self._h, table_id, _f32p(grad),
+                                            grad.size)
+        if rc != 0:
+            raise RuntimeError(f"push_dense failed (table {table_id})")
+
+    def pull_sparse(self, table_id: int, keys: np.ndarray, dim: int) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, np.int64).ravel()
+        out = np.empty((keys.size, dim), np.float32)
+        with self._mu:
+            rc = self._lib.pt_ps_pull_sparse(self._h, table_id, _i64p(keys),
+                                             keys.size, _f32p(out), dim)
+        if rc != 0:
+            raise RuntimeError(f"pull_sparse failed (table {table_id})")
+        return out
+
+    def push_sparse_grad(self, table_id: int, keys: np.ndarray,
+                         grads: np.ndarray):
+        keys = np.ascontiguousarray(keys, np.int64).ravel()
+        grads = np.ascontiguousarray(grads, np.float32)
+        assert grads.shape[0] == keys.size
+        with self._mu:
+            rc = self._lib.pt_ps_push_sparse(self._h, table_id, _i64p(keys),
+                                             keys.size, _f32p(grads),
+                                             grads.shape[1])
+        if rc != 0:
+            raise RuntimeError(f"push_sparse failed (table {table_id})")
+
+    def barrier(self):
+        with self._mu:
+            if self._lib.pt_ps_barrier(self._h) != 0:
+                raise RuntimeError("barrier failed")
+
+    def save(self, path: str):
+        with self._mu:
+            if self._lib.pt_ps_save(self._h, path.encode()) != 0:
+                raise RuntimeError("ps save failed")
+
+    def load(self, path: str):
+        with self._mu:
+            if self._lib.pt_ps_load(self._h, path.encode()) != 0:
+                raise RuntimeError("ps load failed")
+
+    def shutdown_server(self):
+        with self._mu:
+            self._lib.pt_ps_shutdown(self._h)
+
+    def disconnect(self):
+        if getattr(self, "_h", None):
+            self._lib.pt_ps_disconnect(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.disconnect()
+        except Exception:
+            pass
+
+
+class SparseEmbedding:
+    """PS-backed embedding (reference: distributed_lookup_table_op /
+    the_one_ps sparse table). Rows live on the server; the worker pulls the
+    batch's unique ids, computes on device, pushes grads back."""
+
+    def __init__(self, client: PsClient, table_id: int, dim: int):
+        self.client = client
+        self.table_id = table_id
+        self.dim = dim
+
+    def lookup(self, ids: np.ndarray) -> np.ndarray:
+        """ids: any shape int64 → [*, dim] float32 (pulls unique rows once)."""
+        shape = ids.shape
+        flat = ids.ravel()
+        uniq, inv = np.unique(flat, return_inverse=True)
+        rows = self.client.pull_sparse(self.table_id, uniq, self.dim)
+        return rows[inv].reshape(*shape, self.dim)
+
+    def push_grad(self, ids: np.ndarray, grad: np.ndarray):
+        """grad: [*, dim] matching ids' shape; duplicate ids accumulate."""
+        flat = ids.ravel()
+        g = grad.reshape(-1, self.dim)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        acc = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(acc, inv, g)
+        self.client.push_sparse_grad(self.table_id, uniq, acc)
+
+
+class AsyncCommunicator:
+    """Async push mode (reference: distributed/service/communicator.h
+    AsyncCommunicator): worker queues grads, a background thread pushes —
+    training never blocks on the PS round-trip."""
+
+    def __init__(self, client: PsClient, max_queue: int = 64):
+        self.client = client
+        self._q: _queue.Queue = _queue.Queue(maxsize=max_queue)
+        self._stop = False
+        self._exc: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            try:
+                kind, args = item
+                if kind == "dense":
+                    self.client.push_dense_grad(*args)
+                else:
+                    self.client.push_sparse_grad(*args)
+            except BaseException as e:  # surfaced on flush/stop
+                self._exc = e
+            finally:
+                self._q.task_done()
+
+    def push_dense_async(self, table_id: int, grad: np.ndarray):
+        self._check()
+        self._q.put(("dense", (table_id, np.array(grad, np.float32, copy=True))))
+
+    def push_sparse_async(self, table_id: int, keys: np.ndarray,
+                          grads: np.ndarray):
+        self._check()
+        self._q.put(("sparse", (table_id, np.array(keys, np.int64, copy=True),
+                                np.array(grads, np.float32, copy=True))))
+
+    def flush(self):
+        self._q.join()
+        self._check()
+
+    def _check(self):
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise RuntimeError("async communicator push failed") from exc
+
+    def stop(self):
+        self.flush()
+        self._q.put(None)
+        self._thread.join(timeout=5)

@@ -1,5 +1,11 @@
 """paddle_tpu.io — datasets, samplers, DataLoader (parity python/paddle/io)."""
 from .collate import default_collate_fn, default_convert_fn  # noqa: F401
+from .data_feed import (  # noqa: F401
+    InMemoryDataset,
+    MultiSlotDataFeed,
+    RaggedSlot,
+    SlotDesc,
+)
 from .dataloader import DataLoader, get_worker_info  # noqa: F401
 from .dataset import (  # noqa: F401
     ChainDataset,

@@ -91,6 +91,61 @@ def _declare(lib):
     lib.pt_ring_closed.argtypes = [ctypes.c_void_p]
     lib.pt_ring_release.argtypes = [ctypes.c_void_p]
 
+    lib.pt_feed_create.restype = ctypes.c_void_p
+    lib.pt_feed_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_uint64,
+    ]
+    lib.pt_feed_next.restype = ctypes.c_void_p
+    lib.pt_feed_next.argtypes = [ctypes.c_void_p]
+    lib.pt_batch_nrecords.restype = ctypes.c_uint64
+    lib.pt_batch_nrecords.argtypes = [ctypes.c_void_p]
+    lib.pt_batch_slot.restype = ctypes.c_uint64
+    lib.pt_batch_slot.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.pt_batch_release.argtypes = [ctypes.c_void_p]
+    lib.pt_feed_error.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.pt_feed_destroy.argtypes = [ctypes.c_void_p]
+
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.pt_ps_server_create.restype = ctypes.c_void_p
+    lib.pt_ps_server_create.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.pt_ps_server_port.restype = ctypes.c_int
+    lib.pt_ps_server_port.argtypes = [ctypes.c_void_p]
+    lib.pt_ps_add_dense_table.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64, f32p,
+        ctypes.c_int, ctypes.c_float,
+    ]
+    lib.pt_ps_add_sparse_table.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_float, ctypes.c_float, ctypes.c_uint64,
+    ]
+    lib.pt_ps_server_start.argtypes = [ctypes.c_void_p]
+    lib.pt_ps_server_stopped.restype = ctypes.c_int
+    lib.pt_ps_server_stopped.argtypes = [ctypes.c_void_p]
+    lib.pt_ps_server_destroy.argtypes = [ctypes.c_void_p]
+    lib.pt_ps_connect.restype = ctypes.c_void_p
+    lib.pt_ps_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.pt_ps_pull_dense.argtypes = [ctypes.c_void_p, ctypes.c_uint32, f32p,
+                                     ctypes.c_uint64]
+    lib.pt_ps_push_dense.argtypes = [ctypes.c_void_p, ctypes.c_uint32, f32p,
+                                     ctypes.c_uint64]
+    lib.pt_ps_pull_sparse.argtypes = [ctypes.c_void_p, ctypes.c_uint32, i64p,
+                                      ctypes.c_uint64, f32p, ctypes.c_uint64]
+    lib.pt_ps_push_sparse.argtypes = [ctypes.c_void_p, ctypes.c_uint32, i64p,
+                                      ctypes.c_uint64, f32p, ctypes.c_uint64]
+    for fn in ("pt_ps_barrier", "pt_ps_shutdown"):
+        getattr(lib, fn).restype = ctypes.c_int
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    for fn in ("pt_ps_save", "pt_ps_load"):
+        getattr(lib, fn).restype = ctypes.c_int
+        getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.pt_ps_disconnect.argtypes = [ctypes.c_void_p]
+
 
 def available() -> bool:
     return ensure_built() is not None

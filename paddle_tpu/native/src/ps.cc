@@ -1,0 +1,612 @@
+// Parameter server — native TCP server/client with dense + sparse tables
+// and server-side optimizers. TPU-native equivalent of the reference's
+// "pscore" stack (distributed/service/brpc_ps_server.h, brpc_ps_client.h,
+// distributed/table/common_dense_table.h, common_sparse_table.h,
+// sendrecv.proto): brpc → plain framed TCP (host-side RPC needs no
+// accelerator awareness), tables keep fp32 host weights, workers are the
+// TPU hosts pulling/pushing over DCN.
+//
+// Wire protocol (little-endian):
+//   request : [u32 op][u32 table][u64 a][u64 b][payload]
+//   response: [u32 status][u64 nbytes][payload]
+// ops: 1 pull_dense  2 push_dense_grad  3 pull_sparse  4 push_sparse_grad
+//      5 barrier     6 save             7 load         8 shutdown
+//      9 set_clock (a=worker_id)
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <new>
+#include <random>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum Op : uint32_t {
+  kPullDense = 1,
+  kPushDenseGrad = 2,
+  kPullSparse = 3,
+  kPushSparseGrad = 4,
+  kBarrier = 5,
+  kSave = 6,
+  kLoad = 7,
+  kShutdown = 8,
+};
+
+enum Optimizer : int { kSGD = 0, kAdagrad = 1, kAdam = 2 };
+
+struct DenseTable {
+  std::mutex mu;
+  std::vector<float> w;
+  std::vector<float> m0, m1;  // optimizer state
+  int opt = kSGD;
+  float lr = 0.01f;
+  int64_t step = 0;
+};
+
+struct SparseShard {
+  std::mutex mu;
+  std::unordered_map<int64_t, std::vector<float>> rows;  // dim*(1..3) floats
+};
+
+struct SparseTable {
+  uint64_t dim = 0;
+  int opt = kSGD;
+  float lr = 0.01f;
+  float init_range = 0.01f;
+  uint64_t seed = 1234;
+  static constexpr int kShards = 16;
+  SparseShard shards[kShards];
+
+  SparseShard& shard(int64_t key) {
+    return shards[(uint64_t)key % kShards];
+  }
+  // adam rows carry a trailing per-row step counter for bias correction
+  size_t row_floats() const {
+    return dim * (opt == kSGD ? 1 : (opt == kAdagrad ? 2 : 3)) +
+           (opt == kAdam ? 1 : 0);
+  }
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  int n_workers = 1;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conns;
+  std::vector<int> conn_fds;  // so the destructor can unblock recv()
+  std::mutex conns_mu;
+
+  std::unordered_map<uint32_t, DenseTable*> dense;
+  std::unordered_map<uint32_t, SparseTable*> sparse;
+
+  // barrier
+  std::mutex bar_mu;
+  std::condition_variable bar_cv;
+  int bar_count = 0;
+  uint64_t bar_gen = 0;
+
+  ~Server() {
+    stop.store(true);
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      close(listen_fd);
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    {
+      std::lock_guard<std::mutex> g(conns_mu);
+      for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+      for (auto& t : conns)
+        if (t.joinable()) t.join();
+    }
+    for (auto& kv : dense) delete kv.second;
+    for (auto& kv : sparse) delete kv.second;
+  }
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t got = recv(fd, p, n, 0);
+    if (got <= 0) return false;
+    p += got;
+    n -= got;
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t put = send(fd, p, n, MSG_NOSIGNAL);
+    if (put <= 0) return false;
+    p += put;
+    n -= put;
+  }
+  return true;
+}
+
+bool send_resp(int fd, uint32_t status, const void* payload, uint64_t n) {
+  char hdr[12];
+  memcpy(hdr, &status, 4);
+  memcpy(hdr + 4, &n, 8);
+  if (!write_full(fd, hdr, 12)) return false;
+  if (n && !write_full(fd, payload, n)) return false;
+  return true;
+}
+
+void init_row(SparseTable* t, int64_t key, std::vector<float>* row) {
+  row->assign(t->row_floats(), 0.0f);
+  // deterministic per-key init (uniform in ±init_range)
+  std::mt19937_64 gen(t->seed ^ (uint64_t)key);
+  std::uniform_real_distribution<float> dist(-t->init_range, t->init_range);
+  for (uint64_t d = 0; d < t->dim; ++d) (*row)[d] = dist(gen);
+}
+
+void apply_grad(int opt, float lr, float* w, float* m0, float* m1, int64_t step,
+                const float* g, uint64_t n) {
+  switch (opt) {
+    case kSGD:
+      for (uint64_t i = 0; i < n; ++i) w[i] -= lr * g[i];
+      break;
+    case kAdagrad:
+      for (uint64_t i = 0; i < n; ++i) {
+        m0[i] += g[i] * g[i];
+        w[i] -= lr * g[i] / (std::sqrt(m0[i]) + 1e-6f);
+      }
+      break;
+    case kAdam: {
+      const float b1 = 0.9f, b2 = 0.999f, eps = 1e-8f;
+      float c1 = 1.0f - std::pow(b1, (float)step);
+      float c2 = 1.0f - std::pow(b2, (float)step);
+      for (uint64_t i = 0; i < n; ++i) {
+        m0[i] = b1 * m0[i] + (1 - b1) * g[i];
+        m1[i] = b2 * m1[i] + (1 - b2) * g[i] * g[i];
+        w[i] -= lr * (m0[i] / c1) / (std::sqrt(m1[i] / c2) + eps);
+      }
+      break;
+    }
+  }
+}
+
+void handle_conn(Server* sv, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::vector<char> payload;
+  for (;;) {
+    char hdr[24];
+    if (!read_full(fd, hdr, 24)) break;
+    uint32_t op, table;
+    uint64_t a, b;
+    memcpy(&op, hdr, 4);
+    memcpy(&table, hdr + 4, 4);
+    memcpy(&a, hdr + 8, 8);
+    memcpy(&b, hdr + 16, 8);
+
+    switch (op) {
+      case kPullDense: {
+        auto it = sv->dense.find(table);
+        if (it == sv->dense.end()) {
+          send_resp(fd, 1, nullptr, 0);
+          break;
+        }
+        DenseTable* t = it->second;
+        std::lock_guard<std::mutex> g(t->mu);
+        send_resp(fd, 0, t->w.data(), t->w.size() * 4);
+        break;
+      }
+      case kPushDenseGrad: {
+        payload.resize(a * 4);
+        if (!read_full(fd, payload.data(), payload.size())) return;
+        auto it = sv->dense.find(table);
+        if (it == sv->dense.end()) {
+          send_resp(fd, 1, nullptr, 0);
+          break;
+        }
+        DenseTable* t = it->second;
+        std::lock_guard<std::mutex> g(t->mu);
+        uint64_t n = std::min<uint64_t>(a, t->w.size());
+        t->step += 1;
+        apply_grad(t->opt, t->lr, t->w.data(), t->m0.data(), t->m1.data(),
+                   t->step, reinterpret_cast<float*>(payload.data()), n);
+        send_resp(fd, 0, nullptr, 0);
+        break;
+      }
+      case kPullSparse: {
+        payload.resize(a * 8);
+        if (!read_full(fd, payload.data(), payload.size())) return;
+        auto it = sv->sparse.find(table);
+        if (it == sv->sparse.end()) {
+          send_resp(fd, 1, nullptr, 0);
+          break;
+        }
+        SparseTable* t = it->second;
+        if (b != t->dim) {  // client/table dim mismatch is an error
+          send_resp(fd, 2, nullptr, 0);
+          break;
+        }
+        const int64_t* keys = reinterpret_cast<int64_t*>(payload.data());
+        std::vector<float> out(a * t->dim);
+        for (uint64_t i = 0; i < a; ++i) {
+          SparseShard& sh = t->shard(keys[i]);
+          std::lock_guard<std::mutex> g(sh.mu);
+          auto& row = sh.rows[keys[i]];
+          if (row.empty()) init_row(t, keys[i], &row);
+          memcpy(&out[i * t->dim], row.data(), t->dim * 4);
+        }
+        send_resp(fd, 0, out.data(), out.size() * 4);
+        break;
+      }
+      case kPushSparseGrad: {
+        auto it = sv->sparse.find(table);
+        uint64_t dim = b;
+        payload.resize(a * 8 + a * dim * 4);
+        if (!read_full(fd, payload.data(), payload.size())) return;
+        if (it == sv->sparse.end()) {
+          send_resp(fd, 1, nullptr, 0);
+          break;
+        }
+        SparseTable* t = it->second;
+        if (dim != t->dim) {
+          send_resp(fd, 2, nullptr, 0);
+          break;
+        }
+        const int64_t* keys = reinterpret_cast<int64_t*>(payload.data());
+        const float* grads = reinterpret_cast<float*>(payload.data() + a * 8);
+        for (uint64_t i = 0; i < a; ++i) {
+          SparseShard& sh = t->shard(keys[i]);
+          std::lock_guard<std::mutex> g(sh.mu);
+          auto& row = sh.rows[keys[i]];
+          if (row.empty()) init_row(t, keys[i], &row);
+          float* w = row.data();
+          float* m0 = t->opt == kSGD ? nullptr : w + t->dim;
+          float* m1 = t->opt == kAdam ? w + 2 * t->dim : nullptr;
+          int64_t step = 1;
+          if (t->opt == kAdam) {
+            float* step_f = w + 3 * t->dim;
+            *step_f += 1.0f;
+            step = (int64_t)*step_f;
+          }
+          apply_grad(t->opt, t->lr, w, m0, m1, step, &grads[i * t->dim],
+                     t->dim);
+        }
+        send_resp(fd, 0, nullptr, 0);
+        break;
+      }
+      case kBarrier: {
+        std::unique_lock<std::mutex> lk(sv->bar_mu);
+        uint64_t gen = sv->bar_gen;
+        if (++sv->bar_count >= sv->n_workers) {
+          sv->bar_count = 0;
+          sv->bar_gen += 1;
+          sv->bar_cv.notify_all();
+        } else {
+          sv->bar_cv.wait(lk, [&] {
+            return sv->bar_gen != gen || sv->stop.load();
+          });
+        }
+        send_resp(fd, 0, nullptr, 0);
+        break;
+      }
+      case kSave: {
+        payload.resize(a);
+        if (!read_full(fd, payload.data(), a)) return;
+        std::string path(payload.data(), a);
+        FILE* fp = fopen(path.c_str(), "wb");
+        if (!fp) {
+          send_resp(fd, 1, nullptr, 0);
+          break;
+        }
+        uint64_t nd = sv->dense.size(), ns = sv->sparse.size();
+        fwrite(&nd, 8, 1, fp);
+        for (auto& kv : sv->dense) {
+          DenseTable* t = kv.second;
+          std::lock_guard<std::mutex> g(t->mu);
+          uint64_t sz = t->w.size();
+          fwrite(&kv.first, 4, 1, fp);
+          fwrite(&sz, 8, 1, fp);
+          fwrite(t->w.data(), 4, sz, fp);
+        }
+        fwrite(&ns, 8, 1, fp);
+        for (auto& kv : sv->sparse) {
+          SparseTable* t = kv.second;
+          fwrite(&kv.first, 4, 1, fp);
+          fwrite(&t->dim, 8, 1, fp);
+          uint64_t total = 0;
+          for (auto& sh : t->shards) {
+            std::lock_guard<std::mutex> g(sh.mu);
+            total += sh.rows.size();
+          }
+          fwrite(&total, 8, 1, fp);
+          for (auto& sh : t->shards) {
+            std::lock_guard<std::mutex> g(sh.mu);
+            for (auto& row : sh.rows) {
+              fwrite(&row.first, 8, 1, fp);
+              fwrite(row.second.data(), 4, t->dim, fp);  // weights only
+            }
+          }
+        }
+        fclose(fp);
+        send_resp(fd, 0, nullptr, 0);
+        break;
+      }
+      case kLoad: {
+        payload.resize(a);
+        if (!read_full(fd, payload.data(), a)) return;
+        std::string path(payload.data(), a);
+        FILE* fp = fopen(path.c_str(), "rb");
+        if (!fp) {
+          send_resp(fd, 1, nullptr, 0);
+          break;
+        }
+        uint64_t nd = 0;
+        bool ok = fread(&nd, 8, 1, fp) == 1;
+        for (uint64_t i = 0; ok && i < nd; ++i) {
+          uint32_t id;
+          uint64_t sz;
+          ok = fread(&id, 4, 1, fp) == 1 && fread(&sz, 8, 1, fp) == 1;
+          auto it = sv->dense.find(id);
+          if (!ok) break;
+          std::vector<float> w(sz);
+          ok = fread(w.data(), 4, sz, fp) == sz;
+          if (ok && it != sv->dense.end()) {
+            std::lock_guard<std::mutex> g(it->second->mu);
+            it->second->w = std::move(w);
+          }
+        }
+        uint64_t ns = 0;
+        ok = ok && fread(&ns, 8, 1, fp) == 1;
+        for (uint64_t i = 0; ok && i < ns; ++i) {
+          uint32_t id;
+          uint64_t dim, total;
+          ok = fread(&id, 4, 1, fp) == 1 && fread(&dim, 8, 1, fp) == 1 &&
+               fread(&total, 8, 1, fp) == 1;
+          auto it = sv->sparse.find(id);
+          for (uint64_t k = 0; ok && k < total; ++k) {
+            int64_t key;
+            std::vector<float> w(dim);
+            ok = fread(&key, 8, 1, fp) == 1 && fread(w.data(), 4, dim, fp) == dim;
+            if (ok && it != sv->sparse.end() && dim == it->second->dim) {
+              SparseTable* t = it->second;
+              SparseShard& sh = t->shard(key);
+              std::lock_guard<std::mutex> g(sh.mu);
+              auto& row = sh.rows[key];
+              row.assign(t->row_floats(), 0.0f);
+              memcpy(row.data(), w.data(), dim * 4);
+            }
+          }
+        }
+        fclose(fp);
+        send_resp(fd, ok ? 0 : 1, nullptr, 0);
+        break;
+      }
+      case kShutdown: {
+        send_resp(fd, 0, nullptr, 0);
+        sv->stop.store(true);
+        {
+          std::lock_guard<std::mutex> lk(sv->bar_mu);
+          sv->bar_cv.notify_all();
+        }
+        ::shutdown(sv->listen_fd, SHUT_RDWR);
+        close(fd);
+        return;
+      }
+      default:
+        send_resp(fd, 3, nullptr, 0);
+    }
+  }
+  close(fd);
+}
+
+struct Client {
+  int fd = -1;
+};
+
+bool client_req(Client* c, uint32_t op, uint32_t table, uint64_t a, uint64_t b,
+                const void* payload, uint64_t pn, std::vector<char>* reply) {
+  char hdr[24];
+  memcpy(hdr, &op, 4);
+  memcpy(hdr + 4, &table, 4);
+  memcpy(hdr + 8, &a, 8);
+  memcpy(hdr + 16, &b, 8);
+  if (!write_full(c->fd, hdr, 24)) return false;
+  if (pn && !write_full(c->fd, payload, pn)) return false;
+  char rhdr[12];
+  if (!read_full(c->fd, rhdr, 12)) return false;
+  uint32_t status;
+  uint64_t n;
+  memcpy(&status, rhdr, 4);
+  memcpy(&n, rhdr + 4, 8);
+  reply->resize(n);
+  if (n && !read_full(c->fd, reply->data(), n)) return false;
+  return status == 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_ps_server_create(int port, int n_workers) {
+  Server* sv = new (std::nothrow) Server();
+  if (!sv) return nullptr;
+  sv->n_workers = n_workers > 0 ? n_workers : 1;
+  sv->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(sv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (bind(sv->listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(sv->listen_fd, 64) != 0) {
+    delete sv;
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(sv->listen_fd, (sockaddr*)&addr, &len);
+  sv->port = ntohs(addr.sin_port);
+  return sv;
+}
+
+int pt_ps_server_port(void* server) { return static_cast<Server*>(server)->port; }
+
+// opt: 0=sgd 1=adagrad 2=adam. init: initial weights (may be null → zeros).
+int pt_ps_add_dense_table(void* server, uint32_t id, uint64_t size,
+                          const float* init, int opt, float lr) {
+  Server* sv = static_cast<Server*>(server);
+  DenseTable* t = new DenseTable();
+  t->opt = opt;
+  t->lr = lr;
+  t->w.assign(size, 0.0f);
+  if (init) memcpy(t->w.data(), init, size * 4);
+  if (opt != kSGD) t->m0.assign(size, 0.0f);
+  if (opt == kAdam) t->m1.assign(size, 0.0f);
+  sv->dense[id] = t;
+  return 0;
+}
+
+int pt_ps_add_sparse_table(void* server, uint32_t id, uint64_t dim, int opt,
+                           float lr, float init_range, uint64_t seed) {
+  Server* sv = static_cast<Server*>(server);
+  SparseTable* t = new SparseTable();
+  t->dim = dim;
+  t->opt = opt;
+  t->lr = lr;
+  t->init_range = init_range;
+  t->seed = seed;
+  sv->sparse[id] = t;
+  return 0;
+}
+
+// Start accepting (call after tables are registered).
+void pt_ps_server_start(void* server) {
+  Server* sv = static_cast<Server*>(server);
+  sv->accept_thread = std::thread([sv] {
+    while (!sv->stop.load()) {
+      int fd = accept(sv->listen_fd, nullptr, nullptr);
+      if (fd < 0) break;
+      std::lock_guard<std::mutex> g(sv->conns_mu);
+      sv->conn_fds.push_back(fd);
+      sv->conns.emplace_back(handle_conn, sv, fd);
+    }
+  });
+}
+
+int pt_ps_server_stopped(void* server) {
+  return static_cast<Server*>(server)->stop.load() ? 1 : 0;
+}
+
+void pt_ps_server_destroy(void* server) { delete static_cast<Server*>(server); }
+
+void* pt_ps_connect(const char* host, int port) {
+  Client* c = new (std::nothrow) Client();
+  if (!c) return nullptr;
+  c->fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  inet_pton(AF_INET, host, &addr.sin_addr);
+  if (connect(c->fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(c->fd);
+    delete c;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return c;
+}
+
+int pt_ps_pull_dense(void* client, uint32_t table, float* out, uint64_t n) {
+  std::vector<char> reply;
+  if (!client_req(static_cast<Client*>(client), kPullDense, table, n, 0,
+                  nullptr, 0, &reply))
+    return -1;
+  memcpy(out, reply.data(), std::min<uint64_t>(n * 4, reply.size()));
+  return 0;
+}
+
+int pt_ps_push_dense(void* client, uint32_t table, const float* grad,
+                     uint64_t n) {
+  std::vector<char> reply;
+  return client_req(static_cast<Client*>(client), kPushDenseGrad, table, n, 0,
+                    grad, n * 4, &reply)
+             ? 0
+             : -1;
+}
+
+int pt_ps_pull_sparse(void* client, uint32_t table, const int64_t* keys,
+                      uint64_t n, float* out, uint64_t dim) {
+  std::vector<char> reply;
+  if (!client_req(static_cast<Client*>(client), kPullSparse, table, n, dim,
+                  keys, n * 8, &reply))
+    return -1;
+  memcpy(out, reply.data(), std::min<uint64_t>(n * dim * 4, reply.size()));
+  return 0;
+}
+
+int pt_ps_push_sparse(void* client, uint32_t table, const int64_t* keys,
+                      uint64_t n, const float* grads, uint64_t dim) {
+  std::vector<char> payload(n * 8 + n * dim * 4);
+  memcpy(payload.data(), keys, n * 8);
+  memcpy(payload.data() + n * 8, grads, n * dim * 4);
+  std::vector<char> reply;
+  return client_req(static_cast<Client*>(client), kPushSparseGrad, table, n,
+                    dim, payload.data(), payload.size(), &reply)
+             ? 0
+             : -1;
+}
+
+int pt_ps_barrier(void* client) {
+  std::vector<char> reply;
+  return client_req(static_cast<Client*>(client), kBarrier, 0, 0, 0, nullptr, 0,
+                    &reply)
+             ? 0
+             : -1;
+}
+
+int pt_ps_save(void* client, const char* path) {
+  std::vector<char> reply;
+  uint64_t n = strlen(path);
+  return client_req(static_cast<Client*>(client), kSave, 0, n, 0, path, n,
+                    &reply)
+             ? 0
+             : -1;
+}
+
+int pt_ps_load(void* client, const char* path) {
+  std::vector<char> reply;
+  uint64_t n = strlen(path);
+  return client_req(static_cast<Client*>(client), kLoad, 0, n, 0, path, n,
+                    &reply)
+             ? 0
+             : -1;
+}
+
+int pt_ps_shutdown(void* client) {
+  std::vector<char> reply;
+  return client_req(static_cast<Client*>(client), kShutdown, 0, 0, 0, nullptr,
+                    0, &reply)
+             ? 0
+             : -1;
+}
+
+void pt_ps_disconnect(void* client) {
+  Client* c = static_cast<Client*>(client);
+  if (c->fd >= 0) close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

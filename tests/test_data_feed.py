@@ -1,0 +1,164 @@
+"""MultiSlot data feed: native parser vs python-written golden files
+(reference test style: data_feed tests + golden comparison)."""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+from paddle_tpu.io import InMemoryDataset, MultiSlotDataFeed, RaggedSlot, SlotDesc
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def _write_slot_file(path, records):
+    """records: list of (label:int, feats:list[float], ids:list[int])."""
+    with open(path, "w") as f:
+        for label, feats, ids in records:
+            parts = ["1", str(label)]
+            parts.append(str(len(feats)))
+            parts += [f"{v:.6f}" for v in feats]
+            parts.append(str(len(ids)))
+            parts += [str(i) for i in ids]
+            f.write(" ".join(parts) + "\n")
+
+
+SLOTS = [
+    SlotDesc("label", "int64", dense_dim=1),
+    SlotDesc("feat", "float32"),  # ragged
+    SlotDesc("ids", "int64"),     # ragged
+]
+
+DENSE_SLOTS = [
+    SlotDesc("label", "int64", dense_dim=1),
+    SlotDesc("feat", "float32", dense_dim=4),
+    SlotDesc("ids", "int64"),
+]
+
+
+def _make_records(rng, n, feat_dim=None, max_ids=6):
+    recs = []
+    for i in range(n):
+        fd = feat_dim if feat_dim else rng.randint(1, 5)
+        recs.append((
+            int(rng.randint(0, 10)),
+            [float(x) for x in rng.randn(fd)],
+            [int(x) for x in rng.randint(0, 1000, rng.randint(1, max_ids))],
+        ))
+    return recs
+
+
+class TestMultiSlotDataFeed:
+    def test_dense_and_ragged_slots(self, tmp_path, rng):
+        recs = _make_records(rng, 10, feat_dim=4)
+        p = str(tmp_path / "a.txt")
+        _write_slot_file(p, recs)
+        feed = MultiSlotDataFeed(DENSE_SLOTS, batch_size=10, num_threads=1)
+        feed.set_filelist([p])
+        (batch,) = list(feed)
+        # dense: uniform 4-dim feat → [10, 4]; uniform 1-dim label → [10, 1]
+        assert batch["feat"].shape == (10, 4)
+        assert batch["label"].shape == (10, 1)
+        assert isinstance(batch["ids"], RaggedSlot)
+        np.testing.assert_array_equal(
+            batch["label"].ravel(), [r[0] for r in recs])
+        np.testing.assert_allclose(  # file stores %.6f → atol at that grain
+            batch["feat"], [r[1] for r in recs], atol=1e-6)
+        got_ids = batch["ids"].rows()
+        for got, (_, _, want) in zip(got_ids, recs):
+            np.testing.assert_array_equal(got, want)
+
+    def test_multifile_multithread_complete(self, tmp_path, rng):
+        all_labels = set()
+        files = []
+        for fi in range(4):
+            recs = _make_records(rng, 25)
+            recs = [(fi * 1000 + i, r[1], r[2]) for i, r in enumerate(recs)]
+            all_labels.update(r[0] for r in recs)
+            p = str(tmp_path / f"f{fi}.txt")
+            _write_slot_file(p, recs)
+            files.append(p)
+        feed = MultiSlotDataFeed(SLOTS, batch_size=8, num_threads=3)
+        feed.set_filelist(files)
+        seen = []
+        total = 0
+        for batch in feed:
+            labels = batch["label"].ravel()  # dense_dim=1 → always ndarray
+            seen.extend(int(x) for x in labels)
+            total += len(labels)
+        assert total == 100
+        assert set(seen) == all_labels
+
+    def test_padded_densification(self, tmp_path, rng):
+        recs = _make_records(rng, 6)
+        p = str(tmp_path / "c.txt")
+        _write_slot_file(p, recs)
+        feed = MultiSlotDataFeed(SLOTS, batch_size=6, num_threads=1)
+        feed.set_filelist([p])
+        (batch,) = list(feed)
+        ids = batch["ids"]
+        padded, mask = ids.to_padded(8, pad_value=-1)
+        assert padded.shape == (6, 8) and mask.shape == (6, 8)
+        for i, (_, _, want) in enumerate(recs):
+            np.testing.assert_array_equal(padded[i, : len(want)], want)
+            assert mask[i].sum() == len(want)
+            assert (padded[i, len(want):] == -1).all()
+
+    def test_malformed_line_raises(self, tmp_path):
+        p = str(tmp_path / "bad.txt")
+        with open(p, "w") as f:
+            f.write("1 5 3 nota number x\n")
+        feed = MultiSlotDataFeed(SLOTS, batch_size=2, num_threads=1)
+        feed.set_filelist([p])
+        with pytest.raises(RuntimeError):
+            list(feed)
+
+    def test_malformed_line_does_not_corrupt_neighbors(self, tmp_path, rng):
+        # good records around a bad line: parsed batches stay intact
+        recs = _make_records(rng, 4, feat_dim=2)
+        recs = [(100 + i, r[1], r[2]) for i, r in enumerate(recs)]
+        p = str(tmp_path / "mixed.txt")
+        _write_slot_file(p, recs[:2])
+        with open(p, "a") as f:
+            f.write("1 7 2 0.5 oops 1 3\n")  # fails mid-record (slot 2)
+        _records_tail = recs[2:]
+        with open(p, "a") as f:
+            for label, feats, ids in _records_tail:
+                parts = ["1", str(label), str(len(feats))]
+                parts += [f"{v:.6f}" for v in feats]
+                parts.append(str(len(ids)))
+                parts += [str(i) for i in ids]
+                f.write(" ".join(parts) + "\n")
+        feed = MultiSlotDataFeed(SLOTS, batch_size=4, num_threads=1)
+        feed.set_filelist([p])
+        got = []
+        with pytest.raises(RuntimeError):
+            for batch in feed:
+                got.append(batch)
+        (batch,) = got  # the 4 good records formed one clean batch
+        np.testing.assert_array_equal(batch["label"].ravel(),
+                                      [r[0] for r in recs])
+        for row, (_, want, _) in zip(batch["feat"].rows(), recs):
+            np.testing.assert_allclose(row, want, atol=1e-6)
+        for row, (_, _, want) in zip(batch["ids"].rows(), recs):
+            np.testing.assert_array_equal(row, want)
+
+
+class TestInMemoryDataset:
+    def test_load_shuffle_iterate(self, tmp_path, rng):
+        recs = _make_records(rng, 30, feat_dim=3)
+        recs = [(i, r[1], r[2]) for i, r in enumerate(recs)]
+        p = str(tmp_path / "mem.txt")
+        _write_slot_file(p, recs)
+        ds = InMemoryDataset(SLOTS, batch_size=7, num_threads=2)
+        ds.set_filelist([p])
+        ds.load_into_memory()
+        assert len(ds) == 30
+        order_before = [int(r["label"][0]) for r in ds._records]
+        ds.local_shuffle(seed=3)
+        order_after = [int(r["label"][0]) for r in ds._records]
+        assert sorted(order_after) == sorted(order_before)
+        assert order_after != order_before
+        batches = list(ds)
+        assert sum(len(b["label"]) for b in batches) == 30
