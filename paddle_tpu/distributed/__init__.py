@@ -24,14 +24,25 @@ from .parallel import (  # noqa: F401
 )
 
 from . import fleet  # noqa: F401
+from . import launch  # noqa: F401
+from . import ps  # noqa: F401
 from .fleet import mesh_utils  # noqa: F401
+
+
+def _spawn_worker(func, rank, nprocs, args):
+    import os
+
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_LOCAL_RANK"] = str(rank)
+    func(*args)
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
     """Parity with paddle.distributed.spawn (spawn.py:321): launch ``nprocs``
-    local worker processes running ``func``. On a TPU host, multi-process
-    spawn is only used for CPU-mesh simulation tests; real multi-chip scale
-    goes through the mesh + pjit instead."""
+    local worker processes running ``func`` with the rank env-var contract
+    set. On a TPU host, multi-process spawn is only used for CPU-mesh
+    simulation tests; real multi-chip scale goes through the mesh + pjit."""
     import multiprocessing as mp
 
     if nprocs == -1:
@@ -39,10 +50,17 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
     ctx = mp.get_context("spawn")
     procs = []
     for rank in range(nprocs):
-        p = ctx.Process(target=func, args=args, daemon=daemon)
+        p = ctx.Process(target=_spawn_worker, args=(func, rank, nprocs, args),
+                        daemon=daemon)
         p.start()
         procs.append(p)
     if join:
         for p in procs:
             p.join()
+        failed = [(rank, p.exitcode) for rank, p in enumerate(procs)
+                  if p.exitcode != 0]
+        if failed:
+            raise RuntimeError(
+                f"spawn worker(s) failed (rank, exitcode): {failed}"
+            )
     return procs

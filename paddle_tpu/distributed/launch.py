@@ -1,0 +1,191 @@
+"""Process launcher — ``python -m paddle_tpu.distributed.launch`` (parity
+with fleet.launch, fleet/launch.py:364 + launch_utils.py:268,449,556).
+
+Spawns one trainer process per device/proc on this host, wires the
+reference's env-var contract (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT) plus the JAX-native
+coordinator vars consumed by init_parallel_env, streams per-rank logs to a
+log dir, and fail-fast watches the children (watch_local_trainers parity:
+any child death tears the job down; no rank replacement — recovery is
+checkpoint-based, matching the reference's elastic posture).
+
+Multi-host: pass ``--ips host1,host2`` and run the same command on every
+host (reference contract); rank 0's host:port becomes the JAX coordinator.
+On Cloud TPU pods the runtime usually supplies coordination natively — then
+the launcher is only needed for CPU-simulation or PS mode.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["launch", "get_cluster_env", "watch_local_trainers"]
+
+
+def _free_ports(n: int) -> List[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def get_cluster_env(node_ip: str, ips: List[str], nproc_per_node: int,
+                    base_port: Optional[int] = None):
+    """Build the per-rank env dicts for this node (launch_utils.get_cluster
+    parity). Returns (envs, global_endpoints)."""
+    nnodes = len(ips)
+    if nnodes > 1 and base_port is None:
+        raise ValueError(
+            "multi-node launch requires --started_port: without a common "
+            "base port each node would advertise unknowable (0) ports for "
+            "its peers and the endpoint lists would disagree across nodes"
+        )
+    node_rank = ips.index(node_ip)
+    ports = ([base_port + i for i in range(nproc_per_node)] if base_port
+             else _free_ports(nproc_per_node))
+    # endpoints of ALL ranks (node-major) — ports must match across nodes
+    # when base_port is given; for single-node free ports are fine
+    all_eps = []
+    for ni, ip in enumerate(ips):
+        for pi in range(nproc_per_node):
+            port = (base_port + pi) if base_port else (
+                ports[pi] if ni == node_rank else 0)
+            all_eps.append(f"{ip}:{port}")
+    world = nnodes * nproc_per_node
+    envs = []
+    for local_rank in range(nproc_per_node):
+        rank = node_rank * nproc_per_node + local_rank
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(all_eps),
+            "PADDLE_CURRENT_ENDPOINT": all_eps[rank],
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_NNODES": str(nnodes),
+            "PADDLE_NODE_RANK": str(node_rank),
+            # JAX-native names (init_parallel_env reads either contract)
+            "COORDINATOR_ADDRESS": all_eps[0],
+            "NUM_PROCESSES": str(world),
+            "PROCESS_ID": str(rank),
+        }
+        envs.append(env)
+    return envs, all_eps
+
+
+def watch_local_trainers(procs: List[subprocess.Popen],
+                         poll_interval: float = 1.0) -> int:
+    """Fail-fast watch (launch_utils.py:556): block until all children exit
+    cleanly, or kill the survivors as soon as one fails. Returns the job's
+    exit code."""
+    try:
+        while True:
+            alive = False
+            for p in procs:
+                rc = p.poll()
+                if rc is None:
+                    alive = True
+                elif rc != 0:
+                    for q in procs:
+                        if q.poll() is None:
+                            q.terminate()
+                    deadline = time.time() + 10
+                    for q in procs:
+                        if q.poll() is None:
+                            try:
+                                q.wait(timeout=max(0.1, deadline - time.time()))
+                            except subprocess.TimeoutExpired:
+                                q.kill()
+                    return rc
+            if not alive:
+                return 0
+            time.sleep(poll_interval)
+    except KeyboardInterrupt:
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGINT)
+        for q in procs:
+            try:
+                q.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                q.kill()
+        return 130
+
+
+def launch(training_script: str, script_args: List[str],
+           nproc_per_node: int = 1, ips: str = "127.0.0.1",
+           node_ip: Optional[str] = None, base_port: Optional[int] = None,
+           log_dir: str = "log", backend: Optional[str] = None,
+           extra_env: Optional[dict] = None) -> int:
+    ip_list = [s.strip() for s in ips.split(",") if s.strip()]
+    node_ip = node_ip or ip_list[0]
+    envs, _ = get_cluster_env(node_ip, ip_list, nproc_per_node, base_port)
+    os.makedirs(log_dir, exist_ok=True)
+    procs = []
+    logs = []
+    for local_rank, env in enumerate(envs):
+        full_env = {**os.environ, **env, **(extra_env or {})}
+        if backend == "cpu":  # simulation mode: each rank is a 1-device CPU
+            full_env.setdefault("JAX_PLATFORMS", "cpu")
+        rank = env["PADDLE_TRAINER_ID"]
+        log_f = open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
+        logs.append(log_f)
+        p = subprocess.Popen(
+            [sys.executable, "-u", training_script, *script_args],
+            env=full_env, stdout=log_f, stderr=subprocess.STDOUT,
+        )
+        procs.append(p)
+    rc = watch_local_trainers(procs)
+    for f in logs:
+        f.close()
+    if rc != 0:
+        # surface the failing rank's tail, like the reference's log pull
+        for local_rank, env in enumerate(envs):
+            rank = env["PADDLE_TRAINER_ID"]
+            path = os.path.join(log_dir, f"workerlog.{rank}")
+            try:
+                with open(path) as f:
+                    tail = f.readlines()[-20:]
+                if procs[local_rank].returncode not in (0, None):
+                    sys.stderr.write(f"----- rank {rank} failed; log tail -----\n")
+                    sys.stderr.writelines(tail)
+            except OSError:
+                pass
+    return rc
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Multi-process trainer launcher (fleet.launch parity)",
+    )
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--ips", type=str, default="127.0.0.1",
+                        help="comma-separated host ips (same order everywhere)")
+    parser.add_argument("--node_ip", type=str, default=None)
+    parser.add_argument("--started_port", type=int, default=None)
+    parser.add_argument("--log_dir", type=str, default="log")
+    parser.add_argument("--backend", type=str, default=None,
+                        choices=[None, "cpu", "tpu"])
+    parser.add_argument("training_script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    rc = launch(args.training_script, args.script_args,
+                nproc_per_node=args.nproc_per_node, ips=args.ips,
+                node_ip=args.node_ip, base_port=args.started_port,
+                log_dir=args.log_dir, backend=args.backend)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+"""paddle_tpu.incubate — incubating subsystems (parity fluid/incubate)."""
+from . import checkpoint  # noqa: F401

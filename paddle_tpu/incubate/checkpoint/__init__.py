@@ -1,0 +1,136 @@
+"""Checkpoint/auto-resume (parity fluid/incubate/checkpoint/
+auto_checkpoint.py:71,265,598 + checkpoint_saver.py).
+
+Two layers:
+- ``CheckpointSaver`` — numbered snapshots with retention (keep_max), atomic
+  via temp-dir rename. Payload storage is orbax PyTreeCheckpointer, the
+  TPU-native answer to the reference's per-process save_persistables files:
+  jax.Arrays save with their ShardingMetadata, so a mesh-sharded train state
+  checkpoints and restores without gathering to one host (SURVEY.md §5
+  "TPU-equiv: sharded array checkpointing keyed by mesh sharding").
+- ``train_epoch_range`` — the auto-checkpoint epoch loop: resumes from the
+  last completed epoch for a job id, saving state at every epoch end.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["CheckpointSaver", "train_epoch_range", "save_train_state",
+           "restore_train_state"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_train_state(state: Dict[str, Any], path: str):
+    """Save a pytree of (possibly mesh-sharded) arrays atomically."""
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    _checkpointer().save(path, state)
+
+
+def restore_train_state(path: str):
+    return _checkpointer().restore(os.path.abspath(path))
+
+
+class CheckpointSaver:
+    """Numbered checkpoints under a root dir with retention.
+
+    Layout: <root>/ckpt-<n>/{payload orbax tree}, <root>/LATEST (json:
+    number + user meta). Save is atomic: orbax writes to a temp name then
+    this class renames and updates LATEST last.
+    """
+
+    def __init__(self, root: str, keep_max: int = 3):
+        self.root = os.path.abspath(root)
+        self.keep_max = keep_max
+        os.makedirs(self.root, exist_ok=True)
+
+    def _ckpt_dir(self, n: int) -> str:
+        return os.path.join(self.root, f"ckpt-{n}")
+
+    def latest(self) -> Optional[int]:
+        f = os.path.join(self.root, "LATEST")
+        if not os.path.exists(f):
+            return None
+        with open(f) as fh:
+            return json.load(fh)["number"]
+
+    def latest_meta(self) -> Optional[dict]:
+        f = os.path.join(self.root, "LATEST")
+        if not os.path.exists(f):
+            return None
+        with open(f) as fh:
+            return json.load(fh).get("meta", {})
+
+    def numbers(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.root):
+            if name.startswith("ckpt-"):
+                try:
+                    out.append(int(name.split("-", 1)[1]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def save(self, number: int, state: Dict[str, Any],
+             meta: Optional[dict] = None):
+        tmp = self._ckpt_dir(number) + ".tmp"
+        final = self._ckpt_dir(number)
+        for p in (tmp, final):
+            if os.path.exists(p):
+                shutil.rmtree(p)
+        _checkpointer().save(tmp, state)
+        os.rename(tmp, final)
+        with open(os.path.join(self.root, "LATEST.tmp"), "w") as fh:
+            json.dump({"number": number, "meta": meta or {}}, fh)
+        os.replace(os.path.join(self.root, "LATEST.tmp"),
+                   os.path.join(self.root, "LATEST"))
+        self._gc()
+
+    def restore(self, number: Optional[int] = None):
+        number = self.latest() if number is None else number
+        if number is None:
+            return None
+        return _checkpointer().restore(self._ckpt_dir(number))
+
+    def _gc(self):
+        nums = self.numbers()
+        latest = self.latest()
+        while len(nums) > self.keep_max:
+            n = nums.pop(0)
+            if n == latest:
+                continue
+            shutil.rmtree(self._ckpt_dir(n), ignore_errors=True)
+
+
+def train_epoch_range(max_epoch: int, root: str,
+                      get_state: Callable[[], Dict[str, Any]],
+                      set_state: Callable[[Dict[str, Any]], None],
+                      keep_max: int = 2, save_every: int = 1):
+    """Auto-checkpoint epoch loop (auto_checkpoint.py:265
+    _train_epoch_range parity):
+
+        for epoch in train_epoch_range(10, dir, get_state, set_state):
+            ...train one epoch...
+
+    On a fresh run yields 0..max_epoch-1 saving state each epoch; on restart
+    restores the snapshot and resumes from the next epoch.
+    """
+    saver = CheckpointSaver(root, keep_max=keep_max)
+    last = saver.latest()
+    start = 0
+    if last is not None:
+        set_state(saver.restore(last))
+        start = last + 1
+    for epoch in range(start, max_epoch):
+        yield epoch
+        if (epoch + 1) % save_every == 0 or epoch == max_epoch - 1:
+            saver.save(epoch, get_state(), meta={"epoch": epoch})

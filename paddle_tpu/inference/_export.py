@@ -1,0 +1,77 @@
+"""Shared .pdexport writer — single home for the serving-artifact format
+(consumed by inference.Predictor._init_from_files; produced by jit.save and
+static.save_inference_model).
+
+Dynamic dims: None/-1 in an input spec become jax.export symbolic dims, so
+the serialized executable accepts any size there (the reference's variable
+batch dimension in save_inference_model)."""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+
+
+def make_structs(shapes_dtypes: Sequence[Tuple[Sequence, object]]):
+    """[(shape-with-None/-1, jax dtype)] → ShapeDtypeStructs, symbolic where
+    dynamic. All dynamic dims share one scope; each gets its own symbol."""
+    from jax import export as jax_export
+
+    scope = jax_export.SymbolicScope()
+    structs = []
+    sym_idx = 0
+    any_dynamic = False
+    for shape, dtype in shapes_dtypes:
+        dims = []
+        for s in shape:
+            if s is None or (isinstance(s, int) and s < 0):
+                (d,) = jax_export.symbolic_shape(f"d{sym_idx}", scope=scope)
+                dims.append(d)
+                sym_idx += 1
+                any_dynamic = True
+            else:
+                dims.append(int(s))
+        structs.append(jax.ShapeDtypeStruct(tuple(dims), dtype))
+    return structs, any_dynamic
+
+
+def export_fn(closed_fn, shapes_dtypes):
+    """Export ``closed_fn`` (weights already baked in) over the specs.
+    Tries symbolic shapes for dynamic dims; falls back to pinning them to 1
+    only if symbolic export fails, and says so in the returned flag."""
+    from jax import export as jax_export
+
+    structs, any_dynamic = make_structs(shapes_dtypes)
+    try:
+        return jax_export.export(jax.jit(closed_fn))(*structs), False
+    except Exception:
+        if not any_dynamic:
+            raise
+        concrete = [
+            jax.ShapeDtypeStruct(
+                tuple(1 if not isinstance(s, int) or s < 0 else s
+                      for s in shape), dtype)
+            for shape, dtype in shapes_dtypes
+        ]
+        return jax_export.export(jax.jit(closed_fn))(*concrete), True
+
+
+def write_pdexport(path_prefix: str, exported, input_names: List[str],
+                   output_names: List[str],
+                   in_specs: List[Tuple[list, str]],
+                   pinned_dynamic_dims: bool = False):
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    blob = {
+        "serialized": exported.serialize(),
+        "input_names": input_names,
+        "output_names": output_names,
+        "in_specs": in_specs,
+        "pinned_dynamic_dims": pinned_dynamic_dims,
+    }
+    with open(path_prefix + ".pdexport", "wb") as f:
+        pickle.dump(blob, f)
+    return blob

@@ -114,11 +114,17 @@ def not_to_static(fn):
 
 
 def save(layer, path, input_spec=None, **configs):
-    """jit.save parity: persist (state_dict + structure metadata + StableHLO
-    export when input_spec is given) under ``path``.
+    """jit.save parity: persist state + a deployable AOT artifact.
 
-    Layout: <path>.pdiparams (pickled state), <path>.pdmodel (metadata incl.
-    serialized StableHLO text when exportable).
+    Layout (reference: save_inference_model's program+params pair,
+    fluid/io.py:1199):
+    - ``<path>.pdiparams`` — pickled state_dict (always written).
+    - ``<path>.pdmodel``  — metadata (class name, input specs, StableHLO
+      text for inspection).
+    - ``<path>.pdexport`` — with ``input_spec``: jax.export serialization of
+      the jitted forward with the weights baked in as constants. This is the
+      self-contained serving artifact paddle_tpu.inference loads — no model
+      code needed at serving time.
     """
     from ..framework.io import save as _save_state
 
@@ -132,18 +138,42 @@ def save(layer, path, input_spec=None, **configs):
     meta = {"class": type(layer).__name__}
     if input_spec:
         try:
+            from ..core import dtype as dtype_mod
+            from ..inference._export import export_fn, write_pdexport
+
             apply = functionalize(layer, training=False)
             params = get_params(layer)
             buffers = get_buffers(layer)
-            structs = [
-                s.to_shape_dtype_struct() if isinstance(s, InputSpec) else s
-                for s in input_spec
+
+            def closed(*xs):
+                return apply(params, buffers, *xs)[0]
+
+            shapes_dtypes = []
+            for s in input_spec:
+                if isinstance(s, InputSpec):
+                    shapes_dtypes.append(
+                        (list(s.shape), dtype_mod.convert_dtype(s.dtype)))
+                else:  # a ShapeDtypeStruct / array-like
+                    shapes_dtypes.append((list(s.shape), s.dtype))
+            # dynamic (None/-1) dims export symbolically: the artifact
+            # accepts any size there (variable batch)
+            exported, pinned = export_fn(closed, shapes_dtypes)
+            input_names = [
+                (s.name or f"x{i}") if isinstance(s, InputSpec) else f"x{i}"
+                for i, s in enumerate(input_spec)
             ]
-            lowered = jax.jit(apply).lower(params, buffers, *structs)
-            meta["stablehlo"] = lowered.as_text()
-            meta["in_specs"] = [
-                (list(s.shape), str(s.dtype)) for s in structs
+            n_out = len(jax.tree_util.tree_leaves(exported.out_avals))
+            in_specs = [
+                ([None if not isinstance(d, int) else d for d in shape],
+                 str(dt)) for shape, dt in shapes_dtypes
             ]
+            blob = write_pdexport(
+                path, exported, input_names,
+                [f"output{i}" for i in range(n_out)], in_specs,
+                pinned_dynamic_dims=pinned,
+            )
+            meta["stablehlo"] = exported.mlir_module()
+            meta["in_specs"] = blob["in_specs"]
         except Exception as e:  # export is best-effort; state always saved
             meta["export_error"] = repr(e)
     with open(path + ".pdmodel", "wb") as f:

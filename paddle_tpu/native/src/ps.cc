@@ -205,6 +205,10 @@ void handle_conn(Server* sv, int fd) {
         }
         DenseTable* t = it->second;
         std::lock_guard<std::mutex> g(t->mu);
+        if (a != t->w.size()) {  // client/table size mismatch is an error
+          send_resp(fd, 2, nullptr, 0);
+          break;
+        }
         send_resp(fd, 0, t->w.data(), t->w.size() * 4);
         break;
       }

@@ -1,0 +1,191 @@
+"""paddle_tpu.quant — quantization (parity fluid/contrib/slim/quantization:
+QuantizationTransformPass / ImperativeQuantAware QAT + PostTrainingQuantization).
+
+TPU-first design:
+- **QAT** (``quant_aware``): wrap Linear/Conv layers with fake-quant
+  (quantize-dequantize) on weights and activations. Scales come from
+  per-tensor absmax with EMA observers (the reference's
+  'moving_average_abs_max' strategy); the straight-through estimator is
+  jax's gradient through round() via the dequantize expression.
+- **PTQ** (``PostTrainingQuantization``): run calibration batches,
+  observe activation ranges, then ``convert`` snapshots int8 weights.
+- **Converted inference** runs real int8×int8→int32 matmuls via
+  ``lax.dot_general(..., preferred_element_type=int32)`` — the MXU's
+  native int8 path — then rescales, instead of the reference's
+  cuDNN/TensorRT int8 kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply_op
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.nn.layer.common import Linear
+
+__all__ = [
+    "QuantConfig", "FakeQuantDequant", "QuantedLinear", "quant_aware",
+    "convert", "Int8Linear", "PostTrainingQuantization", "quant_dequant",
+]
+
+
+def _absmax_scale(x, bits=8):
+    return jnp.maximum(jnp.max(jnp.abs(x)), 1e-8) / (2 ** (bits - 1) - 1)
+
+
+def quant_dequant(x, scale, bits=8):
+    """Fake-quant with straight-through rounding (round's zero gradient is
+    bypassed because d(dequant)/dx flows through the affine part)."""
+    qmax = 2 ** (bits - 1) - 1
+    q = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax)
+    q = x / scale + jax.lax.stop_gradient(q - x / scale)  # STE
+    return q * scale
+
+
+class QuantConfig:
+    def __init__(self, weight_bits=8, activation_bits=8, ema_decay=0.99,
+                 quantizable_layer_type=("Linear",)):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.ema_decay = ema_decay
+        self.quantizable_layer_type = tuple(quantizable_layer_type)
+
+
+class FakeQuantDequant(Layer):
+    """Activation observer + fake-quant (moving_average_abs_max parity)."""
+
+    def __init__(self, bits=8, ema_decay=0.99):
+        super().__init__()
+        self.bits = bits
+        self.ema_decay = ema_decay
+        self.scale = self.register_buffer(
+            "scale", Tensor(np.asarray(1.0, np.float32)))
+        self._seen = False  # first batch seeds the scale; then EMA
+
+    def forward(self, x):
+        if self.training:
+            cur = apply_op(lambda a: _absmax_scale(a, self.bits), x)
+            if not self._seen:
+                new_scale = cur
+                self._seen = True
+            else:
+                new_scale = apply_op(
+                    lambda s, c: self.ema_decay * s + (1 - self.ema_decay) * c,
+                    self.scale, cur,
+                )
+            self.scale.set_value(new_scale)
+        return apply_op(
+            lambda a, s: quant_dequant(a, s, self.bits), x, self.scale
+        )
+
+
+class QuantedLinear(Layer):
+    """QAT wrapper around a Linear (reference: QuantizedLinear in
+    imperative/qat quant layers)."""
+
+    def __init__(self, linear: Linear, config: QuantConfig):
+        super().__init__()
+        self.inner = linear
+        self.config = config
+        self.act_quant = FakeQuantDequant(config.activation_bits,
+                                          config.ema_decay)
+
+    def forward(self, x):
+        from paddle_tpu.nn import functional as F
+
+        x = self.act_quant(x)
+        w = apply_op(
+            lambda a: quant_dequant(a, _absmax_scale(a, self.config.weight_bits),
+                                    self.config.weight_bits),
+            self.inner.weight,
+        )
+        return F.linear(x, w, self.inner.bias)
+
+
+def quant_aware(model: Layer, config: QuantConfig | None = None) -> Layer:
+    """Swap quantizable sublayers for QAT wrappers in place (parity:
+    ImperativeQuantAware.quantize). Returns the same model."""
+    config = config or QuantConfig()
+    for name, child in list(model.named_children()):
+        if type(child).__name__ in config.quantizable_layer_type and \
+                isinstance(child, Linear):
+            model.add_sublayer(name, QuantedLinear(child, config))
+        elif not isinstance(child, (QuantedLinear, FakeQuantDequant)):
+            quant_aware(child, config)
+    return model
+
+
+class Int8Linear(Layer):
+    """Converted inference layer: int8 weights + per-tensor scales, real
+    int8 dot on the MXU (preferred_element_type=int32)."""
+
+    def __init__(self, w_int8: np.ndarray, w_scale: float, act_scale: float,
+                 bias=None, act_bits=8):
+        super().__init__()
+        self.w_int8 = self.register_buffer(
+            "w_int8", Tensor(w_int8.astype(np.int8)))
+        self.w_scale = float(w_scale)
+        self.act_scale = float(act_scale)
+        self.bias = bias  # Tensor or None
+        self.act_bits = act_bits
+
+    def forward(self, x):
+        w_scale, act_scale, bits = self.w_scale, self.act_scale, self.act_bits
+
+        def int8_matmul(a, w_q, b=None):
+            qmax = 2 ** (bits - 1) - 1
+            a_q = jnp.clip(jnp.round(a / act_scale), -qmax - 1, qmax
+                           ).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                a_q, w_q,
+                dimension_numbers=(((a.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            out = acc.astype(jnp.float32) * (act_scale * w_scale)
+            if b is not None:
+                out = out + b
+            return out
+
+        args = (x, self.w_int8) + ((self.bias,) if self.bias is not None else ())
+        return apply_op(int8_matmul, *args)
+
+
+def convert(model: Layer) -> Layer:
+    """Snapshot QAT wrappers into int8 inference layers (parity:
+    ImperativeQuantAware.save_quantized_model conversion step)."""
+    for name, child in list(model.named_children()):
+        if isinstance(child, QuantedLinear):
+            w = child.inner.weight.numpy()
+            w_scale = float(np.maximum(np.abs(w).max(), 1e-8) /
+                            (2 ** (child.config.weight_bits - 1) - 1))
+            w_int8 = np.clip(np.round(w / w_scale), -128, 127)
+            model.add_sublayer(name, Int8Linear(
+                w_int8, w_scale, float(child.act_quant.scale.numpy()),
+                bias=child.inner.bias, act_bits=child.config.activation_bits,
+            ))
+        else:
+            convert(child)
+    return model
+
+
+class PostTrainingQuantization:
+    """PTQ (parity: PostTrainingQuantization in slim): calibrate activation
+    ranges on sample data with observers, then produce the converted model."""
+
+    def __init__(self, model: Layer, config: QuantConfig | None = None):
+        self.config = config or QuantConfig(ema_decay=0.9)
+        self.model = quant_aware(model, self.config)
+
+    def calibrate(self, data_iter, num_batches=10):
+        self.model.train()  # observers update in training mode
+        import itertools
+
+        for batch in itertools.islice(iter(data_iter), num_batches):
+            xs = batch[0] if isinstance(batch, (list, tuple)) else batch
+            self.model(xs if isinstance(xs, Tensor) else Tensor(np.asarray(xs)))
+        self.model.eval()
+        return self
+
+    def quantize(self) -> Layer:
+        return convert(self.model)

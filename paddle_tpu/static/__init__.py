@@ -2,6 +2,8 @@
 python/paddle/static + fluid Program APIs; SURVEY.md §2 #49-52)."""
 from __future__ import annotations
 
+import os
+
 from .executor import Executor, global_scope, scope_guard  # noqa: F401
 from .program import (  # noqa: F401
     InputSpec,
@@ -127,3 +129,96 @@ def load(program, model_path, executor=None, var_list=None):
 
     state = _load(model_path + ".pdparams")
     set_program_state(program, state)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None):
+    """Parity with fluid/io.py:1199 save_inference_model: prune the program
+    to the feed→fetch subgraph and persist a deployable artifact.
+
+    TPU-native: the Program replay is closed over its parameters, jitted,
+    and serialized with jax.export (weights baked in) → ``.pdexport`` that
+    paddle_tpu.inference.create_predictor loads without model code.
+    """
+    import pickle
+
+    from ..inference._export import export_fn, write_pdexport
+
+    program = program or default_main_program()
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    feed_names = [t.name for t in feed_vars]
+    feed_ids = {id(t) for t in feed_vars}
+    fetch_ids = [id(t) for t in fetch_vars]
+
+    # prune to the feed→fetch subgraph (fluid/io.py prune parity): keep only
+    # ops transitively producing a fetch, walking backwards
+    needed = set(fetch_ids)
+    kept = []
+    for op in reversed(program.ops):
+        if any(o in needed for o in op.out_ids):
+            kept.append(op)
+            for kind, v in op.args:
+                if kind == "var":
+                    needed.add(v)
+    kept.reverse()
+    # feeds the subgraph actually consumes must all be provided
+    required_feeds = {
+        name for name, t in program.feed_vars.items() if id(t) in needed
+    }
+    missing = required_feeds - set(feed_names)
+    if missing:
+        raise ValueError(
+            f"inference subgraph reads feed vars {sorted(missing)} that are "
+            "not in feed_vars — include them or fetch something upstream"
+        )
+    params_raw = {
+        uid: p._value for uid, p in program.parameters.items() if uid in needed
+    }
+    var_refs = program._var_refs
+
+    def closed(*arrays):
+        env = dict(zip([id(t) for t in feed_vars], arrays))
+        env.update(params_raw)
+
+        def resolve(ref):
+            kind, v = ref
+            if kind == "const":
+                return v
+            if v in env:
+                return env[v]
+            return var_refs[v]._value  # recorded buffer/constant
+
+        for op in kept:
+            vals = [resolve(r) for r in op.args]
+            out = op.fn(*vals)
+            if op.multi_out:
+                for uid, o in zip(op.out_ids, out):
+                    env[uid] = o
+            else:
+                env[op.out_ids[0]] = out
+        return tuple(env[fid] for fid in fetch_ids)
+
+    shapes_dtypes = [(list(t.shape), t._value.dtype) for t in feed_vars]
+    exported, pinned = export_fn(closed, shapes_dtypes)
+    output_names = [t.name or f"output{i}" for i, t in enumerate(fetch_vars)]
+    in_specs = [(list(t.shape), str(t._value.dtype)) for t in feed_vars]
+    write_pdexport(path_prefix, exported, feed_names, output_names, in_specs,
+                   pinned_dynamic_dims=pinned)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump({"feed_names": feed_names, "fetch_names": output_names,
+                     "in_specs": in_specs}, f)
+
+
+def load_inference_model(path_prefix, executor=None):
+    """Parity with fluid/io.py:1412: returns (predictor, feed_names,
+    fetch_names) — the predictor plays the pruned program's role."""
+    import pickle
+
+    from ..inference import Config, create_predictor
+
+    config = Config(path_prefix)
+    predictor = create_predictor(config)
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    return predictor, meta["feed_names"], meta["fetch_names"]

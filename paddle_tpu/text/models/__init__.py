@@ -1,3 +1,15 @@
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForPretraining,
+    BertForSequenceClassification,
+    BertModel,
+    ErnieModel,
+    bert_base,
+    bert_large,
+    bert_tiny,
+    ernie_1_5b,
+    ernie_3_0_medium,
+)
 from .gpt import (  # noqa: F401
     GPT,
     GPTConfig,

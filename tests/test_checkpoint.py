@@ -1,0 +1,113 @@
+"""Checkpoint/auto-resume: CheckpointSaver retention + atomicity,
+train_epoch_range resume, sharded train-state roundtrip through the fleet
+engine (reference: auto_checkpoint.py epoch resume; TPU-equiv sharded
+arrays keep their mesh sharding through save/restore)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.checkpoint import (
+    CheckpointSaver,
+    restore_train_state,
+    save_train_state,
+    train_epoch_range,
+)
+
+
+class TestCheckpointSaver:
+    def test_save_restore_numbers(self, tmp_path):
+        s = CheckpointSaver(str(tmp_path / "ck"), keep_max=2)
+        assert s.latest() is None
+        s.save(0, {"w": np.arange(4.0)})
+        s.save(1, {"w": np.arange(4.0) + 1})
+        got = s.restore()
+        np.testing.assert_array_equal(np.asarray(got["w"]), [1, 2, 3, 4])
+        assert s.latest() == 1
+
+    def test_retention_gc(self, tmp_path):
+        s = CheckpointSaver(str(tmp_path / "ck"), keep_max=2)
+        for i in range(5):
+            s.save(i, {"x": np.array([float(i)])})
+        assert s.numbers() == [3, 4]
+        assert s.latest() == 4
+
+    def test_meta_roundtrip(self, tmp_path):
+        s = CheckpointSaver(str(tmp_path / "ck"))
+        s.save(7, {"x": np.zeros(1)}, meta={"epoch": 7, "loss": 0.5})
+        assert s.latest_meta() == {"epoch": 7, "loss": 0.5}
+
+
+class TestEpochRange:
+    def test_fresh_run_and_resume(self, tmp_path):
+        root = str(tmp_path / "auto")
+        state = {"weights": np.zeros(3), "epoch_log": []}
+
+        def get_state():
+            return {"weights": state["weights"]}
+
+        def set_state(s):
+            state["weights"] = np.asarray(s["weights"])
+
+        done = []
+        for epoch in train_epoch_range(3, root, get_state, set_state):
+            state["weights"] = state["weights"] + 1
+            done.append(epoch)
+            if epoch == 1:
+                break  # simulate a crash after epoch-1's checkpoint...
+        # epoch 1 yielded but its post-yield save didn't run (we broke out),
+        # so the snapshot on disk is epoch 0
+        done2 = []
+        for epoch in train_epoch_range(3, root, get_state, set_state):
+            state["weights"] = state["weights"] + 1
+            done2.append(epoch)
+        assert done == [0, 1]
+        assert done2 == [1, 2]  # resumed after last completed epoch (0)
+        np.testing.assert_array_equal(state["weights"], 3 * np.ones(3))
+
+
+class TestShardedTrainState:
+    def test_fleet_engine_state_roundtrip(self, tmp_path):
+        import jax
+        import numpy as onp
+        from jax.sharding import Mesh
+
+        from paddle_tpu.distributed.fleet.engine import ParallelTrainStep
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        net = nn.Linear(8, 4)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters())
+        devs = onp.array(jax.devices()[:4]).reshape(2, 2)
+        mesh = Mesh(devs, ("dp", "sharding"))
+
+        def loss_fn(out, y):
+            return ((out - y) ** 2).mean()
+
+        step = ParallelTrainStep(net, loss_fn, opt, mesh, zero_stage=1)
+        x = onp.random.RandomState(0).randn(8, 8).astype("float32")
+        y = onp.random.RandomState(1).randn(8, 4).astype("float32")
+        step((x,), (y,))
+        path = str(tmp_path / "trainstate")
+        save_train_state(
+            {"params": step._params, "opt": step._opt_state}, path)
+        before = {k: onp.asarray(v) for k, v in step._params.items()}
+
+        step((x,), (y,))  # advance past the snapshot
+        restored = restore_train_state(path)
+        for k, v in restored["params"].items():
+            np.testing.assert_allclose(onp.asarray(v), before[k], atol=1e-6)
+        # restored arrays carry shardings usable for another step
+        step._params = {
+            k: jax.device_put(v, step._param_shardings[k])
+            for k, v in restored["params"].items()
+        }
+        step._opt_state = {
+            n: {k: jax.device_put(s, step._opt_shardings[n][k])
+                for k, s in st.items()}
+            for n, st in restored["opt"].items()
+        }
+        out = step((x,), (y,))
+        assert np.isfinite(float(out.numpy()))

@@ -1,0 +1,152 @@
+"""Inference path: jit.save → .pdexport → Config/create_predictor
+(reference: AnalysisPredictor API, analysis_predictor.cc:1140,846) and
+static save_inference_model/load_inference_model (fluid/io.py:1199,1412)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.inference import Config, create_predictor
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+class TestJitExportPredictor:
+    def test_export_and_predict_matches_eager(self, tmp_path):
+        net = SmallNet()
+        net.eval()
+        prefix = str(tmp_path / "small")
+        paddle.jit.save(net, prefix,
+                        input_spec=[paddle.jit.InputSpec([2, 8], "float32", "x")])
+        x = np.random.RandomState(0).randn(2, 8).astype("float32")
+        eager = net(paddle.to_tensor(x)).numpy()
+
+        config = Config(prefix)
+        predictor = create_predictor(config)
+        assert predictor.get_input_names() == ["x"]
+        h = predictor.get_input_handle("x")
+        h.copy_from_cpu(x)
+        predictor.run()
+        out = predictor.get_output_handle(predictor.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, eager, atol=1e-5)
+
+    def test_run_with_inputs_shortcut(self, tmp_path):
+        net = SmallNet()
+        net.eval()
+        prefix = str(tmp_path / "small2")
+        paddle.jit.save(net, prefix,
+                        input_spec=[paddle.jit.InputSpec([3, 8], "float32")])
+        x = np.random.RandomState(1).randn(3, 8).astype("float32")
+        predictor = create_predictor(Config(prefix))
+        (out,) = predictor.run([x])
+        np.testing.assert_allclose(out, net(paddle.to_tensor(x)).numpy(),
+                                   atol=1e-5)
+
+    def test_predictor_from_layer_direct(self):
+        net = SmallNet()
+        net.eval()
+        config = Config()
+        config.set_layer(net, [paddle.jit.InputSpec([2, 8], "float32", "inp")])
+        predictor = create_predictor(config)
+        x = np.random.RandomState(2).randn(2, 8).astype("float32")
+        (out,) = predictor.run([x])
+        np.testing.assert_allclose(out, net(paddle.to_tensor(x)).numpy(),
+                                   atol=1e-5)
+
+    def test_missing_export_raises(self, tmp_path):
+        with pytest.raises((FileNotFoundError, ValueError)):
+            create_predictor(Config(str(tmp_path / "nope")))
+
+    def test_dynamic_batch_export(self, tmp_path):
+        """InputSpec([None, 8]) serves any batch size (symbolic export)."""
+        net = SmallNet()
+        net.eval()
+        prefix = str(tmp_path / "dyn")
+        paddle.jit.save(net, prefix,
+                        input_spec=[paddle.jit.InputSpec([None, 8], "float32")])
+        predictor = create_predictor(Config(prefix))
+        for b in (1, 5, 32):
+            x = np.random.RandomState(b).randn(b, 8).astype("float32")
+            (out,) = predictor.run([x])
+            assert out.shape == (b, 4)
+            np.testing.assert_allclose(
+                out, net(paddle.to_tensor(x)).numpy(), atol=1e-5)
+
+
+class TestStaticInferenceModel:
+    def test_save_load_inference_model(self, tmp_path):
+        paddle.enable_static()
+        try:
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                x = paddle.static.data("x", [4, 6], "float32")
+                hid = paddle.static.nn.fc(x, 10, activation="relu")
+                out = paddle.static.nn.fc(hid, 3)
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            xv = np.random.RandomState(3).randn(4, 6).astype("float32")
+            (want,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+
+            prefix = str(tmp_path / "static_model")
+            paddle.static.save_inference_model(prefix, [x], [out], exe,
+                                               program=main)
+        finally:
+            paddle.disable_static()
+
+        predictor, feed_names, fetch_names = (
+            paddle.static.load_inference_model(prefix))
+        assert feed_names == ["x"]
+        (got,) = predictor.run([xv])
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_prunes_training_subgraph(self, tmp_path):
+        """Exporting [x]→[pred] from a program that also has label/loss ops
+        must prune them (not demand the label feed)."""
+        paddle.enable_static()
+        try:
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                x = paddle.static.data("x", [2, 6], "float32")
+                label = paddle.static.data("label", [2, 1], "float32")
+                pred = paddle.static.nn.fc(x, 3)
+                loss = paddle.mean((pred - label) ** 2)  # noqa: F841
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            xv = np.random.RandomState(4).randn(2, 6).astype("float32")
+            lv = np.zeros((2, 1), "float32")
+            want, _ = exe.run(main, feed={"x": xv, "label": lv},
+                              fetch_list=[pred, loss])
+            prefix = str(tmp_path / "pruned")
+            paddle.static.save_inference_model(prefix, [x], [pred], exe,
+                                               program=main)
+        finally:
+            paddle.disable_static()
+        predictor, feed_names, _ = paddle.static.load_inference_model(prefix)
+        assert feed_names == ["x"]
+        (got,) = predictor.run([xv])
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_missing_required_feed_raises(self, tmp_path):
+        paddle.enable_static()
+        try:
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                x = paddle.static.data("x", [2, 4], "float32")
+                y = paddle.static.data("y", [2, 4], "float32")
+                out = x * y
+            with pytest.raises(ValueError, match="feed vars"):
+                paddle.static.save_inference_model(
+                    str(tmp_path / "bad"), [x], [out], None, program=main)
+        finally:
+            paddle.disable_static()

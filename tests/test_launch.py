@@ -1,0 +1,141 @@
+"""Launcher: env contract, fail-fast watch, multi-rank run + PS cluster
+(reference pattern: test_dist_base.py:682 subprocess ranks on localhost)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.launch import get_cluster_env, launch
+
+
+class TestClusterEnv:
+    def test_single_node_env(self):
+        envs, eps = get_cluster_env("127.0.0.1", ["127.0.0.1"], 4)
+        assert len(envs) == 4 and len(eps) == 4
+        for rank, env in enumerate(envs):
+            assert env["PADDLE_TRAINER_ID"] == str(rank)
+            assert env["PADDLE_TRAINERS_NUM"] == "4"
+            assert env["PADDLE_CURRENT_ENDPOINT"] == eps[rank]
+            assert env["COORDINATOR_ADDRESS"] == eps[0]
+
+    def test_multi_node_without_port_raises(self):
+        with pytest.raises(ValueError, match="started_port"):
+            get_cluster_env("10.0.0.1", ["10.0.0.1", "10.0.0.2"], 2)
+
+    def test_multi_node_ranks(self):
+        envs, eps = get_cluster_env("10.0.0.2", ["10.0.0.1", "10.0.0.2"], 2,
+                                    base_port=6170)
+        assert len(eps) == 4
+        assert envs[0]["PADDLE_TRAINER_ID"] == "2"  # node 1, local 0
+        assert envs[1]["PADDLE_TRAINER_ID"] == "3"
+        assert envs[0]["PADDLE_NODE_RANK"] == "1"
+        assert eps[0] == "10.0.0.1:6170" and eps[3] == "10.0.0.2:6171"
+
+
+class TestLaunchRun:
+    def test_two_ranks_write_logs(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(textwrap.dedent("""
+            import os
+            print("rank", os.environ["PADDLE_TRAINER_ID"],
+                  "of", os.environ["PADDLE_TRAINERS_NUM"])
+        """))
+        log_dir = str(tmp_path / "logs")
+        rc = launch(str(script), [], nproc_per_node=2, log_dir=log_dir,
+                    extra_env={"JAX_PLATFORMS": "cpu"})
+        assert rc == 0
+        log0 = open(os.path.join(log_dir, "workerlog.0")).read()
+        log1 = open(os.path.join(log_dir, "workerlog.1")).read()
+        assert "rank 0 of 2" in log0
+        assert "rank 1 of 2" in log1
+
+    def test_fail_fast_tears_down(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys, time
+            if os.environ["PADDLE_TRAINER_ID"] == "1":
+                sys.exit(3)   # rank 1 dies immediately
+            time.sleep(60)    # rank 0 would run forever
+        """))
+        import time
+
+        t0 = time.time()
+        rc = launch(str(script), [], nproc_per_node=2,
+                    log_dir=str(tmp_path / "logs"),
+                    extra_env={"JAX_PLATFORMS": "cpu"})
+        assert rc == 3
+        assert time.time() - t0 < 30  # rank 0 was terminated, not awaited
+
+
+class TestSpawnEnv:
+    def test_spawn_sets_rank_env(self, tmp_path):
+        # spawn in a subprocess so mp.spawn pickling has an importable main
+        script = tmp_path / "sp.py"
+        out_dir = str(tmp_path)
+        script.write_text(textwrap.dedent(f"""
+            import os
+            import paddle_tpu.distributed as dist
+
+            def worker(out_dir):
+                rank = os.environ["PADDLE_TRAINER_ID"]
+                with open(os.path.join(out_dir, f"r{{rank}}.txt"), "w") as f:
+                    f.write(os.environ["PADDLE_TRAINERS_NUM"])
+
+            if __name__ == "__main__":
+                dist.spawn(worker, args=({out_dir!r},), nprocs=2)
+        """))
+        r = subprocess.run([sys.executable, str(script)], capture_output=True,
+                           text=True, timeout=120,
+                           env={**os.environ, "JAX_PLATFORMS": "cpu",
+                                "PYTHONPATH": "/root/repo"})
+        assert r.returncode == 0, r.stderr[-800:]
+        assert open(os.path.join(out_dir, "r0.txt")).read() == "2"
+        assert open(os.path.join(out_dir, "r1.txt")).read() == "2"
+
+
+@pytest.mark.skipif(
+    not __import__("paddle_tpu.native", fromlist=["available"]).available(),
+    reason="native toolchain unavailable")
+class TestPSCluster:
+    def test_launch_ps_workers_train_parity(self, tmp_path):
+        """2 workers + 1 PS: both workers pull the same dense weights after
+        barriered pushes (reference: test_dist_base loss-parity method)."""
+        from paddle_tpu.distributed.ps import PsClient, PsServer
+
+        server = PsServer(port=0, n_workers=2)
+        server.add_dense_table(0, 8, init=np.zeros(8, np.float32), lr=0.1)
+        server.start()
+        port = server.port
+
+        script = tmp_path / "ps_worker.py"
+        script.write_text(textwrap.dedent(f"""
+            import os
+            import numpy as np
+            from paddle_tpu.distributed.ps import PsClient
+
+            rank = int(os.environ["PADDLE_TRAINER_ID"])
+            c = PsClient("127.0.0.1", {port})
+            for step in range(5):
+                c.push_dense_grad(0, np.full(8, 1.0 + rank, np.float32))
+            c.barrier()
+            w = c.pull_dense(0, 8)
+            np.save(os.environ["OUT_PREFIX"] + f"_{{rank}}.npy", w)
+            c.barrier()
+            c.disconnect()
+        """))
+        out_prefix = str(tmp_path / "w")
+        rc = launch(str(script), [], nproc_per_node=2,
+                    log_dir=str(tmp_path / "logs"),
+                    extra_env={"JAX_PLATFORMS": "cpu",
+                               "OUT_PREFIX": out_prefix,
+                               "PYTHONPATH": "/root/repo"})
+        assert rc == 0
+        w0 = np.load(out_prefix + "_0.npy")
+        w1 = np.load(out_prefix + "_1.npy")
+        # total grad = 5*(1.0) + 5*(2.0) = 15 per element, lr 0.1 → -1.5
+        np.testing.assert_allclose(w0, -1.5 * np.ones(8), atol=1e-5)
+        np.testing.assert_array_equal(w0, w1)
+        server.destroy()

@@ -1,0 +1,129 @@
+"""Quantization: QAT fake-quant accuracy, observers, int8 conversion
+(reference: slim/quantization tests — quantized model must stay close to
+fp32 and the converted graph must use int8 weights)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quant import (
+    Int8Linear,
+    PostTrainingQuantization,
+    QuantConfig,
+    QuantedLinear,
+    convert,
+    quant_aware,
+    quant_dequant,
+)
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+class TestFakeQuant:
+    def test_quant_dequant_grid(self):
+        import jax.numpy as jnp
+
+        x = jnp.asarray(np.linspace(-1, 1, 11, dtype=np.float32))
+        scale = 1.0 / 127
+        qd = quant_dequant(x, scale)
+        # every output is on the int8 grid
+        np.testing.assert_allclose(
+            np.asarray(qd) / scale, np.round(np.asarray(qd) / scale), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(qd), np.asarray(x), atol=scale)
+
+    def test_ste_gradient_flows(self):
+        import jax
+        import jax.numpy as jnp
+
+        g = jax.grad(lambda x: quant_dequant(x, 0.01).sum())(
+            jnp.ones((4,), jnp.float32))
+        np.testing.assert_allclose(np.asarray(g), np.ones(4), atol=1e-5)
+
+
+class TestQAT:
+    def test_wrapping_and_close_outputs(self):
+        paddle.seed(1)
+        net = MLP()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 16).astype("float32"))
+        ref = net(x).numpy()
+        quant_aware(net)
+        assert isinstance(net.fc1, QuantedLinear)
+        assert isinstance(net.fc2, QuantedLinear)
+        net.train()
+        for _ in range(20):  # calibrate the activation observers
+            net(x)
+        net.eval()
+        out = net(x).numpy()
+        # int8 fake-quant stays close to fp32
+        assert np.abs(out - ref).max() < 0.15 * np.abs(ref).max() + 0.05
+
+    def test_qat_training_reduces_loss(self):
+        paddle.seed(2)
+        net = quant_aware(MLP())
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters())
+        rng = np.random.RandomState(3)
+        x = paddle.to_tensor(rng.randn(64, 16).astype("float32"))
+        y = paddle.to_tensor(rng.randn(64, 4).astype("float32"))
+        losses = []
+        for _ in range(30):
+            out = net(x)
+            loss = ((out - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < 0.5 * losses[0]
+
+    def test_observer_updates_only_in_training(self):
+        paddle.seed(4)
+        net = quant_aware(MLP())
+        big = paddle.to_tensor(
+            100 * np.random.RandomState(5).randn(8, 16).astype("float32"))
+        net.eval()
+        s_before = float(net.fc1.act_quant.scale.numpy())
+        net(big)
+        assert float(net.fc1.act_quant.scale.numpy()) == s_before
+        net.train()
+        net(big)
+        assert float(net.fc1.act_quant.scale.numpy()) > s_before
+
+
+class TestConvert:
+    def test_int8_conversion_close_and_int8_weights(self):
+        paddle.seed(6)
+        net = quant_aware(MLP())
+        rng = np.random.RandomState(7)
+        xs = rng.randn(32, 16).astype("float32")
+        net.train()
+        for i in range(8):  # calibrate observers
+            net(paddle.to_tensor(xs[i * 4:(i + 1) * 4]))
+        net.eval()
+        ref = net(paddle.to_tensor(xs)).numpy()
+        convert(net)
+        assert isinstance(net.fc1, Int8Linear)
+        assert str(net.fc1.w_int8.dtype) in ("int8", "paddle.int8")
+        out = net(paddle.to_tensor(xs)).numpy()
+        assert np.abs(out - ref).max() < 0.2 * np.abs(ref).max() + 0.1
+
+    def test_ptq_pipeline(self):
+        paddle.seed(8)
+        net = MLP()
+        rng = np.random.RandomState(9)
+        data = [paddle.to_tensor(rng.randn(4, 16).astype("float32"))
+                for _ in range(6)]
+        ref = net(data[0]).numpy()
+        ptq = PostTrainingQuantization(net, QuantConfig(ema_decay=0.8))
+        q = ptq.calibrate(data, num_batches=6).quantize()
+        out = q(data[0]).numpy()
+        assert isinstance(q.fc1, Int8Linear)
+        assert np.abs(out - ref).max() < 0.25 * np.abs(ref).max() + 0.1
