@@ -98,6 +98,9 @@ def watch_local_trainers(procs: List[subprocess.Popen],
                 elif rc != 0:
                     for q in procs:
                         if q.poll() is None:
+                            # mark survivors we are about to kill so the log
+                            # report does not blame them for the failure
+                            q.killed_by_watcher = True
                             q.terminate()
                     deadline = time.time() + 10
                     for q in procs:
@@ -156,7 +159,12 @@ def launch(training_script: str, script_args: List[str],
             try:
                 with open(path) as f:
                     tail = f.readlines()[-20:]
-                if procs[local_rank].returncode not in (0, None):
+                p = procs[local_rank]
+                if getattr(p, "killed_by_watcher", False):
+                    sys.stderr.write(
+                        f"----- rank {rank} terminated by watcher after "
+                        "another rank failed -----\n")
+                elif p.returncode not in (0, None):
                     sys.stderr.write(f"----- rank {rank} failed; log tail -----\n")
                     sys.stderr.writelines(tail)
             except OSError:

@@ -29,11 +29,20 @@ def _checkpointer():
 
 
 def save_train_state(state: Dict[str, Any], path: str):
-    """Save a pytree of (possibly mesh-sharded) arrays atomically."""
+    """Save a pytree of (possibly mesh-sharded) arrays atomically: write to a
+    temp sibling, then swap — a crash mid-save never destroys the previous
+    checkpoint."""
     path = os.path.abspath(path)
+    tmp = path + ".tmp-save"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    _checkpointer().save(tmp, state)
+    old = path + ".tmp-old"
     if os.path.exists(path):
-        shutil.rmtree(path)
-    _checkpointer().save(path, state)
+        os.rename(path, old)
+    os.rename(tmp, path)
+    if os.path.exists(old):
+        shutil.rmtree(old)
 
 
 def restore_train_state(path: str):

@@ -137,8 +137,20 @@ class Predictor:
         exported = jax_export.deserialize(blob["serialized"])
         self._input_names = blob["input_names"]
         self._output_names = blob["output_names"]
+        pinned = blob.get("pinned_dynamic_dims", False)
+        expect = [tuple(a.shape) for a in exported.in_avals]
 
         def fn(*arrays):
+            if pinned:
+                for arr, shp, name in zip(arrays, expect, self._input_names):
+                    if tuple(arr.shape) != shp:
+                        raise ValueError(
+                            f"input '{name}' has shape {tuple(arr.shape)} but "
+                            f"this model was exported with its dynamic dims "
+                            f"pinned to {shp} (symbolic-shape export failed "
+                            "at save time); re-export with static shapes or "
+                            "feed exactly this shape"
+                        )
             out = exported.call(*arrays)
             return out if isinstance(out, (list, tuple)) else (out,)
 

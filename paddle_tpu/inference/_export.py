@@ -46,9 +46,16 @@ def export_fn(closed_fn, shapes_dtypes):
     structs, any_dynamic = make_structs(shapes_dtypes)
     try:
         return jax_export.export(jax.jit(closed_fn))(*structs), False
-    except Exception:
+    except Exception as e:
         if not any_dynamic:
             raise
+        import warnings
+
+        warnings.warn(
+            "symbolic-shape export failed; dynamic dims were PINNED to 1 — "
+            f"the exported model only accepts that exact shape ({e})",
+            stacklevel=3,
+        )
         concrete = [
             jax.ShapeDtypeStruct(
                 tuple(1 if not isinstance(s, int) or s < 0 else s

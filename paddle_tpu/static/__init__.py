@@ -2,8 +2,6 @@
 python/paddle/static + fluid Program APIs; SURVEY.md §2 #49-52)."""
 from __future__ import annotations
 
-import os
-
 from .executor import Executor, global_scope, scope_guard  # noqa: F401
 from .program import (  # noqa: F401
     InputSpec,
@@ -19,6 +17,17 @@ from .program import (  # noqa: F401
     current_program,
 )
 from . import nn  # noqa: F401
+from .control_flow import (  # noqa: F401
+    array_length,
+    array_read,
+    array_write,
+    case,
+    cond,
+    create_array,
+    increment,
+    switch_case,
+    while_loop,
+)
 
 
 def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None):
@@ -175,28 +184,19 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     params_raw = {
         uid: p._value for uid, p in program.parameters.items() if uid in needed
     }
-    var_refs = program._var_refs
+
+    # pruned Program reusing the one replay implementation (program.py)
+    pruned = Program()
+    pruned.ops = kept
+    pruned.feed_vars = {t.name: t for t in feed_vars}
+    pruned.parameters = {
+        uid: p for uid, p in program.parameters.items() if uid in needed
+    }
+    pruned._var_refs = program._var_refs
+    replay = pruned.build_replay()
 
     def closed(*arrays):
-        env = dict(zip([id(t) for t in feed_vars], arrays))
-        env.update(params_raw)
-
-        def resolve(ref):
-            kind, v = ref
-            if kind == "const":
-                return v
-            if v in env:
-                return env[v]
-            return var_refs[v]._value  # recorded buffer/constant
-
-        for op in kept:
-            vals = [resolve(r) for r in op.args]
-            out = op.fn(*vals)
-            if op.multi_out:
-                for uid, o in zip(op.out_ids, out):
-                    env[uid] = o
-            else:
-                env[op.out_ids[0]] = out
+        env = replay(dict(zip(feed_names, arrays)), params_raw)
         return tuple(env[fid] for fid in fetch_ids)
 
     shapes_dtypes = [(list(t.shape), t._value.dtype) for t in feed_vars]

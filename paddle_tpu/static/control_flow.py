@@ -1,0 +1,465 @@
+"""Control-flow ops: cond / case / switch_case / while_loop / tensor arrays.
+
+Capability parity with the reference's control-flow operator family
+(/root/reference/paddle/fluid/operators/controlflow/conditional_block_op.cc,
+while_op.cc) and its python surface (fluid.layers.cond/case/switch_case/
+while_loop), redesigned for XLA:
+
+- In **eager** mode (concrete predicate) branches dispatch in Python, so the
+  define-by-run autograd tape records only the taken branch — the exact
+  semantics of the reference's dygraph control flow.
+- Under **jit tracing** (predicate is a JAX tracer) the ops lower to
+  ``lax.cond`` / ``lax.switch`` / ``lax.while_loop``, which compile to
+  XLA conditionals with static shapes — no python fallback, no retrace per
+  iteration, and reverse-mode AD through ``cond``/``switch`` comes from XLA.
+
+The reference's ConditionalBlockOp runs a sub-block in a child scope; here a
+"block" is simply a Python callable traced into the branch computation.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tensor as tensor_mod
+from ..core.tensor import Tensor, _is_tracer, wrap_raw
+
+__all__ = [
+    "cond",
+    "case",
+    "switch_case",
+    "while_loop",
+    "increment",
+    "create_array",
+    "array_write",
+    "array_read",
+    "array_length",
+]
+
+
+def _unwrap(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x._value if isinstance(x, Tensor) else x,
+        tree,
+        is_leaf=lambda x: isinstance(x, Tensor),
+    )
+
+
+def _wrap_out(tree):
+    def w(x):
+        if isinstance(x, Tensor):
+            return x
+        if _is_tracer(x) or isinstance(x, jax.Array):
+            return wrap_raw(x)
+        return x
+
+    return jax.tree_util.tree_map(w, tree)
+
+
+def _pred_raw(pred):
+    p = pred._value if isinstance(pred, Tensor) else jnp.asarray(pred)
+    if p.ndim > 0:
+        p = p.reshape(())
+    return p
+
+
+def _is_concrete(x) -> bool:
+    return not _is_tracer(x)
+
+
+def _recording() -> bool:
+    """True when a Program is recording ops (inside static.program_guard)."""
+    return tensor_mod._op_recorder is not None
+
+
+# --------------------------------------------------------------------------
+# Static-mode support: trace each branch/body into a sub-program, then record
+# ONE composite op into the parent Program whose replay executes lax.cond /
+# lax.while_loop on the fed values. This is the TPU-native analogue of the
+# reference's ConditionalBlockOp / WhileOp holding a sub-BlockDesc
+# (operators/controlflow/conditional_block_op.cc, while_op.cc).
+# --------------------------------------------------------------------------
+def _subtrace(fn, *args):
+    """Run ``fn`` eagerly while capturing its ops into a fresh sub-program."""
+    from .program import Program
+
+    sub = Program()
+    prev = tensor_mod._op_recorder
+    tensor_mod._op_recorder = sub.record_op
+    try:
+        out = fn(*args)
+    finally:
+        tensor_mod._op_recorder = prev
+    return out, sub
+
+
+def _flatten_tensors(tree):
+    leaves, treedef = jax.tree_util.tree_flatten(
+        tree, is_leaf=lambda x: isinstance(x, Tensor)
+    )
+    return leaves, treedef
+
+
+def _external_ids(sub, out_tensors, bound_ids):
+    """Var ids a sub-trace reads that it does not itself produce."""
+    produced = set(bound_ids)
+    ext = []
+    for op in sub.ops:
+        for kind, v in op.args:
+            if kind == "var" and v not in produced and v not in ext:
+                ext.append(v)
+        produced.update(op.out_ids)
+    for t in out_tensors:
+        if isinstance(t, Tensor) and id(t) not in produced and id(t) not in ext:
+            ext.append(id(t))
+    return ext
+
+
+def _make_branch_replay(sub, out_tensors, bound_ids, ext_ids):
+    """Pure fn(env: {var_id: raw}) -> list of raw outputs for the branch."""
+    ops = list(sub.ops)
+    out_specs = [
+        (id(t), None) if isinstance(t, Tensor) else (None, t) for t in out_tensors
+    ]
+    refs = sub._var_refs
+
+    def replay(env):
+        env = dict(env)
+        for op in ops:
+            vals = []
+            for kind, v in op.args:
+                if kind == "const":
+                    vals.append(v)
+                elif v in env:
+                    vals.append(env[v])
+                else:
+                    vals.append(refs[v]._value)
+            out = op.fn(*vals)
+            if op.multi_out:
+                for uid, o in zip(op.out_ids, out):
+                    env[uid] = o
+            else:
+                env[op.out_ids[0]] = out
+        res = []
+        for uid, const in out_specs:
+            if uid is None:
+                res.append(const)
+            elif uid in env:
+                res.append(env[uid])
+            else:
+                res.append(refs[uid]._value)
+        return res
+
+    return replay
+
+
+def _record_cond(pred, true_fn, false_fn):
+    true_out, true_sub = _subtrace(true_fn)
+    false_out, false_sub = _subtrace(false_fn)
+    t_leaves, t_def = _flatten_tensors(true_out)
+    f_leaves, f_def = _flatten_tensors(false_out)
+    if t_def != f_def or len(t_leaves) != len(f_leaves):
+        raise ValueError(
+            "cond branches must return the same structure under static mode; "
+            f"got {t_def} vs {f_def}"
+        )
+    ext = []
+    for v in _external_ids(true_sub, t_leaves, []) + _external_ids(
+        false_sub, f_leaves, []
+    ):
+        if v not in ext:
+            ext.append(v)
+    all_refs = {**false_sub._var_refs, **true_sub._var_refs}
+    ext_tensors = [all_refs[v] for v in ext]
+    t_replay = _make_branch_replay(true_sub, t_leaves, [], ext)
+    f_replay = _make_branch_replay(false_sub, f_leaves, [], ext)
+
+    def composite(pred_raw, *ext_vals):
+        env = dict(zip(ext, ext_vals))
+        p = pred_raw.reshape(()) if hasattr(pred_raw, "reshape") else pred_raw
+        outs = jax.lax.cond(
+            p, lambda _: tuple(t_replay(env)), lambda _: tuple(f_replay(env)), None
+        )
+        return outs
+
+    pred_t = pred if isinstance(pred, Tensor) else wrap_raw(jnp.asarray(pred))
+    raw = composite(pred_t._value, *[t._value for t in ext_tensors])
+    out_tensors = tuple(wrap_raw(o) for o in raw)
+    tensor_mod._op_recorder(
+        composite, [pred_t] + ext_tensors, out_tensors, True, "cond"
+    )
+    return jax.tree_util.tree_unflatten(t_def, out_tensors)
+
+
+def _record_while(cond_fn, body_fn, loop_vars):
+    bound = [id(v) for v in loop_vars]
+    pred0, cond_sub = _subtrace(cond_fn, *loop_vars)
+    body_out, body_sub = _subtrace(body_fn, *loop_vars)
+    body_out = list(body_out) if isinstance(body_out, (list, tuple)) else [body_out]
+    if len(body_out) != len(loop_vars):
+        raise ValueError("body must return as many values as loop_vars")
+    ext = []
+    for v in _external_ids(cond_sub, [pred0], bound) + _external_ids(
+        body_sub, body_out, bound
+    ):
+        if v not in ext and v not in bound:
+            ext.append(v)
+    all_refs = {**cond_sub._var_refs, **body_sub._var_refs}
+    for v in loop_vars:
+        all_refs[id(v)] = v
+    ext_tensors = [all_refs[v] for v in ext]
+    c_replay = _make_branch_replay(cond_sub, [pred0], bound, ext)
+    b_replay = _make_branch_replay(body_sub, body_out, bound, ext)
+    n = len(loop_vars)
+
+    def composite(*vals):
+        carry0, ext_vals = vals[:n], vals[n:]
+        base_env = dict(zip(ext, ext_vals))
+
+        def raw_cond(carry):
+            env = dict(base_env)
+            env.update(zip(bound, carry))
+            p = c_replay(env)[0]
+            return p.reshape(()) if hasattr(p, "reshape") else p
+
+        def raw_body(carry):
+            env = dict(base_env)
+            env.update(zip(bound, carry))
+            return tuple(b_replay(env))
+
+        return jax.lax.while_loop(raw_cond, raw_body, tuple(carry0))
+
+    raw = composite(
+        *[v._value for v in loop_vars], *[t._value for t in ext_tensors]
+    )
+    out_tensors = tuple(wrap_raw(o) for o in raw)
+    tensor_mod._op_recorder(
+        composite, list(loop_vars) + ext_tensors, out_tensors, True, "while"
+    )
+    return list(out_tensors)
+
+
+def cond(pred, true_fn: Callable = None, false_fn: Callable = None,
+         name=None):
+    """Run ``true_fn()`` if ``pred`` else ``false_fn()``.
+
+    Both branches must return structurally identical outputs (same tree of
+    shapes/dtypes) when traced; in eager mode only the taken branch runs.
+    Parity: fluid.layers.cond (operators/controlflow/conditional_block_op.cc).
+    """
+    if _recording():
+        return _record_cond(pred, true_fn, false_fn)
+    p = _pred_raw(pred)
+    if _is_concrete(p):
+        fn = true_fn if bool(p) else false_fn
+        return fn() if fn is not None else None
+
+    def branch(fn):
+        def inner(_):
+            return _unwrap(fn())
+
+        return inner
+
+    out = jax.lax.cond(p, branch(true_fn), branch(false_fn), operand=None)
+    return _wrap_out(out)
+
+
+def case(pred_fn_pairs: Sequence[Tuple], default: Callable = None, name=None):
+    """First pair whose predicate is True wins; ``default`` if none are.
+
+    Parity: fluid.layers.case. Lowers to a chain of ``lax.cond`` when traced.
+    """
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    for pair in pred_fn_pairs:
+        if len(pair) != 2 or not callable(pair[1]):
+            raise TypeError("each pred_fn_pair must be (Tensor, callable)")
+    if default is None:
+        # reference semantics: last fn doubles as the default
+        pred_fn_pairs, default = pred_fn_pairs[:-1], pred_fn_pairs[-1][1]
+
+    result = default
+    for pred, fn in reversed(list(pred_fn_pairs)):
+        prev = result
+
+        def make(pred=pred, fn=fn, prev=prev):
+            return lambda: cond(pred, fn, prev if callable(prev) else (lambda: prev))
+
+        result = make()
+    return result()
+
+
+def switch_case(branch_index, branch_fns, default: Callable = None, name=None):
+    """Dispatch on an integer index. Parity: fluid.layers.switch_case.
+
+    ``branch_fns`` is a dict {int: fn} or list of (int, fn) or list of fns.
+    Lowers to ``lax.switch`` when traced.
+    """
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    elif branch_fns and callable(branch_fns[0]):
+        pairs = list(enumerate(branch_fns))
+    else:
+        pairs = sorted(branch_fns)
+    keys = [k for k, _ in pairs]
+    fns = [f for _, f in pairs]
+    idx = branch_index._value if isinstance(branch_index, Tensor) else jnp.asarray(branch_index)
+    if idx.ndim > 0:
+        idx = idx.reshape(())
+
+    if _recording():
+        # chain of cond composite ops; the recorded program replays lax.conds
+        idx_t = branch_index if isinstance(branch_index, Tensor) else wrap_raw(idx)
+        result = default if default is not None else fns[-1]
+        for k, fn in reversed(pairs):
+            prev = result
+
+            def make(k=k, fn=fn, prev=prev):
+                return lambda: cond(idx_t == k, fn,
+                                    prev if callable(prev) else (lambda: prev))
+
+            result = make()
+        return result()
+
+    if _is_concrete(idx):
+        i = int(idx)
+        if i in keys:
+            return fns[keys.index(i)]()
+        if default is not None:
+            return default()
+        return fns[-1]()  # reference: largest key is the fallback
+
+    # Traced: densify onto lax.switch. Map the runtime key to a branch slot;
+    # unmatched keys take the default slot.
+    if default is None:
+        default = fns[-1]
+    all_fns = fns + [default]
+    slot = jnp.full((), len(fns), jnp.int32)
+    for j, k in enumerate(keys):
+        slot = jnp.where(idx == k, jnp.int32(j), slot)
+
+    def branch(fn):
+        def inner(_):
+            return _unwrap(fn())
+
+        return inner
+
+    out = jax.lax.switch(slot, [branch(f) for f in all_fns], None)
+    return _wrap_out(out)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
+               is_test=False, name=None):
+    """Repeat ``body`` while ``cond`` holds. Parity: fluid.layers.while_loop
+    (operators/controlflow/while_op.cc).
+
+    Eager: a Python loop (autograd records every executed op, like the
+    reference's dygraph while). Traced: ``lax.while_loop`` — single
+    compilation, shapes must be loop-invariant, and (as in XLA) reverse-mode
+    AD through the loop is not available; use ``lax.scan``-style
+    ``paddle_tpu.jit`` staging for differentiable loops of known length.
+    """
+    if not callable(cond_fn) or not callable(body_fn):
+        raise TypeError("cond and body must be callable")
+    loop_vars = list(loop_vars)
+    if not loop_vars:
+        raise ValueError("loop_vars must be non-empty")
+    if _recording():
+        return _record_while(cond_fn, body_fn, loop_vars)
+
+    p = _pred_raw(cond_fn(*loop_vars))
+    traced = _is_tracer(p) or any(
+        _is_tracer(l) for l in jax.tree_util.tree_leaves(_unwrap(loop_vars))
+    )
+    if not traced:
+        while bool(p):
+            out = body_fn(*loop_vars)
+            loop_vars = list(out) if isinstance(out, (list, tuple)) else [out]
+            p = _pred_raw(cond_fn(*loop_vars))
+        return loop_vars
+
+    treedef = jax.tree_util.tree_structure(
+        loop_vars, is_leaf=lambda x: isinstance(x, Tensor)
+    )
+
+    def raw_cond(carry):
+        vars_ = _wrap_out(jax.tree_util.tree_unflatten(treedef, carry))
+        return _pred_raw(cond_fn(*vars_))
+
+    def raw_body(carry):
+        vars_ = _wrap_out(jax.tree_util.tree_unflatten(treedef, carry))
+        out = body_fn(*vars_)
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        return [
+            l._value if isinstance(l, Tensor) else l
+            for l in jax.tree_util.tree_leaves(
+                out, is_leaf=lambda x: isinstance(x, Tensor)
+            )
+        ]
+
+    carry0 = [
+        l._value if isinstance(l, Tensor) else jnp.asarray(l)
+        for l in jax.tree_util.tree_leaves(
+            loop_vars, is_leaf=lambda x: isinstance(x, Tensor)
+        )
+    ]
+    out = jax.lax.while_loop(raw_cond, raw_body, carry0)
+    return list(_wrap_out(jax.tree_util.tree_unflatten(treedef, out)))
+
+
+def increment(x, value=1.0):
+    """In-place-style increment (parity: fluid.layers.increment).
+
+    Mutates ``x`` only when both the input and the result are concrete
+    (eager mode); under tracing the pure result is returned and callers must
+    use it (in-place semantics cannot cross a trace boundary).
+    """
+    out = x + value
+    out_raw = out._value if isinstance(out, Tensor) else out
+    if _recording() and isinstance(x, Tensor) and isinstance(out, Tensor):
+        # True in-place static semantics (reference increment_op writes its
+        # input variable): rebind x's slot in the replay env to the add's
+        # output, so later ops reading x see the incremented value. The
+        # build-time concrete value is deliberately NOT mutated — replay owns
+        # the semantics, and mutating here would corrupt the recorded initial
+        # value of while_loop carries that alias x.
+        tensor_mod._op_recorder(lambda v: v, [out], (x,), False, "assign")
+        return x
+    if (isinstance(x, Tensor) and not _is_tracer(x._value)
+            and not _is_tracer(out_raw)):
+        x.set_value(out)
+        return x
+    return out
+
+
+# --------------------------------------------------------------------------
+# TensorArray facade (reference: LoDTensorArray + array_write/read ops,
+# operators/controlflow/ tensor_array ops). Eager-only python list semantics;
+# for traced loops use lax.scan via paddle_tpu.jit.
+# --------------------------------------------------------------------------
+def create_array(dtype="float32", initialized_list=None):
+    arr: List = []
+    if initialized_list:
+        arr.extend(initialized_list)
+    return arr
+
+
+def array_write(x, i, array: Optional[list] = None):
+    if array is None:
+        array = []
+    idx = int(i) if not isinstance(i, Tensor) else int(i.numpy())
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+def array_read(array: list, i):
+    idx = int(i) if not isinstance(i, Tensor) else int(i.numpy())
+    return array[idx]
+
+
+def array_length(array: list):
+    return wrap_raw(jnp.asarray(len(array), jnp.int64))

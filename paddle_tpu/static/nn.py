@@ -10,7 +10,15 @@ from ..nn import initializer as I
 from ..nn.param_attr import ParamAttr
 from ..core import dtype as dtype_mod
 
-__all__ = ["fc", "conv2d", "batch_norm", "embedding"]
+from .control_flow import (  # noqa: F401
+    case,
+    cond,
+    switch_case,
+    while_loop,
+)
+
+__all__ = ["fc", "conv2d", "batch_norm", "embedding", "cond", "case",
+           "switch_case", "while_loop"]
 
 
 def _make_param(shape, attr, is_bias, dtype="float32"):
