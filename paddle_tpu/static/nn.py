@@ -16,9 +16,27 @@ from .control_flow import (  # noqa: F401
     switch_case,
     while_loop,
 )
+from ..tensor.sequence import (  # noqa: F401
+    sequence_concat,
+    sequence_enumerate,
+    sequence_expand,
+    sequence_expand_as,
+    sequence_first_step,
+    sequence_last_step,
+    sequence_pad,
+    sequence_pool,
+    sequence_reverse,
+    sequence_slice,
+    sequence_softmax,
+    sequence_unpad,
+)
 
 __all__ = ["fc", "conv2d", "batch_norm", "embedding", "cond", "case",
-           "switch_case", "while_loop"]
+           "switch_case", "while_loop", "sequence_pad", "sequence_unpad",
+           "sequence_pool", "sequence_softmax", "sequence_reverse",
+           "sequence_expand", "sequence_expand_as", "sequence_concat",
+           "sequence_first_step", "sequence_last_step", "sequence_slice",
+           "sequence_enumerate"]
 
 
 def _make_param(shape, attr, is_bias, dtype="float32"):

@@ -17,9 +17,11 @@ from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 
-from . import attribute, creation, linalg, logic, manipulation, math, random, search, stat
+from . import (attribute, creation, linalg, logic, manipulation, math, random,
+               search, sequence, stat)
 
 # ---------------------------------------------------------------------------
 # Attach functional ops as Tensor methods (paddle-style method surface).

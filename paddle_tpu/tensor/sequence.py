@@ -1,0 +1,262 @@
+"""Sequence (LoD) ops over a TPU-friendly ragged representation.
+
+Capability parity with the reference's sequence op family
+(/root/reference/paddle/fluid/operators/sequence_ops/ — sequence_pad_op.cc,
+sequence_pool_op.cc, sequence_softmax_op.cc, sequence_reverse_op.h,
+sequence_expand_op.cc, sequence_mask_op.cc, …). The reference represents
+variable-length batches as LoDTensor (flat values + level-of-detail offsets,
+framework/lod_tensor.h:109) and every kernel walks the offsets.
+
+XLA wants static shapes, so the TPU-native ragged representation is
+**padded data + per-row lengths**: ``x[B, T, ...]`` with ``lengths[B]``
+(``paddle_tpu.io.RaggedSlot`` is the host-side flat+offsets twin and
+converts via ``to_padded``). Every op here that has a static output shape
+(mask/pool/softmax/reverse/pad/enumerate) is pure jnp — jittable, fusible,
+MXU/VPU friendly. Ops whose *output* is inherently ragged (unpad/expand/
+concat/slice) return per-row python lists and are eager-only, exactly the
+cases where the reference materializes a new LoD.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor, apply_op, wrap_raw
+
+__all__ = [
+    "sequence_mask",
+    "sequence_pad",
+    "sequence_unpad",
+    "sequence_pool",
+    "sequence_softmax",
+    "sequence_reverse",
+    "sequence_expand",
+    "sequence_expand_as",
+    "sequence_concat",
+    "sequence_first_step",
+    "sequence_last_step",
+    "sequence_slice",
+    "sequence_enumerate",
+]
+
+
+def _raw(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _lengths_raw(lengths):
+    l = _raw(lengths)
+    return l.astype(jnp.int32) if l.dtype not in (jnp.int32, jnp.int64) else l
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """mask[i, j] = j < x[i]. Parity: sequence_mask_op.cc / paddle.nn.functional.
+
+    ``maxlen=None`` uses max(x) — that makes the output shape data-dependent,
+    so under jit pass an explicit ``maxlen``.
+    """
+    lens = _lengths_raw(x)
+    if maxlen is None:
+        maxlen = int(jnp.max(lens))
+    d = dtype_mod.convert_dtype(dtype)
+
+    def fn(lens):
+        pos = jnp.arange(maxlen, dtype=lens.dtype)
+        return (pos[None, :] < lens[..., None]).astype(d)
+
+    return apply_op(fn, wrap_raw(lens), op_name="sequence_mask")
+
+
+def _rows_of(x, lengths):
+    """Normalize input to a list of per-row arrays (host side)."""
+    if isinstance(x, (list, tuple)):
+        return [np.asarray(_raw(r)) for r in x]
+    data = np.asarray(_raw(x))
+    lens = np.asarray(_raw(lengths))
+    if data.ndim >= 2 and data.shape[0] == len(lens):
+        return [data[i, : int(lens[i])] for i in range(len(lens))]
+    # flat values + lengths (LoDTensor layout)
+    offs = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
+    return [data[offs[i]:offs[i + 1]] for i in range(len(lens))]
+
+
+def sequence_pad(x, pad_value=0.0, maxlen=None, length=None, name=None):
+    """Pad ragged rows to ``[B, maxlen, ...]``; returns (padded, lengths).
+
+    Accepts a list of rows, or (flat_values, length), or an already-padded
+    ``[B, T, ...]`` plus ``length``. Parity: sequence_pad_op.cc (which also
+    returns the Length tensor).
+    """
+    rows = _rows_of(x, length)
+    lens = np.asarray([len(r) for r in rows], np.int64)
+    t = int(maxlen) if maxlen is not None else int(lens.max() if len(lens) else 0)
+    tail = rows[0].shape[1:] if rows and rows[0].ndim > 1 else ()
+    pv = np.asarray(_raw(pad_value)) if not np.isscalar(pad_value) else pad_value
+    out = np.full((len(rows), t) + tail, pv, dtype=rows[0].dtype if rows else np.float32)
+    for i, r in enumerate(rows):
+        n = min(len(r), t)
+        out[i, :n] = r[:n]
+        lens[i] = n
+    return wrap_raw(jnp.asarray(out)), wrap_raw(jnp.asarray(lens))
+
+
+def sequence_unpad(x, length, name=None):
+    """Strip padding; returns the list of valid rows (ragged output ⇒ eager).
+    Parity: sequence_unpad_op.cc."""
+    data = np.asarray(_raw(x))
+    lens = np.asarray(_raw(length)).astype(np.int64)
+    return [wrap_raw(jnp.asarray(data[i, : int(lens[i])])) for i in range(len(lens))]
+
+
+def sequence_pool(x, pool_type: str, lengths=None, pad_value=0.0, name=None):
+    """Pool each row over its valid timesteps. [B, T, ...] + lengths -> [B, ...].
+
+    pool_type ∈ {sum, average, sqrt, max, min, first, last}. Rows with
+    length 0 produce ``pad_value``. Parity: sequence_pool_op.cc (same set).
+    Pure jnp — jittable.
+    """
+    if lengths is None:
+        raise ValueError("sequence_pool needs lengths (padded+lengths ragged form)")
+    pool_type = pool_type.lower()
+    lens = _lengths_raw(lengths)
+
+    def fn(data, lens):
+        t = data.shape[1]
+        pos = jnp.arange(t)
+        mask = pos[None, :] < lens[:, None]  # [B, T]
+        mshape = mask.shape + (1,) * (data.ndim - 2)
+        m = mask.reshape(mshape)
+        lensf = jnp.maximum(lens, 1).astype(data.dtype).reshape(
+            (-1,) + (1,) * (data.ndim - 2))
+        if pool_type == "sum":
+            out = jnp.where(m, data, 0).sum(axis=1)
+        elif pool_type in ("average", "mean"):
+            out = jnp.where(m, data, 0).sum(axis=1) / lensf
+        elif pool_type == "sqrt":
+            out = jnp.where(m, data, 0).sum(axis=1) / jnp.sqrt(lensf)
+        elif pool_type == "max":
+            out = jnp.where(m, data, -jnp.inf).max(axis=1)
+        elif pool_type == "min":
+            out = jnp.where(m, data, jnp.inf).min(axis=1)
+        elif pool_type == "first":
+            out = data[:, 0]
+        elif pool_type == "last":
+            idx = jnp.maximum(lens - 1, 0)
+            out = jnp.take_along_axis(
+                data, idx.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1
+            ).squeeze(1)
+        else:
+            raise ValueError(f"unknown pool_type {pool_type!r}")
+        empty = (lens == 0).reshape((-1,) + (1,) * (data.ndim - 2))
+        return jnp.where(empty, jnp.asarray(pad_value, data.dtype), out)
+
+    return apply_op(fn, x, wrap_raw(lens), op_name=f"sequence_pool_{pool_type}")
+
+
+def sequence_first_step(x, lengths=None):
+    return sequence_pool(x, "first", lengths)
+
+
+def sequence_last_step(x, lengths=None):
+    return sequence_pool(x, "last", lengths)
+
+
+def sequence_softmax(x, lengths=None, name=None):
+    """Masked softmax over the time axis of [B, T] (or [B, T, ...], over axis
+    1). Padding positions get probability 0. Parity: sequence_softmax_op.cc."""
+    if lengths is None:
+        raise ValueError("sequence_softmax needs lengths")
+    lens = _lengths_raw(lengths)
+
+    def fn(data, lens):
+        t = data.shape[1]
+        mask = jnp.arange(t)[None, :] < lens[:, None]
+        mshape = mask.shape + (1,) * (data.ndim - 2)
+        m = mask.reshape(mshape)
+        z = jnp.where(m, data, -jnp.inf)
+        z = z - jax.lax.stop_gradient(jnp.max(jnp.where(m, z, -jnp.inf), axis=1, keepdims=True))
+        e = jnp.where(m, jnp.exp(z), 0)
+        return e / jnp.maximum(e.sum(axis=1, keepdims=True), 1e-38)
+
+    return apply_op(fn, x, wrap_raw(lens), op_name="sequence_softmax")
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    """Reverse each row's valid prefix, keeping padding in place.
+    Parity: sequence_reverse_op.h. Pure jnp — jittable."""
+    if lengths is None:
+        raise ValueError("sequence_reverse needs lengths")
+    lens = _lengths_raw(lengths)
+
+    def fn(data, lens):
+        t = data.shape[1]
+        pos = jnp.arange(t)[None, :]
+        src = jnp.where(pos < lens[:, None], lens[:, None] - 1 - pos, pos)
+        return jnp.take_along_axis(
+            data, src.reshape(src.shape + (1,) * (data.ndim - 2)), axis=1
+        )
+
+    return apply_op(fn, x, wrap_raw(lens), op_name="sequence_reverse")
+
+
+def sequence_expand(x, ref_lengths, x_lengths=None, name=None):
+    """Repeat row i of ``x`` ``ref_lengths[i]`` times (ragged output ⇒ eager).
+    Parity: sequence_expand_op.cc at ref_level 0 — the common embedding-
+    broadcast use."""
+    reps = np.asarray(_raw(ref_lengths)).astype(np.int64)
+    rows = _rows_of(x, x_lengths) if x_lengths is not None else list(
+        np.asarray(_raw(x)))
+    out = []
+    for i, r in enumerate(rows):
+        for _ in range(int(reps[i]) if i < len(reps) else 1):
+            out.append(r)
+    return wrap_raw(jnp.asarray(np.stack(out))) if out else wrap_raw(
+        jnp.zeros((0,) + tuple(np.asarray(rows[0]).shape), np.float32))
+
+
+def sequence_expand_as(x, y_lengths, name=None):
+    return sequence_expand(x, y_lengths)
+
+
+def sequence_concat(xs: Sequence, lengths_list: Sequence, name=None):
+    """Row-wise concat of ragged batches: out row i = concat of every input's
+    row i. Returns (padded, lengths). Parity: sequence_concat_op.cc."""
+    all_rows = [
+        _rows_of(x, l) for x, l in zip(xs, lengths_list)
+    ]
+    b = len(all_rows[0])
+    rows = [np.concatenate([g[i] for g in all_rows]) for i in range(b)]
+    return sequence_pad(rows)
+
+
+def sequence_slice(x, offset, length, lengths=None, name=None):
+    """Per-row slice [offset[i] : offset[i]+length[i]] (ragged ⇒ eager).
+    Parity: sequence_slice_op.h."""
+    rows = _rows_of(x, lengths)
+    off = np.asarray(_raw(offset)).astype(np.int64).reshape(-1)
+    ln = np.asarray(_raw(length)).astype(np.int64).reshape(-1)
+    out = [r[int(off[i]): int(off[i] + ln[i])] for i, r in enumerate(rows)]
+    return sequence_pad(out)
+
+
+def sequence_enumerate(x, win_size: int, pad_value=0, lengths=None, name=None):
+    """Sliding windows: out[i, j] = [x[i, j], …, x[i, j+w-1]], positions past
+    a row's length filled with pad_value. [B, T] -> [B, T, win_size].
+    Parity: sequence_enumerate_op.cc. Pure jnp — jittable."""
+    lens = _lengths_raw(lengths) if lengths is not None else None
+
+    def fn(data, lens):
+        t = data.shape[1]
+        pos = jnp.arange(t)[:, None] + jnp.arange(win_size)[None, :]  # [T, W]
+        gathered = jnp.take(data, jnp.minimum(pos, t - 1), axis=1)  # [B, T, W]
+        limit = lens[:, None, None] if lens is not None else t
+        valid = pos[None, :, :] < limit
+        return jnp.where(valid, gathered, jnp.asarray(pad_value, data.dtype))
+
+    if lens is None:
+        return apply_op(lambda d: fn(d, None), x, op_name="sequence_enumerate")
+    return apply_op(fn, x, wrap_raw(lens), op_name="sequence_enumerate")

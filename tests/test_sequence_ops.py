@@ -1,0 +1,178 @@
+"""Sequence (LoD) ops vs numpy goldens — the reference's sequence_ops suite
+pattern (tests/unittests/test_sequence_*.py): golden outputs per row computed
+with plain numpy over the ragged rows, compared against the padded+lengths
+kernels; grad checks through the masked ops; jit parity for the static-shape
+ops."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import tensor as T
+
+
+def ragged(rng, b=4, tmax=6, tail=()):
+    lens = rng.randint(1, tmax + 1, size=b)
+    rows = [rng.randn(l, *tail).astype(np.float32) for l in lens]
+    padded = np.zeros((b, tmax) + tail, np.float32)
+    for i, r in enumerate(rows):
+        padded[i, : len(r)] = r
+    return rows, padded, lens.astype(np.int64)
+
+
+class TestSequenceMask:
+    def test_basic(self):
+        out = T.sequence_mask(paddle.to_tensor([2, 0, 3]), maxlen=4)
+        exp = np.array([[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+        np.testing.assert_array_equal(out.numpy(), exp)
+
+    def test_auto_maxlen_and_dtype(self):
+        out = T.sequence_mask(paddle.to_tensor([1, 3]), dtype="float32")
+        assert out.shape == [2, 3] and str(out.dtype) == "float32"
+
+    def test_jit(self):
+        f = jax.jit(lambda l: T.sequence_mask(l, maxlen=5)._value)
+        np.testing.assert_array_equal(
+            np.asarray(f(jnp.asarray([2, 5]))),
+            [[1, 1, 0, 0, 0], [1, 1, 1, 1, 1]])
+
+
+class TestSequencePad:
+    def test_rows_roundtrip(self, rng):
+        rows, padded, lens = ragged(rng)
+        out, l = T.sequence_pad([paddle.to_tensor(r) for r in rows],
+                                pad_value=0.0, maxlen=6)
+        np.testing.assert_allclose(out.numpy(), padded)
+        np.testing.assert_array_equal(l.numpy(), lens)
+
+    def test_flat_plus_lengths(self):
+        flat = np.arange(5, dtype=np.float32)
+        out, l = T.sequence_pad(flat, pad_value=-1.0, maxlen=3,
+                                length=np.array([2, 3]))
+        np.testing.assert_allclose(out.numpy(),
+                                   [[0, 1, -1], [2, 3, 4]])
+
+    def test_unpad_roundtrip(self, rng):
+        rows, padded, lens = ragged(rng)
+        back = T.sequence_unpad(paddle.to_tensor(padded),
+                                paddle.to_tensor(lens))
+        for r, b in zip(rows, back):
+            np.testing.assert_allclose(b.numpy(), r)
+
+
+class TestSequencePool:
+    @pytest.mark.parametrize("ptype,npfn", [
+        ("sum", lambda r: r.sum(0)),
+        ("average", lambda r: r.mean(0)),
+        ("sqrt", lambda r: r.sum(0) / np.sqrt(len(r))),
+        ("max", lambda r: r.max(0)),
+        ("min", lambda r: r.min(0)),
+        ("first", lambda r: r[0]),
+        ("last", lambda r: r[-1]),
+    ])
+    def test_golden(self, rng, ptype, npfn):
+        rows, padded, lens = ragged(rng, tail=(3,))
+        out = T.sequence_pool(paddle.to_tensor(padded), ptype,
+                              lengths=paddle.to_tensor(lens))
+        exp = np.stack([npfn(r) for r in rows])
+        np.testing.assert_allclose(out.numpy(), exp, rtol=1e-6)
+
+    def test_grad_sum(self, rng):
+        rows, padded, lens = ragged(rng)
+        x = paddle.to_tensor(padded, stop_gradient=False)
+        out = T.sequence_pool(x, "sum", lengths=paddle.to_tensor(lens))
+        out.sum().backward()
+        # grad is 1 on valid positions, 0 on padding
+        exp = (np.arange(padded.shape[1])[None, :] < lens[:, None]).astype(np.float32)
+        np.testing.assert_allclose(x.grad.numpy(), exp)
+
+    def test_empty_row_pad_value(self):
+        padded = np.ones((2, 3), np.float32)
+        out = T.sequence_pool(paddle.to_tensor(padded), "max",
+                              lengths=paddle.to_tensor([0, 2]),
+                              pad_value=-7.0)
+        np.testing.assert_allclose(out.numpy(), [-7.0, 1.0])
+
+
+class TestSequenceSoftmax:
+    def test_golden(self, rng):
+        rows, padded, lens = ragged(rng)
+        out = T.sequence_softmax(paddle.to_tensor(padded),
+                                 lengths=paddle.to_tensor(lens))
+        o = out.numpy()
+        for i, r in enumerate(rows):
+            e = np.exp(r - r.max())
+            np.testing.assert_allclose(o[i, : len(r)], e / e.sum(), rtol=1e-5)
+            np.testing.assert_allclose(o[i, len(r):], 0.0)
+
+    def test_rows_sum_to_one(self, rng):
+        _, padded, lens = ragged(rng)
+        out = T.sequence_softmax(paddle.to_tensor(padded),
+                                 lengths=paddle.to_tensor(lens))
+        np.testing.assert_allclose(out.numpy().sum(1), 1.0, rtol=1e-5)
+
+    def test_grad_finite(self, rng):
+        _, padded, lens = ragged(rng)
+        x = paddle.to_tensor(padded, stop_gradient=False)
+        out = T.sequence_softmax(x, lengths=paddle.to_tensor(lens))
+        (out * out).sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+
+class TestSequenceReverse:
+    def test_golden(self, rng):
+        rows, padded, lens = ragged(rng, tail=(2,))
+        out = T.sequence_reverse(paddle.to_tensor(padded),
+                                 lengths=paddle.to_tensor(lens))
+        o = out.numpy()
+        for i, r in enumerate(rows):
+            np.testing.assert_allclose(o[i, : len(r)], r[::-1])
+            np.testing.assert_allclose(o[i, len(r):], padded[i, len(r):])
+
+    def test_involution(self, rng):
+        _, padded, lens = ragged(rng)
+        l = paddle.to_tensor(lens)
+        x = paddle.to_tensor(padded)
+        twice = T.sequence_reverse(T.sequence_reverse(x, lengths=l), lengths=l)
+        np.testing.assert_allclose(twice.numpy(), padded)
+
+
+class TestSequenceExpandConcatSlice:
+    def test_expand(self):
+        x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        out = T.sequence_expand(paddle.to_tensor(x), np.array([2, 3]))
+        exp = np.stack([x[0], x[0], x[1], x[1], x[1]])
+        np.testing.assert_allclose(out.numpy(), exp)
+
+    def test_concat(self, rng):
+        rows_a, pa, la = ragged(rng, b=3)
+        rows_b, pb, lb = ragged(rng, b=3)
+        out, lens = T.sequence_concat([pa, pb], [la, lb])
+        for i in range(3):
+            exp = np.concatenate([rows_a[i], rows_b[i]])
+            np.testing.assert_allclose(out.numpy()[i, : len(exp)], exp)
+            assert int(lens.numpy()[i]) == len(exp)
+
+    def test_slice(self):
+        padded = np.arange(12, dtype=np.float32).reshape(2, 6)
+        out, lens = T.sequence_slice(padded, offset=[1, 2], length=[2, 3],
+                                     lengths=np.array([6, 6]))
+        np.testing.assert_allclose(out.numpy()[0, :2], [1, 2])
+        np.testing.assert_allclose(out.numpy()[1, :3], [8, 9, 10])
+
+
+class TestSequenceEnumerate:
+    def test_golden(self):
+        x = np.array([[1, 2, 3, 0]], np.int64)
+        out = T.sequence_enumerate(paddle.to_tensor(x), win_size=2,
+                                   pad_value=0,
+                                   lengths=paddle.to_tensor([3]))
+        exp = np.array([[[1, 2], [2, 3], [3, 0], [0, 0]]])
+        np.testing.assert_array_equal(out.numpy(), exp)
+
+    def test_jit(self):
+        f = jax.jit(lambda d, l: T.sequence_enumerate(
+            d, win_size=2, lengths=l)._value)
+        out = f(jnp.asarray([[1, 2, 3, 0]]), jnp.asarray([3]))
+        assert out.shape == (1, 4, 2)
