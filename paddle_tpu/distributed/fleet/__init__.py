@@ -18,4 +18,9 @@ from .fleet_base import (  # noqa: F401
     get_hybrid_communicate_group,
 )
 from . import meta_parallel  # noqa: F401
+from .meta_strategies import (  # noqa: F401
+    DPStrategyTrainStep,
+    LocalSGDTrainStep,
+    create_strategy_train_step,
+)
 from .utils import recompute  # noqa: F401

@@ -39,7 +39,37 @@ from paddle_tpu.jit.functionalize import (
     set_params,
 )
 
-__all__ = ["ParallelTrainStep", "param_partition_spec"]
+__all__ = ["ParallelTrainStep", "param_partition_spec", "apply_optimizer_update"]
+
+
+def apply_optimizer_update(opt, named_params, params, grads, opt_state, lr):
+    """Functional optimizer application shared by every fleet engine.
+
+    Replicates what ``Optimizer.step()`` does imperatively (optimizer.py):
+    global-norm gradient clipping, L2 decay folded into the grad, AdamW's
+    decoupled decay applied to the param, then the per-param ``_update``.
+    Keeping it in one place stops the engines drifting from each other.
+    """
+    if opt._grad_clip is not None:
+        from paddle_tpu.nn.clip import ClipGradByGlobalNorm, clip_grads_global_norm_raw
+
+        if isinstance(opt._grad_clip, ClipGradByGlobalNorm):
+            grads = clip_grads_global_norm_raw(grads, opt._grad_clip.clip_norm)
+    new_params, new_state = {}, {}
+    is_adamw = type(opt).__name__ == "AdamW"
+    for name, pv in params.items():
+        g = grads[name].astype(pv.dtype)
+        wd = opt._decay_coeff(named_params[name])
+        if wd and not is_adamw:
+            g = g + wd * pv
+        if is_adamw and getattr(opt, "_coeff", 0.0):
+            if (opt._apply_decay_param_fun is None
+                    or opt._apply_decay_param_fun(name)):
+                pv = pv * (1.0 - lr * opt._coeff)
+        np_, ns = opt._update(pv, g, opt_state[name], lr)
+        new_params[name] = np_
+        new_state[name] = ns
+    return new_params, new_state
 
 
 def param_partition_spec(param, shape, zero_stage=0, sharding_axis="sharding",
@@ -78,7 +108,7 @@ class ParallelTrainStep:
     def __init__(self, layer, loss_fn: Callable, optimizer, mesh: Mesh,
                  dp_axis="dp", mp_axis="mp", sharding_axis="sharding",
                  zero_stage=0, recompute=False, compute_dtype=None,
-                 donate=True, extra_batch_axes=()):
+                 donate=True, extra_batch_axes=(), offload=False):
         self._layer = layer
         self._optimizer = optimizer
         self._loss_fn = loss_fn
@@ -103,6 +133,14 @@ class ParallelTrainStep:
             n: NamedSharding(mesh, s) for n, s in self._param_specs.items()
         }
 
+        # ZeRO offload (sharding_optimizer.py offload=True parity): optimizer
+        # state lives in host DRAM ("pinned_host" memory space) between steps
+        # and is streamed to device memory around the jitted update — on TPU
+        # this frees HBM for params/activations the way the reference frees
+        # GPU memory. The transfers happen outside the compiled step (async
+        # device_put), keeping the XLA program all-device.
+        self._offload = bool(offload)
+
         def opt_state_sharding(name, v):
             pspec = self._param_specs[name]
             st = optimizer._init_state(v)
@@ -123,6 +161,10 @@ class ParallelTrainStep:
         self._opt_shardings = {
             n: opt_state_sharding(n, v) for n, v in params_host.items()
         }
+        self._opt_host_shardings = {
+            n: {k: s.with_memory_kind("pinned_host") for k, s in d.items()}
+            for n, d in self._opt_shardings.items()
+        } if offload else None
         batch_axes = (dp_axis,) + tuple(extra_batch_axes)
         self._batch_sharding = NamedSharding(
             mesh, P(batch_axes if len(batch_axes) > 1 else dp_axis)
@@ -136,9 +178,10 @@ class ParallelTrainStep:
             for n, v in params_host.items()
         }
         self._buffers = {n: jax.device_put(v, repl) for n, v in buffers_host.items()}
+        opt_home = self._opt_host_shardings if offload else self._opt_shardings
         self._opt_state = {
             n: {
-                k: jax.device_put(s, self._opt_shardings[n][k])
+                k: jax.device_put(s, opt_home[n][k])
                 for k, s in optimizer._init_state(v).items()
             }
             for n, v in params_host.items()
@@ -168,28 +211,8 @@ class ParallelTrainStep:
             inputs, labels = batch
             (loss, new_buffers), grads = jax.value_and_grad(
                 forward_loss, has_aux=True)(params, buffers, inputs, labels)
-            if opt._grad_clip is not None:
-                from paddle_tpu.nn.clip import (
-                    ClipGradByGlobalNorm,
-                    clip_grads_global_norm_raw,
-                )
-
-                if isinstance(opt._grad_clip, ClipGradByGlobalNorm):
-                    grads = clip_grads_global_norm_raw(grads, opt._grad_clip.clip_norm)
-            new_params, new_opt = {}, {}
-            for name, pv in params.items():
-                g = grads[name].astype(pv.dtype)
-                wd = opt._decay_coeff(named[name])
-                if wd and type(opt).__name__ != "AdamW":
-                    g = g + wd * pv
-                if type(opt).__name__ == "AdamW" and getattr(opt, "_coeff", 0.0):
-                    decay = (opt._apply_decay_param_fun is None
-                             or opt._apply_decay_param_fun(name))
-                    if decay:
-                        pv = pv * (1.0 - lr * opt._coeff)
-                np_, ns = opt._update(pv, g, opt_state[name], lr)
-                new_params[name] = np_
-                new_opt[name] = ns
+            new_params, new_opt = apply_optimizer_update(
+                opt, named, params, grads, opt_state, lr)
             return new_params, new_buffers, new_opt, loss
 
         in_shardings = (
@@ -228,9 +251,23 @@ class ParallelTrainStep:
             for a in (labels if isinstance(labels, (tuple, list)) else (labels,))
         )
         lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
-        self._params, self._buffers, self._opt_state, loss = self._jitted(
-            self._params, self._buffers, self._opt_state, lr, (raw_in, raw_lab)
+        opt_state = self._opt_state
+        if self._offload:
+            # stream host-resident optimizer state into HBM (async device_put)
+            opt_state = jax.tree_util.tree_map(
+                lambda s, sh: jax.device_put(s, sh)
+                if hasattr(s, "shape") else s,
+                opt_state, self._opt_shardings)
+        self._params, self._buffers, new_opt, loss = self._jitted(
+            self._params, self._buffers, opt_state, lr, (raw_in, raw_lab)
         )
+        if self._offload:
+            # evacuate the updated state back to host DRAM, freeing HBM
+            new_opt = jax.tree_util.tree_map(
+                lambda s, sh: jax.device_put(s, sh)
+                if hasattr(s, "shape") else s,
+                new_opt, self._opt_host_shardings)
+        self._opt_state = new_opt
         self._optimizer._global_step += 1
         self._dirty = True
         return Tensor(loss)

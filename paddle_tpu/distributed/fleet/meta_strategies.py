@@ -1,0 +1,444 @@
+"""Grad-communication meta-strategies: LocalSGD, DGC, fp16-allreduce,
+gradient merge.
+
+Parity with the reference meta-optimizers (SURVEY.md §2 #75/#76):
+- localsgd_optimizer.py / adaptive variant — periodic model averaging
+- dgc_optimizer.py + details/sparse_all_reduce_op_handle.cc — deep gradient
+  compression (top-k sparsification with momentum correction + residual
+  accumulation, Lin et al. 2017)
+- fp16_allreduce_optimizer.py — gradients cast to half precision for the
+  allreduce only
+- gradient_merge_optimizer.py — accumulate k micro-steps before the update
+
+The reference implements each as a ProgramDesc rewrite inserting c_* ops.
+TPU-native, they are all modifications of the *gradient synchronisation
+path*, so this engine runs the train step under ``shard_map`` over the 'dp'
+mesh axis, where that path is explicit (``lax.pmean``) and each strategy
+edits it directly. Per-rank state (LocalSGD's diverged replicas, DGC's
+residuals) lives in arrays stacked on a leading dp-sharded axis — the GSPMD
+engine (engine.py) cannot express per-rank state, which is why these
+strategies get their own engine.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit.functionalize import functionalize, get_buffers, get_params, set_buffers, set_params
+from .engine import apply_optimizer_update
+
+__all__ = ["DPStrategyTrainStep", "LocalSGDTrainStep", "create_strategy_train_step"]
+
+
+def _tree_map(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def _dgc_mask(v, sparsity: float):
+    """Top-k magnitude mask keeping a (1-sparsity) fraction of entries."""
+    flat = jnp.abs(v.reshape(-1))
+    n = flat.shape[0]
+    k = max(1, int(math.ceil(n * (1.0 - sparsity))))
+    kth = jax.lax.top_k(flat, k)[0][-1]
+    return (jnp.abs(v) >= kth).astype(v.dtype)
+
+
+class DPStrategyTrainStep:
+    """Data-parallel train step with a strategy-modified allreduce.
+
+    Params/opt-state are replicated (synchronised every step, as in plain
+    DP); ``gradient_merge``, ``dgc`` and ``fp16_allreduce`` change what is
+    summed and when the optimizer applies. For diverged-replica LocalSGD use
+    :class:`LocalSGDTrainStep`.
+    """
+
+    def __init__(self, layer, loss_fn: Callable, optimizer, mesh: Mesh,
+                 dp_axis: str = "dp",
+                 gradient_merge_k: int = 1, gradient_merge_avg: bool = True,
+                 dgc: bool = False, dgc_sparsity: float = 0.999,
+                 dgc_momentum: float = 0.9, dgc_rampup_begin_step: int = 0,
+                 fp16_allreduce: bool = False, allreduce_dtype=jnp.bfloat16,
+                 compute_dtype=None):
+        self._layer = layer
+        self._optimizer = optimizer
+        self._mesh = mesh
+        self._dp = dp_axis
+        self._apply = functionalize(layer, training=True)
+        self._named = dict(layer.named_parameters())
+        self._dirty = True
+        ndp = mesh.shape[dp_axis]
+
+        params = get_params(layer)
+        buffers = get_buffers(layer)
+        repl = NamedSharding(mesh, P())
+        stacked = NamedSharding(mesh, P(dp_axis))
+        self._batch_sharding = NamedSharding(mesh, P(dp_axis))
+        self._repl = repl
+
+        self._params = {n: jax.device_put(v, repl) for n, v in params.items()}
+        self._buffers = {n: jax.device_put(v, repl) for n, v in buffers.items()}
+        self._opt_state = {
+            n: {k: jax.device_put(s, repl)
+                for k, s in optimizer._init_state(v).items()}
+            for n, v in params.items()
+        }
+        zeros_like_f32 = lambda v: jnp.zeros(v.shape, jnp.float32)
+        self._gm_acc = ({n: jax.device_put(zeros_like_f32(v), repl)
+                         for n, v in params.items()}
+                        if gradient_merge_k > 1 else None)
+        if dgc:
+            stack = lambda v: jnp.zeros((ndp,) + v.shape, jnp.float32)
+            self._dgc_u = {n: jax.device_put(stack(v), stacked)
+                           for n, v in params.items()}
+            self._dgc_v = {n: jax.device_put(stack(v), stacked)
+                           for n, v in params.items()}
+        else:
+            self._dgc_u = self._dgc_v = None
+        self._count = jax.device_put(jnp.zeros((), jnp.int32), repl)
+
+        opt = optimizer
+        named = self._named
+        apply = self._apply
+        cd = compute_dtype
+        gm_k = int(gradient_merge_k)
+
+        def forward_loss(p, buf, inputs, labels):
+            if cd is not None:
+                p = _tree_map(
+                    lambda a: a.astype(cd)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+            out, new_b = apply(p, buf, *inputs)
+            loss = loss_fn(out, *labels)
+            if isinstance(loss, Tensor):
+                loss = loss._value
+            return loss.astype(jnp.float32), new_b
+
+        def opt_apply(params_, opt_state_, grads_, lr):
+            return apply_optimizer_update(opt, named, params_, grads_,
+                                          opt_state_, lr)
+
+        def local_step(params_, buf, opt_state_, gm_acc, u, v, count, lr, batch):
+            """Body under shard_map: one rank's shard of the dp axis."""
+            inputs, labels = batch
+            (loss, new_buf), grads = jax.value_and_grad(
+                forward_loss, has_aux=True)(params_, buf, inputs, labels)
+            loss = jax.lax.pmean(loss, dp_axis)
+
+            if dgc:
+                u = _tree_map(lambda a: a[0], u)  # [1,...] shard -> local
+                v = _tree_map(lambda a: a[0], v)
+
+                def sparse_sync(g, u, v):
+                    u2 = dgc_momentum * u + g.astype(jnp.float32)
+                    v2 = v + u2
+                    mask = _dgc_mask(v2, dgc_sparsity)
+                    send = v2 * mask
+                    synced = jax.lax.pmean(
+                        send.astype(allreduce_dtype) if fp16_allreduce else send,
+                        dp_axis).astype(jnp.float32)
+                    return synced, u2 * (1 - mask), v2 * (1 - mask)
+
+                def dense_sync(g, u, v):
+                    g32 = g.astype(jnp.float32)
+                    synced = jax.lax.pmean(
+                        g32.astype(allreduce_dtype) if fp16_allreduce else g32,
+                        dp_axis).astype(jnp.float32)
+                    return synced, u, v
+
+                in_rampup = count < dgc_rampup_begin_step
+                synced, new_u, new_v = {}, {}, {}
+                for n, g in grads.items():
+                    s, nu, nv = jax.lax.cond(
+                        in_rampup, dense_sync, sparse_sync, g, u[n], v[n])
+                    synced[n], new_u[n], new_v[n] = s, nu, nv
+                grads = synced
+                new_u = _tree_map(lambda a: a[None], new_u)
+                new_v = _tree_map(lambda a: a[None], new_v)
+            else:
+                cast = (lambda g: g.astype(allreduce_dtype)) if fp16_allreduce \
+                    else (lambda g: g)
+                grads = _tree_map(
+                    lambda g: jax.lax.pmean(cast(g), dp_axis).astype(jnp.float32),
+                    grads)
+                new_u, new_v = u, v
+
+            if gm_k > 1:
+                gm_acc = _tree_map(lambda a, g: a + g, gm_acc, grads)
+                do_apply = (count + 1) % gm_k == 0
+
+                def apply_branch(p, s, acc):
+                    eff = _tree_map(
+                        lambda a: a / gm_k if gradient_merge_avg else a, acc)
+                    np_, ns = opt_apply(p, s, eff, lr)
+                    zero = _tree_map(jnp.zeros_like, acc)
+                    return np_, ns, zero
+
+                def skip_branch(p, s, acc):
+                    return p, s, acc
+
+                params_, opt_state_, gm_acc = jax.lax.cond(
+                    do_apply, apply_branch, skip_branch,
+                    params_, opt_state_, gm_acc)
+            else:
+                params_, opt_state_ = opt_apply(params_, opt_state_, grads, lr)
+
+            return (params_, new_buf, opt_state_, gm_acc, new_u, new_v,
+                    count + 1, loss)
+
+        n_p = P()
+        spec_params = _tree_map(lambda _: n_p, self._params)
+        spec_buf = _tree_map(lambda _: n_p, self._buffers)
+        spec_opt = _tree_map(lambda _: n_p, self._opt_state)
+        spec_gm = _tree_map(lambda _: n_p, self._gm_acc) if gm_k > 1 else None
+        spec_uv = (_tree_map(lambda _: P(dp_axis), self._dgc_u)
+                   if dgc else None)
+        spec_batch = P(dp_axis)
+
+        # spec_batch is a pytree PREFIX for the whole (inputs, labels) batch
+        # arg, so models with any number of inputs/labels shard over dp
+        shard_step = jax.shard_map(
+            local_step, mesh=mesh,
+            in_specs=(spec_params, spec_buf, spec_opt, spec_gm, spec_uv,
+                      spec_uv, n_p, n_p, spec_batch),
+            out_specs=(spec_params, spec_buf, spec_opt, spec_gm, spec_uv,
+                       spec_uv, n_p, n_p),
+            check_vma=False,
+        )
+        self._jitted = jax.jit(shard_step, donate_argnums=(0, 2, 3, 4, 5))
+
+    def __call__(self, inputs, labels):
+        put = lambda a: jax.device_put(
+            a._value if isinstance(a, Tensor) else jnp.asarray(a),
+            self._batch_sharding)
+        raw_in = tuple(put(a) for a in
+                       (inputs if isinstance(inputs, (tuple, list)) else (inputs,)))
+        raw_lab = tuple(put(a) for a in
+                        (labels if isinstance(labels, (tuple, list)) else (labels,)))
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        (self._params, self._buffers, self._opt_state, self._gm_acc,
+         self._dgc_u, self._dgc_v, self._count, loss) = self._jitted(
+            self._params, self._buffers, self._opt_state, self._gm_acc,
+            self._dgc_u, self._dgc_v, self._count, lr, (raw_in, raw_lab))
+        self._optimizer._global_step += 1
+        self._dirty = True
+        return Tensor(loss)
+
+    def sync_to_layer(self):
+        if self._dirty:
+            set_params(self._layer, self._params)
+            set_buffers(self._layer, self._buffers)
+            for name, p in self._named.items():
+                self._optimizer._accumulators[id(p)] = self._opt_state[name]
+            self._dirty = False
+
+
+class LocalSGDTrainStep:
+    """LocalSGD / AdaptiveLocalSGD (localsgd_optimizer.py parity).
+
+    Each dp rank holds its own diverged replica (params and optimizer state
+    stacked on a leading dp-sharded axis) and trains locally; every
+    ``k_steps`` the replicas are averaged over the dp axis (the reference
+    inserts c_allreduce on the params; here it is a ``lax.pmean`` guarded by
+    ``lax.cond``, all inside one compiled step — no separate sync program).
+
+    Adaptive mode re-estimates k on the host between steps from the loss
+    trajectory (k grows as the loss flattens — the Wang & Joshi adaptive
+    communication schedule, which the reference approximates too).
+    """
+
+    def __init__(self, layer, loss_fn: Callable, optimizer, mesh: Mesh,
+                 dp_axis: str = "dp", k_steps: int = 1, begin_step: int = 1,
+                 adaptive: bool = False, max_k_steps: int = 16,
+                 compute_dtype=None):
+        self._layer = layer
+        self._optimizer = optimizer
+        self._mesh = mesh
+        self._apply = functionalize(layer, training=True)
+        self._named = dict(layer.named_parameters())
+        self._dirty = True
+        self._k = int(k_steps)
+        self._begin = int(begin_step)
+        self._adaptive = adaptive
+        self._max_k = int(max_k_steps)
+        self._loss0 = None
+        ndp = mesh.shape[dp_axis]
+
+        params = get_params(layer)
+        buffers = get_buffers(layer)
+        repl = NamedSharding(mesh, P())
+        stacked = NamedSharding(mesh, P(dp_axis))
+        self._batch_sharding = NamedSharding(mesh, P(dp_axis))
+
+        stack = lambda v: jax.device_put(
+            jnp.broadcast_to(v[None], (ndp,) + v.shape), stacked)
+        self._params = {n: stack(v) for n, v in params.items()}
+        self._buffers = {n: jax.device_put(v, repl) for n, v in buffers.items()}
+        self._opt_state = {
+            n: {k: stack(s) if hasattr(s, "shape") and s.shape == v.shape
+                else jax.device_put(s, repl)
+                for k, s in optimizer._init_state(v).items()}
+            for n, v in params.items()
+        }
+        self._count = jax.device_put(jnp.zeros((), jnp.int32), repl)
+
+        opt = optimizer
+        named = self._named
+        apply = self._apply
+        cd = compute_dtype
+
+        def forward_loss(p, buf, inputs, labels):
+            if cd is not None:
+                p = _tree_map(
+                    lambda a: a.astype(cd)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+            out, new_b = apply(p, buf, *inputs)
+            loss = loss_fn(out, *labels)
+            if isinstance(loss, Tensor):
+                loss = loss._value
+            return loss.astype(jnp.float32), new_b
+
+        def local_step(params_, buf, opt_state_, count, lr, k, batch):
+            # shard view: stacked arrays arrive as [1, ...] — drop the axis
+            params_ = _tree_map(lambda a: a[0], params_)
+            opt_local = {
+                n: {kk: (s[0] if hasattr(s, "shape")
+                         and s.shape[1:] == params_[n].shape else s)
+                    for kk, s in st.items()}
+                for n, st in opt_state_.items()
+            }
+            inputs, labels = batch
+            (loss, new_buf), grads = jax.value_and_grad(
+                forward_loss, has_aux=True)(params_, buf, inputs, labels)
+            new_p, new_s = apply_optimizer_update(opt, named, params_, grads,
+                                                  opt_local, lr)
+
+            do_sync = jnp.logical_and(count + 1 >= self._begin,
+                                      (count + 1) % k == 0)
+            new_p = jax.lax.cond(
+                do_sync,
+                lambda p: _tree_map(lambda a: jax.lax.pmean(a, dp_axis), p),
+                lambda p: p,
+                new_p)
+            loss = jax.lax.pmean(loss, dp_axis)
+            # buffers: ranks may diverge between syncs; keep them averaged
+            new_buf = _tree_map(
+                lambda a: jax.lax.pmean(a, dp_axis)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, new_buf)
+
+            restack = lambda st, n: {
+                kk: (s[None] if hasattr(s, "shape")
+                     and s.shape == new_p[n].shape else s)
+                for kk, s in st.items()
+            }
+            return (_tree_map(lambda a: a[None], new_p),
+                    new_buf,
+                    {n: restack(st, n) for n, st in new_s.items()},
+                    count + 1, loss)
+
+        n_p = P()
+        spec_stack = P(dp_axis)
+        spec_params = _tree_map(lambda _: spec_stack, self._params)
+        spec_buf = _tree_map(lambda _: n_p, self._buffers)
+
+        def opt_spec(n, st):
+            return {kk: (spec_stack if hasattr(s, "shape")
+                         and s.shape[1:] == params[n].shape else n_p)
+                    for kk, s in st.items()}
+
+        spec_opt = {n: opt_spec(n, st) for n, st in self._opt_state.items()}
+        spec_batch = P(dp_axis)
+        shard_step = jax.shard_map(
+            local_step, mesh=mesh,
+            in_specs=(spec_params, spec_buf, spec_opt, n_p, n_p, n_p,
+                      spec_batch),  # prefix spec: any batch arity
+            out_specs=(spec_params, spec_buf, spec_opt, n_p, n_p),
+            check_vma=False,
+        )
+        self._jitted = jax.jit(shard_step, donate_argnums=(0, 2))
+
+    def __call__(self, inputs, labels):
+        put = lambda a: jax.device_put(
+            a._value if isinstance(a, Tensor) else jnp.asarray(a),
+            self._batch_sharding)
+        raw_in = tuple(put(a) for a in
+                       (inputs if isinstance(inputs, (tuple, list)) else (inputs,)))
+        raw_lab = tuple(put(a) for a in
+                        (labels if isinstance(labels, (tuple, list)) else (labels,)))
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        k = jnp.asarray(self._k, jnp.int32)
+        (self._params, self._buffers, self._opt_state, self._count,
+         loss) = self._jitted(self._params, self._buffers, self._opt_state,
+                              self._count, lr, k, (raw_in, raw_lab))
+        self._optimizer._global_step += 1
+        self._dirty = True
+        if self._adaptive:
+            # adaptive mode needs the scalar on host; non-adaptive returns the
+            # device array without syncing so dispatch stays ahead of compute
+            lv = float(loss)
+            if self._loss0 is None:
+                self._loss0 = lv
+            elif lv > 0:
+                # loss flattening -> widen the averaging period
+                est = int(math.sqrt(max(self._loss0 / lv, 1.0)) * max(self._k, 1))
+                self._k = max(1, min(self._max_k, est))
+        return Tensor(loss)
+
+    def sync_to_layer(self):
+        """Average the replicas and write back to the layer."""
+        if self._dirty:
+            avg = {n: jnp.mean(v, axis=0) for n, v in self._params.items()}
+            set_params(self._layer, avg)
+            set_buffers(self._layer, self._buffers)
+            self._dirty = False
+
+
+def create_strategy_train_step(layer, loss_fn, optimizer, mesh, strategy,
+                               compute_dtype=None, **kw):
+    """Factory: pick the engine a DistributedStrategy asks for (the
+    StrategyCompiler role, fleet/base/strategy_compiler.py)."""
+    if strategy is None:
+        from .engine import ParallelTrainStep
+
+        return ParallelTrainStep(layer, loss_fn=loss_fn, optimizer=optimizer,
+                                 mesh=mesh, compute_dtype=compute_dtype, **kw)
+    if strategy.localsgd or strategy.adaptive_localsgd:
+        cfg = (strategy.adaptive_localsgd_configs if strategy.adaptive_localsgd
+               else strategy.localsgd_configs)
+        return LocalSGDTrainStep(
+            layer, loss_fn, optimizer, mesh,
+            k_steps=cfg.get("k_steps", cfg.get("init_k_steps", 1)),
+            begin_step=cfg.get("begin_step", 1),
+            adaptive=strategy.adaptive_localsgd,
+            compute_dtype=compute_dtype)
+    if strategy.dgc or strategy.fp16_allreduce or strategy.gradient_merge:
+        gm = strategy.gradient_merge_configs
+        dgc_cfg = strategy.dgc_configs
+        sparsity = dgc_cfg.get("sparsity", [0.999])
+        return DPStrategyTrainStep(
+            layer, loss_fn, optimizer, mesh,
+            gradient_merge_k=(gm.get("k_steps", 1)
+                              if strategy.gradient_merge else 1),
+            gradient_merge_avg=gm.get("avg", True),
+            dgc=strategy.dgc,
+            dgc_sparsity=sparsity[-1] if isinstance(sparsity, (list, tuple))
+            else float(sparsity),
+            dgc_rampup_begin_step=dgc_cfg.get("rampup_begin_step", 0),
+            fp16_allreduce=strategy.fp16_allreduce,
+            compute_dtype=compute_dtype)
+    from .engine import ParallelTrainStep
+
+    zero = 0
+    offload = False
+    if strategy.sharding:
+        zero = int(strategy.sharding_configs.get("stage", 1))
+        offload = bool(strategy.sharding_configs.get("offload", False))
+    return ParallelTrainStep(
+        layer, loss_fn=loss_fn, optimizer=optimizer, mesh=mesh,
+        zero_stage=zero, recompute=strategy.recompute,
+        compute_dtype=compute_dtype, offload=offload, **kw)

@@ -30,23 +30,38 @@ def _checkpointer():
 
 def save_train_state(state: Dict[str, Any], path: str):
     """Save a pytree of (possibly mesh-sharded) arrays atomically: write to a
-    temp sibling, then swap — a crash mid-save never destroys the previous
-    checkpoint."""
+    temp sibling, then swap — a crash mid-save never loses the previous
+    checkpoint (it survives at ``path`` or ``path + '.tmp-old'``, and
+    ``restore_train_state`` checks both)."""
     path = os.path.abspath(path)
     tmp = path + ".tmp-save"
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    _checkpointer().save(tmp, state)
     old = path + ".tmp-old"
+    for stale in (tmp, old):  # crash leftovers from a previous save
+        if os.path.exists(stale) and os.path.exists(path):
+            shutil.rmtree(stale)
+    _checkpointer().save(tmp, state)
     if os.path.exists(path):
+        if os.path.exists(old):
+            shutil.rmtree(old)
         os.rename(path, old)
     os.rename(tmp, path)
     if os.path.exists(old):
         shutil.rmtree(old)
 
 
+def _resolve_ckpt_path(path: str) -> str:
+    """The committed checkpoint, or the .tmp-old survivor of a mid-swap crash."""
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        return path
+    old = path + ".tmp-old"
+    if os.path.exists(old):
+        return old
+    return path
+
+
 def restore_train_state(path: str):
-    return _checkpointer().restore(os.path.abspath(path))
+    return _checkpointer().restore(_resolve_ckpt_path(path))
 
 
 class CheckpointSaver:

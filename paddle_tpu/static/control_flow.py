@@ -172,6 +172,11 @@ def _record_cond(pred, true_fn, false_fn):
         if v not in ext:
             ext.append(v)
     all_refs = {**false_sub._var_refs, **true_sub._var_refs}
+    # passthrough branches (e.g. ``lambda: x``) return external tensors that
+    # never appear as op args — register them so ext resolution finds them
+    for t in list(t_leaves) + list(f_leaves):
+        if isinstance(t, Tensor):
+            all_refs.setdefault(id(t), t)
     ext_tensors = [all_refs[v] for v in ext]
     t_replay = _make_branch_replay(true_sub, t_leaves, [], ext)
     f_replay = _make_branch_replay(false_sub, f_leaves, [], ext)
@@ -209,6 +214,9 @@ def _record_while(cond_fn, body_fn, loop_vars):
     all_refs = {**cond_sub._var_refs, **body_sub._var_refs}
     for v in loop_vars:
         all_refs[id(v)] = v
+    for t in body_out + [pred0]:
+        if isinstance(t, Tensor):
+            all_refs.setdefault(id(t), t)
     ext_tensors = [all_refs[v] for v in ext]
     c_replay = _make_branch_replay(cond_sub, [pred0], bound, ext)
     b_replay = _make_branch_replay(body_sub, body_out, bound, ext)

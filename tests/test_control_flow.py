@@ -198,6 +198,34 @@ class TestStaticProgramControlFlow:
         assert float(exe.run(prog, feed={"idx": np.asarray(9, np.int64)},
                              fetch_list=[out])[0]) == -1.0
 
+    def test_cond_passthrough_branch(self):
+        # a branch that returns an external tensor without recording any op
+        prog = static.Program()
+        with static.program_guard(prog, static.Program()):
+            x = static.data("x", [1], "float32")
+            y = x * 2
+            out = static.cond(x.sum() > 2.0, lambda: x, lambda: y)
+        exe = static.Executor()
+        np.testing.assert_allclose(
+            exe.run(prog, feed={"x": np.asarray([3.0], np.float32)},
+                    fetch_list=[out])[0], [3.0])
+        np.testing.assert_allclose(
+            exe.run(prog, feed={"x": np.asarray([1.0], np.float32)},
+                    fetch_list=[out])[0], [2.0])
+
+    def test_while_passthrough_external_in_body(self):
+        prog = static.Program()
+        with static.program_guard(prog, static.Program()):
+            n = static.data("n", [], "int64")
+            c = paddle.to_tensor(2)  # external constant used in the body
+            i = paddle.to_tensor(0)
+            (i,) = static.while_loop(lambda i: i < n,
+                                     lambda i: [i + c], [i])
+        exe = static.Executor()
+        r = exe.run(prog, feed={"n": np.asarray(5, np.int64)},
+                    fetch_list=[i])[0]
+        assert int(r) == 6  # 0,2,4,6
+
     def test_increment_is_inplace_in_program(self):
         # reference increment_op writes its input var; replay must see it
         prog = static.Program()
