@@ -239,3 +239,8 @@ class Predictor:
 def create_predictor(config: Config) -> Predictor:
     """CreatePaddlePredictor parity (analysis_predictor.cc:1140)."""
     return Predictor(config)
+
+
+def create_predictor_from_path(model_prefix: str) -> Predictor:
+    """Entry point used by the C API shim (inference/capi)."""
+    return Predictor(Config(model_prefix))

@@ -1,0 +1,80 @@
+"""C inference API loader (parity: inference/capi_exp/pd_inference_api.h).
+
+``ensure_built()`` compiles libpd_inference_c.so lazily (g++ + python
+headers) and returns its path; ``load()`` returns a ctypes CDLL with the
+argtypes declared, ready to drive from Python or hand to a C consumer.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "build", "libpd_inference_c.so")
+_lock = threading.Lock()
+_build_failed: Optional[str] = None
+
+
+def header_path() -> str:
+    return os.path.join(_HERE, "pd_inference_api.h")
+
+
+def ensure_built() -> Optional[str]:
+    global _build_failed
+    if _build_failed is not None:
+        return None
+    with _lock:
+        src = os.path.join(_HERE, "pd_inference_api.cc")
+        if (not os.path.exists(_LIB_PATH)
+                or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)):
+            proc = subprocess.run(["make", "-s"], cwd=_HERE,
+                                  capture_output=True, text=True, timeout=120)
+            if proc.returncode != 0:
+                _build_failed = proc.stderr
+                return None
+    return _LIB_PATH
+
+
+def load() -> ctypes.CDLL:
+    path = ensure_built()
+    if path is None:
+        raise RuntimeError(f"building libpd_inference_c failed:\n{_build_failed}")
+    lib = ctypes.CDLL(path)
+    c = ctypes
+    decl = {
+        "PD_ConfigCreate": (c.c_void_p, []),
+        "PD_ConfigDestroy": (None, [c.c_void_p]),
+        "PD_ConfigSetModel": (None, [c.c_void_p, c.c_char_p, c.c_char_p]),
+        "PD_PredictorCreate": (c.c_void_p, [c.c_void_p]),
+        "PD_PredictorDestroy": (None, [c.c_void_p]),
+        "PD_PredictorGetInputNum": (c.c_size_t, [c.c_void_p]),
+        "PD_PredictorGetOutputNum": (c.c_size_t, [c.c_void_p]),
+        "PD_PredictorGetInputName": (c.c_char_p, [c.c_void_p, c.c_size_t]),
+        "PD_PredictorGetOutputName": (c.c_char_p, [c.c_void_p, c.c_size_t]),
+        "PD_PredictorGetInputHandle": (c.c_void_p, [c.c_void_p, c.c_char_p]),
+        "PD_PredictorGetOutputHandle": (c.c_void_p, [c.c_void_p, c.c_char_p]),
+        "PD_PredictorRun": (c.c_int32, [c.c_void_p]),
+        "PD_GetLastError": (c.c_char_p, []),
+        "PD_TensorDestroy": (None, [c.c_void_p]),
+        "PD_TensorReshape": (None, [c.c_void_p, c.c_size_t,
+                                    c.POINTER(c.c_int32)]),
+        "PD_TensorGetShape": (None, [c.c_void_p, c.POINTER(c.c_size_t),
+                                     c.POINTER(c.c_int32)]),
+        "PD_TensorCopyFromCpuFloat": (None, [c.c_void_p,
+                                             c.POINTER(c.c_float)]),
+        "PD_TensorCopyFromCpuInt64": (None, [c.c_void_p,
+                                             c.POINTER(c.c_int64)]),
+        "PD_TensorCopyFromCpuInt32": (None, [c.c_void_p,
+                                             c.POINTER(c.c_int32)]),
+        "PD_TensorCopyToCpuFloat": (None, [c.c_void_p, c.POINTER(c.c_float)]),
+        "PD_TensorCopyToCpuInt64": (None, [c.c_void_p, c.POINTER(c.c_int64)]),
+        "PD_TensorCopyToCpuInt32": (None, [c.c_void_p, c.POINTER(c.c_int32)]),
+    }
+    for name, (res, args) in decl.items():
+        fn = getattr(lib, name)
+        fn.restype = res
+        fn.argtypes = args
+    return lib

@@ -1,0 +1,352 @@
+/* C inference API implementation — embeds CPython and drives
+ * paddle_tpu.inference (Config/Predictor/_IOHandle).
+ *
+ * Reference equivalent: inference/capi_exp/pd_config.cc, pd_predictor.cc,
+ * pd_tensor.cc wrapping AnalysisPredictor. Here the predictor is the
+ * AOT-exported XLA executable behind paddle_tpu.inference.Predictor; this
+ * shim owns only PyObject references and numpy buffers.
+ *
+ * Threading: every entry point takes the GIL via PyGILState_Ensure, so the
+ * library is safe both standalone (it initializes the interpreter) and
+ * inside an existing Python process (ctypes).
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "pd_inference_api.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+struct GIL {
+  PyGILState_STATE st;
+  GIL() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+    }
+    st = PyGILState_Ensure();
+  }
+  ~GIL() { PyGILState_Release(st); }
+};
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      g_last_error = c != nullptr ? c : "<unprintable python error>";
+      Py_DECREF(s);
+    }
+  } else {
+    g_last_error = "unknown python error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+PyObject* inference_module() {
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (mod == nullptr) set_error_from_python();
+  return mod;
+}
+
+}  // namespace
+
+struct PD_Config {
+  std::string model_prefix;
+  std::string params_path;
+};
+
+struct PD_Tensor {
+  PyObject* handle;  // owned ref to the python _IOHandle
+  std::vector<int32_t> shape;
+  explicit PD_Tensor(PyObject* h) : handle(h) {}
+};
+
+struct PD_Predictor {
+  PyObject* predictor = nullptr;  // owned
+  std::vector<std::string> input_names;
+  std::vector<std::string> output_names;
+};
+
+namespace {
+
+int64_t tensor_numel(PD_Tensor* t) {
+  int64_t n = 1;
+  for (int32_t d : t->shape) n *= d;
+  return n;
+}
+
+template <typename T>
+void copy_from_cpu(PD_Tensor* t, const T* data, const char* np_dtype) {
+  if (t == nullptr || data == nullptr) return;
+  if (t->shape.empty()) {
+    g_last_error = "PD_TensorReshape must be called before CopyFromCpu";
+    return;
+  }
+  GIL gil;
+  /* build a numpy array through python (avoids linking numpy's C API) */
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (np == nullptr) {
+    set_error_from_python();
+    return;
+  }
+  int64_t numel = tensor_numel(t);
+  PyObject* mem = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<T*>(data)),
+      numel * static_cast<int64_t>(sizeof(T)), PyBUF_READ);
+  PyObject* flat = PyObject_CallMethod(np, "frombuffer", "Os", mem, np_dtype);
+  Py_DECREF(mem);
+  Py_DECREF(np);
+  if (flat == nullptr) {
+    set_error_from_python();
+    return;
+  }
+  PyObject* dims = PyTuple_New(static_cast<Py_ssize_t>(t->shape.size()));
+  for (size_t i = 0; i < t->shape.size(); ++i) {
+    PyTuple_SetItem(dims, static_cast<Py_ssize_t>(i),
+                    PyLong_FromLong(t->shape[i]));
+  }
+  PyObject* arr = PyObject_CallMethod(flat, "reshape", "O", dims);
+  Py_DECREF(flat);
+  Py_DECREF(dims);
+  if (arr == nullptr) {
+    set_error_from_python();
+    return;
+  }
+  PyObject* r = PyObject_CallMethod(t->handle, "copy_from_cpu", "O", arr);
+  Py_DECREF(arr);
+  if (r == nullptr) {
+    set_error_from_python();
+    return;
+  }
+  Py_DECREF(r);
+}
+
+template <typename T>
+void copy_to_cpu(PD_Tensor* t, T* data, const char* np_dtype) {
+  if (t == nullptr || data == nullptr) return;
+  GIL gil;
+  PyObject* arr = PyObject_CallMethod(t->handle, "copy_to_cpu", nullptr);
+  if (arr == nullptr) {
+    set_error_from_python();
+    return;
+  }
+  PyObject* cast = PyObject_CallMethod(arr, "astype", "s", np_dtype);
+  Py_DECREF(arr);
+  if (cast == nullptr) {
+    set_error_from_python();
+    return;
+  }
+  PyObject* bytes = PyObject_CallMethod(cast, "tobytes", nullptr);
+  Py_DECREF(cast);
+  if (bytes == nullptr) {
+    set_error_from_python();
+    return;
+  }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(bytes, &buf, &len) == 0) {
+    std::memcpy(data, buf, static_cast<size_t>(len));
+  } else {
+    set_error_from_python();
+  }
+  Py_DECREF(bytes);
+}
+
+}  // namespace
+
+extern "C" {
+
+PD_Config* PD_ConfigCreate(void) { return new PD_Config(); }
+
+void PD_ConfigDestroy(PD_Config* config) { delete config; }
+
+void PD_ConfigSetModel(PD_Config* config, const char* model_prefix,
+                       const char* params_path) {
+  if (config == nullptr || model_prefix == nullptr) return;
+  config->model_prefix = model_prefix;
+  if (params_path != nullptr) config->params_path = params_path;
+}
+
+/* device/opt toggles: the XLA predictor compiles for whatever backend JAX
+ * selected; these exist for signature parity and are recorded no-ops, like
+ * the reference's toggles that don't apply to a given build. */
+void PD_ConfigEnableUseGpu(PD_Config*, uint64_t, int32_t) {}
+void PD_ConfigDisableGpu(PD_Config*) {}
+void PD_ConfigSetCpuMathLibraryNumThreads(PD_Config*, int32_t) {}
+void PD_ConfigSwitchIrOptim(PD_Config*, PD_Bool) {}
+void PD_ConfigEnableMemoryOptim(PD_Config*, PD_Bool) {}
+
+const char* PD_GetLastError(void) {
+  return g_last_error.empty() ? nullptr : g_last_error.c_str();
+}
+
+PD_Predictor* PD_PredictorCreate(PD_Config* config) {
+  if (config == nullptr) return nullptr;
+  GIL gil;
+  PyObject* mod = inference_module();
+  if (mod == nullptr) return nullptr;
+  PyObject* pred = PyObject_CallMethod(mod, "create_predictor_from_path", "s",
+                                       config->model_prefix.c_str());
+  Py_DECREF(mod);
+  if (pred == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  auto* p = new PD_Predictor();
+  p->predictor = pred;
+  for (const char* meth : {"get_input_names", "get_output_names"}) {
+    PyObject* names = PyObject_CallMethod(pred, meth, nullptr);
+    if (names == nullptr) {
+      set_error_from_python();
+      Py_DECREF(pred);
+      delete p;
+      return nullptr;
+    }
+    auto& dst = std::strcmp(meth, "get_input_names") == 0 ? p->input_names
+                                                          : p->output_names;
+    for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
+      dst.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(names, i)));
+    }
+    Py_DECREF(names);
+  }
+  return p;
+}
+
+void PD_PredictorDestroy(PD_Predictor* predictor) {
+  if (predictor == nullptr) return;
+  {
+    GIL gil;
+    Py_XDECREF(predictor->predictor);
+  }
+  delete predictor;
+}
+
+size_t PD_PredictorGetInputNum(PD_Predictor* p) {
+  return p != nullptr ? p->input_names.size() : 0;
+}
+
+size_t PD_PredictorGetOutputNum(PD_Predictor* p) {
+  return p != nullptr ? p->output_names.size() : 0;
+}
+
+const char* PD_PredictorGetInputName(PD_Predictor* p, size_t idx) {
+  if (p == nullptr || idx >= p->input_names.size()) return nullptr;
+  return p->input_names[idx].c_str();
+}
+
+const char* PD_PredictorGetOutputName(PD_Predictor* p, size_t idx) {
+  if (p == nullptr || idx >= p->output_names.size()) return nullptr;
+  return p->output_names[idx].c_str();
+}
+
+static PD_Tensor* get_handle(PD_Predictor* p, const char* name,
+                             const char* meth) {
+  if (p == nullptr || name == nullptr) return nullptr;
+  GIL gil;
+  PyObject* h = PyObject_CallMethod(p->predictor, meth, "s", name);
+  if (h == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  return new PD_Tensor(h);
+}
+
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor* p, const char* name) {
+  return get_handle(p, name, "get_input_handle");
+}
+
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* p, const char* name) {
+  return get_handle(p, name, "get_output_handle");
+}
+
+PD_Bool PD_PredictorRun(PD_Predictor* p) {
+  if (p == nullptr) return 0;
+  GIL gil;
+  PyObject* r = PyObject_CallMethod(p->predictor, "run", nullptr);
+  if (r == nullptr) {
+    set_error_from_python();
+    return 0;
+  }
+  Py_DECREF(r);
+  return 1;
+}
+
+void PD_TensorDestroy(PD_Tensor* t) {
+  if (t == nullptr) return;
+  {
+    GIL gil;
+    Py_XDECREF(t->handle);
+  }
+  delete t;
+}
+
+void PD_TensorReshape(PD_Tensor* t, size_t ndims, const int32_t* shape) {
+  if (t == nullptr || shape == nullptr) return;
+  t->shape.assign(shape, shape + ndims);
+  GIL gil;
+  PyObject* dims = PyList_New(static_cast<Py_ssize_t>(ndims));
+  for (size_t i = 0; i < ndims; ++i) {
+    PyList_SetItem(dims, static_cast<Py_ssize_t>(i),
+                   PyLong_FromLong(shape[i]));
+  }
+  PyObject* r = PyObject_CallMethod(t->handle, "reshape", "O", dims);
+  Py_DECREF(dims);
+  if (r == nullptr) {
+    set_error_from_python();
+    return;
+  }
+  Py_DECREF(r);
+}
+
+void PD_TensorGetShape(PD_Tensor* t, size_t* ndims, int32_t* shape) {
+  if (t == nullptr || ndims == nullptr) return;
+  GIL gil;
+  PyObject* s = PyObject_GetAttrString(t->handle, "shape");
+  if (s == nullptr) {
+    set_error_from_python();
+    *ndims = 0;
+    return;
+  }
+  Py_ssize_t n = PySequence_Size(s);
+  size_t cap = *ndims;
+  *ndims = static_cast<size_t>(n);
+  if (shape != nullptr) {
+    for (Py_ssize_t i = 0; i < n && static_cast<size_t>(i) < cap; ++i) {
+      PyObject* d = PySequence_GetItem(s, i);
+      shape[i] = static_cast<int32_t>(PyLong_AsLong(d));
+      Py_DECREF(d);
+    }
+  }
+  Py_DECREF(s);
+}
+
+void PD_TensorCopyFromCpuFloat(PD_Tensor* t, const float* d) {
+  copy_from_cpu(t, d, "float32");
+}
+void PD_TensorCopyFromCpuInt64(PD_Tensor* t, const int64_t* d) {
+  copy_from_cpu(t, d, "int64");
+}
+void PD_TensorCopyFromCpuInt32(PD_Tensor* t, const int32_t* d) {
+  copy_from_cpu(t, d, "int32");
+}
+void PD_TensorCopyToCpuFloat(PD_Tensor* t, float* d) {
+  copy_to_cpu(t, d, "float32");
+}
+void PD_TensorCopyToCpuInt64(PD_Tensor* t, int64_t* d) {
+  copy_to_cpu(t, d, "int64");
+}
+void PD_TensorCopyToCpuInt32(PD_Tensor* t, int32_t* d) {
+  copy_to_cpu(t, d, "int32");
+}
+
+}  // extern "C"

@@ -1,0 +1,72 @@
+/* C inference API — parity with the reference's stable C ABI
+ * (/root/reference/paddle/fluid/inference/capi_exp/pd_inference_api.h,
+ * pd_config.h, pd_predictor.h, pd_tensor.h).
+ *
+ * The reference's C API wraps AnalysisPredictor; this one wraps the
+ * TPU-native predictor (paddle_tpu.inference.Predictor — an AOT-exported XLA
+ * executable) by embedding CPython. Link against libpd_inference_c.so and a
+ * libpython; from an already-running Python process the API attaches to the
+ * existing interpreter instead (PyGILState), so ctypes consumers work too.
+ */
+#ifndef PD_INFERENCE_API_H_
+#define PD_INFERENCE_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+typedef struct PD_Tensor PD_Tensor;
+typedef int32_t PD_Bool;
+
+/* ---- config (pd_config.h parity) ---- */
+PD_Config* PD_ConfigCreate(void);
+void PD_ConfigDestroy(PD_Config* config);
+/* model_prefix is the jit.save/save_inference_model path prefix;
+ * params_path is accepted for signature parity and may be NULL. */
+void PD_ConfigSetModel(PD_Config* config, const char* model_prefix,
+                       const char* params_path);
+void PD_ConfigEnableUseGpu(PD_Config* config, uint64_t memory_pool_mb,
+                           int32_t device_id);
+void PD_ConfigDisableGpu(PD_Config* config);
+void PD_ConfigSetCpuMathLibraryNumThreads(PD_Config* config, int32_t n);
+void PD_ConfigSwitchIrOptim(PD_Config* config, PD_Bool on);
+void PD_ConfigEnableMemoryOptim(PD_Config* config, PD_Bool on);
+
+/* ---- predictor (pd_predictor.h parity) ---- */
+PD_Predictor* PD_PredictorCreate(PD_Config* config);
+void PD_PredictorDestroy(PD_Predictor* predictor);
+size_t PD_PredictorGetInputNum(PD_Predictor* predictor);
+size_t PD_PredictorGetOutputNum(PD_Predictor* predictor);
+/* returns a pointer owned by the predictor; valid until destroy */
+const char* PD_PredictorGetInputName(PD_Predictor* predictor, size_t idx);
+const char* PD_PredictorGetOutputName(PD_Predictor* predictor, size_t idx);
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor* predictor,
+                                      const char* name);
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* predictor,
+                                       const char* name);
+PD_Bool PD_PredictorRun(PD_Predictor* predictor);
+/* last error message for this thread, or NULL; owned by the library */
+const char* PD_GetLastError(void);
+
+/* ---- tensor (pd_tensor.h parity) ---- */
+void PD_TensorDestroy(PD_Tensor* tensor);
+void PD_TensorReshape(PD_Tensor* tensor, size_t ndims, const int32_t* shape);
+/* shape query: writes up to *ndims entries, sets *ndims to the rank */
+void PD_TensorGetShape(PD_Tensor* tensor, size_t* ndims, int32_t* shape);
+void PD_TensorCopyFromCpuFloat(PD_Tensor* tensor, const float* data);
+void PD_TensorCopyFromCpuInt64(PD_Tensor* tensor, const int64_t* data);
+void PD_TensorCopyFromCpuInt32(PD_Tensor* tensor, const int32_t* data);
+void PD_TensorCopyToCpuFloat(PD_Tensor* tensor, float* data);
+void PD_TensorCopyToCpuInt64(PD_Tensor* tensor, int64_t* data);
+void PD_TensorCopyToCpuInt32(PD_Tensor* tensor, int32_t* data);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PD_INFERENCE_API_H_ */

@@ -1,0 +1,238 @@
+"""Custom C++ op extension — parity with the reference's out-of-tree op API
+(/root/reference/paddle/utils/cpp_extension/, extension/include/ext_tensor.h,
+framework/custom_operator.cc).
+
+The reference JIT-compiles user C++ into a shared library whose ops register
+into the global op registry and then dispatch like any built-in kernel.
+TPU-native, the compute path is XLA, so a host C++ kernel enters the graph as
+a **host callback**: ``load()`` builds the sources with g++ into a shared
+library, binds the exported C symbols with ctypes, and wraps each op as a
+JAX-differentiable function via ``jax.pure_callback`` (+ ``jax.custom_vjp``
+when a backward kernel is exported). The resulting op works in eager mode,
+under ``jax.jit``, and inside the static Program facade, with autograd.
+
+C ABI contract (the TPU-native 'ext_tensor.h'): for an op NAME operating on
+float32 buffers, export
+
+    extern "C" void NAME_forward(const float* x, float* y, int64_t numel);
+    extern "C" void NAME_backward(const float* x, const float* grad_out,
+                                  float* grad_in, int64_t numel);   // optional
+
+Shape-preserving elementwise/map ops cover the reference's custom-op tutorial
+tier (custom relu/…); the backward entry makes them differentiable.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["load", "CppExtension", "CUDAExtension", "BuildExtension", "setup",
+           "get_build_directory"]
+
+
+def get_build_directory() -> str:
+    d = os.environ.get("PADDLE_EXTENSION_DIR") or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _compile(name: str, sources: Sequence[str], build_directory: str,
+             extra_cflags: Optional[List[str]] = None,
+             extra_ldflags: Optional[List[str]] = None,
+             verbose: bool = False) -> str:
+    os.makedirs(build_directory, exist_ok=True)
+    tag = hashlib.sha1()
+    for s in sources:
+        with open(s, "rb") as f:
+            tag.update(f.read())
+    tag.update(" ".join(extra_cflags or []).encode())
+    tag.update(b"\0")
+    tag.update(" ".join(extra_ldflags or []).encode())
+    so_path = os.path.join(build_directory, f"{name}_{tag.hexdigest()[:12]}.so")
+    if os.path.exists(so_path):
+        return so_path
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           *(extra_cflags or []), *map(str, sources), "-o", so_path,
+           *(extra_ldflags or [])]
+    if verbose:
+        print("[cpp_extension]", " ".join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"compiling extension '{name}' failed:\n{proc.stderr}")
+    return so_path
+
+
+def _sym(lib, name):
+    try:
+        fn = getattr(lib, name)
+    except AttributeError:
+        return None
+    fn.restype = None
+    return fn
+
+
+_F32P = ctypes.POINTER(ctypes.c_float)
+
+
+def _make_op(op_name: str, lib):
+    fwd = _sym(lib, f"{op_name}_forward")
+    if fwd is None:
+        return None
+    fwd.argtypes = [_F32P, _F32P, ctypes.c_int64]
+    bwd = _sym(lib, f"{op_name}_backward")
+    if bwd is not None:
+        bwd.argtypes = [_F32P, _F32P, _F32P, ctypes.c_int64]
+
+    def _fwd_host(x):
+        x = np.ascontiguousarray(x, np.float32)
+        y = np.empty_like(x)
+        fwd(x.ctypes.data_as(_F32P), y.ctypes.data_as(_F32P), x.size)
+        return y
+
+    def _bwd_host(x, gy):
+        x = np.ascontiguousarray(x, np.float32)
+        gy = np.ascontiguousarray(gy, np.float32)
+        gx = np.empty_like(x)
+        bwd(x.ctypes.data_as(_F32P), gy.ctypes.data_as(_F32P),
+            gx.ctypes.data_as(_F32P), x.size)
+        return gx
+
+    def _call_fwd(x):
+        return jax.pure_callback(
+            _fwd_host, jax.ShapeDtypeStruct(x.shape, jnp.float32), x,
+            vmap_method="sequential")
+
+    if bwd is not None:
+        @jax.custom_vjp
+        def raw(x):
+            return _call_fwd(x)
+
+        def raw_fwd(x):
+            return _call_fwd(x), x
+
+        def raw_bwd(x, gy):
+            gx = jax.pure_callback(
+                _bwd_host, jax.ShapeDtypeStruct(x.shape, jnp.float32), x, gy,
+                vmap_method="sequential")
+            return (gx,)
+
+        raw.defvjp(raw_fwd, raw_bwd)
+    else:
+        def raw(x):
+            return _call_fwd(x)
+
+    raw.__name__ = op_name
+
+    def op(x):
+        from ..core.tensor import Tensor, apply_op
+
+        if isinstance(x, Tensor) or not isinstance(
+                x, (jax.Array, np.ndarray)):
+            from ..core.tensor import to_tensor
+
+            x = x if isinstance(x, Tensor) else to_tensor(x)
+            return apply_op(lambda v: raw(v.astype(jnp.float32)), x,
+                            op_name=op_name)
+        return raw(jnp.asarray(x, jnp.float32))
+
+    op.__name__ = op_name
+    return op
+
+
+class _ExtensionModule:
+    """Namespace of the ops a loaded extension exports."""
+
+    def __init__(self, name, so_path, ops):
+        self.name = name
+        self.so_path = so_path
+        self._ops = ops
+        for k, v in ops.items():
+            setattr(self, k, v)
+
+    def op_names(self):
+        return sorted(self._ops)
+
+    def __repr__(self):
+        return f"ExtensionModule({self.name}, ops={self.op_names()})"
+
+
+def _discover_ops(so_path: str) -> List[str]:
+    """Exported *_forward symbols name the ops (nm over the .so)."""
+    out = subprocess.run(["nm", "-D", "--defined-only", so_path],
+                         capture_output=True, text=True)
+    names = []
+    for line in out.stdout.splitlines():
+        parts = line.split()
+        if parts and parts[-1].endswith("_forward"):
+            names.append(parts[-1][: -len("_forward")])
+    return names
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cxx_cflags: Optional[List[str]] = None,
+         extra_cflags: Optional[List[str]] = None,
+         extra_ldflags: Optional[List[str]] = None,
+         build_directory: Optional[str] = None,
+         verbose: bool = False, **_ignored) -> _ExtensionModule:
+    """JIT-compile + load a custom op extension (reference
+    utils/cpp_extension/cpp_extension.py:load parity)."""
+    so_path = _compile(name, sources, build_directory or get_build_directory(),
+                       extra_cflags=extra_cxx_cflags or extra_cflags,
+                       extra_ldflags=extra_ldflags, verbose=verbose)
+    lib = ctypes.CDLL(so_path)
+    ops = {}
+    for op_name in _discover_ops(so_path):
+        op = _make_op(op_name, lib)
+        if op is not None:
+            ops[op_name] = op
+    if not ops:
+        raise RuntimeError(
+            f"extension '{name}' exports no '<op>_forward' symbols — see the "
+            "C ABI contract in paddle_tpu.utils.cpp_extension")
+    return _ExtensionModule(name, so_path, ops)
+
+
+class CppExtension:
+    """setup()-style extension description (cpp_extension.py:CppExtension)."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = list(sources)
+        self.kwargs = kwargs
+
+
+CUDAExtension = CppExtension  # no CUDA on TPU hosts; kept for API parity
+
+
+class BuildExtension:
+    """Build command shim: compiles every extension at setup() time."""
+
+    @classmethod
+    def with_options(cls, **options):
+        return cls
+
+    def __init__(self, **options):
+        self.options = options
+
+
+def setup(name: str, ext_modules=None, **kwargs):
+    """Build extensions in-place and return their module namespaces keyed by
+    name (the reference installs an importable module; here the loaded
+    namespace is returned directly and also cached in the build dir)."""
+    exts = ext_modules or []
+    if isinstance(exts, CppExtension):
+        exts = [exts]
+    mods = {}
+    for i, ext in enumerate(exts):
+        ext_name = name if len(exts) == 1 else f"{name}_{i}"
+        mods[ext_name] = load(ext_name, ext.sources, **ext.kwargs)
+    return mods

@@ -1,0 +1,99 @@
+"""C inference API: build libpd_inference_c.so with g++, drive it via ctypes
+against a jit.save'd model, and compare against the python Predictor —
+the reference's capi_exp test pattern (inference/capi_exp tests) on the
+TPU-native predictor."""
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def have_toolchain():
+    try:
+        subprocess.run(["g++", "--version"], capture_output=True, check=True)
+        subprocess.run(["python3-config", "--includes"], capture_output=True,
+                       check=True)
+        return True
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(not have_toolchain(),
+                                reason="no g++/python3-config")
+
+
+@pytest.fixture(scope="module")
+def model_prefix(tmp_path_factory):
+    d = tmp_path_factory.mktemp("capi_model")
+    paddle.seed(0)
+    net = nn.Linear(4, 3)
+    net.eval()
+    prefix = str(d / "linear")
+    paddle.jit.save(net, prefix,
+                    input_spec=[paddle.jit.InputSpec([2, 4], "float32")])
+    return prefix, net
+
+
+@pytest.fixture(scope="module")
+def lib():
+    from paddle_tpu.inference import capi
+
+    return capi.load()
+
+
+class TestCApi:
+    def test_header_exists(self):
+        from paddle_tpu.inference import capi
+
+        assert os.path.exists(capi.header_path())
+
+    def test_end_to_end(self, lib, model_prefix):
+        prefix, net = model_prefix
+        cfg = lib.PD_ConfigCreate()
+        lib.PD_ConfigSetModel(cfg, prefix.encode(), None)
+        pred = lib.PD_PredictorCreate(cfg)
+        assert pred, lib.PD_GetLastError()
+        assert lib.PD_PredictorGetInputNum(pred) == 1
+        assert lib.PD_PredictorGetOutputNum(pred) == 1
+        in_name = lib.PD_PredictorGetInputName(pred, 0)
+        out_name = lib.PD_PredictorGetOutputName(pred, 0)
+
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        h = lib.PD_PredictorGetInputHandle(pred, in_name)
+        shape = (ctypes.c_int32 * 2)(2, 4)
+        lib.PD_TensorReshape(h, 2, shape)
+        lib.PD_TensorCopyFromCpuFloat(
+            h, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        assert lib.PD_PredictorRun(pred), lib.PD_GetLastError()
+
+        oh = lib.PD_PredictorGetOutputHandle(pred, out_name)
+        nd = ctypes.c_size_t(8)
+        oshape = (ctypes.c_int32 * 8)()
+        lib.PD_TensorGetShape(oh, ctypes.byref(nd), oshape)
+        dims = [oshape[i] for i in range(nd.value)]
+        assert dims == [2, 3]
+        out = np.zeros((2, 3), np.float32)
+        lib.PD_TensorCopyToCpuFloat(
+            oh, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+
+        expected = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+        lib.PD_TensorDestroy(h)
+        lib.PD_TensorDestroy(oh)
+        lib.PD_PredictorDestroy(pred)
+        lib.PD_ConfigDestroy(cfg)
+
+    def test_error_reporting(self, lib):
+        cfg = lib.PD_ConfigCreate()
+        lib.PD_ConfigSetModel(cfg, b"/nonexistent/model", None)
+        pred = lib.PD_PredictorCreate(cfg)
+        assert not pred
+        err = lib.PD_GetLastError()
+        assert err and b"pdexport" in err
+        lib.PD_ConfigDestroy(cfg)
