@@ -6,7 +6,7 @@ import jax as _jax
 # pass explicit dtypes so the x64 default does not leak into compute.
 _jax.config.update("jax_enable_x64", True)
 
-from . import dtype, enforce, flags, place, rng, tensor  # noqa: E402,F401
+from . import dtype, enforce, flags, monitor, place, rng, tensor  # noqa: E402,F401
 from .dtype import (  # noqa: E402,F401
     bfloat16,
     bool_,

@@ -1,0 +1,72 @@
+"""Named runtime counters — parity with the reference's monitor subsystem
+(/root/reference/paddle/fluid/platform/monitor.h:77 StatRegistry,
+STAT_ADD/STAT_RESET macros :130).
+
+The reference registers int64 stats (e.g. STAT_gpu0_mem_size) that kernels
+bump from C++. Here counters are process-level Python (the hot path is
+compiled by XLA, so the useful counters are host-side events: steps run,
+bytes fed, retraces, checkpoint writes) with the same add/get/reset surface.
+Thread-safe.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["StatRegistry", "stat_add", "stat_get", "stat_reset",
+           "stat_sub", "all_stats"]
+
+
+class StatRegistry:
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[str, int] = {}
+
+    @classmethod
+    def instance(cls) -> "StatRegistry":
+        if cls._instance is None:
+            with cls._instance_lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+    def add(self, name: str, value: int = 1) -> int:
+        with self._lock:
+            self._stats[name] = self._stats.get(name, 0) + int(value)
+            return self._stats[name]
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._stats.get(name, 0)
+
+    def reset(self, name: str) -> None:
+        with self._lock:
+            self._stats[name] = 0
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+
+def stat_add(name: str, value: int = 1) -> int:
+    """STAT_ADD parity (monitor.h:130)."""
+    return StatRegistry.instance().add(name, value)
+
+
+def stat_sub(name: str, value: int = 1) -> int:
+    return StatRegistry.instance().add(name, -value)
+
+
+def stat_get(name: str) -> int:
+    return StatRegistry.instance().get(name)
+
+
+def stat_reset(name: str) -> None:
+    StatRegistry.instance().reset(name)
+
+
+def all_stats() -> Dict[str, int]:
+    return StatRegistry.instance().snapshot()

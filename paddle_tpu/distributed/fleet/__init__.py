@@ -18,6 +18,7 @@ from .fleet_base import (  # noqa: F401
     get_hybrid_communicate_group,
 )
 from . import meta_parallel  # noqa: F401
+from . import metrics  # noqa: F401
 from .meta_strategies import (  # noqa: F401
     DPStrategyTrainStep,
     LocalSGDTrainStep,

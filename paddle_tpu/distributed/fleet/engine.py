@@ -57,6 +57,7 @@ def apply_optimizer_update(opt, named_params, params, grads, opt_state, lr):
             grads = clip_grads_global_norm_raw(grads, opt._grad_clip.clip_norm)
     new_params, new_state = {}, {}
     is_adamw = type(opt).__name__ == "AdamW"
+    is_lamb = type(opt).__name__ == "Lamb"
     for name, pv in params.items():
         g = grads[name].astype(pv.dtype)
         wd = opt._decay_coeff(named_params[name])
@@ -66,7 +67,13 @@ def apply_optimizer_update(opt, named_params, params, grads, opt_state, lr):
             if (opt._apply_decay_param_fun is None
                     or opt._apply_decay_param_fun(name)):
                 pv = pv * (1.0 - lr * opt._coeff)
-        np_, ns = opt._update(pv, g, opt_state[name], lr)
+        if is_lamb:
+            # Lamb.step() parity: honor exclude_from_weight_decay_fn
+            decay = (opt._exclude_fn is None
+                     or not opt._exclude_fn(named_params[name]))
+            np_, ns = opt._update(pv, g, opt_state[name], lr, decay=decay)
+        else:
+            np_, ns = opt._update(pv, g, opt_state[name], lr)
         new_params[name] = np_
         new_state[name] = ns
     return new_params, new_state

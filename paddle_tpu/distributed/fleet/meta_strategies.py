@@ -266,6 +266,7 @@ class LocalSGDTrainStep:
         self._begin = int(begin_step)
         self._adaptive = adaptive
         self._max_k = int(max_k_steps)
+        self._k0 = max(int(k_steps), 1)
         self._loss0 = None
         ndp = mesh.shape[dp_axis]
 
@@ -384,8 +385,10 @@ class LocalSGDTrainStep:
             if self._loss0 is None:
                 self._loss0 = lv
             elif lv > 0:
-                # loss flattening -> widen the averaging period
-                est = int(math.sqrt(max(self._loss0 / lv, 1.0)) * max(self._k, 1))
+                # Wang & Joshi schedule: k scales with sqrt(loss0/loss) from
+                # the INITIAL k, so it is bounded by the loss ratio (scaling
+                # the current k would compound exponentially to max_k)
+                est = int(math.sqrt(max(self._loss0 / lv, 1.0)) * self._k0)
                 self._k = max(1, min(self._max_k, est))
         return Tensor(loss)
 

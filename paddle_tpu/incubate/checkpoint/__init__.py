@@ -36,9 +36,14 @@ def save_train_state(state: Dict[str, Any], path: str):
     path = os.path.abspath(path)
     tmp = path + ".tmp-save"
     old = path + ".tmp-old"
-    for stale in (tmp, old):  # crash leftovers from a previous save
-        if os.path.exists(stale) and os.path.exists(path):
-            shutil.rmtree(stale)
+    # crash leftovers from a previous save: a stale tmp is always garbage
+    # (orbax refuses to write into an existing dir); old may only be removed
+    # while the committed path exists — otherwise it is the sole survivor
+    # restore_train_state falls back to
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    if os.path.exists(old) and os.path.exists(path):
+        shutil.rmtree(old)
     _checkpointer().save(tmp, state)
     if os.path.exists(path):
         if os.path.exists(old):

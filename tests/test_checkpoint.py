@@ -111,3 +111,39 @@ class TestShardedTrainState:
         }
         out = step((x,), (y,))
         assert np.isfinite(float(out.numpy()))
+
+
+class TestAtomicSaveCrashRecovery:
+    """save_train_state must survive crashes at any point of the swap:
+    stale .tmp-save never blocks the next save, and the previous checkpoint
+    is restorable from .tmp-old after a mid-swap crash."""
+
+    def test_save_after_mid_swap_crash(self, tmp_path):
+        import os, shutil
+        from paddle_tpu.incubate.checkpoint import (
+            restore_train_state, save_train_state)
+
+        path = str(tmp_path / "ck")
+        save_train_state({"a": np.asarray([1.0])}, path)
+        # simulate a crash between rename(path, old) and rename(tmp, path):
+        # a fresh tmp exists and the committed dir moved to .tmp-old
+        shutil.copytree(path, path + ".tmp-save")
+        os.rename(path, path + ".tmp-old")
+        # restore falls back to the survivor
+        got = restore_train_state(path)
+        np.testing.assert_allclose(np.asarray(got["a"]), [1.0])
+        # and the next save succeeds despite the stale tmp
+        save_train_state({"a": np.asarray([2.0])}, path)
+        got = restore_train_state(path)
+        np.testing.assert_allclose(np.asarray(got["a"]), [2.0])
+        assert not os.path.exists(path + ".tmp-save")
+
+    def test_overwrite_keeps_latest(self, tmp_path):
+        from paddle_tpu.incubate.checkpoint import (
+            restore_train_state, save_train_state)
+
+        path = str(tmp_path / "ck2")
+        save_train_state({"a": np.asarray([1.0])}, path)
+        save_train_state({"a": np.asarray([3.0])}, path)
+        np.testing.assert_allclose(
+            np.asarray(restore_train_state(path)["a"]), [3.0])

@@ -222,6 +222,20 @@ class TestLocalSGD:
         losses = run_engine_fixed(step, rng, iters=20)
         assert np.mean(losses[-5:]) < np.mean(losses[:5])
 
+    def test_adaptive_k_bounded_by_loss_ratio(self):
+        """k must track sqrt(loss0/loss)*k0, not compound from the current k."""
+        paddle.seed(5)
+        m = MLP()
+        step = LocalSGDTrainStep(m, loss_fn,
+                                 optimizer.SGD(0.0, m.parameters()),  # lr=0
+                                 dp_mesh(), k_steps=2, adaptive=True,
+                                 max_k_steps=64)
+        rng = np.random.RandomState(4)
+        x, y = make_batch(rng)
+        for _ in range(10):  # lr=0 -> loss constant -> ratio 1 -> k stays k0
+            step((x,), (y,))
+        assert step._k == 2
+
     def test_adaptive_k_grows(self):
         paddle.seed(5)
         m = MLP()
@@ -315,6 +329,35 @@ class TestOptimizerParityAcrossEngines:
             opt2.clear_grad()
             ref.append(float(loss.numpy()))
         np.testing.assert_allclose(l1, ref, rtol=1e-4, atol=1e-5)
+
+    def test_lamb_exclude_from_weight_decay(self):
+        """Engines must honor Lamb's exclude_from_weight_decay_fn the way
+        Lamb.step() does."""
+        from paddle_tpu.distributed.fleet.engine import ParallelTrainStep
+        paddle.seed(17)
+        m1, m2 = MLP(), MLP()
+        m2.set_state_dict(m1.state_dict())
+        exclude = lambda p: "bias" in p.name
+        s1 = ParallelTrainStep(
+            m1, loss_fn,
+            optimizer.Lamb(1e-2, lamb_weight_decay=0.5,
+                           exclude_from_weight_decay_fn=exclude,
+                           parameters=m1.parameters()),
+            dp_mesh())
+        opt2 = optimizer.Lamb(1e-2, lamb_weight_decay=0.5,
+                              exclude_from_weight_decay_fn=exclude,
+                              parameters=m2.parameters())
+        rng = np.random.RandomState(0)
+        x, y = make_batch(rng)
+        s1((x,), (y,))
+        s1.sync_to_layer()
+        loss = loss_fn(m2(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        opt2.step()
+        for (n1, p1), (_, p2) in zip(sorted(m1.named_parameters()),
+                                     sorted(m2.named_parameters())):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy(), atol=1e-5,
+                                       err_msg=n1)
 
     def test_dp_strategy_grad_clip_applied(self):
         from paddle_tpu.nn import ClipGradByGlobalNorm

@@ -1,0 +1,86 @@
+"""StatRegistry counters (platform/monitor.h parity) and fleet metrics
+(fleet/metrics/metric.py parity) — numpy-golden checks; the distributed
+reduction path collapses to identity in a single-process world."""
+import threading
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core import monitor
+from paddle_tpu.distributed.fleet import metrics
+
+
+class TestStatRegistry:
+    def test_add_get_reset(self):
+        monitor.stat_reset("t_steps")
+        assert monitor.stat_get("t_steps") == 0
+        monitor.stat_add("t_steps", 5)
+        monitor.stat_add("t_steps")
+        assert monitor.stat_get("t_steps") == 6
+        monitor.stat_sub("t_steps", 2)
+        assert monitor.stat_get("t_steps") == 4
+        monitor.stat_reset("t_steps")
+        assert monitor.stat_get("t_steps") == 0
+
+    def test_snapshot(self):
+        monitor.stat_reset("t_a")
+        monitor.stat_add("t_a", 3)
+        snap = monitor.all_stats()
+        assert snap["t_a"] == 3
+
+    def test_thread_safety(self):
+        monitor.stat_reset("t_conc")
+
+        def bump():
+            for _ in range(1000):
+                monitor.stat_add("t_conc")
+
+        threads = [threading.Thread(target=bump) for _ in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert monitor.stat_get("t_conc") == 8000
+
+
+class TestFleetMetrics:
+    def test_sum_max_min(self):
+        x = np.asarray([1.0, 2.0, 3.0])
+        assert metrics.sum(x) == 6.0
+        assert metrics.max(x) == 3.0
+        assert metrics.min(x) == 1.0
+
+    def test_acc_mae_rmse(self):
+        assert metrics.acc(np.asarray(8.0), np.asarray(10.0)) == 0.8
+        assert abs(metrics.mae(np.asarray(5.0), np.asarray(10.0)) - 0.5) < 1e-12
+        assert abs(metrics.rmse(np.asarray(4.0), np.asarray(16.0)) - 0.5) < 1e-12
+
+    def test_mean(self):
+        assert metrics.mean(np.asarray(10.0), np.asarray(4.0)) == 2.5
+
+    def test_auc_perfect_and_random(self):
+        nbins = 100
+        pos = np.zeros(nbins)
+        neg = np.zeros(nbins)
+        pos[90] = 100  # all positives score high
+        neg[10] = 100  # all negatives score low
+        assert metrics.auc(pos, neg) == 1.0
+        pos2 = np.ones(nbins)
+        neg2 = np.ones(nbins)  # indistinguishable
+        assert abs(metrics.auc(pos2, neg2) - 0.5) < 1e-6
+        assert metrics.auc(np.zeros(nbins), np.zeros(nbins)) == 0.5
+
+    def test_auc_matches_sklearn_formula(self):
+        rng = np.random.RandomState(0)
+        nbins = 50
+        pos = rng.randint(0, 10, nbins).astype(float)
+        neg = rng.randint(0, 10, nbins).astype(float)
+        # golden: explicit pairwise comparison over expanded scores
+        pos_scores = np.repeat(np.arange(nbins), pos.astype(int))
+        neg_scores = np.repeat(np.arange(nbins), neg.astype(int))
+        wins = (pos_scores[:, None] > neg_scores[None, :]).sum()
+        ties = (pos_scores[:, None] == neg_scores[None, :]).sum()
+        expected = (wins + 0.5 * ties) / (len(pos_scores) * len(neg_scores))
+        assert abs(metrics.auc(pos, neg) - expected) < 1e-9
+
+    def test_tensor_inputs(self):
+        t = paddle.to_tensor([2.0, 4.0])
+        assert metrics.sum(t) == 6.0
