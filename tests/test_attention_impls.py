@@ -1,0 +1,84 @@
+"""Attention implementation tiers agree numerically (blockwise is the
+reference recurrence; xla_attention is the materialized TPU fast path;
+flash falls back to blockwise off-TPU) and the dispatch honors
+set_attention_impl."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import attention as att
+
+
+def rand_qkv(rng, b=2, h=4, L=64, d=32, dtype=jnp.float32):
+    mk = lambda: jnp.asarray(rng.randn(b, h, L, d), dtype)
+    return mk(), mk(), mk()
+
+
+class TestXlaAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_blockwise_f32(self, rng, causal):
+        q, k, v = rand_qkv(rng)
+        a = att.xla_attention(q, k, v, causal=causal)
+        b = att.blockwise_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_matches_naive_softmax(self, rng):
+        q, k, v = rand_qkv(rng, L=16, d=8)
+        s = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), np.asarray(k))
+        s = s / np.sqrt(q.shape[-1])
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        exp = np.einsum("bhqk,bhkd->bhqd", p, np.asarray(v))
+        out = att.xla_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), exp, rtol=1e-5, atol=1e-5)
+
+    def test_bf16_prob_roundtrip_close(self, rng):
+        q, k, v = rand_qkv(rng, dtype=jnp.bfloat16)
+        a = att.xla_attention(q, k, v, causal=True).astype(jnp.float32)
+        b = att.blockwise_attention(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), causal=True)
+        # bf16 inputs + bf16 probs: agreement within bf16 tolerance
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0.05, atol=0.05)
+
+    def test_bias(self, rng):
+        q, k, v = rand_qkv(rng, L=16, d=8)
+        bias = jnp.asarray(rng.randn(1, 1, 16, 16), jnp.float32)
+        a = att.xla_attention(q, k, v, bias=bias)
+        b = att.blockwise_attention(q, k, v, bias=bias)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grad_flows(self, rng):
+        q, k, v = rand_qkv(rng, L=16, d=8)
+        g = jax.grad(lambda q: att.xla_attention(q, k, v, causal=True).sum())(q)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestDispatch:
+    def test_set_attention_impl_validates(self):
+        with pytest.raises(ValueError):
+            att.set_attention_impl("nope")
+
+    def test_explicit_xla_impl(self, rng):
+        att.set_attention_impl("xla")
+        try:
+            q, k, v = rand_qkv(rng)
+            out = att.dot_product_attention(q, k, v, causal=True)
+            ref = att.blockwise_attention(q, k, v, causal=True)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
+        finally:
+            att.set_attention_impl("auto")
+
+    def test_blockwise_impl(self, rng):
+        att.set_attention_impl("blockwise")
+        try:
+            q, k, v = rand_qkv(rng)
+            out = att.dot_product_attention(q, k, v, causal=True)
+            assert out.shape == q.shape
+        finally:
+            att.set_attention_impl("auto")
