@@ -1,2 +1,3 @@
 """paddle_tpu.incubate — incubating subsystems (parity fluid/incubate)."""
 from . import checkpoint  # noqa: F401
+from . import moe  # noqa: F401

@@ -1,0 +1,180 @@
+"""Mixture-of-Experts with expert parallelism over an 'ep' mesh axis.
+
+The reference snapshot predates its MoE work (SURVEY.md §2: EP-precursor —
+none), so this is net-new capability, designed TPU-first rather than ported:
+the Mesh-TensorFlow/GShard dense-dispatch formulation — gate → top-k →
+dispatch einsum → per-expert FFN on stacked weights → combine einsum — which
+XLA partitions cleanly: sharding the expert axis of the stacked weights and
+dispatched activations over 'ep' makes the dispatch/combine einsums lower to
+all-to-alls on ICI, with no hand-written routing code.
+
+Components:
+- ``top_k_gating``      — softmax gate, top-k selection, capacity dropping,
+                          load-balance aux loss (GShard eq. 4).
+- ``moe_dispatch``      — build dispatch/combine tensors.
+- ``ExpertMLP``         — stacked per-expert FFN ([E, ...] weights carrying
+                          tp_spec ('ep', ...) so fleet engines shard them).
+- ``MoELayer``          — drop-in FFN replacement (eager Layer API).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor, _is_tracer, apply_op
+from ..nn import initializer as I
+
+__all__ = ["top_k_gating", "moe_dispatch", "ExpertMLP", "MoELayer"]
+
+
+def top_k_gating(gate_logits, top_k: int, capacity: int):
+    """Returns (combine_weights [T, E, C], dispatch_mask [T, E, C], aux_loss).
+
+    GShard-style: softmax over experts, top-k per token, position-in-expert
+    by cumulative sum, tokens beyond ``capacity`` dropped (their combine
+    weight is 0 → the residual connection carries them). Pure jnp; vmappable
+    and shardable.
+    """
+    t, e = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    dispatch = jnp.zeros((t, e, capacity), bool)
+    # occupancy per expert accumulates across the k routing rounds
+    occupancy = jnp.zeros((e,), jnp.int32)
+    masked = probs
+    density_frac = jnp.zeros((e,), jnp.float32)
+
+    for _ in range(top_k):
+        idx = jnp.argmax(masked, axis=-1)                      # [T]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)       # [T, E]
+        # position of each token inside its chosen expert's buffer
+        pos_in_round = jnp.cumsum(onehot, axis=0) - onehot      # [T, E]
+        pos = (pos_in_round + occupancy[None, :]) * onehot
+        pos_tok = jnp.sum(pos, axis=-1)                        # [T]
+        keep = pos_tok < capacity
+        w = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]  # [T]
+        w = jnp.where(keep, w, 0.0)
+        pos_clip = jnp.minimum(pos_tok, capacity - 1)
+        cap_onehot = jax.nn.one_hot(pos_clip, capacity, dtype=jnp.float32)
+        contrib = (onehot.astype(jnp.float32)[:, :, None]
+                   * cap_onehot[:, None, :]) * w[:, None, None]
+        combine = combine + contrib
+        dispatch = dispatch | (contrib > 0)
+        occupancy = occupancy + jnp.sum(onehot * keep[:, None].astype(jnp.int32),
+                                        axis=0)
+        density_frac = density_frac + jnp.mean(onehot.astype(jnp.float32),
+                                               axis=0)
+        masked = jnp.where(onehot.astype(bool), -jnp.inf, masked)
+
+    # renormalize the k selected weights per token (top2 gating convention)
+    denom = jnp.maximum(combine.sum(axis=(1, 2)), 1e-9)
+    combine = combine / denom[:, None, None]
+    dispatch = combine > 0
+
+    # load-balance loss: E * mean_e(density * mean-gate-prob) (GShard eq. 4)
+    density = density_frac / top_k
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(density * mean_prob)
+    return combine, dispatch, aux
+
+
+def moe_dispatch(x, dispatch):
+    """x: [T, D], dispatch: [T, E, C] → expert inputs [E, C, D]."""
+    return jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+
+
+class ExpertMLP(nn.Layer):
+    """E parallel FFNs as stacked weights [E, d, ff] / [E, ff, d] with
+    tp_spec ('ep', …): fleet engines shard the expert axis, so each ep rank
+    holds E/ep experts and the dispatch/combine einsums become all-to-alls."""
+
+    def __init__(self, num_experts: int, d_model: int, d_ff: int,
+                 activation: str = "gelu"):
+        super().__init__()
+        std = 0.02
+        init = I.Normal(0.0, std)
+        self.w_in = self.create_parameter(
+            [num_experts, d_model, d_ff], default_initializer=init)
+        self.b_in = self.create_parameter(
+            [num_experts, 1, d_ff], default_initializer=I.Constant(0.0))
+        self.w_out = self.create_parameter(
+            [num_experts, d_ff, d_model], default_initializer=init)
+        self.b_out = self.create_parameter(
+            [num_experts, 1, d_model], default_initializer=I.Constant(0.0))
+        for p in (self.w_in, self.b_in, self.w_out, self.b_out):
+            p.tp_spec = ("ep",) + (None,) * (len(p.shape) - 1)
+        self._act = activation
+
+    def forward(self, expert_in):
+        """expert_in: [E, C, D] → [E, C, D]; one batched MXU matmul pair."""
+
+        def f(xe, wi, bi, wo, bo):
+            h = jnp.einsum("ecd,edf->ecf", xe, wi) + bi
+            h = jax.nn.gelu(h, approximate=True) if self._act == "gelu" else (
+                jnp.maximum(h, 0))
+            return jnp.einsum("ecf,efd->ecd", h, wo) + bo
+
+        return apply_op(f, expert_in, self.w_in, self.b_in, self.w_out,
+                        self.b_out, op_name="expert_mlp")
+
+
+class MoELayer(nn.Layer):
+    """Drop-in FFN replacement: ``y = combine(experts(dispatch(x)))``.
+
+    Aux (load-balance) loss: in eager mode it lands on ``self.aux_loss``
+    after each forward — add ``layer.aux_loss * coeff`` to the loss. Under
+    jit/fleet engines a side-effect attribute cannot carry a traced value
+    out (it would leak the tracer), so ``self.aux_loss`` stays None there;
+    jitted training must call :meth:`forward_with_aux` and fold the returned
+    aux into the loss functionally.
+    """
+
+    def __init__(self, d_model: int, d_ff: int, num_experts: int,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 activation: str = "gelu", gate_noise: float = 0.0):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.gate = nn.Linear(d_model, num_experts, bias_attr=False)
+        self.experts = ExpertMLP(num_experts, d_model, d_ff, activation)
+        self.aux_loss = None
+
+    def forward(self, x):
+        """x: [B, L, D] (or [T, D]) → same shape."""
+        out, aux = self.forward_with_aux(x)
+        # only a concrete value may live on the layer (a tracer stored here
+        # would escape its trace and error on any later access)
+        self.aux_loss = None if _is_tracer(aux._value) else aux
+        return out
+
+    def forward_with_aux(self, x):
+        """Functional form for jitted training: returns (out, aux_loss)."""
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        t = int(np.prod(orig_shape[:-1]))
+        cap = max(1, int(math.ceil(
+            self.capacity_factor * self.top_k * t / self.num_experts)))
+        flat = x.reshape([t, d])
+        logits = self.gate(flat)
+
+        def route(flat_raw, logits_raw):
+            combine, dispatch, aux = top_k_gating(
+                logits_raw, self.top_k, cap)
+            expert_in = moe_dispatch(flat_raw, dispatch)
+            return expert_in, combine.astype(flat_raw.dtype), aux
+
+        expert_in, combine, aux = apply_op(route, flat, logits,
+                                           multi_out=True, op_name="moe_route")
+        expert_out = self.experts(expert_in)
+
+        def unroute(eo, comb):
+            return jnp.einsum("ecd,tec->td", eo, comb)
+
+        out = apply_op(unroute, expert_out, combine, op_name="moe_combine")
+        return out.reshape(list(orig_shape)), aux

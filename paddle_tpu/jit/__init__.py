@@ -25,10 +25,11 @@ from .functionalize import (
     _wrap_tree,
 )
 from .train_step import EvalStep, TrainStep
+from . import dy2static  # noqa: F401
 
 __all__ = [
     "to_static", "save", "load", "not_to_static", "TracedLayer", "TrainStep",
-    "EvalStep", "functionalize", "InputSpec",
+    "EvalStep", "functionalize", "InputSpec", "dy2static",
 ]
 
 
@@ -96,12 +97,25 @@ def _sig(a):
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, **kwargs):
-    """Decorator staging a function/Layer.forward into a compiled callable."""
+    """Decorator staging a function/Layer.forward into a compiled callable.
+
+    Data-dependent python control flow (``if``/``while``/``and``/``or`` over
+    tensors) is first rewritten by the AST converter (dy2static.py — the
+    ProgramTranslator equivalent) into lax-compatible ops, then the result is
+    traced and jit-compiled.
+    """
+    from .dy2static import convert_to_static
 
     def decorate(fn):
         if isinstance(fn, Layer):
+            fwd = fn.forward
+            raw = getattr(fwd, "__func__", None)
+            if raw is not None:
+                conv = convert_to_static(raw)
+                if getattr(conv, "_dy2static_converted", False):
+                    fn.forward = conv.__get__(fn)
             return StaticFunction(fn.forward, input_spec, layer=fn)
-        return StaticFunction(fn, input_spec)
+        return StaticFunction(convert_to_static(fn), input_spec)
 
     if function is not None:
         return decorate(function)

@@ -1,0 +1,486 @@
+"""dygraph→static conversion: AST rewriting of data-dependent Python control
+flow into traceable ops.
+
+Parity with the reference's ProgramTranslator + AST transformer stack
+(/root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py, ifelse_transformer.py, loop_transformer.py,
+logical_transformer.py — 24 transformer files). The reference rewrites
+``if``/``while``/``and``/``or``/``not`` over Variables into
+cond/while_loop/logical_* layer calls so the same Python runs as a static
+program; here the rewrite targets ``paddle_tpu.static.cond/while_loop``,
+which already dispatch three ways (eager python, ``lax.cond/while_loop``
+under jit tracing, composite op under Program recording) — so one converted
+function serves dygraph, ``jax.jit``, and the Program facade.
+
+Supported rewrites (the reference's core set):
+- ``if``/``elif``/``else`` whose test involves a Tensor → ``convert_ifelse``
+  with branch closures returning the variables either branch assigns.
+- ``while`` whose test involves a Tensor → ``convert_while`` over the loop
+  variables assigned in the body.
+- ``and`` / ``or`` / ``not`` over Tensors → short-circuit-free
+  ``convert_logical_*`` (lax-compatible).
+Statements a branch cannot stage (``return``/``break``/``continue`` inside a
+converted block) keep their Python form — identical to eager semantics, and
+an error only if actually traced with a tracer predicate, matching the
+reference's partial-support behavior.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Callable, List, Optional
+
+from ..core.tensor import Tensor, _is_tracer
+
+__all__ = [
+    "convert_to_static",
+    "convert_ifelse",
+    "convert_while",
+    "convert_logical_and",
+    "convert_logical_or",
+    "convert_logical_not",
+    "convert_bool",
+]
+
+
+# ---------------------------------------------------------------------------
+# runtime conversion helpers (reference: dygraph_to_static/convert_operators.py)
+# ---------------------------------------------------------------------------
+def _is_dynamic(x) -> bool:
+    if isinstance(x, Tensor):
+        return _is_tracer(x._value) or _recording()
+    return _is_tracer(x)
+
+
+def _recording() -> bool:
+    from ..core import tensor as tensor_mod
+
+    return tensor_mod._op_recorder is not None
+
+
+class _Undefined:
+    """Sentinel for names unbound before a converted block (the reference's
+    UndefinedVar, dygraph_to_static/utils.py). Any USE raises the NameError
+    python would have raised — only threading it through the branch plumbing
+    untouched is allowed."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<undefined before converted control flow>"
+
+    def _raise(self, *a, **k):
+        raise NameError(
+            "variable used before assignment: it was unbound before a "
+            "converted if/while and the taken branch did not assign it")
+
+    __getattr__ = _raise
+    __bool__ = _raise
+    __float__ = _raise
+    __int__ = _raise
+    __iter__ = _raise
+    __call__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = _raise
+    __mul__ = __rmul__ = __truediv__ = __rtruediv__ = _raise
+    __getitem__ = __len__ = _raise
+
+
+UNDEF = _Undefined()
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, args=()):
+    """Dispatch an ``if``: python branch for concrete predicates, static.cond
+    for tracers/recorded programs (ifelse_transformer.py semantics)."""
+    if _is_dynamic(pred):
+        from ..static.control_flow import cond
+
+        return cond(pred, lambda: true_fn(*args), lambda: false_fn(*args))
+    taken = bool(pred.numpy()) if isinstance(pred, Tensor) else bool(pred)
+    return true_fn(*args) if taken else false_fn(*args)
+
+
+def convert_while(cond_fn: Callable, body_fn: Callable, loop_vars: tuple):
+    """Dispatch a ``while`` (loop_transformer.py semantics)."""
+    first = cond_fn(*loop_vars)
+    if _is_dynamic(first) or any(_is_dynamic(v) for v in loop_vars):
+        from ..static.control_flow import while_loop
+
+        out = while_loop(cond_fn, lambda *vs: tuple(body_fn(*vs)),
+                         list(loop_vars))
+        return tuple(out)
+    vars_ = tuple(loop_vars)
+    cur = first
+    while bool(cur.numpy()) if isinstance(cur, Tensor) else bool(cur):
+        vars_ = tuple(body_fn(*vars_))
+        cur = cond_fn(*vars_)
+    return vars_
+
+
+def convert_logical_and(lhs, rhs_fn: Callable):
+    """``a and b`` — short-circuits for python values, elementwise logical
+    for Tensors (logical_transformer.py)."""
+    if isinstance(lhs, Tensor) and _is_dynamic(lhs):
+        return lhs & rhs_fn()
+    if isinstance(lhs, Tensor):
+        if not bool(lhs.numpy().all() if lhs.ndim else lhs.numpy()):
+            return lhs
+        return rhs_fn()
+    return lhs and rhs_fn()
+
+
+def convert_logical_or(lhs, rhs_fn: Callable):
+    if isinstance(lhs, Tensor) and _is_dynamic(lhs):
+        return lhs | rhs_fn()
+    if isinstance(lhs, Tensor):
+        if bool(lhs.numpy().all() if lhs.ndim else lhs.numpy()):
+            return lhs
+        return rhs_fn()
+    return lhs or rhs_fn()
+
+
+def convert_logical_not(x):
+    if isinstance(x, Tensor):
+        return x.logical_not() if hasattr(x, "logical_not") else ~x
+    return not x
+
+
+def convert_bool(x):
+    """bool(x) in a converted test position."""
+    if isinstance(x, Tensor) and _is_dynamic(x):
+        return x
+    if isinstance(x, Tensor):
+        return bool(x.numpy().all() if x.ndim else x.numpy())
+    return x
+
+
+# ---------------------------------------------------------------------------
+# AST analysis
+# ---------------------------------------------------------------------------
+def _assigned_names(nodes: List[ast.stmt]) -> List[str]:
+    out: List[str] = []
+
+    class V(ast.NodeVisitor):
+        def visit_Assign(self, n):
+            for t in n.targets:
+                self._target(t)
+            self.generic_visit(n)
+
+        def visit_AugAssign(self, n):
+            self._target(n.target)
+            self.generic_visit(n)
+
+        def visit_AnnAssign(self, n):
+            if n.value is not None:
+                self._target(n.target)
+            self.generic_visit(n)
+
+        def visit_For(self, n):
+            self._target(n.target)
+            self.generic_visit(n)
+
+        def _target(self, t):
+            if isinstance(t, ast.Name):
+                if t.id not in out:
+                    out.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    self._target(e)
+
+        # do not descend into nested function defs
+        def visit_FunctionDef(self, n):
+            if n.name not in out:
+                out.append(n.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return out
+
+
+def _read_names(node) -> set:
+    names = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            names.add(n.id)
+    return names
+
+
+def _has_scope_decl(nodes: List[ast.stmt]) -> bool:
+    """global/nonlocal in the block: declared names cannot also be branch-fn
+    parameters, so such blocks keep their python form."""
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, (ast.Global, ast.Nonlocal)):
+                return True
+    return False
+
+
+def _has_escape(nodes: List[ast.stmt]) -> bool:
+    """return/break/continue/yield at this block's level (not in nested defs
+    or nested loops for break/continue)."""
+
+    class V(ast.NodeVisitor):
+        found = False
+        loop_depth = 0
+
+        def visit_Return(self, n):
+            self.found = True
+
+        def visit_Yield(self, n):
+            self.found = True
+
+        def visit_YieldFrom(self, n):
+            self.found = True
+
+        def visit_Break(self, n):
+            if self.loop_depth == 0:
+                self.found = True
+
+        visit_Continue = visit_Break
+
+        def visit_While(self, n):
+            self.loop_depth += 1
+            self.generic_visit(n)
+            self.loop_depth -= 1
+
+        visit_For = visit_While
+
+        def visit_FunctionDef(self, n):
+            pass  # don't descend
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return v.found
+
+
+_HELPER = "_jst"
+
+
+def _undef_guards(names: List[str]) -> List[ast.stmt]:
+    """Per name: ``try: <name>\nexcept NameError: <name> = _jst.UNDEF`` so a
+    converted block can thread names that were unbound before it (the
+    reference pre-assigns UndefinedVar the same way)."""
+    out = []
+    for n in names:
+        out.append(ast.Try(
+            body=[ast.Expr(value=ast.Name(id=n, ctx=ast.Load()))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Name(id="NameError", ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(
+                    targets=[ast.Name(id=n, ctx=ast.Store())],
+                    value=ast.Attribute(
+                        value=ast.Name(id=_HELPER, ctx=ast.Load()),
+                        attr="UNDEF", ctx=ast.Load()))])],
+            orelse=[], finalbody=[]))
+    return out
+
+
+class _Dy2StaticTransformer(ast.NodeTransformer):
+    """Rewrites if/while/boolop into _jst.convert_* calls."""
+
+    def __init__(self):
+        self.counter = 0
+
+    def _name(self, tag):
+        self.counter += 1
+        return f"__{tag}_{self.counter}"
+
+    # -- boolean operators --------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        expr = node.values[-1]
+        for lhs in reversed(node.values[:-1]):
+            expr = ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=_HELPER, ctx=ast.Load()),
+                    attr=fn, ctx=ast.Load()),
+                args=[lhs,
+                      ast.Lambda(
+                          args=ast.arguments(posonlyargs=[], args=[],
+                                             kwonlyargs=[], kw_defaults=[],
+                                             defaults=[]),
+                          body=expr)],
+                keywords=[])
+        return ast.copy_location(expr, node)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.copy_location(
+                ast.Call(
+                    func=ast.Attribute(
+                        value=ast.Name(id=_HELPER, ctx=ast.Load()),
+                        attr="convert_logical_not", ctx=ast.Load()),
+                    args=[node.operand], keywords=[]),
+                node)
+        return node
+
+    # -- if -----------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if (_has_escape(node.body) or _has_escape(node.orelse)
+                or _has_scope_decl(node.body + node.orelse)):
+            return node  # python semantics preserved (partial support)
+        assigned = _assigned_names(node.body + node.orelse)
+        if not assigned:
+            # branches are pure side-effect python (e.g. appends): keep as-is
+            return node
+
+        true_name = self._name("true_fn")
+        false_name = self._name("false_fn")
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in assigned],
+            ctx=ast.Load()))
+        # the assigned names are branch-fn PARAMETERS (reads see the outer
+        # value, writes stay branch-local) — the reference's true_fn/false_fn
+        # argument threading, ifelse_transformer.py
+        params = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n) for n in assigned],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+
+        def mk_fn(name, body):
+            body = list(body) if body else [ast.Pass()]
+            return ast.FunctionDef(name=name, args=params,
+                                   body=body + [ret], decorator_list=[])
+
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in assigned],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(value=ast.Name(id=_HELPER, ctx=ast.Load()),
+                                   attr="convert_ifelse", ctx=ast.Load()),
+                args=[node.test,
+                      ast.Name(id=true_name, ctx=ast.Load()),
+                      ast.Name(id=false_name, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                      for n in assigned], ctx=ast.Load())],
+                keywords=[]))
+        out = _undef_guards(assigned) + [
+            mk_fn(true_name, node.body), mk_fn(false_name, node.orelse), call]
+        for n in out:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return out
+
+    # -- while --------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or node.orelse or _has_scope_decl(node.body):
+            return node
+        assigned = _assigned_names(node.body)
+        loop_vars = [n for n in assigned] + [
+            n for n in sorted(_read_names(node.test))
+            if n not in assigned and n != _HELPER
+        ]
+        if not loop_vars:
+            return node
+
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n) for n in loop_vars],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        cond_name = self._name("loop_cond")
+        body_name = self._name("loop_body")
+        cond_fn = ast.FunctionDef(
+            name=cond_name, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        body_fn = ast.FunctionDef(
+            name=body_name, args=args,
+            body=list(node.body) + [ast.Return(value=ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Load()) for n in loop_vars],
+                ctx=ast.Load()))],
+            decorator_list=[])
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in loop_vars],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(value=ast.Name(id=_HELPER, ctx=ast.Load()),
+                                   attr="convert_while", ctx=ast.Load()),
+                args=[ast.Name(id=cond_name, ctx=ast.Load()),
+                      ast.Name(id=body_name, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                      for n in loop_vars], ctx=ast.Load())],
+                keywords=[]))
+        out = _undef_guards(loop_vars) + [cond_fn, body_fn, call]
+        for n in out:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return out
+
+
+def convert_to_static(fn: Callable) -> Callable:
+    """Rewrite ``fn``'s data-dependent control flow; returns the converted
+    function (or ``fn`` unchanged when its source is unavailable — builtins,
+    C extensions, REPL lambdas)."""
+    if getattr(fn, "_not_to_static", False):
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []  # strip @to_static etc. — we call the raw result
+    new_tree = _Dy2StaticTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+    try:
+        code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
+                       mode="exec")
+    except SyntaxError:
+        return fn  # converted form invalid for this function: keep python
+    from . import dy2static as _self
+
+    import types
+
+    glob = dict(fn.__globals__)
+    glob[_HELPER] = _self
+    if fn.__closure__:
+        # Rebuild inside a wrapper that redeclares the free variables, then
+        # swap in the ORIGINAL cells so later nonlocal mutation stays visible
+        # (copying cell contents would freeze them at conversion time).
+        freevars = fn.__code__.co_freevars
+        wrapper_src = "def __outer__({}):\n".format(", ".join(freevars))
+        wrapper_src += textwrap.indent(ast.unparse(new_tree.body[0]), "    ")
+        wrapper_src += f"\n    return {fdef.name}"
+        wglob = dict(glob)
+        try:
+            exec(compile(wrapper_src, f"<dy2static {fn.__qualname__}>",
+                         "exec"), wglob)
+        except SyntaxError:
+            return fn
+        snapshot = wglob["__outer__"](
+            *[c.cell_contents for c in fn.__closure__])
+        cellmap = dict(zip(freevars, fn.__closure__))
+        try:
+            live_cells = tuple(cellmap[n]
+                               for n in snapshot.__code__.co_freevars)
+            converted = types.FunctionType(
+                snapshot.__code__, glob, fn.__name__, fn.__defaults__,
+                live_cells)
+        except KeyError:
+            converted = snapshot  # new freevar we can't map: snapshot mode
+    else:
+        exec(code, glob)
+        converted = glob[fdef.name]
+    converted = functools.wraps(fn)(converted)
+    converted._dy2static_converted = True
+    return converted

@@ -1,0 +1,275 @@
+"""dygraph→static AST conversion: converted functions must match eager
+execution exactly, and stage data-dependent control flow under jax.jit —
+the reference's dygraph_to_static test pattern (test_ifelse.py,
+test_loop.py: run eager vs declarative and compare)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit.dy2static import convert_to_static
+
+
+class TestIfConversion:
+    def test_tensor_if_eager(self):
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2
+            else:
+                y = x - 1
+            return y
+
+        g = convert_to_static(f)
+        assert g._dy2static_converted
+        xp = paddle.to_tensor([1.0, 2.0])
+        np.testing.assert_allclose(g(xp).numpy(), f(xp).numpy())
+        xn = paddle.to_tensor([-1.0, -2.0])
+        np.testing.assert_allclose(g(xn).numpy(), f(xn).numpy())
+
+    def test_tensor_if_under_jit(self):
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2
+            else:
+                y = x - 1
+            return y
+
+        g = convert_to_static(f)
+        jf = jax.jit(lambda v: g(paddle.Tensor(v))._value)
+        np.testing.assert_allclose(np.asarray(jf(jnp.asarray([1.0]))), [2.0])
+        np.testing.assert_allclose(np.asarray(jf(jnp.asarray([-1.0]))), [-2.0])
+
+    def test_unconverted_python_if_fails_under_jit(self):
+        def f(x):
+            if x.sum() > 0:  # raw python branch on a tracer
+                return x * 2
+            return x - 1
+
+        with pytest.raises(Exception):
+            jax.jit(lambda v: f(paddle.Tensor(v))._value)(jnp.asarray([1.0]))
+
+    def test_elif_chain(self):
+        def f(x):
+            if x.sum() > 10:
+                y = x * 10
+            elif x.sum() > 0:
+                y = x * 2
+            else:
+                y = x * 0
+            return y
+
+        g = convert_to_static(f)
+        for v in ([20.0], [1.0], [-5.0]):
+            np.testing.assert_allclose(
+                g(paddle.to_tensor(v)).numpy(),
+                f(paddle.to_tensor(v)).numpy())
+
+    def test_augassign_in_branch(self):
+        def f(x):
+            y = x * 1.0
+            if x.sum() > 0:
+                y += 10.0
+            else:
+                y -= 10.0
+            return y
+
+        g = convert_to_static(f)
+        np.testing.assert_allclose(g(paddle.to_tensor([1.0])).numpy(), [11.0])
+        np.testing.assert_allclose(g(paddle.to_tensor([-1.0])).numpy(), [-11.0])
+
+    def test_python_if_untouched(self):
+        def f(x, flag=True):
+            if flag:  # plain python condition keeps python semantics
+                return x * 2
+            return x
+
+        g = convert_to_static(f)
+        np.testing.assert_allclose(g(paddle.to_tensor([3.0])).numpy(), [6.0])
+
+    def test_return_in_branch_preserved(self):
+        # early returns are not converted; eager still works
+        def f(x):
+            if x.sum() > 0:
+                return x * 2
+            return x - 1
+
+        g = convert_to_static(f)
+        np.testing.assert_allclose(g(paddle.to_tensor([2.0])).numpy(), [4.0])
+        np.testing.assert_allclose(g(paddle.to_tensor([-2.0])).numpy(), [-3.0])
+
+
+class TestConversionRobustness:
+    def test_global_in_branch_keeps_python_form(self):
+        def f(x):
+            if x.sum() > 0:
+                global _d2s_counter
+                _d2s_counter = 1
+                y = x + 1
+            else:
+                y = x - 1
+            return y
+
+        g = convert_to_static(f)  # must not raise at conversion time
+        np.testing.assert_allclose(g(paddle.to_tensor([1.0])).numpy(), [2.0])
+
+    def test_one_branch_assignment_raises_on_use(self):
+        def f(x):
+            if x.sum() > 0:
+                y = x + 1
+            else:
+                z = x - 1
+            return y
+
+        g = convert_to_static(f)
+        with pytest.raises(NameError):
+            g(paddle.to_tensor([-1.0])).numpy()  # y unbound on this path
+        np.testing.assert_allclose(g(paddle.to_tensor([1.0])).numpy(), [2.0])
+
+    def test_closure_sees_later_mutation(self):
+        def outer():
+            scale = paddle.to_tensor(1.0)
+
+            def f(x):
+                if x.sum() > 0:
+                    y = x * scale
+                else:
+                    y = x
+                return y
+
+            def bump():
+                nonlocal scale
+                scale = paddle.to_tensor(10.0)
+
+            return f, bump
+
+        f, bump = outer()
+        g = convert_to_static(f)
+        np.testing.assert_allclose(g(paddle.to_tensor([2.0])).numpy(), [2.0])
+        bump()
+        np.testing.assert_allclose(g(paddle.to_tensor([2.0])).numpy(), [20.0])
+
+
+class TestWhileConversion:
+    def test_while_eager(self):
+        def f(n):
+            i = paddle.to_tensor(0)
+            s = paddle.to_tensor(0)
+            while i < n:
+                s = s + i
+                i = i + 1
+            return s
+
+        g = convert_to_static(f)
+        assert int(g(paddle.to_tensor(5)).numpy()) == 10
+        assert int(f(paddle.to_tensor(5)).numpy()) == 10
+
+    def test_while_under_jit(self):
+        def f(n):
+            i = paddle.Tensor(jnp.asarray(0))
+            s = paddle.Tensor(jnp.asarray(0))
+            while i < n:
+                s = s + i
+                i = i + 1
+            return s
+
+        g = convert_to_static(f)
+        jf = jax.jit(lambda v: g(paddle.Tensor(v))._value)
+        assert int(jf(jnp.asarray(6))) == 15
+
+
+class TestLogicalOps:
+    def test_and_or_not_eager(self):
+        def f(x):
+            if (x.sum() > 0) & (x.max() < 10):
+                y = x + 1
+            else:
+                y = x - 1
+            return y
+
+        # also the converted `and` form
+        def f2(x):
+            if x.sum() > 0 and x.max() < 10:
+                y = x + 1
+            else:
+                y = x - 1
+            return y
+
+        g = convert_to_static(f2)
+        for v in ([1.0], [20.0], [-1.0]):
+            np.testing.assert_allclose(
+                g(paddle.to_tensor(v)).numpy(),
+                f(paddle.to_tensor(v)).numpy())
+
+    def test_and_under_jit(self):
+        def f(x):
+            if x.sum() > 0 and x.max() < 10:
+                y = x + 1
+            else:
+                y = x - 1
+            return y
+
+        g = convert_to_static(f)
+        jf = jax.jit(lambda v: g(paddle.Tensor(v))._value)
+        np.testing.assert_allclose(np.asarray(jf(jnp.asarray([1.0]))), [2.0])
+        np.testing.assert_allclose(np.asarray(jf(jnp.asarray([20.0]))), [19.0])
+
+    def test_python_bool_shortcircuit_kept(self):
+        calls = []
+
+        def side():
+            calls.append(1)
+            return True
+
+        def f(x, flag=False):
+            if flag and side():
+                y = x * 2
+            else:
+                y = x
+            return y
+
+        g = convert_to_static(f)
+        g(paddle.to_tensor([1.0]))
+        assert calls == []  # rhs never evaluated
+
+
+class TestLayerConversion:
+    def test_layer_with_tensor_if(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if h.sum() > 0:
+                    out = h * 2
+                else:
+                    out = h * -1
+                return out
+
+        paddle.seed(0)
+        net = Net()
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4).astype("float32"))
+        eager = net(x).numpy()
+        st = paddle.jit.to_static(Net())
+        st_net = st  # StaticFunction over a converted forward
+        paddle.seed(0)
+        net2 = Net()
+        net2.set_state_dict(net.state_dict())
+        st2 = paddle.jit.to_static(net2)
+        np.testing.assert_allclose(st2(x).numpy(), eager, rtol=1e-5, atol=1e-6)
+
+    def test_closure_function(self):
+        scale = paddle.to_tensor(3.0)
+
+        def f(x):
+            if x.sum() > 0:
+                y = x * scale
+            else:
+                y = x
+            return y
+
+        g = convert_to_static(f)
+        np.testing.assert_allclose(g(paddle.to_tensor([2.0])).numpy(), [6.0])
