@@ -67,6 +67,7 @@ from . import static  # noqa: E402
 from . import distributed  # noqa: E402
 from . import vision  # noqa: E402
 from . import text  # noqa: E402
+from . import dataset  # noqa: E402
 from . import utils  # noqa: E402
 from . import hapi  # noqa: E402
 from .hapi import Model  # noqa: E402,F401

@@ -1,0 +1,197 @@
+"""paddle.dataset reader-API compat — parity with
+python/paddle/dataset/ (mnist.py, cifar.py, imdb.py, imikolov.py,
+uci_housing.py, movielens.py, conll05.py, wmt14.py, wmt16.py, flowers.py).
+
+The reference's legacy data layer exposes *reader creators*:
+``paddle.dataset.mnist.train()`` returns a zero-arg callable (the reader),
+and calling THAT yields sample tuples — the two-level convention the old
+``fluid.io``/``paddle.batch`` pipeline composes over. Each creator here is a
+thin adapter over the map-style Datasets in ``paddle_tpu.vision/.text``
+(which already handle local files + zero-egress synthetic fallback), so
+legacy training scripts port unchanged while new code uses
+``paddle_tpu.io.DataLoader``. Submodules are registered in ``sys.modules``
+so ``import paddle_tpu.dataset.mnist`` works like the reference.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+
+
+def _reader_from(dataset_factory, transform=None):
+    """Build a reader: a zero-arg callable yielding transformed samples."""
+
+    def reader():
+        ds = dataset_factory()
+        for i in range(len(ds)):
+            sample = ds[i]
+            if transform is not None:
+                yield transform(sample)
+            elif isinstance(sample, (list, tuple)):
+                yield tuple(sample)
+            else:
+                yield sample
+
+    return reader
+
+
+def _creator(dataset_factory, transform=None):
+    """Reader *creator*: calling it returns the reader callable (the
+    reference's ``mnist.train()`` convention)."""
+
+    def create(*_a, **_k):
+        return _reader_from(dataset_factory, transform)
+
+    return create
+
+
+def _module(name, **attrs):
+    m = types.ModuleType(f"{__name__}.{name}")
+    for k, v in attrs.items():
+        setattr(m, k, v)
+    sys.modules[m.__name__] = m
+    return m
+
+
+def _flat_sample(sample):
+    """(image, label) → (1-D float32 image, int label) — the legacy layout."""
+    img, label = sample
+    return (np.asarray(img, np.float32).reshape(-1),
+            int(np.asarray(label).ravel()[0]))
+
+
+def _make_mnist():
+    from ..vision.datasets import MNIST
+
+    return _module(
+        "mnist",
+        train=_creator(lambda: MNIST(mode="train"), _flat_sample),
+        test=_creator(lambda: MNIST(mode="test"), _flat_sample),
+    )
+
+
+def _make_cifar():
+    from ..vision.datasets import Cifar10, Cifar100
+
+    return _module(
+        "cifar",
+        train10=_creator(lambda: Cifar10(mode="train"), _flat_sample),
+        test10=_creator(lambda: Cifar10(mode="test"), _flat_sample),
+        train100=_creator(lambda: Cifar100(mode="train"), _flat_sample),
+        test100=_creator(lambda: Cifar100(mode="test"), _flat_sample),
+    )
+
+
+def _make_uci_housing():
+    from ..text.datasets import UCIHousing
+
+    return _module(
+        "uci_housing",
+        train=_creator(lambda: UCIHousing(mode="train")),
+        test=_creator(lambda: UCIHousing(mode="test")),
+    )
+
+
+def _make_imdb():
+    from ..text.datasets import Imdb
+
+    def pair(sample):
+        doc, label = sample
+        return list(np.asarray(doc)), int(label)
+
+    return _module(
+        "imdb",
+        train=_creator(lambda: Imdb(mode="train"), pair),
+        test=_creator(lambda: Imdb(mode="test"), pair),
+        word_dict=lambda: Imdb(mode="train").word_idx,
+    )
+
+
+def _make_imikolov():
+    from ..text.datasets import Imikolov
+
+    def build_dict(min_word_freq=50):
+        return Imikolov(mode="train", min_word_freq=min_word_freq).word_idx
+
+    def train(word_idx=None, n=5, data_type="NGRAM"):
+        return _reader_from(
+            lambda: Imikolov(mode="train", data_type=data_type, window_size=n))
+
+    def test(word_idx=None, n=5, data_type="NGRAM"):
+        return _reader_from(
+            lambda: Imikolov(mode="test", data_type=data_type, window_size=n))
+
+    return _module("imikolov", build_dict=build_dict, train=train, test=test)
+
+
+def _make_movielens():
+    from ..text.datasets import Movielens
+
+    return _module(
+        "movielens",
+        train=_creator(lambda: Movielens(mode="train")),
+        test=_creator(lambda: Movielens(mode="test")),
+    )
+
+
+def _make_conll05():
+    from ..text.datasets import Conll05st
+
+    return _module(
+        "conll05",
+        test=_creator(lambda: Conll05st()),
+        get_dict=lambda: Conll05st().get_dict(),
+    )
+
+
+def _make_wmt14():
+    from ..text.datasets import WMT14
+
+    return _module(
+        "wmt14",
+        train=lambda dict_size=1000: _reader_from(
+            lambda: WMT14(mode="train", dict_size=dict_size)),
+        test=lambda dict_size=1000: _reader_from(
+            lambda: WMT14(mode="test", dict_size=dict_size)),
+    )
+
+
+def _make_wmt16():
+    from ..text.datasets import WMT16
+
+    return _module(
+        "wmt16",
+        train=lambda src_dict_size=1000, trg_dict_size=1000: _reader_from(
+            lambda: WMT16(mode="train", src_dict_size=src_dict_size,
+                          trg_dict_size=trg_dict_size)),
+        test=lambda src_dict_size=1000, trg_dict_size=1000: _reader_from(
+            lambda: WMT16(mode="test", src_dict_size=src_dict_size,
+                          trg_dict_size=trg_dict_size)),
+    )
+
+
+def _make_flowers():
+    from ..vision.datasets import Flowers
+
+    return _module(
+        "flowers",
+        train=_creator(lambda: Flowers(), _flat_sample),
+        test=_creator(lambda: Flowers(), _flat_sample),
+    )
+
+
+mnist = _make_mnist()
+cifar = _make_cifar()
+uci_housing = _make_uci_housing()
+imdb = _make_imdb()
+imikolov = _make_imikolov()
+movielens = _make_movielens()
+conll05 = _make_conll05()
+wmt14 = _make_wmt14()
+wmt16 = _make_wmt16()
+flowers = _make_flowers()
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov", "movielens",
+           "conll05", "wmt14", "wmt16", "flowers"]

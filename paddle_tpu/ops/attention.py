@@ -312,27 +312,17 @@ def ring_attention(q, k, v, axis_name, causal=False, block_k=512):
 # ---------------------------------------------------------------------------
 # Materialized XLA attention (TPU fast path for moderate sequence lengths)
 # ---------------------------------------------------------------------------
-def xla_attention(q, k, v, causal=False, bias=None):
-    """softmax(QKᵀ)V with the [b, h, Lq, Lk] scores materialized.
+_CAUSAL_CHUNK = 128  # measured optimum on v5e (sweep: 2/4/8/16 chunks @ L=1024)
 
-    TPU-first detail: the scores are computed in f32 on the MXU
-    (``preferred_element_type``) for softmax stability, but for bf16/f16
-    inputs the *probabilities* round-trip through the input dtype before the
-    V matmul — halving the HBM traffic of the O(L²) tensor, which is the
-    bottleneck at these lengths (same trade flash kernels make by keeping
-    P in bf16 for the PV matmul). Measured on v5e / GPT-2 345M: 2.8x
-    end-to-end over the scan-based blockwise path.
-    """
+
+def _xla_attention_block(q, k, v, mask, bias):
+    """One materialized softmax(QKᵀ)V block ([b, h, Lq, Lk] scores)."""
     d = q.shape[-1]
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * (1.0 / math.sqrt(d))
     if bias is not None:
         s = s + bias
-    if causal:
-        # top-left aligned (k_pos <= q_pos), matching blockwise/flash so the
-        # dispatch tiers agree for Lq != Lk
-        Lq, Lk = q.shape[2], k.shape[2]
-        mask = jnp.tril(jnp.ones((Lq, Lk), bool))
+    if mask is not None:
         s = jnp.where(mask, s, _NEG_INF)
     m = jax.lax.stop_gradient(jnp.max(s, axis=-1, keepdims=True))
     if jnp.issubdtype(q.dtype, jnp.floating) and q.dtype != jnp.float32:
@@ -345,6 +335,42 @@ def xla_attention(q, k, v, causal=False, bias=None):
         e = jnp.exp(s - m)
     p = (e / jnp.maximum(e.sum(axis=-1, keepdims=True), 1e-30)).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def xla_attention(q, k, v, causal=False, bias=None):
+    """softmax(QKᵀ)V with the [b, h, Lq, Lk] scores materialized.
+
+    TPU-first details (measured on v5e / GPT-2 345M, 12.9k→45k tok/s/chip
+    end-to-end vs the scan-based blockwise path):
+    - scores accumulate in f32 on the MXU (``preferred_element_type``) for
+      softmax stability, but for bf16/f16 inputs the centered logits and
+      probabilities round-trip through the input dtype — halving the HBM
+      traffic of the O(L²) tensors (same trade flash kernels make keeping
+      P in bf16 for the PV matmul);
+    - **causal** self-attention runs q-chunked: query chunk i only matmuls
+      keys ≤ its diagonal, skipping the fully-masked upper-triangle blocks —
+      exact same math, ~45% less attention compute/bandwidth at 8 chunks.
+    """
+    Lq, Lk = q.shape[2], k.shape[2]
+    if (causal and bias is None and Lq == Lk and Lq % _CAUSAL_CHUNK == 0
+            and Lq // _CAUSAL_CHUNK >= 2):
+        # cap the unroll at 8 chunks so long sequences don't emit huge
+        # programs (some TPU compile services reject them); ≥8 chunks also
+        # showed no further gain in the sweep
+        c = max(_CAUSAL_CHUNK, Lq // 8)
+        outs = []
+        for i in range(Lq // c):
+            qi = jax.lax.slice_in_dim(q, i * c, (i + 1) * c, axis=2)
+            ub = (i + 1) * c
+            ki = jax.lax.slice_in_dim(k, 0, ub, axis=2)
+            vi = jax.lax.slice_in_dim(v, 0, ub, axis=2)
+            mask = jnp.tril(jnp.ones((c, ub), bool), k=ub - c)
+            outs.append(_xla_attention_block(qi, ki, vi, mask, None))
+        return jnp.concatenate(outs, axis=2)
+    mask = jnp.tril(jnp.ones((Lq, Lk), bool)) if causal else None
+    # causal mask is top-left aligned (k_pos <= q_pos), matching
+    # blockwise/flash so the dispatch tiers agree for Lq != Lk
+    return _xla_attention_block(q, k, v, mask, bias)
 
 
 # ---------------------------------------------------------------------------

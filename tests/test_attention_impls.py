@@ -44,6 +44,19 @@ class TestXlaAttention:
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=0.05, atol=0.05)
 
+    def test_chunked_causal_path_exact(self, rng):
+        # L=256 crosses the q-chunk threshold (2 chunks of 128): the chunked
+        # causal path must be numerically identical to the single-block form
+        q, k, v = rand_qkv(rng, L=256, d=16)
+        a = att.xla_attention(q, k, v, causal=True)
+        b = att._xla_attention_block(
+            q, k, v, jnp.tril(jnp.ones((256, 256), bool)), None)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+        c = att.blockwise_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=2e-5, atol=2e-5)
+
     def test_bias(self, rng):
         q, k, v = rand_qkv(rng, L=16, d=8)
         bias = jnp.asarray(rng.randn(1, 1, 16, 16), jnp.float32)

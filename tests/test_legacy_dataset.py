@@ -1,0 +1,92 @@
+"""Legacy paddle.dataset reader-creator API (python/paddle/dataset parity):
+each creator returns a generator of sample tuples with the reference's
+shapes, usable by legacy reader-loop training scripts."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import dataset
+
+
+def take(reader, n):
+    """reader creators return a CALLABLE reader (the legacy two-level
+    convention); iterate by calling it."""
+    out = []
+    for i, s in enumerate(reader()):
+        if i >= n:
+            break
+        out.append(s)
+    return out
+
+
+class TestReaders:
+    def test_mnist(self):
+        # the reference convention: train() -> reader; reader() -> generator
+        reader = dataset.mnist.train()
+        assert callable(reader)
+        samples = take(reader, 3)
+        img, label = samples[0]
+        assert img.shape == (784,) and img.dtype == np.float32
+        assert isinstance(label, int) and 0 <= label <= 9
+
+    def test_cifar(self):
+        img, label = take(dataset.cifar.train10(), 1)[0]
+        assert img.shape == (3 * 32 * 32,)
+        img, label = take(dataset.cifar.train100(), 1)[0]
+        assert 0 <= label <= 99
+
+    def test_uci_housing(self):
+        x, y = take(dataset.uci_housing.train(), 1)[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_imdb(self):
+        doc, label = take(dataset.imdb.train(), 1)[0]
+        assert isinstance(doc, list) and label in (0, 1)
+        assert len(dataset.imdb.word_dict()) > 0
+
+    def test_imikolov(self):
+        s = take(dataset.imikolov.train(n=5), 1)[0]
+        assert len(s) == 5
+        assert len(dataset.imikolov.build_dict(min_word_freq=1)) >= len(
+            dataset.imikolov.build_dict(min_word_freq=3))
+
+    def test_submodule_import(self):
+        import paddle_tpu.dataset.mnist as m
+        assert callable(m.train)
+
+    def test_movielens(self):
+        row = take(dataset.movielens.train(), 1)[0]
+        assert len(row) == 8
+
+    def test_conll05(self):
+        s = take(dataset.conll05.test(), 1)[0]
+        assert len(s) == 9
+        wd, pd, ld = dataset.conll05.get_dict()
+        assert len(wd) > 0
+
+    def test_wmt(self):
+        src, trg, nxt = take(dataset.wmt14.train(dict_size=64), 1)[0]
+        assert trg[0] == 0
+        src, trg, nxt = take(dataset.wmt16.train(64, 64), 1)[0]
+        assert nxt[-1] == 1
+
+    def test_legacy_training_loop(self):
+        """The old reader-loop style trains end-to-end."""
+        from paddle_tpu import nn, optimizer
+
+        net = nn.Linear(13, 1)
+        opt = optimizer.SGD(0.05, parameters=net.parameters())
+        losses = []
+        for epoch in range(3):
+            batch = []
+            for x, y in dataset.uci_housing.train()():
+                batch.append((x, y))
+                if len(batch) == 32:
+                    xb = paddle.to_tensor(np.stack([b[0] for b in batch]))
+                    yb = paddle.to_tensor(np.stack([b[1] for b in batch]))
+                    loss = ((net(xb) - yb) ** 2).mean()
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+                    losses.append(float(loss.numpy()))
+                    batch = []
+        assert losses[-1] < losses[0]
