@@ -95,6 +95,98 @@ class Executor:
         return [Tensor(o) for o in outs]
 
     # ------------------------------------------------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Dataset-driven training (reference call stack §3.4:
+        Executor.train_from_dataset → trainer/DeviceWorker loop,
+        fluid/executor.py:1433). Iterates the dataset's parsed batches,
+        builds a feed per batch from the program's feed vars ↔ slot names,
+        and replays the compiled step for each. Returns the last fetch
+        values (if any)."""
+        if dataset is None:
+            raise InvalidArgumentError("dataset is required")
+        program = program if isinstance(program, Program) else (
+            getattr(program, "_program", None) or default_main_program()
+        )
+        fetch_list = fetch_list or []
+        feed_names = list(program.feed_vars)
+        last = None
+        step = 0
+        for batch in dataset:
+            feed = {}
+            for name in feed_names:
+                if name not in batch:
+                    raise InvalidArgumentError(
+                        f"dataset batch has no slot '{name}' for feed var "
+                        f"(slots: {sorted(batch)})")
+                feed[name] = self._slot_to_array(
+                    batch[name], program.feed_vars[name],
+                    program.declared_shapes.get(name))
+            last = self.run(program, feed=feed, fetch_list=fetch_list)
+            step += 1
+            if debug or (fetch_list and step % print_period == 0):
+                vals = ", ".join(f"{float(np.asarray(v).ravel()[0]):.6f}"
+                                 for v in last)
+                print(f"[train_from_dataset] step {step}: {vals}")
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Inference twin of train_from_dataset (fluid/executor.py:1385):
+        runs a for_test clone so no optimizer update is applied. The clone
+        is cached per source program — cloning per call would recompile and
+        leak a cache entry every time."""
+        program = program if isinstance(program, Program) else (
+            getattr(program, "_program", None) or default_main_program()
+        )
+        if not hasattr(self, "_infer_clones"):
+            self._infer_clones = {}
+        key = id(program)
+        if key not in self._infer_clones:
+            self._infer_clones[key] = program.clone(for_test=True)
+        return self.train_from_dataset(self._infer_clones[key], dataset,
+                                       scope, thread, debug, fetch_list,
+                                       fetch_info, print_period)
+
+    @staticmethod
+    def _pad_target(feed_var, declared, batch_max: int) -> int:
+        """Time dim to pad to: the feed var's declared dim, or the batch max
+        when that dim was declared dynamic (None/-1)."""
+        shape = declared if declared is not None else list(feed_var.shape)
+        if len(shape) > 1:
+            d = shape[1]
+            if d is not None and (not isinstance(d, int) or d > 0):
+                return int(d)
+        return batch_max
+
+    @staticmethod
+    def _slot_to_array(slot, feed_var, declared=None):
+        """Dense slot rows stack; ragged slots pad to the feed var's declared
+        time dim (LoD → padded+mask ragged form, SURVEY §7 map). Returns
+        numpy — run() moves it to device once."""
+        from ..io.data_feed import RaggedSlot
+
+        if isinstance(slot, RaggedSlot):
+            t = Executor._pad_target(feed_var, declared,
+                                     int(slot.lengths().max()))
+            padded, _ = slot.to_padded(t)
+            return padded
+        if isinstance(slot, np.ndarray):
+            return slot
+        rows = [np.asarray(r) for r in slot]
+        if rows and any(r.shape != rows[0].shape for r in rows):
+            # ragged list-of-rows (InMemoryDataset form): pad
+            t = Executor._pad_target(feed_var, declared,
+                                     max(len(r) for r in rows))
+            out = np.zeros((len(rows), t), rows[0].dtype)
+            for i, r in enumerate(rows):
+                out[i, : min(len(r), t)] = r[:t]
+            return out
+        return np.stack(rows)
+
+    # ------------------------------------------------------------------
     def _compile(self, program: Program, fetch_ids: List[int]):
         replay = program.build_replay()
         param_items = list(program.parameters.items())

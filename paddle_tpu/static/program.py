@@ -48,6 +48,7 @@ class Program:
         self._grad_map: Dict[int, Tensor] = {}  # param id -> grad placeholder
         self.random_seed = 0
         self._appended_backward = False
+        self.declared_shapes: Dict[str, list] = {}  # feed name -> user shape
 
     # ------------------------------------------------------------- recording
     def record_op(self, fn, args, outs, multi_out, name=""):
@@ -121,6 +122,7 @@ class Program:
         p.parameters = dict(self.parameters)
         p._var_refs = dict(self._var_refs)
         p._optimize = None if for_test else self._optimize
+        p.declared_shapes = dict(self.declared_shapes)
         return p
 
     def all_parameters(self):
@@ -216,11 +218,14 @@ def data(name, shape, dtype="float32", lod_level=0):
 
     from ..core import dtype as dtype_mod
 
+    declared = list(shape)
     shape = [1 if (s is None or (isinstance(s, int) and s < 0)) else int(s) for s in shape]
     d = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
     t = Tensor(jnp.zeros(tuple(shape), d), stop_gradient=True, name=name)
     prog = default_main_program()
     prog.add_feed_var(name, t)
+    # keep None/-1 dims distinguishable from literal 1 (ragged pad targets)
+    prog.declared_shapes[name] = declared
     return t
 
 

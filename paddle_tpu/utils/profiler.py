@@ -9,7 +9,9 @@ a full XLA trace viewable in TensorBoard/perfetto.
 from __future__ import annotations
 
 import contextlib
+import json
 import os
+import threading
 import time
 from collections import defaultdict
 
@@ -21,6 +23,8 @@ __all__ = [
 ]
 
 _host_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+_host_spans = []  # (name, start_us, dur_us, tid) for the chrome timeline
+_spans_active = False  # spans record only inside a profiling window
 _trace_dir = None
 
 
@@ -38,11 +42,33 @@ class RecordEvent:
 
     def __exit__(self, *exc):
         self._ann.__exit__(*exc)
-        dt = time.perf_counter() - self._t0
+        t1 = time.perf_counter()
+        dt = t1 - self._t0
         ev = _host_events[self.name]
         ev[0] += 1
         ev[1] += dt
+        if _spans_active:  # unbounded outside a window ⇒ gated
+            _host_spans.append((self.name, self._t0 * 1e6, dt * 1e6,
+                                threading.get_ident()))
         return False
+
+
+def export_chrome_tracing(path: str):
+    """Write the host event spans as a chrome://tracing (catapult) JSON —
+    the role of the reference's protobuf timeline (platform/profiler.proto →
+    chrome timeline); the device-side kernel timeline is the jax trace in
+    ``log_dir`` (TensorBoard/perfetto)."""
+    events = [
+        {"name": name, "ph": "X", "ts": ts, "dur": dur,
+         "pid": os.getpid(), "tid": tid, "cat": "host"}
+        for name, ts, dur, tid in _host_spans
+    ]
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
 
 
 @contextlib.contextmanager
@@ -52,13 +78,17 @@ def record_event(name):
 
 
 def start_profiler(state="All", tracer_option="Default", log_dir="./profiler_log"):
-    global _trace_dir
+    global _trace_dir, _spans_active
     _trace_dir = log_dir
+    _host_spans.clear()  # export covers THIS window, not process lifetime
+    _spans_active = True
     os.makedirs(log_dir, exist_ok=True)
     jax.profiler.start_trace(log_dir)
 
 
 def stop_profiler(sorted_key="total", profile_path="/tmp/profile"):
+    global _spans_active
+    _spans_active = False
     jax.profiler.stop_trace()
     summary = profiler_summary(sorted_key)
     print(summary)
@@ -98,19 +128,26 @@ class Profiler:
         self._running = True
 
     def stop(self):
+        global _spans_active
         if self._running:
+            _spans_active = False
             jax.profiler.stop_trace()
             self._running = False
 
     def step(self, num_samples=None):
-        pass
+        self._step_count = getattr(self, "_step_count", 0) + 1
+        if _spans_active:
+            _host_spans.append((f"ProfilerStep#{self._step_count}",
+                                time.perf_counter() * 1e6, 0.0,
+                                threading.get_ident()))
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
         return profiler_summary()
 
     def export(self, path, format="json"):
-        pass
+        """Chrome-tracing JSON of host spans (device trace is in log_dir)."""
+        return export_chrome_tracing(path)
 
     def __enter__(self):
         self.start()
