@@ -5,6 +5,7 @@ fleet.init / DistributedStrategy / distributed_optimizer / distributed_model,
 over the TPU mesh instead of NCCL rings.
 """
 from . import mesh_utils  # noqa: F401
+from .form_mesh import strategy_mesh  # noqa: F401
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
 from .distributed_strategy import DistributedStrategy  # noqa: F401
 from .fleet_base import (  # noqa: F401

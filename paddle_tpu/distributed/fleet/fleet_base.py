@@ -149,6 +149,34 @@ class Fleet:
             return opt.minimize(loss)
         return None, None
 
+    def create_train_step(self, model, loss_fn, optimizer=None, mesh=None,
+                          compute_dtype=None, **kw):
+        """Compile one distributed train step per the active
+        DistributedStrategy — the StrategyCompiler role
+        (fleet/base/strategy_compiler.py): picks the GSPMD engine, a
+        LocalSGD/DGC/gradient-merge shard_map engine, ZeRO stage/offload,
+        AMP compute dtype, and recompute from the strategy flags."""
+        from jax import numpy as jnp
+
+        from .form_mesh import strategy_mesh
+        from .meta_strategies import create_strategy_train_step
+
+        opt = optimizer or self._user_defined_optimizer
+        if hasattr(opt, "_inner_opt"):
+            opt = opt._inner_opt  # HybridParallelOptimizer wrapper
+        if mesh is None:
+            # the mesh fleet.init installed (same axis order as the
+            # topology/hcg); strategy_mesh only when init never ran
+            mesh = mesh_utils.get_mesh() or strategy_mesh(self._strategy)
+        if compute_dtype is None and self._strategy is not None:
+            amp_cfg = self._strategy.amp_configs
+            if self._strategy.amp:
+                compute_dtype = (jnp.bfloat16 if amp_cfg.get("use_bf16", True)
+                                 else jnp.float16)
+        return create_strategy_train_step(model, loss_fn, opt, mesh,
+                                          self._strategy,
+                                          compute_dtype=compute_dtype, **kw)
+
     # ------------------------------------------------------------ checkpoint
     def save_persistables(self, executor=None, dirname=None, main_program=None,
                           mode=0):

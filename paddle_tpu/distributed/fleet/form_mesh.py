@@ -1,0 +1,56 @@
+"""Strategy → Mesh: build the device mesh the DistributedStrategy's
+hybrid_configs describe (the reference's HybridCommunicateGroup topology
+construction, fleet/base/topology.py:35,111 — here a jax.sharding.Mesh with
+named axes instead of rank groups)."""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from . import mesh_utils
+
+__all__ = ["strategy_mesh"]
+
+_AXIS_KEYS = [  # (hybrid_configs key, mesh axis name) — SAME ORDER as
+    # Fleet.init's mesh so device coordinates agree with the topology/hcg
+    ("dp_degree", "dp"),
+    ("pp_degree", "pp"),
+    ("sharding_degree", "sharding"),
+    ("mp_degree", "mp"),
+    ("sp_degree", "sp"),
+]
+
+
+def strategy_mesh(strategy=None, devices=None) -> Mesh:
+    """Mesh from hybrid_configs; unset/1 axes are dropped, dp_degree=-1
+    absorbs the remaining devices. Falls back to the process-global mesh,
+    else all devices on one 'dp' axis."""
+    if strategy is None:
+        m = mesh_utils.get_mesh()
+        if m is not None:
+            return m
+        return mesh_utils.init_mesh()
+    devs = np.array(devices if devices is not None else jax.devices())
+    hc = strategy.hybrid_configs
+    sizes, names = [], []
+    for key, axis in _AXIS_KEYS:
+        d = int(hc.get(key, 1) or 1)
+        if d == -1 or d > 1:
+            sizes.append(d)
+            names.append(axis)
+    if not sizes:
+        return Mesh(devs, ("dp",))
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = max(1, len(devs) // known)
+    total = int(np.prod(sizes))
+    if total != len(devs):
+        if len(devs) == 1:
+            # single-device escape hatch (matches Fleet.init): degrees are
+            # kept as config intent, the mesh degenerates to one chip
+            return Mesh(devs, ("dp",))
+        raise ValueError(
+            f"hybrid_configs axes {dict(zip(names, sizes))} need {total} "
+            f"devices but {len(devs)} are visible")
+    return Mesh(devs.reshape(sizes), tuple(names))

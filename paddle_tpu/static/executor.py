@@ -143,23 +143,38 @@ class Executor:
         )
         if not hasattr(self, "_infer_clones"):
             self._infer_clones = {}
-        key = id(program)
-        if key not in self._infer_clones:
-            self._infer_clones[key] = program.clone(for_test=True)
-        return self.train_from_dataset(self._infer_clones[key], dataset,
+        # key on op count too (programs mutate after first use, like run()'s
+        # cache), and keep the SOURCE program referenced so a freed id can't
+        # alias a different program to a stale clone
+        key = (id(program), len(program.ops))
+        entry = self._infer_clones.get(key)
+        if entry is None or entry[0] is not program:
+            entry = (program, program.clone(for_test=True))
+            self._infer_clones[key] = entry
+        return self.train_from_dataset(entry[1], dataset,
                                        scope, thread, debug, fetch_list,
                                        fetch_info, print_period)
 
     @staticmethod
+    def _bucket(n: int) -> int:
+        """Next power-of-two ≥ n (min 16)."""
+        b = 16
+        while b < n:
+            b *= 2
+        return b
+
+    @staticmethod
     def _pad_target(feed_var, declared, batch_max: int) -> int:
-        """Time dim to pad to: the feed var's declared dim, or the batch max
-        when that dim was declared dynamic (None/-1)."""
+        """Time dim to pad to: the feed var's declared dim; for a dynamic
+        (None/-1) dim, the batch max BUCKETED to a power of two — tracking
+        each batch's exact max would give almost every batch a fresh feed
+        shape and thus a fresh XLA compile."""
         shape = declared if declared is not None else list(feed_var.shape)
         if len(shape) > 1:
             d = shape[1]
             if d is not None and (not isinstance(d, int) or d > 0):
                 return int(d)
-        return batch_max
+        return Executor._bucket(batch_max)
 
     @staticmethod
     def _slot_to_array(slot, feed_var, declared=None):

@@ -19,7 +19,7 @@ import jax
 
 __all__ = [
     "RecordEvent", "record_event", "start_profiler", "stop_profiler",
-    "profiler", "Profiler",
+    "profiler", "Profiler", "export_chrome_tracing",
 ]
 
 _host_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
@@ -90,6 +90,9 @@ def stop_profiler(sorted_key="total", profile_path="/tmp/profile"):
     global _spans_active
     _spans_active = False
     jax.profiler.stop_trace()
+    if profile_path:
+        # reference semantics: the timeline lands at profile_path
+        export_chrome_tracing(profile_path)
     summary = profiler_summary(sorted_key)
     print(summary)
     return summary

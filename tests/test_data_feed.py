@@ -250,4 +250,18 @@ class TestTrainFromDataset:
         arr = exe._slot_to_array(batch["ids"], prog.feed_vars["ids"],
                                  prog.declared_shapes.get("ids"))
         maxlen = max(len(r) for r in batch["ids"].rows())
-        assert arr.shape == (8, maxlen) and maxlen >= 2
+        # padded to the BUCKETED max (not the placeholder dim of 1, and not
+        # the raw max — that would recompile per batch)
+        assert arr.shape == (8, exe._bucket(maxlen)) and maxlen >= 2
+        got = arr[:, :maxlen]
+        for i, r in enumerate(batch["ids"].rows()):
+            np.testing.assert_array_equal(got[i, :len(r)], r)
+
+    def test_dynamic_pad_is_bucketed(self, tmp_path, rng):
+        """Dynamic dims bucket to powers of two so varying batch max lengths
+        reuse one compiled shape instead of recompiling per batch."""
+        from paddle_tpu import static
+
+        assert static.Executor._bucket(1) == 16
+        assert static.Executor._bucket(17) == 32
+        assert static.Executor._bucket(64) == 64

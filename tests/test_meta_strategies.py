@@ -443,3 +443,60 @@ class TestStrategyFactory:
             m, loss_fn, optimizer.SGD(0.1, m.parameters()), dp_mesh(),
             DistributedStrategy())
         assert isinstance(step, ParallelTrainStep)
+
+
+class TestFleetFacadeTrainStep:
+    """fleet.init + strategy -> fleet.create_train_step builds the right
+    engine on the mesh the strategy's hybrid_configs describe."""
+
+    def test_strategy_mesh_from_hybrid_configs(self):
+        from paddle_tpu.distributed.fleet.form_mesh import strategy_mesh
+
+        s = DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": -1, "mp_degree": 2}
+        mesh = strategy_mesh(s)
+        assert mesh.axis_names == ("dp", "mp")
+        assert mesh.shape["mp"] == 2 and mesh.shape["dp"] == 4
+
+    def test_strategy_mesh_size_mismatch_raises(self):
+        from paddle_tpu.distributed.fleet.form_mesh import strategy_mesh
+
+        s = DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 3, "mp_degree": 5}
+        with pytest.raises(ValueError, match="devices"):
+            strategy_mesh(s)
+
+    def test_fleet_create_train_step_end_to_end(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        paddle.seed(4)
+        strategy = DistributedStrategy()
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        fleet.init(is_collective=True, strategy=strategy)
+        m = MLP()
+        opt = fleet.distributed_optimizer(
+            optimizer.SGD(0.1, parameters=m.parameters()))
+        step = fleet.fleet_base.fleet.create_train_step(m, loss_fn)
+        assert isinstance(step, DPStrategyTrainStep)
+        rng = np.random.RandomState(0)
+        x, y = make_batch(rng)
+        assert np.isfinite(float(step((x,), (y,)).numpy()))
+
+    def test_fleet_amp_strategy_sets_compute_dtype(self):
+        import jax.numpy as jnp
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.distributed.fleet.engine import ParallelTrainStep
+
+        paddle.seed(4)
+        strategy = DistributedStrategy()
+        strategy.amp = True
+        fleet.init(is_collective=True, strategy=strategy)
+        m = MLP()
+        fleet.distributed_optimizer(
+            optimizer.SGD(0.1, parameters=m.parameters()))
+        step = fleet.fleet_base.fleet.create_train_step(m, loss_fn)
+        assert isinstance(step, ParallelTrainStep)
+        rng = np.random.RandomState(0)
+        x, y = make_batch(rng)
+        assert np.isfinite(float(step((x,), (y,)).numpy()))
