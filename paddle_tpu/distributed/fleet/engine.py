@@ -123,6 +123,7 @@ class ParallelTrainStep:
         self._apply = functionalize(layer, training=True)
         self._named_params = dict(layer.named_parameters())
         self._zero = zero_stage
+        self._compute_dtype = compute_dtype
         self._dirty = True
 
         params_host = get_params(layer)

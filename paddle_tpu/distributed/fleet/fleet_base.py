@@ -123,6 +123,15 @@ class Fleet:
         return self._hcg
 
     # ------------------------------------------------------ optimizer / model
+    @property
+    def util(self):
+        """fleet.util (UtilBase parity): worker collectives + file shards."""
+        from .util import UtilBase
+
+        if not hasattr(self, "_util"):
+            self._util = UtilBase(self)
+        return self._util
+
     def distributed_optimizer(self, optimizer, strategy=None):
         if strategy is not None:
             self._strategy = strategy

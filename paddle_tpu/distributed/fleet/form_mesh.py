@@ -30,7 +30,10 @@ def strategy_mesh(strategy=None, devices=None) -> Mesh:
         m = mesh_utils.get_mesh()
         if m is not None:
             return m
-        return mesh_utils.init_mesh()
+        # ephemeral mesh: installing a global one here would be a hidden
+        # side effect changing every later get_mesh() caller
+        devs = np.array(devices if devices is not None else jax.devices())
+        return Mesh(devs, ("dp",))
     devs = np.array(devices if devices is not None else jax.devices())
     hc = strategy.hybrid_configs
     sizes, names = [], []

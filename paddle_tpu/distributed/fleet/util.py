@@ -1,0 +1,69 @@
+"""fleet.util — parity with UtilBase (fleet/base/util_factory.py): worker
+collectives outside the training graph + file sharding helpers."""
+from __future__ import annotations
+
+import os
+from typing import List
+
+import numpy as np
+
+__all__ = ["UtilBase"]
+
+
+class UtilBase:
+    def __init__(self, fleet=None):
+        self._fleet = fleet
+
+    # -- collectives over workers (host-side, small payloads) --------------
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        from .. import all_reduce as dist_all_reduce, get_world_size
+        from ..communication import ReduceOp
+
+        if get_world_size() <= 1:
+            arr = np.asarray(input)
+            return arr
+        import jax.numpy as jnp
+
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}[mode]
+        return np.asarray(dist_all_reduce(jnp.asarray(np.asarray(input)),
+                                          op=op))
+
+    def all_gather(self, input, comm_world="worker") -> List:
+        from .. import get_world_size
+
+        if get_world_size() <= 1:
+            return [input]
+        from jax.experimental import multihost_utils
+
+        stacked = multihost_utils.process_allgather(np.asarray(input))
+        return [stacked[i] for i in range(stacked.shape[0])]
+
+    def barrier(self, comm_world="worker"):
+        from .. import barrier as dist_barrier
+
+        dist_barrier()
+
+    # -- file sharding (util_factory.py:get_file_shard) --------------------
+    def get_file_shard(self, files: List[str]) -> List[str]:
+        """Split ``files`` contiguously over workers: the first
+        ``len(files) % n`` workers take one extra (reference semantics)."""
+        from ..parallel import get_rank, get_world_size
+
+        n = max(get_world_size(), 1)
+        rank = get_rank() or 0
+        base = len(files) // n
+        extra = len(files) % n
+        if rank < extra:
+            start = rank * (base + 1)
+            end = start + base + 1
+        else:
+            start = extra * (base + 1) + (rank - extra) * base
+            end = start + base
+        return files[start:end]
+
+    def print_on_rank(self, message: str, rank_id: int = 0):
+        from ..parallel import get_rank
+
+        if (get_rank() or 0) == rank_id:
+            print(message)

@@ -1,2 +1,3 @@
 """fleet.utils — recompute + fs helpers (parity fleet/utils/)."""
 from .recompute import recompute  # noqa: F401
+from .fs import FS, HDFSClient, LocalFS  # noqa: F401

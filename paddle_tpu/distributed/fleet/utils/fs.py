@@ -1,0 +1,199 @@
+"""Filesystem utilities — parity with fleet/utils/fs.py (LocalFS + HDFS).
+
+The reference ships a LocalFS and an HDFS client (shelling out to ``hadoop
+fs``) used by auto-checkpoint and PS save paths. LocalFS is fully native
+here; HDFS keeps the same surface and drives the ``hadoop`` CLI when one is
+on PATH (zero-egress images without Hadoop raise a clear error on use).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+__all__ = ["FS", "LocalFS", "HDFSClient"]
+
+
+class ExecuteError(RuntimeError):
+    pass
+
+
+class FS:
+    def ls_dir(self, path) -> Tuple[List[str], List[str]]:
+        raise NotImplementedError
+
+    def is_file(self, path) -> bool:
+        raise NotImplementedError
+
+    def is_dir(self, path) -> bool:
+        raise NotImplementedError
+
+    def is_exist(self, path) -> bool:
+        raise NotImplementedError
+
+    def mkdirs(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+    def touch(self, path, exist_ok=True):
+        raise NotImplementedError
+
+    def mv(self, src, dst, overwrite=False):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local filesystem with the reference's method surface (fs.py:LocalFS)."""
+
+    def ls_dir(self, path):
+        if not self.is_exist(path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, name)) else files).append(name)
+        return dirs, files
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def touch(self, path, exist_ok=True):
+        if os.path.exists(path):
+            if not exist_ok:
+                raise ExecuteError(f"{path} exists")
+            return
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        open(path, "a").close()
+
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(src):
+            raise ExecuteError(f"{src} does not exist")
+        if self.is_exist(dst):
+            if not overwrite:
+                raise ExecuteError(f"{dst} exists and overwrite=False")
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def upload(self, local_path, fs_path):
+        try:
+            if os.path.isdir(local_path):
+                shutil.copytree(local_path, fs_path, dirs_exist_ok=True)
+            else:
+                shutil.copy2(local_path, fs_path)
+        except OSError as e:
+            raise ExecuteError(f"copy {local_path} -> {fs_path}: {e}") from e
+
+    download = upload
+
+    def list_dirs(self, path):
+        return self.ls_dir(path)[0]
+
+
+class HDFSClient(FS):
+    """``hadoop fs`` CLI client (fs.py:HDFSClient surface)."""
+
+    def __init__(self, hadoop_home: Optional[str] = None, configs=None,
+                 time_out=5 * 60 * 1000, sleep_inter=1000):
+        self._hadoop = (os.path.join(hadoop_home, "bin", "hadoop")
+                        if hadoop_home else shutil.which("hadoop"))
+        self._configs = configs or {}
+        self._timeout_s = max(time_out, 1000) / 1000.0
+
+    def _run(self, *args) -> str:
+        if not self._hadoop:
+            raise ExecuteError(
+                "hadoop CLI not found — set hadoop_home or install Hadoop")
+        cfg = []
+        for k, v in self._configs.items():
+            cfg += ["-D", f"{k}={v}"]
+        cmd = [self._hadoop, "fs", *cfg, *args]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=self._timeout_s)
+        except subprocess.TimeoutExpired as e:
+            raise ExecuteError(
+                f"{' '.join(cmd)} timed out after {self._timeout_s:.0f}s") from e
+        if proc.returncode != 0:
+            raise ExecuteError(f"{' '.join(cmd)} failed: {proc.stderr}")
+        return proc.stdout
+
+    def ls_dir(self, path):
+        out = self._run("-ls", path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_exist(self, path):
+        try:
+            self._run("-test", "-e", path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, path):
+        try:
+            self._run("-test", "-f", path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, path):
+        try:
+            self._run("-test", "-d", path)
+            return True
+        except ExecuteError:
+            return False
+
+    def mkdirs(self, path):
+        self._run("-mkdir", "-p", path)
+
+    def delete(self, path):
+        self._run("-rm", "-r", "-f", path)
+
+    def touch(self, path, exist_ok=True):
+        if self.is_exist(path):
+            if not exist_ok:
+                raise ExecuteError(f"{path} exists")
+            return
+        self._run("-touchz", path)
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        self._run("-mv", src, dst)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)

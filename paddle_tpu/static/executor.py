@@ -116,6 +116,8 @@ class Executor:
         for batch in dataset:
             feed = {}
             for name in feed_names:
+                if name.endswith("_length") and name[:-7] in batch:
+                    continue  # filled from its base slot below
                 if name not in batch:
                     raise InvalidArgumentError(
                         f"dataset batch has no slot '{name}' for feed var "
@@ -123,6 +125,22 @@ class Executor:
                 feed[name] = self._slot_to_array(
                     batch[name], program.feed_vars[name],
                     program.declared_shapes.get(name))
+                # padded form alone loses the row lengths; a feed var named
+                # '<slot>_length' receives them so mask-aware programs
+                # (sequence_* ops) see exact ragged semantics despite
+                # bucketed padding
+                lname = f"{name}_length"
+                if lname in program.feed_vars:
+                    from ..io.data_feed import RaggedSlot
+
+                    slot = batch[name]
+                    if isinstance(slot, RaggedSlot):
+                        feed[lname] = slot.lengths().astype(np.int64)
+                    else:
+                        rows = (slot if isinstance(slot, np.ndarray)
+                                else [np.asarray(r) for r in slot])
+                        feed[lname] = np.asarray(
+                            [len(r) for r in rows], np.int64)
             last = self.run(program, feed=feed, fetch_list=fetch_list)
             step += 1
             if debug or (fetch_list and step % print_period == 0):
@@ -143,15 +161,15 @@ class Executor:
         )
         if not hasattr(self, "_infer_clones"):
             self._infer_clones = {}
-        # key on op count too (programs mutate after first use, like run()'s
-        # cache), and keep the SOURCE program referenced so a freed id can't
-        # alias a different program to a stale clone
-        key = (id(program), len(program.ops))
-        entry = self._infer_clones.get(key)
-        if entry is None or entry[0] is not program:
-            entry = (program, program.clone(for_test=True))
-            self._infer_clones[key] = entry
-        return self.train_from_dataset(entry[1], dataset,
+        # one entry per live program (strong ref prevents id aliasing),
+        # replaced when the program mutated (op count changed) — keying on
+        # the op count itself would pin every historical clone forever
+        entry = self._infer_clones.get(id(program))
+        if (entry is None or entry[0] is not program
+                or entry[1] != len(program.ops)):
+            entry = (program, len(program.ops), program.clone(for_test=True))
+            self._infer_clones[id(program)] = entry
+        return self.train_from_dataset(entry[2], dataset,
                                        scope, thread, debug, fetch_list,
                                        fetch_info, print_period)
 

@@ -53,22 +53,38 @@ def _lengths_raw(lengths):
     return l.astype(jnp.int32) if l.dtype not in (jnp.int32, jnp.int64) else l
 
 
+def _lengths_arg(lengths) -> Tensor:
+    """Lengths as a Tensor, PRESERVING identity when one is passed — a
+    re-wrapped copy would break static Program recording (the recorded op
+    would reference a tensor the replay env never binds, silently replaying
+    the build-time placeholder value). Dtype normalization happens inside
+    each op's fn instead."""
+    if isinstance(lengths, Tensor):
+        return lengths
+    return wrap_raw(jnp.asarray(lengths))
+
+
+def _int_lens(lens):
+    return lens.astype(jnp.int32) if lens.dtype not in (
+        jnp.int32, jnp.int64) else lens
+
+
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     """mask[i, j] = j < x[i]. Parity: sequence_mask_op.cc / paddle.nn.functional.
 
     ``maxlen=None`` uses max(x) — that makes the output shape data-dependent,
     so under jit pass an explicit ``maxlen``.
     """
-    lens = _lengths_raw(x)
     if maxlen is None:
-        maxlen = int(jnp.max(lens))
+        maxlen = int(jnp.max(_lengths_raw(x)))
     d = dtype_mod.convert_dtype(dtype)
 
     def fn(lens):
+        lens = _int_lens(lens)
         pos = jnp.arange(maxlen, dtype=lens.dtype)
         return (pos[None, :] < lens[..., None]).astype(d)
 
-    return apply_op(fn, wrap_raw(lens), op_name="sequence_mask")
+    return apply_op(fn, _lengths_arg(x), op_name="sequence_mask")
 
 
 def _rows_of(x, lengths):
@@ -122,9 +138,9 @@ def sequence_pool(x, pool_type: str, lengths=None, pad_value=0.0, name=None):
     if lengths is None:
         raise ValueError("sequence_pool needs lengths (padded+lengths ragged form)")
     pool_type = pool_type.lower()
-    lens = _lengths_raw(lengths)
 
     def fn(data, lens):
+        lens = _int_lens(lens)
         t = data.shape[1]
         pos = jnp.arange(t)
         mask = pos[None, :] < lens[:, None]  # [B, T]
@@ -154,7 +170,8 @@ def sequence_pool(x, pool_type: str, lengths=None, pad_value=0.0, name=None):
         empty = (lens == 0).reshape((-1,) + (1,) * (data.ndim - 2))
         return jnp.where(empty, jnp.asarray(pad_value, data.dtype), out)
 
-    return apply_op(fn, x, wrap_raw(lens), op_name=f"sequence_pool_{pool_type}")
+    return apply_op(fn, x, _lengths_arg(lengths),
+                    op_name=f"sequence_pool_{pool_type}")
 
 
 def sequence_first_step(x, lengths=None):
@@ -170,9 +187,9 @@ def sequence_softmax(x, lengths=None, name=None):
     1). Padding positions get probability 0. Parity: sequence_softmax_op.cc."""
     if lengths is None:
         raise ValueError("sequence_softmax needs lengths")
-    lens = _lengths_raw(lengths)
 
     def fn(data, lens):
+        lens = _int_lens(lens)
         t = data.shape[1]
         mask = jnp.arange(t)[None, :] < lens[:, None]
         mshape = mask.shape + (1,) * (data.ndim - 2)
@@ -182,7 +199,7 @@ def sequence_softmax(x, lengths=None, name=None):
         e = jnp.where(m, jnp.exp(z), 0)
         return e / jnp.maximum(e.sum(axis=1, keepdims=True), 1e-38)
 
-    return apply_op(fn, x, wrap_raw(lens), op_name="sequence_softmax")
+    return apply_op(fn, x, _lengths_arg(lengths), op_name="sequence_softmax")
 
 
 def sequence_reverse(x, lengths=None, name=None):
@@ -190,9 +207,9 @@ def sequence_reverse(x, lengths=None, name=None):
     Parity: sequence_reverse_op.h. Pure jnp — jittable."""
     if lengths is None:
         raise ValueError("sequence_reverse needs lengths")
-    lens = _lengths_raw(lengths)
 
     def fn(data, lens):
+        lens = _int_lens(lens)
         t = data.shape[1]
         pos = jnp.arange(t)[None, :]
         src = jnp.where(pos < lens[:, None], lens[:, None] - 1 - pos, pos)
@@ -200,7 +217,7 @@ def sequence_reverse(x, lengths=None, name=None):
             data, src.reshape(src.shape + (1,) * (data.ndim - 2)), axis=1
         )
 
-    return apply_op(fn, x, wrap_raw(lens), op_name="sequence_reverse")
+    return apply_op(fn, x, _lengths_arg(lengths), op_name="sequence_reverse")
 
 
 def sequence_expand(x, ref_lengths, x_lengths=None, name=None):
@@ -247,9 +264,9 @@ def sequence_enumerate(x, win_size: int, pad_value=0, lengths=None, name=None):
     """Sliding windows: out[i, j] = [x[i, j], …, x[i, j+w-1]], positions past
     a row's length filled with pad_value. [B, T] -> [B, T, win_size].
     Parity: sequence_enumerate_op.cc. Pure jnp — jittable."""
-    lens = _lengths_raw(lengths) if lengths is not None else None
-
     def fn(data, lens):
+        if lens is not None:
+            lens = _int_lens(lens)
         t = data.shape[1]
         pos = jnp.arange(t)[:, None] + jnp.arange(win_size)[None, :]  # [T, W]
         gathered = jnp.take(data, jnp.minimum(pos, t - 1), axis=1)  # [B, T, W]
@@ -257,6 +274,6 @@ def sequence_enumerate(x, win_size: int, pad_value=0, lengths=None, name=None):
         valid = pos[None, :, :] < limit
         return jnp.where(valid, gathered, jnp.asarray(pad_value, data.dtype))
 
-    if lens is None:
+    if lengths is None:
         return apply_op(lambda d: fn(d, None), x, op_name="sequence_enumerate")
-    return apply_op(fn, x, wrap_raw(lens), op_name="sequence_enumerate")
+    return apply_op(fn, x, _lengths_arg(lengths), op_name="sequence_enumerate")

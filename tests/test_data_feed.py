@@ -265,3 +265,27 @@ class TestTrainFromDataset:
         assert static.Executor._bucket(1) == 16
         assert static.Executor._bucket(17) == 32
         assert static.Executor._bucket(64) == 64
+
+    def test_length_feed_var_receives_row_lengths(self, tmp_path, rng):
+        """A feed var '<slot>_length' gets the ragged rows' true lengths so
+        mask-aware programs keep exact semantics despite bucketed padding."""
+        import paddle_tpu as paddle
+        from paddle_tpu import static
+
+        recs = [(1, [0.5], list(range(1, rng.randint(3, 7)))) for _ in range(8)]
+        p = tmp_path / "part-3.txt"
+        _write_slot_file(str(p), recs)
+        feed = MultiSlotDataFeed(SLOTS, batch_size=8)
+        feed.set_filelist([str(p)])
+        prog = static.Program()
+        with static.program_guard(prog, static.Program()):
+            ids = static.data("ids", [8, -1], "int64")
+            lens = static.data("ids_length", [8], "int64")
+            from paddle_tpu import tensor as T
+            pooled = T.sequence_pool(ids.astype("float32"), "sum",
+                                     lengths=lens)
+            out = pooled.sum()
+        exe = static.Executor()
+        res = exe.train_from_dataset(prog, feed, fetch_list=[out])
+        expect = sum(sum(r[2]) for r in recs)
+        assert float(res[0]) == float(expect)

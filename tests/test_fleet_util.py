@@ -1,0 +1,75 @@
+"""fleet.util (UtilBase) + fleet.utils.fs (LocalFS/HDFSClient surface) —
+parity with fleet/base/util_factory.py and fleet/utils/fs.py."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed.fleet as fleet
+from paddle_tpu.distributed.fleet.util import UtilBase
+from paddle_tpu.distributed.fleet.utils import LocalFS, HDFSClient
+
+
+class TestUtilBase:
+    def test_all_reduce_single_world(self):
+        u = UtilBase()
+        out = u.all_reduce(np.asarray([1.0, 2.0]), mode="sum")
+        np.testing.assert_allclose(out, [1.0, 2.0])
+
+    def test_all_gather_single_world(self):
+        u = UtilBase()
+        assert len(u.all_gather(np.asarray(3))) == 1
+
+    def test_get_file_shard_contiguous(self, monkeypatch):
+        import paddle_tpu.distributed.parallel as par
+
+        files = [f"f{i}" for i in range(7)]
+        shards = []
+        monkeypatch.setattr(par, "get_world_size", lambda: 3)
+        for r in range(3):
+            monkeypatch.setattr(par, "get_rank", lambda g=None, r=r: r)
+            shards.append(UtilBase().get_file_shard(files))
+        # contiguous cover, first len%n workers take one extra
+        assert [len(s) for s in shards] == [3, 2, 2]
+        assert sum(shards, []) == files
+
+    def test_fleet_exposes_util(self):
+        fleet.init(is_collective=True)
+        assert hasattr(fleet.fleet_base.fleet.util, "get_file_shard")
+
+
+class TestLocalFS:
+    def test_roundtrip(self, tmp_path):
+        fs = LocalFS()
+        d = str(tmp_path / "a/b")
+        fs.mkdirs(d)
+        assert fs.is_dir(d)
+        f = os.path.join(d, "x.txt")
+        fs.touch(f)
+        assert fs.is_file(f) and fs.is_exist(f)
+        dirs, files = fs.ls_dir(d)
+        assert files == ["x.txt"]
+        fs.mv(f, os.path.join(d, "y.txt"))
+        assert fs.is_file(os.path.join(d, "y.txt"))
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+    def test_mv_no_overwrite_raises(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils.fs import ExecuteError
+
+        fs = LocalFS()
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        fs.touch(a); fs.touch(b)
+        with pytest.raises(ExecuteError):
+            fs.mv(a, b, overwrite=False)
+        fs.mv(a, b, overwrite=True)
+
+
+class TestHDFSClient:
+    def test_missing_hadoop_raises_clearly(self):
+        from paddle_tpu.distributed.fleet.utils.fs import ExecuteError
+
+        c = HDFSClient(hadoop_home=None)
+        if c._hadoop is None:
+            with pytest.raises(ExecuteError, match="hadoop"):
+                c.mkdirs("/tmp/x")

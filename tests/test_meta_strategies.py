@@ -497,6 +497,7 @@ class TestFleetFacadeTrainStep:
             optimizer.SGD(0.1, parameters=m.parameters()))
         step = fleet.fleet_base.fleet.create_train_step(m, loss_fn)
         assert isinstance(step, ParallelTrainStep)
+        assert step._compute_dtype == jnp.bfloat16  # amp strategy applied
         rng = np.random.RandomState(0)
         x, y = make_batch(rng)
         assert np.isfinite(float(step((x,), (y,)).numpy()))
