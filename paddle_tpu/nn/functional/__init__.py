@@ -5,6 +5,7 @@ from .conv import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
+from .vision import *  # noqa: F401,F403
 from ...tensor.sequence import sequence_mask  # noqa: F401
 
-from . import activation, common, conv, loss, norm, pooling  # noqa: F401
+from . import activation, common, conv, loss, norm, pooling, vision  # noqa: F401

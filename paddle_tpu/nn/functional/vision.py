@@ -1,0 +1,133 @@
+"""Spatial warping ops — parity with the reference's vision kernels
+(operators/grid_sampler_op.*, affine_grid_op.*, temporal_shift_op.*):
+grid_sample (bilinear/nearest, zeros/border padding, align_corners),
+affine_grid, temporal_shift. Pure jnp gather/lerp — jittable, vmappable,
+differentiable; XLA fuses the 4-corner gathers, replacing the reference's
+hand-written CUDA samplers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op
+
+__all__ = ["grid_sample", "affine_grid", "temporal_shift"]
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) * 0.5 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) * 0.5
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """x: [N, C, H, W]; grid: [N, Hg, Wg, 2] in [-1, 1] (xy order).
+    Returns [N, C, Hg, Wg]. Parity: grid_sampler_op.cc semantics."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unsupported mode {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"unsupported padding_mode {padding_mode!r}")
+
+    def fn(img, g):
+        n, c, h, w = img.shape
+        gx = _unnormalize(g[..., 0].astype(jnp.float32), w, align_corners)
+        gy = _unnormalize(g[..., 1].astype(jnp.float32), h, align_corners)
+
+        def reflect(v, size):
+            # canonical reflect_coordinates (grid_sampler reference kernel):
+            # align_corners=True reflects about [0, size-1]; False about
+            # [-0.5, size-0.5]
+            if align_corners:
+                lo, span = 0.0, float(size - 1)
+            else:
+                lo, span = -0.5, float(size)
+            if span <= 0:
+                return jnp.zeros_like(v)
+            u = jnp.abs(v - lo)
+            extra = jnp.mod(u, span)
+            flips = jnp.floor(u / span)
+            even = jnp.mod(flips, 2.0) == 0
+            out = jnp.where(even, extra + lo, span - extra + lo)
+            return jnp.clip(out, 0, size - 1)
+
+        if padding_mode == "reflection":
+            gx = reflect(gx, w)
+            gy = reflect(gy, h)
+
+        def sample(ix, iy):
+            """Gather img[n, :, iy, ix] with out-of-range handling."""
+            inb = ((ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1))
+            cx = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+            cy = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+            # img: [N, C, H, W]; cx/cy: [N, Hg, Wg]
+            batch = jnp.arange(n)[:, None, None]
+            vals = img[batch, :, cy, cx]          # [N, Hg, Wg, C]
+            vals = jnp.moveaxis(vals, -1, 1)      # [N, C, Hg, Wg]
+            if padding_mode == "zeros":
+                vals = vals * inb[:, None, :, :].astype(vals.dtype)
+            return vals
+
+        if mode == "nearest":
+            return sample(jnp.round(gx), jnp.round(gy)).astype(img.dtype)
+
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = gx - x0
+        wy = gy - y0
+        out = (sample(x0, y0) * ((1 - wx) * (1 - wy))[:, None]
+               + sample(x1, y0) * (wx * (1 - wy))[:, None]
+               + sample(x0, y1) * ((1 - wx) * wy)[:, None]
+               + sample(x1, y1) * (wx * wy)[:, None])
+        return out.astype(img.dtype)
+
+    return apply_op(fn, x, grid, op_name="grid_sample")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta: [N, 2, 3] affine matrices → sampling grid [N, H, W, 2] for
+    grid_sample. Parity: affine_grid_op.cc."""
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in out_shape.numpy()]
+    n, c, h, w = [int(v) for v in out_shape]
+
+    def fn(th):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+            xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # [H*W, 3]
+        out = jnp.einsum("nij,pj->npi", th.astype(jnp.float32), base)
+        return out.reshape(th.shape[0], h, w, 2).astype(th.dtype)
+
+    return apply_op(fn, theta, op_name="affine_grid")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM temporal shift (temporal_shift_op.cc): x: [N*T, C, H, W]; the
+    first fold of channels shifts back one timestep, the second shifts
+    forward, the rest stay."""
+    if data_format != "NCHW":
+        raise ValueError("temporal_shift supports NCHW")
+
+    def fn(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        back = jnp.concatenate(
+            [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, fold:2 * fold]), v[:, :-1, fold:2 * fold]],
+            axis=1)
+        rest = v[:, :, 2 * fold:]
+        return jnp.concatenate([back, fwd, rest], axis=2).reshape(nt, c, h, w)
+
+    return apply_op(fn, x, op_name="temporal_shift")

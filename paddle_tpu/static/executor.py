@@ -116,31 +116,25 @@ class Executor:
         for batch in dataset:
             feed = {}
             for name in feed_names:
+                if name in batch:
+                    # a genuine dataset slot always wins — including one
+                    # that happens to be named '<x>_length'
+                    feed[name] = self._slot_to_array(
+                        batch[name], program.feed_vars[name],
+                        program.declared_shapes.get(name))
+                    continue
                 if name.endswith("_length") and name[:-7] in batch:
-                    continue  # filled from its base slot below
-                if name not in batch:
-                    raise InvalidArgumentError(
-                        f"dataset batch has no slot '{name}' for feed var "
-                        f"(slots: {sorted(batch)})")
-                feed[name] = self._slot_to_array(
-                    batch[name], program.feed_vars[name],
-                    program.declared_shapes.get(name))
-                # padded form alone loses the row lengths; a feed var named
-                # '<slot>_length' receives them so mask-aware programs
-                # (sequence_* ops) see exact ragged semantics despite
-                # bucketed padding
-                lname = f"{name}_length"
-                if lname in program.feed_vars:
-                    from ..io.data_feed import RaggedSlot
-
-                    slot = batch[name]
-                    if isinstance(slot, RaggedSlot):
-                        feed[lname] = slot.lengths().astype(np.int64)
-                    else:
-                        rows = (slot if isinstance(slot, np.ndarray)
-                                else [np.asarray(r) for r in slot])
-                        feed[lname] = np.asarray(
-                            [len(r) for r in rows], np.int64)
+                    # synthesized lengths: padded form alone loses the row
+                    # lengths, so a feed var '<slot>_length' (with no slot
+                    # of its own) receives the base slot's true lengths —
+                    # clamped to the padded time dim so mask-aware programs
+                    # never index past truncated rows
+                    feed[name] = self._row_lengths(
+                        batch[name[:-7]], program, name[:-7])
+                    continue
+                raise InvalidArgumentError(
+                    f"dataset batch has no slot '{name}' for feed var "
+                    f"(slots: {sorted(batch)})")
             last = self.run(program, feed=feed, fetch_list=fetch_list)
             step += 1
             if debug or (fetch_list and step % print_period == 0):
@@ -172,6 +166,26 @@ class Executor:
         return self.train_from_dataset(entry[2], dataset,
                                        scope, thread, debug, fetch_list,
                                        fetch_info, print_period)
+
+    @staticmethod
+    def _row_lengths(slot, program, base_name):
+        """True per-row lengths of a slot, clamped to the base feed var's
+        padded time dim when that var is fed (truncated rows must not report
+        lengths past the data)."""
+        from ..io.data_feed import RaggedSlot
+
+        if isinstance(slot, RaggedSlot):
+            lens = slot.lengths().astype(np.int64)
+        else:
+            rows = (slot if isinstance(slot, np.ndarray)
+                    else [np.asarray(r) for r in slot])
+            lens = np.asarray([len(r) for r in rows], np.int64)
+        base = program.feed_vars.get(base_name)
+        if base is not None:
+            t = Executor._pad_target(base, program.declared_shapes.get(base_name),
+                                     int(lens.max()) if len(lens) else 0)
+            lens = np.minimum(lens, t)
+        return lens
 
     @staticmethod
     def _bucket(n: int) -> int:

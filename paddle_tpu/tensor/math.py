@@ -18,7 +18,7 @@ __all__ = [
     "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
     "pow", "sqrt", "rsqrt", "exp", "expm1", "log", "log2", "log10", "log1p",
     "abs", "ceil", "floor", "round", "trunc", "sin", "cos", "tan", "asin",
-    "acos", "atan", "atan2", "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
+    "acos", "atan", "atan2", "hypot", "logaddexp", "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
     "sigmoid", "square", "reciprocal", "sign", "neg", "maximum", "minimum",
     "fmax", "fmin", "sum", "nansum", "mean", "nanmean", "max", "min", "amax",
     "amin", "prod", "cumsum", "cumprod", "cummax", "cummin", "clip", "erf",
@@ -97,6 +97,14 @@ def fmin(x, y, name=None):
 
 def atan2(x, y, name=None):
     return _binop(jnp.arctan2, x, y)
+
+
+def hypot(x, y, name=None):
+    return _binop(jnp.hypot, x, y)
+
+
+def logaddexp(x, y, name=None):
+    return _binop(jnp.logaddexp, x, y)
 
 
 def gcd(x, y, name=None):

@@ -289,3 +289,45 @@ class TestTrainFromDataset:
         res = exe.train_from_dataset(prog, feed, fetch_list=[out])
         expect = sum(sum(r[2]) for r in recs)
         assert float(res[0]) == float(expect)
+
+    def test_real_length_slot_wins_over_synthesis(self, tmp_path, rng):
+        """A dataset slot literally named '<x>_length' must be fed as-is,
+        not replaced by synthesized row lengths."""
+        from paddle_tpu import static
+
+        slots = [
+            SlotDesc("ids", "int64"),
+            SlotDesc("ids_length", "float32", dense_dim=1),
+        ]
+        with open(tmp_path / "p.txt", "w") as f:
+            # record: 2 ids [7, 8]; ids_length slot value 99 (NOT the length)
+            f.write("2 7 8 1 99.0\n2 1 2 1 55.0\n")
+        feed = MultiSlotDataFeed(slots, batch_size=2)
+        feed.set_filelist([str(tmp_path / "p.txt")])
+        prog = static.Program()
+        with static.program_guard(prog, static.Program()):
+            ids = static.data("ids", [2, -1], "int64")
+            lens = static.data("ids_length", [2, 1], "float32")
+            out = lens.sum()
+        exe = static.Executor()
+        res = exe.train_from_dataset(prog, feed, fetch_list=[out])
+        assert float(res[0]) == 154.0  # 99 + 55, the real slot values
+
+    def test_synthesized_lengths_clamped_to_fixed_dim(self, tmp_path, rng):
+        """Rows longer than a FIXED declared time dim are truncated; the
+        synthesized lengths must clamp to match."""
+        from paddle_tpu import static
+
+        recs = [(1, [0.5], list(range(9))) for _ in range(4)]  # len 9 rows
+        p = tmp_path / "part-4.txt"
+        _write_slot_file(str(p), recs)
+        feed = MultiSlotDataFeed(SLOTS, batch_size=4)
+        feed.set_filelist([str(p)])
+        prog = static.Program()
+        with static.program_guard(prog, static.Program()):
+            ids = static.data("ids", [4, 5], "int64")  # fixed dim 5 < 9
+            lens = static.data("ids_length", [4], "int64")
+            out = lens.max()
+        exe = static.Executor()
+        res = exe.train_from_dataset(prog, feed, fetch_list=[out])
+        assert int(res[0]) == 5  # clamped to the padded width

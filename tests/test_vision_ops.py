@@ -1,0 +1,129 @@
+"""Spatial warping ops (grid_sample / affine_grid / temporal_shift) vs
+hand-computed goldens — the reference's grid_sampler/affine_grid op-test
+pattern."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+
+class TestGridSample:
+    def test_identity_grid_returns_input(self, rng):
+        x = rng.randn(1, 2, 4, 4).astype(np.float32)
+        ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                             indexing="ij")
+        grid = np.stack([xs, ys], -1)[None].astype(np.float32)
+        out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                            align_corners=True)
+        np.testing.assert_allclose(out.numpy(), x, rtol=1e-5, atol=1e-5)
+
+    def test_bilinear_midpoint(self):
+        x = np.zeros((1, 1, 2, 2), np.float32)
+        x[0, 0] = [[0.0, 1.0], [2.0, 3.0]]
+        grid = np.zeros((1, 1, 1, 2), np.float32)  # center
+        out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                            align_corners=True)
+        assert float(out.numpy()[0, 0, 0, 0]) == pytest.approx(1.5)
+
+    def test_zeros_padding_outside(self):
+        x = np.ones((1, 1, 2, 2), np.float32)
+        grid = np.full((1, 1, 1, 2), 3.0, np.float32)  # far outside
+        out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                            padding_mode="zeros")
+        assert float(out.numpy()[0, 0, 0, 0]) == 0.0
+
+    def test_border_padding_outside(self):
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+        grid = np.full((1, 1, 1, 2), 5.0, np.float32)
+        out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                            padding_mode="border")
+        assert float(out.numpy()[0, 0, 0, 0]) == 3.0  # bottom-right corner
+
+    def test_nearest_mode(self):
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+        grid = np.asarray([[[[0.9, 0.9]]]], np.float32)
+        out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                            mode="nearest")
+        assert float(out.numpy()[0, 0, 0, 0]) == 3.0
+
+    def test_grad_flows(self, rng):
+        x = paddle.to_tensor(rng.randn(1, 1, 3, 3).astype(np.float32),
+                             stop_gradient=False)
+        ys, xs = np.meshgrid(np.linspace(-0.5, 0.5, 3),
+                             np.linspace(-0.5, 0.5, 3), indexing="ij")
+        grid = paddle.to_tensor(np.stack([xs, ys], -1)[None].astype(np.float32))
+        F.grid_sample(x, grid).sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+        assert np.abs(x.grad.numpy()).sum() > 0
+
+
+class TestAffineGrid:
+    def test_identity_theta(self):
+        theta = np.asarray([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32)
+        grid = F.affine_grid(paddle.to_tensor(theta), [1, 1, 3, 3],
+                             align_corners=True)
+        g = grid.numpy()
+        np.testing.assert_allclose(g[0, 0, 0], [-1, -1], atol=1e-6)
+        np.testing.assert_allclose(g[0, 2, 2], [1, 1], atol=1e-6)
+        np.testing.assert_allclose(g[0, 1, 1], [0, 0], atol=1e-6)
+
+    def test_translation_composes_with_grid_sample(self, rng):
+        # shifting the grid by a full pixel shifts the image
+        x = rng.randn(1, 1, 4, 4).astype(np.float32)
+        shift = 2.0 / 3.0  # one pixel in align_corners [-1,1] over 4 px
+        theta = np.asarray([[[1.0, 0, shift], [0, 1.0, 0]]], np.float32)
+        grid = F.affine_grid(paddle.to_tensor(theta), [1, 1, 4, 4])
+        out = F.grid_sample(paddle.to_tensor(x), grid, padding_mode="zeros")
+        np.testing.assert_allclose(out.numpy()[0, 0, :, :3],
+                                   x[0, 0, :, 1:], rtol=1e-4, atol=1e-5)
+
+
+class TestTemporalShift:
+    def test_shift_pattern(self):
+        n, t, c, h, w = 1, 3, 4, 1, 1
+        x = np.arange(n * t * c, dtype=np.float32).reshape(n * t, c, h, w)
+        out = F.temporal_shift(paddle.to_tensor(x), seg_num=t,
+                               shift_ratio=0.25).numpy().reshape(n, t, c)
+        v = x.reshape(n, t, c)
+        # fold = 1: channel 0 shifts back (future frame), channel 1 forward
+        np.testing.assert_allclose(out[0, 0, 0], v[0, 1, 0])
+        np.testing.assert_allclose(out[0, 2, 0], 0.0)
+        np.testing.assert_allclose(out[0, 0, 1], 0.0)
+        np.testing.assert_allclose(out[0, 1, 1], v[0, 0, 1])
+        # remaining channels unchanged
+        np.testing.assert_allclose(out[0, :, 2:], v[0, :, 2:])
+
+
+class TestReflectionPadding:
+    @pytest.mark.parametrize("align_corners", [True, False])
+    def test_reflection_matches_manual(self, align_corners):
+        """Golden check of the reflect-coordinates rule on a 1x1x1x4 row."""
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 1, 4)
+
+        def unnorm(c, size):
+            return ((c + 1) * 0.5 * (size - 1) if align_corners
+                    else ((c + 1) * size - 1) * 0.5)
+
+        def reflect(v, size):
+            lo, span = (0.0, size - 1) if align_corners else (-0.5, float(size))
+            u = abs(v - lo)
+            extra = u % span
+            flips = int(u // span)
+            out = extra + lo if flips % 2 == 0 else span - extra + lo
+            return min(max(out, 0), size - 1)
+
+        for gx in (-1.8, -1.2, 1.3, 1.9, 2.5):
+            grid = np.asarray([[[[gx, 0.0]]]], np.float32)
+            out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                                padding_mode="reflection",
+                                align_corners=align_corners)
+            vx = reflect(unnorm(gx, 4), 4)
+            x0 = int(np.floor(vx))
+            w1 = vx - x0
+            row = x[0, 0, 0]
+            lo_v = row[min(max(x0, 0), 3)]
+            hi_v = row[min(max(x0 + 1, 0), 3)]
+            expect = lo_v * (1 - w1) + hi_v * w1
+            assert float(out.numpy().ravel()[0]) == pytest.approx(
+                float(expect), abs=1e-5), f"gx={gx}"
