@@ -140,6 +140,24 @@ def convert_logical_or(lhs, rhs_fn: Callable):
     return lhs or rhs_fn()
 
 
+def convert_range_check(i, stop, step):
+    """Loop-continue test for a converted ``for _ in range(...)`` —
+    sign-aware so negative steps work, tensor-safe so it stages. A concrete
+    zero step raises like python's range()."""
+    if not isinstance(step, Tensor) and step == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    if isinstance(step, Tensor) or _is_dynamic(i) or _is_dynamic(stop):
+        import jax.numpy as jnp
+
+        from ..core.tensor import wrap_raw
+
+        iv = i._value if isinstance(i, Tensor) else jnp.asarray(i)
+        sv = stop._value if isinstance(stop, Tensor) else jnp.asarray(stop)
+        st = step._value if isinstance(step, Tensor) else jnp.asarray(step)
+        return wrap_raw((st > 0) & (iv < sv) | (st < 0) & (iv > sv))
+    return (step > 0 and i < stop) or (step < 0 and i > stop)
+
+
 def convert_logical_not(x):
     if isinstance(x, Tensor):
         return x.logical_not() if hasattr(x, "logical_not") else ~x
@@ -188,10 +206,13 @@ def _assigned_names(nodes: List[ast.stmt]) -> List[str]:
                 for e in t.elts:
                     self._target(e)
 
-        # do not descend into nested function defs
+        # do not descend into nested function defs, and do NOT treat their
+        # names as loop/branch variables: function objects cannot be
+        # lax.while_loop carries, and the converter's own generated helper
+        # defs (__true_fn_N, __loop_body_N, …) would otherwise leak into
+        # loop_vars with UNDEF guards that break staging
         def visit_FunctionDef(self, n):
-            if n.name not in out:
-                out.append(n.name)
+            pass
 
         visit_AsyncFunctionDef = visit_FunctionDef
 
@@ -284,7 +305,113 @@ def _undef_guards(names: List[str]) -> List[ast.stmt]:
     return out
 
 
-class _Dy2StaticTransformer(ast.NodeTransformer):
+class _LoopLowering:
+    """Shared while/for lowering: builds the cond_fn/body_fn pair and the
+    ``convert_while`` call over a loop-var tuple (one implementation so the
+    two visitors cannot drift)."""
+
+    def _lower_loop(self, node, loop_vars, cond_expr, body_stmts,
+                    guard_vars=None):
+        params = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in loop_vars],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        cond_name = self._name("loop_cond")
+        body_name = self._name("loop_body")
+        cond_fn = ast.FunctionDef(
+            name=cond_name, args=params,
+            body=[ast.Return(value=cond_expr)], decorator_list=[])
+        body_fn = ast.FunctionDef(
+            name=body_name, args=params,
+            body=list(body_stmts) + [ast.Return(value=ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Load()) for n in loop_vars],
+                ctx=ast.Load()))],
+            decorator_list=[])
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in loop_vars],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(value=ast.Name(id=_HELPER, ctx=ast.Load()),
+                                   attr="convert_while", ctx=ast.Load()),
+                args=[ast.Name(id=cond_name, ctx=ast.Load()),
+                      ast.Name(id=body_name, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                      for n in loop_vars], ctx=ast.Load())],
+                keywords=[]))
+        out = _undef_guards(guard_vars if guard_vars is not None
+                            else loop_vars) + [cond_fn, body_fn, call]
+        for n in out:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return out
+
+
+class _ForRangeTransformer(_LoopLowering):
+    """Mixin for visit_For: ``for i in range(...)`` lowers to the while
+    conversion (loop_transformer.py's for_range path); other iterables keep
+    python form (they are host-side by construction).
+
+    Design: a PRIVATE counter drives the iteration and assigns the user's
+    loop variable at the top of each body — so body code reassigning ``i``
+    cannot derail the iteration (python range semantics), and after the loop
+    ``i`` holds the last yielded value, not last+step."""
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3
+                and isinstance(node.target, ast.Name)):
+            return node
+        if _has_escape(node.body) or node.orelse or _has_scope_decl(node.body):
+            return node
+        args = it.args
+        start = args[0] if len(args) >= 2 else ast.Constant(value=0)
+        stop = args[0] if len(args) == 1 else args[1]
+        step = args[2] if len(args) == 3 else ast.Constant(value=1)
+        ivar = node.target.id
+        counter = self._name("range_it")
+        stop_name = self._name("range_stop")
+        step_name = self._name("range_step")
+
+        def name_l(n):
+            return ast.Name(id=n, ctx=ast.Load())
+
+        def assign(n, value):
+            return ast.Assign(targets=[ast.Name(id=n, ctx=ast.Store())],
+                              value=value)
+
+        pre = [
+            assign(stop_name, stop),
+            assign(step_name, step),
+            assign(counter, start),
+            # carry init for the user var (overwritten by the first
+            # iteration; keeps the carry well-typed for lax.while_loop)
+            assign(ivar, name_l(counter)),
+        ]
+        body_assigned = [n for n in _assigned_names(node.body) if n != ivar]
+        loop_vars = [counter, ivar] + body_assigned
+        cond_expr = ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_HELPER, ctx=ast.Load()),
+                               attr="convert_range_check", ctx=ast.Load()),
+            args=[name_l(counter), name_l(stop_name), name_l(step_name)],
+            keywords=[])
+        body_stmts = (
+            [assign(ivar, name_l(counter))] + list(node.body) +
+            [assign(counter, ast.BinOp(left=name_l(counter), op=ast.Add(),
+                                       right=name_l(step_name)))]
+        )
+        lowered = self._lower_loop(node, loop_vars, cond_expr, body_stmts,
+                                   guard_vars=body_assigned)
+        for n in pre:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return pre + lowered
+
+
+
+class _Dy2StaticTransformer(_ForRangeTransformer, ast.NodeTransformer):
     """Rewrites if/while/boolop into _jst.convert_* calls."""
 
     def __init__(self):
@@ -387,39 +514,7 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
         ]
         if not loop_vars:
             return node
-
-        args = ast.arguments(
-            posonlyargs=[],
-            args=[ast.arg(arg=n) for n in loop_vars],
-            kwonlyargs=[], kw_defaults=[], defaults=[])
-        cond_name = self._name("loop_cond")
-        body_name = self._name("loop_body")
-        cond_fn = ast.FunctionDef(
-            name=cond_name, args=args,
-            body=[ast.Return(value=node.test)], decorator_list=[])
-        body_fn = ast.FunctionDef(
-            name=body_name, args=args,
-            body=list(node.body) + [ast.Return(value=ast.Tuple(
-                elts=[ast.Name(id=n, ctx=ast.Load()) for n in loop_vars],
-                ctx=ast.Load()))],
-            decorator_list=[])
-        call = ast.Assign(
-            targets=[ast.Tuple(
-                elts=[ast.Name(id=n, ctx=ast.Store()) for n in loop_vars],
-                ctx=ast.Store())],
-            value=ast.Call(
-                func=ast.Attribute(value=ast.Name(id=_HELPER, ctx=ast.Load()),
-                                   attr="convert_while", ctx=ast.Load()),
-                args=[ast.Name(id=cond_name, ctx=ast.Load()),
-                      ast.Name(id=body_name, ctx=ast.Load()),
-                      ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
-                                      for n in loop_vars], ctx=ast.Load())],
-                keywords=[]))
-        out = _undef_guards(loop_vars) + [cond_fn, body_fn, call]
-        for n in out:
-            ast.copy_location(n, node)
-            ast.fix_missing_locations(n)
-        return out
+        return self._lower_loop(node, loop_vars, node.test, node.body)
 
 
 def convert_to_static(fn: Callable) -> Callable:

@@ -273,3 +273,119 @@ class TestLayerConversion:
 
         g = convert_to_static(f)
         np.testing.assert_allclose(g(paddle.to_tensor([2.0])).numpy(), [6.0])
+
+
+class TestForRangeConversion:
+    def test_for_range_eager(self):
+        def f(n):
+            s = paddle.to_tensor(0)
+            for i in range(n):
+                s = s + i
+            return s
+
+        g = convert_to_static(f)
+        assert g._dy2static_converted
+        assert int(g(paddle.to_tensor(5)).numpy()) == 10
+        assert int(g(5).numpy()) == 10  # python int still works
+
+    def test_for_range_under_jit(self):
+        def f(n):
+            s = paddle.Tensor(jnp.asarray(0))
+            for i in range(n):
+                s = s + i
+            return s
+
+        g = convert_to_static(f)
+        jf = jax.jit(lambda v: g(paddle.Tensor(v))._value)
+        assert int(jf(jnp.asarray(6))) == 15
+
+    def test_for_range_start_stop_step(self):
+        def f(n):
+            s = paddle.to_tensor(0)
+            for i in range(1, n, 2):
+                s = s + i
+            return s
+
+        g = convert_to_static(f)
+        assert int(g(paddle.to_tensor(8)).numpy()) == 1 + 3 + 5 + 7
+
+    def test_for_range_negative_step(self):
+        def f(n):
+            s = paddle.to_tensor(0)
+            for i in range(n, 0, -1):
+                s = s + i
+            return s
+
+        g = convert_to_static(f)
+        assert int(g(paddle.to_tensor(4)).numpy()) == 10
+
+    def test_for_over_list_kept_python(self):
+        def f(x):
+            s = x
+            for v in [1.0, 2.0]:
+                s = s + v
+            return s
+
+        g = convert_to_static(f)
+        np.testing.assert_allclose(g(paddle.to_tensor([1.0])).numpy(), [4.0])
+
+    def test_for_with_break_kept_python(self):
+        def f(x):
+            s = x
+            for i in range(10):
+                if i >= 2:
+                    break
+                s = s + 1.0
+            return s
+
+        g = convert_to_static(f)
+        np.testing.assert_allclose(g(paddle.to_tensor([0.0])).numpy(), [2.0])
+
+    def test_loop_var_reassigned_in_body_terminates(self):
+        def f(n):
+            s = paddle.to_tensor(0)
+            for i in range(n):
+                i = 0  # noqa: PLW2901 — python range still drives iteration
+                s = s + 1
+            return s
+
+        g = convert_to_static(f)
+        assert int(g(paddle.to_tensor(3)).numpy()) == 3
+
+    def test_loop_var_value_after_loop(self):
+        def f(n):
+            s = paddle.to_tensor(0)
+            for i in range(n):
+                s = s + i
+            return s + i * 100
+
+        g = convert_to_static(f)
+        # python: i ends at the LAST yielded value (4), not last+step
+        assert int(g(paddle.to_tensor(5)).numpy()) == 10 + 400
+        assert int(f(5).numpy()) == 10 + 400
+
+    def test_nested_if_in_for_body_under_jit(self):
+        def f(n, t):
+            s = paddle.Tensor(jnp.asarray(0.0))
+            for i in range(n):
+                if t > 0:
+                    s = s + 1.0
+                else:
+                    s = s - 1.0
+            return s
+
+        g = convert_to_static(f)
+        jf = jax.jit(lambda n, t: g(paddle.Tensor(n), paddle.Tensor(t))._value)
+        assert float(jf(jnp.asarray(3), jnp.asarray(1.0))) == 3.0
+        assert float(jf(jnp.asarray(3), jnp.asarray(-1.0))) == -3.0
+
+    def test_range_step_zero_raises(self):
+        def f(n):
+            s = paddle.to_tensor(0)
+            for i in range(0, n, 0):
+                s = s + 1
+            return s
+
+        g = convert_to_static(f)
+        with pytest.raises(ValueError, match="must not be zero"):
+            g(paddle.to_tensor(3))
