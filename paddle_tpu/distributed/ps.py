@@ -19,7 +19,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 __all__ = ["PsServer", "PsClient", "SparseEmbedding", "AsyncCommunicator",
-           "OPT_SGD", "OPT_ADAGRAD", "OPT_ADAM"]
+           "GeoCommunicator", "OPT_SGD", "OPT_ADAGRAD", "OPT_ADAM"]
 
 OPT_SGD, OPT_ADAGRAD, OPT_ADAM = 0, 1, 2
 
@@ -252,3 +252,53 @@ class AsyncCommunicator:
         self.flush()
         self._q.put(None)
         self._thread.join(timeout=5)
+
+
+class GeoCommunicator:
+    """Geo-async (GeoSGD) mode — parity with the reference's GeoCommunicator
+    + SparseGeoTable (distributed/service/communicator.h, table/
+    common_sparse_table.h geo mode; strategy a_sync_configs k_steps>0):
+    each worker trains LOCALLY and every ``k_steps`` pushes the DELTA of its
+    params since the last sync, then adopts the server's merged view. The
+    server table must be created with ``lr=1.0`` so a pushed grad of
+    ``-delta`` applies as ``param += delta`` (the geo merge rule).
+    """
+
+    def __init__(self, client: PsClient, table_id: int, size: int,
+                 k_steps: int = 100):
+        self.client = client
+        self.table_id = table_id
+        self.size = int(size)
+        self.k_steps = max(int(k_steps), 1)
+        self._step = 0
+        self._base = client.pull_dense(table_id, self.size)
+
+    @property
+    def base(self) -> np.ndarray:
+        """The worker's view of the globally merged params at last sync
+        (a copy — callers train their copy in place, and an aliased _base
+        would zero every future delta)."""
+        return self._base.copy()
+
+    def maybe_sync(self, local_param: np.ndarray):
+        """Called once per local step. On every k-th call: push the local
+        delta, pull the merged params, and return them (the worker must
+        adopt the returned view). Otherwise returns None."""
+        self._step += 1
+        if self._step % self.k_steps:
+            return None
+        return self.sync(local_param)
+
+    def sync(self, local_param: np.ndarray) -> np.ndarray:
+        local = np.ascontiguousarray(local_param, np.float32).ravel()
+        if local.size != self.size:
+            raise ValueError(
+                f"param size {local.size} != table size {self.size}")
+        delta = local - self._base
+        # server rule is param -= lr*grad with lr=1.0 → push -delta
+        self.client.push_dense_grad(self.table_id, -delta)
+        merged = self.client.pull_dense(self.table_id, self.size)
+        # the snapshot must NOT alias the returned array: the caller adopts
+        # and mutates it in place, which would silently zero future deltas
+        self._base = merged.copy()
+        return merged

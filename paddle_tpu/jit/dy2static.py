@@ -102,11 +102,38 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, args=()):
 
 
 def convert_while(cond_fn: Callable, body_fn: Callable, loop_vars: tuple):
-    """Dispatch a ``while`` (loop_transformer.py semantics)."""
+    """Dispatch a ``while`` (loop_transformer.py semantics).
+
+    Loop vars first assigned INSIDE the body arrive as the UNDEF sentinel.
+    Eagerly that is python-exact (zero-trip leaves them undefined; one trip
+    overwrites them). Staged, lax.while_loop needs typed carries, so the
+    body is traced once on the inits — write-before-read slots produce real
+    values — and the UNDEF inits are replaced by typed zeros (a zero-trip
+    traced loop then yields zeros: the documented deviation, matching the
+    reference's created-undefined-var behavior)."""
     first = cond_fn(*loop_vars)
-    if _is_dynamic(first) or any(_is_dynamic(v) for v in loop_vars):
+    if _is_dynamic(first) or any(_is_dynamic(v) for v in loop_vars
+                                 if not isinstance(v, _Undefined)):
         from ..static.control_flow import while_loop
 
+        if any(isinstance(v, _Undefined) for v in loop_vars):
+            import jax.numpy as jnp
+
+            from ..core.tensor import wrap_raw
+
+            template = tuple(body_fn(*loop_vars))
+
+            def zero_like(t):
+                if isinstance(t, Tensor):
+                    return wrap_raw(jnp.zeros(t.shape, t._value.dtype))
+                if hasattr(t, "dtype"):
+                    return jnp.zeros(jnp.shape(t), t.dtype)
+                return type(t)() if t is not None else None
+
+            loop_vars = tuple(
+                zero_like(tp) if isinstance(v, _Undefined) else v
+                for v, tp in zip(loop_vars, template))
+            first = cond_fn(*loop_vars)
         out = while_loop(cond_fn, lambda *vs: tuple(body_fn(*vs)),
                          list(loop_vars))
         return tuple(out)
@@ -176,6 +203,17 @@ def convert_bool(x):
 # ---------------------------------------------------------------------------
 # AST analysis
 # ---------------------------------------------------------------------------
+import re as _re
+
+_GENERATED_NAME = _re.compile(
+    r"^__(true_fn|false_fn|loop_cond|loop_body|range_it|range_stop|"
+    r"range_step)_\d+$")
+
+
+def _is_generated_name(name: str) -> bool:
+    return bool(_GENERATED_NAME.match(name))
+
+
 def _assigned_names(nodes: List[ast.stmt]) -> List[str]:
     out: List[str] = []
 
@@ -200,19 +238,19 @@ def _assigned_names(nodes: List[ast.stmt]) -> List[str]:
 
         def _target(self, t):
             if isinstance(t, ast.Name):
-                if t.id not in out:
+                if t.id not in out and not _is_generated_name(t.id):
                     out.append(t.id)
             elif isinstance(t, (ast.Tuple, ast.List)):
                 for e in t.elts:
                     self._target(e)
 
-        # do not descend into nested function defs, and do NOT treat their
-        # names as loop/branch variables: function objects cannot be
-        # lax.while_loop carries, and the converter's own generated helper
-        # defs (__true_fn_N, __loop_body_N, …) would otherwise leak into
-        # loop_vars with UNDEF guards that break staging
+        # do not descend into nested function defs; record USER def names
+        # (they thread through branches eagerly like any assignment) but
+        # never the converter's own generated helpers (__true_fn_N, …) —
+        # those leaking into loop/branch vars breaks staging
         def visit_FunctionDef(self, n):
-            pass
+            if not _is_generated_name(n.name) and n.name not in out:
+                out.append(n.name)
 
         visit_AsyncFunctionDef = visit_FunctionDef
 
@@ -386,10 +424,10 @@ class _ForRangeTransformer(_LoopLowering):
             assign(stop_name, stop),
             assign(step_name, step),
             assign(counter, start),
-            # carry init for the user var (overwritten by the first
-            # iteration; keeps the carry well-typed for lax.while_loop)
-            assign(ivar, name_l(counter)),
         ]
+        # the user var is NOT pre-assigned: python's zero-trip range leaves
+        # a prior binding untouched (and an unbound name unbound) — the
+        # UNDEF guard + convert_while's typed-zeros staging handle both
         body_assigned = [n for n in _assigned_names(node.body) if n != ivar]
         loop_vars = [counter, ivar] + body_assigned
         cond_expr = ast.Call(
@@ -403,7 +441,7 @@ class _ForRangeTransformer(_LoopLowering):
                                        right=name_l(step_name)))]
         )
         lowered = self._lower_loop(node, loop_vars, cond_expr, body_stmts,
-                                   guard_vars=body_assigned)
+                                   guard_vars=[ivar] + body_assigned)
         for n in pre:
             ast.copy_location(n, node)
             ast.fix_missing_locations(n)

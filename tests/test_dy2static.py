@@ -389,3 +389,59 @@ class TestForRangeConversion:
         g = convert_to_static(f)
         with pytest.raises(ValueError, match="must not be zero"):
             g(paddle.to_tensor(3))
+
+    def test_body_temp_under_jit(self):
+        def f(n):
+            s = paddle.Tensor(jnp.asarray(0))
+            for i in range(n):
+                t = i * 2  # first assigned inside the body
+                s = s + t
+            return s
+
+        g = convert_to_static(f)
+        jf = jax.jit(lambda v: g(paddle.Tensor(v))._value)
+        assert int(jf(jnp.asarray(4))) == 12  # 0+2+4+6
+
+    def test_nested_for_under_jit(self):
+        def f(n):
+            s = paddle.Tensor(jnp.asarray(0))
+            for i in range(n):
+                for j in range(n):
+                    s = s + i * j
+            return s
+
+        g = convert_to_static(f)
+        jf = jax.jit(lambda v: g(paddle.Tensor(v))._value)
+        assert int(jf(jnp.asarray(3))) == sum(i * j for i in range(3)
+                                              for j in range(3))
+
+    def test_empty_range_keeps_prior_binding(self):
+        def f(x, n):
+            i = 100
+            for i in range(n):
+                x = x + i
+            return x + i
+
+        g = convert_to_static(f)
+        # zero-trip: python leaves i at 100
+        assert int(g(paddle.to_tensor(0), 0).numpy()) == 100
+        # 3 iterations: i ends at 2
+        assert int(g(paddle.to_tensor(0), 3).numpy()) == 0 + 1 + 2 + 2
+
+    def test_user_def_in_branch_threads_through(self):
+        def f(t):
+            if t.sum() > 0:
+                y = t + 1
+
+                def h():
+                    return 10
+            else:
+                y = t - 1
+
+                def h():
+                    return 20
+            return y + h()
+
+        g = convert_to_static(f)
+        np.testing.assert_allclose(g(paddle.to_tensor([1.0])).numpy(), [12.0])
+        np.testing.assert_allclose(g(paddle.to_tensor([-1.0])).numpy(), [18.0])

@@ -213,3 +213,79 @@ class TestAsyncCommunicator:
         np.testing.assert_allclose(c.pull_dense(0, 4), -10 * np.ones(4),
                                    atol=1e-5)
         c.shutdown_server()
+
+
+class TestGeoCommunicator:
+    """GeoSGD mode: workers train locally, deltas merge on the server."""
+
+    def test_two_workers_deltas_merge(self, ps):
+        from paddle_tpu.distributed.ps import GeoCommunicator
+
+        size = 8
+        ps.add_dense_table(5, size, init=np.zeros(size, np.float32), lr=1.0)
+        ps.start()
+        c1, c2 = _client(ps), _client(ps)
+        g1 = GeoCommunicator(c1, 5, size, k_steps=1)
+        g2 = GeoCommunicator(c2, 5, size, k_steps=1)
+
+        # worker 1 moves +1 locally, worker 2 moves +2 on another coord
+        p1 = g1.base.copy(); p1[0] += 1.0
+        p2 = g2.base.copy(); p2[1] += 2.0
+        g1.sync(p1)
+        m2 = g2.sync(p2)
+        final = c1.pull_dense(5, size)
+        assert final[0] == pytest.approx(1.0)
+        assert final[1] == pytest.approx(2.0)
+        # worker 2 synced after worker 1: it sees both contributions
+        assert m2[0] == pytest.approx(1.0) and m2[1] == pytest.approx(2.0)
+        c1.disconnect(); c2.disconnect()
+
+    def test_k_steps_gating(self, ps):
+        from paddle_tpu.distributed.ps import GeoCommunicator
+
+        size = 4
+        ps.add_dense_table(6, size, init=np.zeros(size, np.float32), lr=1.0)
+        ps.start()
+        c = _client(ps)
+        geo = GeoCommunicator(c, 6, size, k_steps=3)
+        p = geo.base.copy()
+        p += 1.0
+        assert geo.maybe_sync(p) is None
+        assert geo.maybe_sync(p) is None
+        merged = geo.maybe_sync(p)  # 3rd step syncs
+        assert merged is not None
+        np.testing.assert_allclose(merged, np.ones(size), rtol=1e-6)
+        c.disconnect()
+
+    def test_repeated_sync_is_idempotent_without_change(self, ps):
+        from paddle_tpu.distributed.ps import GeoCommunicator
+
+        size = 4
+        ps.add_dense_table(7, size, init=np.zeros(size, np.float32), lr=1.0)
+        ps.start()
+        c = _client(ps)
+        geo = GeoCommunicator(c, 7, size, k_steps=1)
+        p = geo.base.copy(); p[0] = 5.0
+        first = geo.sync(p)
+        # no further local movement: delta is 0, server stays put
+        second = geo.sync(first)
+        np.testing.assert_allclose(second, first, rtol=1e-6)
+        c.disconnect()
+
+    def test_inplace_training_after_adopt_still_syncs(self, ps):
+        """Adopting sync()'s return and training it IN PLACE must not zero
+        future deltas (the snapshot may not alias the returned array)."""
+        from paddle_tpu.distributed.ps import GeoCommunicator
+
+        size = 4
+        ps.add_dense_table(8, size, init=np.zeros(size, np.float32), lr=1.0)
+        ps.start()
+        c = _client(ps)
+        geo = GeoCommunicator(c, 8, size, k_steps=1)
+        p = geo.base
+        p += 1.0
+        p = geo.sync(p)          # adopt the returned view
+        p += 1.0                 # in-place local training on the adopted array
+        merged = geo.sync(p)
+        np.testing.assert_allclose(merged, np.full(size, 2.0), rtol=1e-6)
+        c.disconnect()
