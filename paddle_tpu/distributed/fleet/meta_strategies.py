@@ -129,6 +129,12 @@ class DPStrategyTrainStep:
             (loss, new_buf), grads = jax.value_and_grad(
                 forward_loss, has_aux=True)(params_, buf, inputs, labels)
             loss = jax.lax.pmean(loss, dp_axis)
+            # buffers (BatchNorm running stats etc.) are computed from each
+            # rank's batch shard but leave under a replicated out_spec — they
+            # must be averaged over dp or the replicas silently diverge
+            new_buf = _tree_map(
+                lambda a: jax.lax.pmean(a, dp_axis)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, new_buf)
 
             if dgc:
                 u = _tree_map(lambda a: a[0], u)  # [1,...] shard -> local

@@ -215,7 +215,15 @@ PD_Predictor* PD_PredictorCreate(PD_Config* config) {
     auto& dst = std::strcmp(meth, "get_input_names") == 0 ? p->input_names
                                                           : p->output_names;
     for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
-      dst.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(names, i)));
+      const char* s = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+      if (s == nullptr) {
+        set_error_from_python();
+        Py_DECREF(names);
+        Py_DECREF(pred);
+        delete p;
+        return nullptr;
+      }
+      dst.emplace_back(s);
     }
     Py_DECREF(names);
   }

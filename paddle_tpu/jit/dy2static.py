@@ -260,10 +260,23 @@ def _assigned_names(nodes: List[ast.stmt]) -> List[str]:
     return out
 
 
-def _read_names(node) -> set:
+def _read_value_names(node) -> set:
+    """Names read as VALUES — excluding names whose only appearance is as
+    the callee base of a Call (``paddle`` in ``paddle.sum(x)``): those are
+    module/function bindings, and threading them through a
+    ``lax.while_loop`` carry fails under jit staging."""
+    callee_bases = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            f = n.func
+            while isinstance(f, ast.Attribute):
+                f = f.value
+            if isinstance(f, ast.Name):
+                callee_bases.add(id(f))
     names = set()
     for n in ast.walk(node):
-        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+        if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                and id(n) not in callee_bases):
             names.add(n.id)
     return names
 
@@ -547,7 +560,7 @@ class _Dy2StaticTransformer(_ForRangeTransformer, ast.NodeTransformer):
             return node
         assigned = _assigned_names(node.body)
         loop_vars = [n for n in assigned] + [
-            n for n in sorted(_read_names(node.test))
+            n for n in sorted(_read_value_names(node.test))
             if n not in assigned and n != _HELPER
         ]
         if not loop_vars:

@@ -239,10 +239,20 @@ def _record_while(cond_fn, body_fn, loop_vars):
 
         return jax.lax.while_loop(raw_cond, raw_body, tuple(carry0))
 
-    raw = composite(
-        *[v._value for v in loop_vars], *[t._value for t in ext_tensors]
+    # Record-time variable values are build-time placeholders (feeds are
+    # zeros), so the loop must NOT run concretely here — a predicate that is
+    # true on placeholders (e.g. ``while err >= 0``) would spin forever
+    # before any feed is supplied. Abstract-trace for output shapes/dtypes
+    # and emit zero placeholders; Executor replay runs the real loop on the
+    # real feeds.
+    abstract = jax.eval_shape(
+        composite,
+        *[jax.ShapeDtypeStruct(v._value.shape, v._value.dtype)
+          for v in loop_vars],
+        *[jax.ShapeDtypeStruct(t._value.shape, t._value.dtype)
+          for t in ext_tensors],
     )
-    out_tensors = tuple(wrap_raw(o) for o in raw)
+    out_tensors = tuple(wrap_raw(jnp.zeros(a.shape, a.dtype)) for a in abstract)
     tensor_mod._op_recorder(
         composite, list(loop_vars) + ext_tensors, out_tensors, True, "while"
     )

@@ -290,3 +290,25 @@ class TestTensorArray:
         x = paddle.to_tensor(1.0)
         static.increment(x, 2.0)
         assert float(x.numpy()) == 3.0
+
+
+class TestWhileRecordAbstract:
+    def test_predicate_true_on_placeholder_does_not_spin(self):
+        """Record-time feed placeholders are zeros; a loop whose predicate is
+        true on zeros (``while x >= lim: x -= d`` with all-zero placeholders
+        never progressing) must not execute concretely during Program
+        construction (advisor finding r1) — it is abstract-traced and only
+        runs on real feeds."""
+        prog = static.Program()
+        with static.program_guard(prog, static.Program()):
+            x = static.data("x", [], "float32")
+            d = static.data("d", [], "float32")
+            lim = static.data("lim", [], "float32")
+            (x,) = static.while_loop(lambda x: x >= lim,
+                                     lambda x: [x - d], [x])
+        exe = static.Executor()
+        r = exe.run(prog, feed={"x": np.asarray(5.0, np.float32),
+                                "d": np.asarray(2.0, np.float32),
+                                "lim": np.asarray(0.0, np.float32)},
+                    fetch_list=[x])[0]
+        assert float(r) == -1.0  # 5 -> 3 -> 1 -> -1

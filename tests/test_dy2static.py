@@ -445,3 +445,16 @@ class TestForRangeConversion:
         g = convert_to_static(f)
         np.testing.assert_allclose(g(paddle.to_tensor([1.0])).numpy(), [12.0])
         np.testing.assert_allclose(g(paddle.to_tensor([-1.0])).numpy(), [18.0])
+
+
+def test_while_with_module_call_in_test_stages():
+    """`while paddle.sum(x) > 0:` — the module name read in the test must not
+    be threaded through the lax.while_loop carry (advisor finding r1)."""
+    @paddle.jit.to_static
+    def f(x):
+        while paddle.sum(x) > 0:
+            x = x - 1.0
+        return x
+
+    out = f(paddle.to_tensor(np.array([2.0, 1.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [0.0, -1.0])
