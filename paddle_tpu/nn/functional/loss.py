@@ -67,6 +67,20 @@ def cross_entropy(
         squeeze = lbl_i.ndim == logp.ndim and lbl_i.shape[axis] == 1
         if squeeze:
             lbl_i = jnp.squeeze(lbl_i, axis=axis)
+        if label_smoothing == 0.0 and use_softmax and not wa:
+            # hard labels: loss = logsumexp - picked logit. Avoids
+            # materializing the full [N, V] log-probs the log_softmax+gather
+            # form writes (for an LM head V is 50k+ — that tensor is HBM
+            # bandwidth, not compute); XLA fuses the exp into the reduce.
+            m2 = jax.lax.stop_gradient(jnp.max(logits, axis=axis, keepdims=True))
+            lse = jnp.log(jnp.sum(jnp.exp(logits - m2), axis=axis)) \
+                + jnp.squeeze(m2, axis=axis)
+            lbl_exp = jnp.expand_dims(lbl_i, axis)
+            picked = jnp.take_along_axis(logits, jnp.clip(lbl_exp, 0, None),
+                                         axis=axis)
+            loss = lse - jnp.squeeze(picked, axis=axis)
+            mask = (lbl_i != ignore_index).astype(loss.dtype)
+            return loss * mask, mask
         if label_smoothing > 0.0:
             k = logp.shape[axis]
             onehot = jax.nn.one_hot(lbl_i, k, axis=axis, dtype=logp.dtype)

@@ -4,6 +4,8 @@ with jnp reductions XLA fuses; running stats updated imperatively on the layer.
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -81,6 +83,16 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
     axes = tuple(range(x.ndim - n_norm, x.ndim))
 
     def f(a, *wb):
+        if (len(axes) == 1 and weight is not None and bias is not None
+                and os.environ.get("PADDLE_TPU_FUSED_LN") == "1"
+                and jax.default_backend() == "tpu"):
+            # opt-in Pallas fwd/bwd kernels (ops/fused.py). Measured on v5e
+            # GPT-2 345M: XLA's own LN fusions fold into the surrounding
+            # residual adds and win end-to-end — the kernel is kept for wide
+            # rows where XLA splits the reduction.
+            from paddle_tpu.ops.fused import fused_layer_norm
+
+            return fused_layer_norm(a, wb[0], wb[1], epsilon)
         mean = jnp.mean(a, axis=axes, keepdims=True)
         var = jnp.var(a, axis=axes, keepdims=True)
         out = (a - mean) * jax.lax.rsqrt(var + epsilon)

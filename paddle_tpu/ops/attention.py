@@ -228,6 +228,34 @@ def _flash_attention_impl(q, k, v, causal, block_q, block_k):
     return blockwise_attention(q, k, v, causal=causal, block_k=block_k)
 
 
+def jax_flash_attention(q, k, v, causal=False, block_q=None, block_k=None):
+    """The jax-shipped Mosaic flash-attention kernel (fwd AND bwd kernels,
+    [b, h, l, d]), with block sizes clamped to the shape. Falls back to the
+    local ``flash_attention`` tier (→ blockwise) when the shape doesn't tile
+    or the rig's Mosaic compile path rejects the trace."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, flash_attention as _fa)
+
+    L, d = q.shape[2], q.shape[3]
+    bq = min(block_q or 512, L)
+    bk = min(block_k or 512, L)
+    if L % bq != 0 or L % bk != 0 or k.shape[2] != L:
+        return flash_attention(q, k, v, causal)
+    bs = BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
+        block_q_dkv=bq, block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
+    )
+    # the kernel's index math assumes 32-bit python-int promotion; this repo
+    # enables x64 globally, so scope it off around the trace
+    try:
+        with jax.enable_x64(False):
+            return _fa(q, k, v, causal=causal, block_sizes=bs,
+                       sm_scale=1.0 / math.sqrt(d))
+    except Exception:
+        return flash_attention(q, k, v, causal)
+
+
 def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
     out = _flash_attention_impl(q, k, v, causal, block_q, block_k)
     return out, (q, k, v)
@@ -313,12 +341,34 @@ def ring_attention(q, k, v, axis_name, causal=False, block_k=512):
 # Materialized XLA attention (TPU fast path for moderate sequence lengths)
 # ---------------------------------------------------------------------------
 _CAUSAL_CHUNK = 128  # measured optimum on v5e (sweep: 2/4/8/16 chunks @ L=1024)
+# max causal q-chunks (sweepable: more chunks skip more upper-triangle work
+# but emit more ops)
+_CAUSAL_MAX_CHUNKS = int(os.environ.get("PADDLE_TPU_ATTN_CHUNKS", "8"))
+# sweep knobs (bench tuning): force the [b,h,l,d] layout path / the legacy
+# concatenated-mask chunking / bf16 score storage (halves the O(L²) tensor's
+# bytes at ~3 decimal digits of logit precision)
+_FORCE_BHLD = os.environ.get("PADDLE_TPU_ATTN_LAYOUT", "") == "bhld"
+_DIAGSPLIT = os.environ.get("PADDLE_TPU_ATTN_DIAGSPLIT", "1") != "0"
+_SCORE_BF16 = os.environ.get("PADDLE_TPU_ATTN_SCORE_BF16", "0") == "1"
 
 
-def _xla_attention_block(q, k, v, mask, bias):
-    """One materialized softmax(QKᵀ)V block ([b, h, Lq, Lk] scores)."""
+def _einsum_eqs(blhd: bool):
+    return (("bqhd,bkhd->bhqk", "bhqk,bkhd->bqhd") if blhd
+            else ("bhqd,bhkd->bhqk", "bhqk,bhkd->bhqd"))
+
+
+def _attention_core(q, k, v, mask, bias=None, blhd=False):
+    """One materialized softmax(QKᵀ)V block.
+
+    ``blhd``: q/k/v are [b, l, h, d] (einsum contracts without pre-transposed
+    operands — the [b,h,l,d] transposes are real HBM copies the model can
+    skip); otherwise [b, h, l, d]. ``mask`` is [Lq, Lk] bool or None. For
+    bf16/f16 inputs the centered logits and probabilities round-trip through
+    the input dtype — the exp input IS materialized, and halving that O(L²)
+    tensor's bytes is a real HBM saving (see xla_attention docstring)."""
     d = q.shape[-1]
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+    eq = _einsum_eqs(blhd)
+    s = jnp.einsum(eq[0], q, k,
                    preferred_element_type=jnp.float32) * (1.0 / math.sqrt(d))
     if bias is not None:
         s = s + bias
@@ -326,18 +376,75 @@ def _xla_attention_block(q, k, v, mask, bias):
         s = jnp.where(mask, s, _NEG_INF)
     m = jax.lax.stop_gradient(jnp.max(s, axis=-1, keepdims=True))
     if jnp.issubdtype(q.dtype, jnp.floating) and q.dtype != jnp.float32:
-        # the centered logits ARE materialized by XLA as exp's input (measured:
-        # removing this cast grows the program past what some TPU compile
-        # services accept, and the f32 tensor doubles that traffic), so the
-        # bf16 round-trip here is a real O(L²) bandwidth saving, not noise
         e = jnp.exp((s - m).astype(q.dtype).astype(jnp.float32))
     else:
         e = jnp.exp(s - m)
     p = (e / jnp.maximum(e.sum(axis=-1, keepdims=True), 1e-30)).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return jnp.einsum(eq[1], p, v)
 
 
-def xla_attention(q, k, v, causal=False, bias=None):
+def _causal_chunk_size(Lq: int):
+    """Chunk size for causal q-chunking, or None when no exact chunking
+    exists (c must divide Lq — a truncated concat would silently drop query
+    rows)."""
+    c = max(_CAUSAL_CHUNK, Lq // max(_CAUSAL_MAX_CHUNKS, 1))
+    if Lq % c != 0 or Lq // c < 2:
+        return None
+    return c
+
+
+def _causal_chunked(q, k, v, blhd: bool):
+    """Causal self-attention, q-chunked: chunk i attends to keys [0, (i+1)·c)
+    under a static top-left tril mask — upper-triangle blocks are never
+    computed (~45% of attention compute+bandwidth at 8 chunks).
+
+    TPU-first structure (profile-driven, v5e):
+    - the softmax NORMALIZATION is deferred until after the PV matmul: the
+      unnormalized exp weights feed the MXU and the divide runs on the
+      [.., c, d] output instead of the [.., c, L] score tensor — one full
+      O(L²) elementwise pass (read+write) removed per chunk (flash's trick,
+      expressed at the XLA level);
+    - the 1/sqrt(d) scale folds into the [.., c, d] query chunk, not the
+      score tensor;
+    - einsums contract the native [b, l, h, d] layout directly (blhd=True):
+      no [b,h,l,d] transpose copies.
+    """
+    axis_l = 1 if blhd else 2
+    Lq = q.shape[axis_l]
+    c = _causal_chunk_size(Lq)
+    n = Lq // c
+    sl = functools.partial(jax.lax.slice_in_dim, axis=axis_l)
+    d = q.shape[-1]
+    scale = 1.0 / math.sqrt(d)
+    eq = _einsum_eqs(blhd)
+    bf = (jnp.issubdtype(q.dtype, jnp.floating) and q.dtype != jnp.float32)
+
+    sdt = q.dtype if (_SCORE_BF16 and bf) else jnp.float32
+    neg = jnp.asarray(_NEG_INF if sdt == jnp.float32 else -3e38, sdt)
+    outs = []
+    for i in range(n):
+        qi = sl(q, i * c, (i + 1) * c) * jnp.asarray(scale, q.dtype)
+        ub = (i + 1) * c
+        ki, vi = sl(k, 0, ub), sl(v, 0, ub)
+        s = jnp.einsum(eq[0], qi, ki, preferred_element_type=sdt)
+        mask = jnp.tril(jnp.ones((c, ub), bool), k=ub - c)
+        s = jnp.where(mask, s, neg)
+        m = jax.lax.stop_gradient(jnp.max(s, axis=-1, keepdims=True))
+        # centered logits round-trip through bf16 (the exp input IS
+        # materialized; halving its bytes is a real HBM saving), and the
+        # UNNORMALIZED probabilities go straight to the MXU — values in
+        # (0, 1], safe in bf16
+        e = (s - m).astype(q.dtype) if bf else (s - m)
+        e = jnp.exp(e.astype(jnp.float32))
+        l_sum = jnp.maximum(e.sum(axis=-1), 1e-30)  # [b, h, c]
+        o = jnp.einsum(eq[1], e.astype(q.dtype), vi)
+        inv = (1.0 / l_sum).astype(q.dtype)
+        outs.append(o * (inv[..., None] if not blhd
+                         else inv.transpose(0, 2, 1)[..., None]))
+    return jnp.concatenate(outs, axis=axis_l)
+
+
+def xla_attention(q, k, v, causal=False, bias=None, layout="bhld"):
     """softmax(QKᵀ)V with the [b, h, Lq, Lk] scores materialized.
 
     TPU-first details (measured on v5e / GPT-2 345M, 12.9k→45k tok/s/chip
@@ -347,62 +454,97 @@ def xla_attention(q, k, v, causal=False, bias=None):
       probabilities round-trip through the input dtype — halving the HBM
       traffic of the O(L²) tensors (same trade flash kernels make keeping
       P in bf16 for the PV matmul);
-    - **causal** self-attention runs q-chunked: query chunk i only matmuls
-      keys ≤ its diagonal, skipping the fully-masked upper-triangle blocks —
-      exact same math, ~45% less attention compute/bandwidth at 8 chunks.
+    - **causal** self-attention runs q-chunked with a diagonal split: query
+      chunk i matmuls keys < i·c with NO mask (all valid) plus its diagonal
+      c×c block under a static tril — skipping the fully-masked
+      upper-triangle blocks entirely (~45% less attention compute/bandwidth
+      at 8 chunks) and the mask/select lanes on the strictly-lower ones;
+    - ``layout='blhd'`` contracts [b, l, h, d] operands directly, letting
+      the model skip the four [b,h,l,d] transpose copies per layer.
     """
-    Lq, Lk = q.shape[2], k.shape[2]
-    if (causal and bias is None and Lq == Lk and Lq % _CAUSAL_CHUNK == 0
-            and Lq // _CAUSAL_CHUNK >= 2):
-        # cap the unroll at 8 chunks so long sequences don't emit huge
-        # programs (some TPU compile services reject them); ≥8 chunks also
-        # showed no further gain in the sweep
-        c = max(_CAUSAL_CHUNK, Lq // 8)
+    blhd = layout == "blhd"
+    axis_l = 1 if blhd else 2
+    Lq, Lk = q.shape[axis_l], k.shape[axis_l]
+    if (causal and bias is None and Lq == Lk
+            and _causal_chunk_size(Lq) is not None):
+        # chunk-count cap keeps the emitted program small (some TPU compile
+        # services reject huge ones)
+        if _DIAGSPLIT:
+            return _causal_chunked(q, k, v, blhd)
+        tr = lambda t: t.transpose(0, 2, 1, 3)
+        if blhd:
+            q, k, v = tr(q), tr(k), tr(v)
+        c = _causal_chunk_size(Lq)
         outs = []
         for i in range(Lq // c):
             qi = jax.lax.slice_in_dim(q, i * c, (i + 1) * c, axis=2)
             ub = (i + 1) * c
             ki = jax.lax.slice_in_dim(k, 0, ub, axis=2)
             vi = jax.lax.slice_in_dim(v, 0, ub, axis=2)
-            mask = jnp.tril(jnp.ones((c, ub), bool), k=ub - c)
-            outs.append(_xla_attention_block(qi, ki, vi, mask, None))
-        return jnp.concatenate(outs, axis=2)
+            cmask = jnp.tril(jnp.ones((c, ub), bool), k=ub - c)
+            outs.append(_attention_core(qi, ki, vi, cmask))
+        out = jnp.concatenate(outs, axis=2)
+        return tr(out) if blhd else out
     mask = jnp.tril(jnp.ones((Lq, Lk), bool)) if causal else None
     # causal mask is top-left aligned (k_pos <= q_pos), matching
     # blockwise/flash so the dispatch tiers agree for Lq != Lk
-    return _xla_attention_block(q, k, v, mask, bias)
+    if blhd and bias is not None:
+        raise NotImplementedError("bias requires layout='bhld'")
+    return _attention_core(q, k, v, mask, bias, blhd)
 
 
 # ---------------------------------------------------------------------------
 # Public dispatch
 # ---------------------------------------------------------------------------
 def dot_product_attention(q, k, v, causal=False, bias=None, sp_axis=None,
-                          use_flash=True):
-    """[b, h, l, d] attention dispatch by context and ``set_attention_impl``:
-    ring (sp sharded) > selected impl > blockwise fallback."""
+                          use_flash=True, layout="bhld"):
+    """Attention dispatch by context and ``set_attention_impl``:
+    ring (sp sharded) > selected impl > blockwise fallback.
+
+    ``layout='blhd'`` passes [b, l, h, d] operands straight into the XLA
+    path (no transpose copies); impls that need [b, h, l, d] get a
+    transposed view and transpose back."""
+    if layout == "blhd":
+        if (sp_axis is None and bias is None and not _FORCE_BHLD
+                and _resolve_impl(q.shape[1], bias, use_flash) == "xla"):
+            return xla_attention(q, k, v, causal=causal, layout="blhd")
+        tr = lambda t: t.transpose(0, 2, 1, 3)
+        out = dot_product_attention(tr(q), tr(k), tr(v), causal=causal,
+                                    bias=bias, sp_axis=sp_axis,
+                                    use_flash=use_flash)
+        return tr(out)
     if sp_axis is not None:
         return ring_attention(q, k, v, sp_axis, causal=causal)
-    L = q.shape[2]
+    impl = _resolve_impl(q.shape[2], bias, use_flash)
+    if impl == "jax_flash":
+        return jax_flash_attention(q, k, v, causal=causal)
+    if impl == "flash":
+        return flash_attention(q, k, v, causal)
+    if impl == "xla":
+        return xla_attention(q, k, v, causal=causal, bias=bias)
+    return blockwise_attention(q, k, v, causal=causal, bias=bias)
+
+
+def _resolve_impl(L, bias, use_flash):
+    """Single source of truth for the impl a [b,h,l,d] dispatch will take
+    (the blhd fast path consults it too, so both layouts always agree).
+
+    auto: ``use_flash=False`` keeps the exact f32 blockwise recurrence (the
+    model-level flag selects numerics, not just a kernel); on TPU short/mid
+    sequences take the materialized XLA path, long ones stream blockwise
+    (never Mosaic unless opted in — some rigs cannot compile Pallas at
+    all); off-TPU flash_attention safely degrades to blockwise."""
     on_tpu = jax.default_backend() == "tpu"
     if _IMPL == "pallas":
-        if bias is None:
-            return flash_attention(q, k, v, causal)
-        return blockwise_attention(q, k, v, causal=causal, bias=bias)
+        if bias is not None:
+            return "blockwise"
+        return "jax_flash" if on_tpu else "flash"
     if _IMPL == "xla":
-        return xla_attention(q, k, v, causal=causal, bias=bias)
+        return "xla"
     if _IMPL == "blockwise":
-        return blockwise_attention(q, k, v, causal=causal, bias=bias)
-    # auto: use_flash=False keeps the exact f32 blockwise recurrence (the
-    # model-level flag selects numerics, not just a kernel); on TPU short/mid
-    # sequences take the materialized XLA path, long ones stream blockwise
-    # (never Mosaic — some rigs cannot compile Pallas at all); off-TPU
-    # flash_attention safely degrades to blockwise.
+        return "blockwise"
     if not use_flash:
-        return blockwise_attention(q, k, v, causal=causal, bias=bias)
+        return "blockwise"
     if on_tpu:
-        if L <= _XLA_MAX_SEQ:
-            return xla_attention(q, k, v, causal=causal, bias=bias)
-        return blockwise_attention(q, k, v, causal=causal, bias=bias)
-    if bias is not None:
-        return blockwise_attention(q, k, v, causal=causal, bias=bias)
-    return flash_attention(q, k, v, causal)
+        return "xla" if L <= _XLA_MAX_SEQ else "blockwise"
+    return "blockwise" if bias is not None else "flash"

@@ -31,33 +31,130 @@ def _ln_kernel(x_ref, w_ref, b_ref, o_ref, *, eps):
                   + b_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
 
 
-def fused_layer_norm(x, weight, bias, eps=1e-5, block_rows=256):
-    """x: [..., hidden]; weight/bias: [hidden]."""
+def _ln_bwd_kernel(x_ref, w_ref, g_ref, dx_ref, dw_ref, db_ref, *, eps):
+    """One row-block of the LayerNorm backward.
+
+    μ/σ are recomputed from x (one extra read of a tile already in VMEM
+    beats materializing per-row stats in HBM); dγ/dβ accumulate into a
+    VMEM-resident (8, hidden) block across the sequential grid (constant
+    index_map), row 0 carrying the sum.
+        dx = σ⁻¹ · (g·w − mean(g·w) − x̂ · mean(g·w·x̂))
+    """
+    from jax.experimental import pallas as pl
+
+    x = x_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean((x - mean) ** 2, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (x - mean) * rstd
+    gw = g * w
+    m1 = jnp.mean(gw, axis=-1, keepdims=True)
+    m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx_ref[...] = (rstd * (gw - m1 - xhat * m2)).astype(dx_ref.dtype)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+        db_ref[...] = jnp.zeros_like(db_ref)
+
+    dw_ref[0, :] += jnp.sum(g * xhat, axis=0)
+    db_ref[0, :] += jnp.sum(g, axis=0)
+
+
+def _ln_shapes_fit(x, block_rows):
     hidden = x.shape[-1]
-    lead = x.shape[:-1]
     rows = 1
-    for s in lead:
+    for s in x.shape[:-1]:
         rows *= s
-    if not _on_tpu() or rows % block_rows != 0 or hidden % 128 != 0:
-        mean = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.var(x, axis=-1, keepdims=True)
-        return ((x - mean) * jax.lax.rsqrt(var + eps) * weight + bias).astype(x.dtype)
+    return (_on_tpu() and rows % block_rows == 0 and hidden % 128 == 0,
+            rows, hidden)
+
+
+def _ln_reference(x, weight, bias, eps):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return ((x - mean) * jax.lax.rsqrt(var + eps) * weight + bias).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_layer_norm(x, weight, bias, eps=1e-5, block_rows=256):
+    """x: [..., hidden]; weight/bias: [hidden]. Pallas forward AND backward
+    kernels on TPU (one pass each over the activation tensor — XLA emits
+    LayerNorm backward as several memory-bound fusions, measured ~3x the
+    bytes); jnp fallback elsewhere."""
+    return _fused_ln_fwd_impl(x, weight, bias, eps, block_rows)
+
+
+def _fused_ln_fwd_impl(x, weight, bias, eps, block_rows):
+    fits, rows, hidden = _ln_shapes_fit(x, block_rows)
+    if not fits:
+        return _ln_reference(x, weight, bias, eps)
 
     from jax.experimental import pallas as pl
 
     x2 = x.reshape(rows, hidden)
-    out = pl.pallas_call(
-        functools.partial(_ln_kernel, eps=eps),
-        grid=(rows // block_rows,),
-        in_specs=[
-            pl.BlockSpec((block_rows, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((hidden,), lambda i: (0,)),
-            pl.BlockSpec((hidden,), lambda i: (0,)),
-        ],
-        out_specs=pl.BlockSpec((block_rows, hidden), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((rows, hidden), x.dtype),
-    )(x2, weight, bias)
+    # pin the trace to 32-bit inside the kernel call: the repo enables x64
+    # globally, and Mosaic cannot legalize the i64 grid scalars it injects
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_ln_kernel, eps=eps),
+            grid=(rows // block_rows,),
+            in_specs=[
+                pl.BlockSpec((block_rows, hidden), lambda i: (i, 0)),
+                pl.BlockSpec((hidden,), lambda i: (0,)),
+                pl.BlockSpec((hidden,), lambda i: (0,)),
+            ],
+            out_specs=pl.BlockSpec((block_rows, hidden), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, hidden), x.dtype),
+        )(x2, weight, bias)
     return out.reshape(x.shape)
+
+
+def _fused_ln_fwd(x, weight, bias, eps, block_rows):
+    return _fused_ln_fwd_impl(x, weight, bias, eps, block_rows), (x, weight, bias)
+
+
+def _fused_ln_bwd(eps, block_rows, res, g):
+    x, weight, bias = res
+    fits, rows, hidden = _ln_shapes_fit(x, block_rows)
+    if not fits:
+        _, vjp = jax.vjp(lambda a, w, b: _ln_reference(a, w, b, eps),
+                         x, weight, bias)
+        return vjp(g)
+
+    from jax.experimental import pallas as pl
+
+    nblocks = rows // block_rows
+    x2 = x.reshape(rows, hidden)
+    g2 = g.reshape(rows, hidden)
+    with jax.enable_x64(False):
+        dx, dw_p, db_p = pl.pallas_call(
+            functools.partial(_ln_bwd_kernel, eps=eps),
+            grid=(nblocks,),
+            in_specs=[
+                pl.BlockSpec((block_rows, hidden), lambda i: (i, 0)),
+                pl.BlockSpec((hidden,), lambda i: (0,)),
+                pl.BlockSpec((block_rows, hidden), lambda i: (i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((block_rows, hidden), lambda i: (i, 0)),
+                pl.BlockSpec((8, hidden), lambda i: (0, 0)),
+                pl.BlockSpec((8, hidden), lambda i: (0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((rows, hidden), x.dtype),
+                jax.ShapeDtypeStruct((8, hidden), jnp.float32),
+                jax.ShapeDtypeStruct((8, hidden), jnp.float32),
+            ],
+        )(x2, weight, g2)
+    dw = dw_p[0].astype(weight.dtype)
+    db = db_p[0].astype(bias.dtype)
+    return dx.reshape(x.shape), dw, db
+
+
+fused_layer_norm.defvjp(_fused_ln_fwd, _fused_ln_bwd)
 
 
 # ---------------------------------------------------------------------------

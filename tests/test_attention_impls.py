@@ -49,8 +49,8 @@ class TestXlaAttention:
         # causal path must be numerically identical to the single-block form
         q, k, v = rand_qkv(rng, L=256, d=16)
         a = att.xla_attention(q, k, v, causal=True)
-        b = att._xla_attention_block(
-            q, k, v, jnp.tril(jnp.ones((256, 256), bool)), None)
+        b = att._attention_core(
+            q, k, v, jnp.tril(jnp.ones((256, 256), bool)))
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6, atol=1e-6)
         c = att.blockwise_attention(q, k, v, causal=True)
