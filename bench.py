@@ -39,13 +39,13 @@ def main():
                            attention_dropout=0.0)
         # batch 8 fills the MXU; 345M + activations fit HBM without remat
         # (recompute trades ~25% throughput and is off for the headline run)
-        batch, seq, iters = 8, 1024, 10
+        batch, seq, iters, reps = 8, 1024, 30, 3
     else:  # smoke mode off-TPU
         config = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=4,
                            num_heads=4, max_position_embeddings=256,
                            hidden_dropout=0.0, attention_dropout=0.0,
                            use_flash_attention=False)
-        batch, seq, iters = 4, 128, 3
+        batch, seq, iters, reps = 4, 128, 3, 1
 
     paddle.seed(0)
     model = GPTForCausalLM(config)
@@ -63,13 +63,17 @@ def main():
 
     loss = step((ids,), (labels,))  # compile + warmup
     float(loss.numpy())
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step((ids,), (labels,))
-    float(loss.numpy())
-    dt = time.perf_counter() - t0
-
-    tokens_per_sec = batch * seq * iters / dt
+    # median of `reps` timed windows of `iters` steps each (clock jitter at
+    # ~100-200 ms/step makes a single short window unreliable)
+    rates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step((ids,), (labels,))
+        float(loss.numpy())
+        dt = time.perf_counter() - t0
+        rates.append(batch * seq * iters / dt)
+    tokens_per_sec = sorted(rates)[len(rates) // 2]
     print(json.dumps({
         "metric": "gpt2_345m_train_tokens_per_sec_per_chip" if on_tpu
         else "gpt2_tiny_train_tokens_per_sec_cpu_smoke",

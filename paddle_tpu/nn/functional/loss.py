@@ -51,6 +51,27 @@ def cross_entropy(
     w = weight
 
     def f(logits, lbl, *wa):
+        # hard-label fast path FIRST, before any full log-softmax exists to
+        # be materialized (in eager mode nothing dead-code-eliminates it)
+        if (not soft_label and label_smoothing == 0.0 and use_softmax
+                and not wa):
+            lbl_i = lbl.astype(jnp.int32)
+            if lbl_i.ndim == logits.ndim and lbl_i.shape[axis] == 1:
+                lbl_i = jnp.squeeze(lbl_i, axis=axis)
+            # loss = logsumexp - picked logit. Avoids materializing the full
+            # [N, V] log-probs the log_softmax+gather form writes (for an LM
+            # head V is 50k+ — that tensor is HBM bandwidth, not compute);
+            # XLA fuses the exp into the reduce.
+            m2 = jax.lax.stop_gradient(
+                jnp.max(logits, axis=axis, keepdims=True))
+            lse = jnp.log(jnp.sum(jnp.exp(logits - m2), axis=axis)) \
+                + jnp.squeeze(m2, axis=axis)
+            lbl_exp = jnp.expand_dims(lbl_i, axis)
+            picked = jnp.take_along_axis(logits, jnp.clip(lbl_exp, 0, None),
+                                         axis=axis)
+            loss = lse - jnp.squeeze(picked, axis=axis)
+            mask = (lbl_i != ignore_index).astype(loss.dtype)
+            return loss * mask, mask
         logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
             jnp.clip(logits, 1e-30, None)
         )
@@ -67,20 +88,6 @@ def cross_entropy(
         squeeze = lbl_i.ndim == logp.ndim and lbl_i.shape[axis] == 1
         if squeeze:
             lbl_i = jnp.squeeze(lbl_i, axis=axis)
-        if label_smoothing == 0.0 and use_softmax and not wa:
-            # hard labels: loss = logsumexp - picked logit. Avoids
-            # materializing the full [N, V] log-probs the log_softmax+gather
-            # form writes (for an LM head V is 50k+ — that tensor is HBM
-            # bandwidth, not compute); XLA fuses the exp into the reduce.
-            m2 = jax.lax.stop_gradient(jnp.max(logits, axis=axis, keepdims=True))
-            lse = jnp.log(jnp.sum(jnp.exp(logits - m2), axis=axis)) \
-                + jnp.squeeze(m2, axis=axis)
-            lbl_exp = jnp.expand_dims(lbl_i, axis)
-            picked = jnp.take_along_axis(logits, jnp.clip(lbl_exp, 0, None),
-                                         axis=axis)
-            loss = lse - jnp.squeeze(picked, axis=axis)
-            mask = (lbl_i != ignore_index).astype(loss.dtype)
-            return loss * mask, mask
         if label_smoothing > 0.0:
             k = logp.shape[axis]
             onehot = jax.nn.one_hot(lbl_i, k, axis=axis, dtype=logp.dtype)

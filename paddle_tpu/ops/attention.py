@@ -30,12 +30,13 @@ __all__ = [
 
 # Attention implementation selector. 'auto' (default) picks per context:
 # ring for sp-sharded, blockwise for biased/very-long sequences, and the
-# materialized XLA path on TPU for moderate lengths — measured 2.8x faster
-# end-to-end than the scan-based blockwise path on v5e for GPT-2 345M
-# (XLA tiles the [L, L] einsums onto the MXU; the scan's small per-block
-# matmuls and f32 operands underutilize it). 'pallas' opts into the custom
-# kernel explicitly: some TPU rigs compile Mosaic through a service that
-# plain XLA doesn't need, so auto never risks it.
+# materialized XLA path on TPU for moderate lengths — measured fastest
+# end-to-end on v5e for GPT-2 345M (L=1024, d=64): the big batched einsums
+# tile onto the MXU better than per-head Pallas kernel ops at these shapes,
+# beating both the scan-based blockwise path (2.8x) and the Mosaic flash
+# kernels ('pallas' = the jax-shipped kernel, 'flash_tpu' = the repo's
+# layout-native kernel in flash_tpu.py — both opt-in; some TPU rigs compile
+# Mosaic through a service plain XLA doesn't need, so auto never risks it).
 _IMPL = os.environ.get("PADDLE_TPU_ATTENTION", "auto")
 # beyond this length the materialized [L, L] scores dominate HBM; stream
 # instead
@@ -43,14 +44,16 @@ _XLA_MAX_SEQ = int(os.environ.get("PADDLE_TPU_ATTENTION_MAX_SEQ", "4096"))
 
 
 def set_attention_impl(impl: str):
-    """impl ∈ {'auto', 'pallas', 'xla', 'blockwise'}.
+    """impl ∈ {'auto', 'pallas', 'flash_tpu', 'xla', 'blockwise'}.
 
-    The selector is read at TRACE time: functions already jitted keep the
-    implementation they compiled with (jit cache). Call before building the
-    train/eval step, or clear caches, for the change to take effect.
+    'pallas' selects the jax-shipped Mosaic flash kernel; 'flash_tpu' the
+    repo's layout-native Pallas kernel (ops/flash_tpu.py). The selector is
+    read at TRACE time: functions already jitted keep the implementation
+    they compiled with (jit cache). Call before building the train/eval
+    step, or clear caches, for the change to take effect.
     """
     global _IMPL
-    if impl not in ("auto", "pallas", "xla", "blockwise"):
+    if impl not in ("auto", "pallas", "flash_tpu", "xla", "blockwise"):
         raise ValueError(f"unknown attention impl {impl!r}")
     _IMPL = impl
 
@@ -231,8 +234,10 @@ def _flash_attention_impl(q, k, v, causal, block_q, block_k):
 def jax_flash_attention(q, k, v, causal=False, block_q=None, block_k=None):
     """The jax-shipped Mosaic flash-attention kernel (fwd AND bwd kernels,
     [b, h, l, d]), with block sizes clamped to the shape. Falls back to the
-    local ``flash_attention`` tier (→ blockwise) when the shape doesn't tile
-    or the rig's Mosaic compile path rejects the trace."""
+    local ``flash_attention`` tier (→ blockwise) when the shape doesn't
+    tile, or when TRACING fails (eager x64 issues etc.) — a Mosaic compile
+    SERVICE failure under jit surfaces at jit-compile time instead; use the
+    'auto'/'xla' impl on such rigs."""
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes, flash_attention as _fa)
 
@@ -340,16 +345,19 @@ def ring_attention(q, k, v, axis_name, causal=False, block_k=512):
 # ---------------------------------------------------------------------------
 # Materialized XLA attention (TPU fast path for moderate sequence lengths)
 # ---------------------------------------------------------------------------
-_CAUSAL_CHUNK = 128  # measured optimum on v5e (sweep: 2/4/8/16 chunks @ L=1024)
+# minimum causal q-chunk rows (sweepable; 128 measured optimum on v5e)
+_CAUSAL_CHUNK = int(os.environ.get("PADDLE_TPU_ATTN_MIN_CHUNK", "128"))
 # max causal q-chunks (sweepable: more chunks skip more upper-triangle work
 # but emit more ops)
-_CAUSAL_MAX_CHUNKS = int(os.environ.get("PADDLE_TPU_ATTN_CHUNKS", "8"))
-# sweep knobs (bench tuning): force the [b,h,l,d] layout path / the legacy
-# concatenated-mask chunking / bf16 score storage (halves the O(L²) tensor's
-# bytes at ~3 decimal digits of logit precision)
+_CAUSAL_MAX_CHUNKS = int(os.environ.get("PADDLE_TPU_ATTN_CHUNKS", "16"))
+# sweep knob (bench tuning): force the [b,h,l,d] layout path
 _FORCE_BHLD = os.environ.get("PADDLE_TPU_ATTN_LAYOUT", "") == "bhld"
-_DIAGSPLIT = os.environ.get("PADDLE_TPU_ATTN_DIAGSPLIT", "1") != "0"
-_SCORE_BF16 = os.environ.get("PADDLE_TPU_ATTN_SCORE_BF16", "0") == "1"
+# bf16 score STORAGE, default ON for bf16/f16 inputs: the centered logits
+# already round-trip through bf16 before exp, and softmax cancels the max
+# shift exactly (m only guards overflow), so bf16-stored scores are
+# numerically ~equivalent (~1 ulp of bf16 either way) while halving the
+# O(L²) tensor's bytes. Set =0 for f32 score storage.
+_SCORE_BF16 = os.environ.get("PADDLE_TPU_ATTN_SCORE_BF16", "1") == "1"
 
 
 def _einsum_eqs(blhd: bool):
@@ -445,20 +453,21 @@ def _causal_chunked(q, k, v, blhd: bool):
 
 
 def xla_attention(q, k, v, causal=False, bias=None, layout="bhld"):
-    """softmax(QKᵀ)V with the [b, h, Lq, Lk] scores materialized.
+    """softmax(QKᵀ)V with the [Lq, Lk] scores materialized (XLA-level).
 
-    TPU-first details (measured on v5e / GPT-2 345M, 12.9k→45k tok/s/chip
-    end-to-end vs the scan-based blockwise path):
-    - scores accumulate in f32 on the MXU (``preferred_element_type``) for
-      softmax stability, but for bf16/f16 inputs the centered logits and
-      probabilities round-trip through the input dtype — halving the HBM
-      traffic of the O(L²) tensors (same trade flash kernels make keeping
-      P in bf16 for the PV matmul);
-    - **causal** self-attention runs q-chunked with a diagonal split: query
-      chunk i matmuls keys < i·c with NO mask (all valid) plus its diagonal
-      c×c block under a static tril — skipping the fully-masked
-      upper-triangle blocks entirely (~45% less attention compute/bandwidth
-      at 8 chunks) and the mask/select lanes on the strictly-lower ones;
+    TPU-first details (profile-driven on v5e / GPT-2 345M, 12.9k→53k
+    tok/s/chip end-to-end vs the scan-based blockwise path):
+    - scores ACCUMULATE in f32 on the MXU regardless of storage dtype; for
+      bf16/f16 inputs the stored scores, centered logits, and unnormalized
+      probabilities round-trip through the input dtype by default
+      (``PADDLE_TPU_ATTN_SCORE_BF16=0`` opts back into f32 storage) —
+      softmax cancels the max shift exactly, so this is numerically ~1 ulp
+      of bf16 either way while halving the O(L²) HBM bytes;
+    - **causal** self-attention runs q-chunked (``_causal_chunked``): chunk
+      i only matmuls keys ≤ its diagonal, skipping the fully-masked
+      upper-triangle blocks (~45% of attention compute/bandwidth at 8
+      chunks), and softmax normalization is deferred until after the PV
+      matmul;
     - ``layout='blhd'`` contracts [b, l, h, d] operands directly, letting
       the model skip the four [b,h,l,d] transpose copies per layer.
     """
@@ -469,22 +478,7 @@ def xla_attention(q, k, v, causal=False, bias=None, layout="bhld"):
             and _causal_chunk_size(Lq) is not None):
         # chunk-count cap keeps the emitted program small (some TPU compile
         # services reject huge ones)
-        if _DIAGSPLIT:
-            return _causal_chunked(q, k, v, blhd)
-        tr = lambda t: t.transpose(0, 2, 1, 3)
-        if blhd:
-            q, k, v = tr(q), tr(k), tr(v)
-        c = _causal_chunk_size(Lq)
-        outs = []
-        for i in range(Lq // c):
-            qi = jax.lax.slice_in_dim(q, i * c, (i + 1) * c, axis=2)
-            ub = (i + 1) * c
-            ki = jax.lax.slice_in_dim(k, 0, ub, axis=2)
-            vi = jax.lax.slice_in_dim(v, 0, ub, axis=2)
-            cmask = jnp.tril(jnp.ones((c, ub), bool), k=ub - c)
-            outs.append(_attention_core(qi, ki, vi, cmask))
-        out = jnp.concatenate(outs, axis=2)
-        return tr(out) if blhd else out
+        return _causal_chunked(q, k, v, blhd)
     mask = jnp.tril(jnp.ones((Lq, Lk), bool)) if causal else None
     # causal mask is top-left aligned (k_pos <= q_pos), matching
     # blockwise/flash so the dispatch tiers agree for Lq != Lk
@@ -505,9 +499,14 @@ def dot_product_attention(q, k, v, causal=False, bias=None, sp_axis=None,
     path (no transpose copies); impls that need [b, h, l, d] get a
     transposed view and transpose back."""
     if layout == "blhd":
-        if (sp_axis is None and bias is None and not _FORCE_BHLD
-                and _resolve_impl(q.shape[1], bias, use_flash) == "xla"):
-            return xla_attention(q, k, v, causal=causal, layout="blhd")
+        if sp_axis is None and bias is None and not _FORCE_BHLD:
+            impl = _resolve_impl(q.shape[1], bias, use_flash, causal)
+            if impl == "flash_tpu":
+                from .flash_tpu import flash_attention_blhd
+
+                return flash_attention_blhd(q, k, v, causal)
+            if impl == "xla":
+                return xla_attention(q, k, v, causal=causal, layout="blhd")
         tr = lambda t: t.transpose(0, 2, 1, 3)
         out = dot_product_attention(tr(q), tr(k), tr(v), causal=causal,
                                     bias=bias, sp_axis=sp_axis,
@@ -515,7 +514,12 @@ def dot_product_attention(q, k, v, causal=False, bias=None, sp_axis=None,
         return tr(out)
     if sp_axis is not None:
         return ring_attention(q, k, v, sp_axis, causal=causal)
-    impl = _resolve_impl(q.shape[2], bias, use_flash)
+    impl = _resolve_impl(q.shape[2], bias, use_flash, causal)
+    if impl == "flash_tpu":
+        from .flash_tpu import flash_attention_blhd
+
+        tr = lambda t: t.transpose(0, 2, 1, 3)
+        return tr(flash_attention_blhd(tr(q), tr(k), tr(v), causal))
     if impl == "jax_flash":
         return jax_flash_attention(q, k, v, causal=causal)
     if impl == "flash":
@@ -525,16 +529,21 @@ def dot_product_attention(q, k, v, causal=False, bias=None, sp_axis=None,
     return blockwise_attention(q, k, v, causal=causal, bias=bias)
 
 
-def _resolve_impl(L, bias, use_flash):
+def _resolve_impl(L, bias, use_flash, causal=True):
     """Single source of truth for the impl a [b,h,l,d] dispatch will take
     (the blhd fast path consults it too, so both layouts always agree).
 
     auto: ``use_flash=False`` keeps the exact f32 blockwise recurrence (the
     model-level flag selects numerics, not just a kernel); on TPU short/mid
-    sequences take the materialized XLA path, long ones stream blockwise
-    (never Mosaic unless opted in — some rigs cannot compile Pallas at
-    all); off-TPU flash_attention safely degrades to blockwise."""
+    sequences take the materialized XLA path (measured fastest at GPT-class
+    shapes — the Mosaic kernels are opt-in via 'pallas'/'flash_tpu'), long
+    ones stream blockwise; off-TPU flash_attention safely degrades to
+    blockwise. The kernel tiers gate on SHAPE at trace time; a rig whose
+    Mosaic compile service itself fails surfaces that at jit-compile time —
+    select 'auto'/'xla' there."""
     on_tpu = jax.default_backend() == "tpu"
+    if _IMPL == "flash_tpu":
+        return "flash_tpu" if (on_tpu and bias is None and causal) else "xla"
     if _IMPL == "pallas":
         if bias is not None:
             return "blockwise"
