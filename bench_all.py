@@ -1,0 +1,177 @@
+"""Measure BASELINE.md configs beyond the headline (bench.py = config #4).
+
+Writes one JSON object per config to stdout and the full list to
+``BENCH_extra.json``. Mirrors the reference's relative-CI approach
+(tools/test_model_benchmark.sh): absolute numbers are recorded per commit
+and tracked regression-style, since the reference publishes none.
+
+Configs (BASELINE.md table):
+  #1 MNIST LeNet, dygraph, host batches           -> samples/sec
+  #2 ResNet-50, static-graph Executor, one chip   -> samples/sec
+  #3 BERT-base pretrain, fleet DP engine, one chip-> samples/sec + tok/sec
+(#5 ERNIE pp+tp needs a pod slice; its sharding path is validated by
+ dryrun_multichip on the virtual mesh.)
+
+Usage: python bench_all.py [--smoke]   (--smoke: tiny shapes, any backend)
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SMOKE = "--smoke" in sys.argv
+
+
+def _block(out):
+    # materialize, don't jax.block_until_ready: on the remote axon
+    # platform block_until_ready returns before execution finishes
+    # (measured: 30-step windows "completed" in dispatch-only time),
+    # while a host transfer genuinely drains the queue
+    np.asarray(getattr(out, "_value", out))
+
+
+def _rate(fn, n_warm, n_iter, reps=3):
+    """Median samples/sec of `reps` windows; fn(i) runs one step and
+    returns an object to block on."""
+    for i in range(n_warm):
+        out = fn(i)
+    _block(out)
+    rates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for i in range(n_iter):
+            out = fn(i)
+        _block(out)
+        rates.append(n_iter / (time.perf_counter() - t0))
+    return sorted(rates)[len(rates) // 2]
+
+
+def bench_lenet():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    net = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    b = 64
+    rng = np.random.RandomState(0)
+    xs = paddle.to_tensor(rng.randn(b, 1, 28, 28).astype(np.float32))
+    ys = paddle.to_tensor(rng.randint(0, 10, b).astype(np.int64))
+
+    step = paddle.jit.TrainStep(net, loss_fn=nn.CrossEntropyLoss(),
+                                optimizer=opt)
+
+    def one(i):
+        return step((xs,), (ys,))
+
+    sps = _rate(one, 3, 5 if SMOKE else 30) * b
+    return {"metric": "lenet_mnist_dygraph_samples_per_sec",
+            "value": round(sps, 2), "unit": "samples/sec"}
+
+
+def bench_resnet50():
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    b = 8 if SMOKE else 64
+    size = 32 if SMOKE else 224
+    main = static.Program()
+    start = static.Program()
+    with static.program_guard(main, start):
+        x = static.data("x", [None, 3, size, size], "float32")
+        y = static.data("y", [None, 1], "int64")
+        model = resnet50(num_classes=100 if SMOKE else 1000)
+        logits = model(x)
+        loss = paddle.nn.functional.cross_entropy(
+            logits, y.reshape([-1]))
+        opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(start)
+    rng = np.random.RandomState(0)
+    # device-resident feed: on this rig host->device rides an HTTP tunnel
+    # (~40MB of images/step would measure the tunnel, not the chip); real
+    # input pipelines keep batches device-side via double-buffered device_put
+    xv = paddle.to_tensor(rng.randn(b, 3, size, size).astype(np.float32))
+    yv = paddle.to_tensor(rng.randint(0, 100, (b, 1)).astype(np.int64))
+
+    def one(i):
+        return exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])[0]
+
+    sps = _rate(one, 2, 3 if SMOKE else 20) * b
+    return {"metric": "resnet50_static_executor_samples_per_sec_per_chip",
+            "value": round(sps, 2), "unit": "samples/sec"}
+
+
+def bench_bert_dp():
+    import paddle_tpu as paddle
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.fleet.engine import ParallelTrainStep
+    from paddle_tpu.text.models.bert import (BertForPretraining, bert_base,
+                                             bert_tiny)
+
+    paddle.seed(0)
+    config = bert_tiny() if SMOKE else bert_base(hidden_dropout=0.0,
+                                                 attention_dropout=0.0)
+    b, L = (4, 64) if SMOKE else (32, 128)  # phase-1 pretrain shape
+    model = BertForPretraining(config)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                                 parameters=model.parameters())
+    # fleet DP engine; one chip here = dp world of 1, the same compiled
+    # path the 8-device CPU-mesh parity tests exercise with dp=8
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    step = ParallelTrainStep(
+        model, loss_fn=model.loss_fn, optimizer=opt, mesh=mesh,
+        compute_dtype=None if SMOKE else jnp.bfloat16)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, config.vocab_size, (b, L)).astype(np.int32)
+    mlm = np.where(rng.rand(b, L) < 0.15, ids, -100).astype(np.int32)
+    nsp = rng.randint(0, 2, b).astype(np.int64)
+
+    def one(i):
+        return step((ids,), (mlm, nsp))
+
+    sps = _rate(one, 2, 3 if SMOKE else 30) * b
+    return {"metric": "bert_base_dp_pretrain_samples_per_sec_per_chip",
+            "value": round(sps, 2), "unit": "samples/sec",
+            "tokens_per_sec": round(sps * L, 2)}
+
+
+def main():
+    only = [a.lstrip("-") for a in sys.argv[1:] if a.lstrip("-") in
+            ("lenet", "resnet50", "bert")]
+    table = {"lenet": bench_lenet, "resnet50": bench_resnet50,
+             "bert": bench_bert_dp}
+    results = []
+    for name, fn in table.items():
+        if only and name not in only:
+            continue
+        r = fn()
+        r["backend"] = jax.default_backend()
+        r["smoke"] = SMOKE
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    if not SMOKE:
+        # merge with any previously recorded configs (per-config runs)
+        try:
+            with open("BENCH_extra.json") as f:
+                old = {r["metric"]: r for r in json.load(f)}
+        except Exception:
+            old = {}
+        for r in results:
+            old[r["metric"]] = r
+        with open("BENCH_extra.json", "w") as f:
+            json.dump(list(old.values()), f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

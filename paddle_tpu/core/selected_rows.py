@@ -1,0 +1,106 @@
+"""Row-sparse gradients — the TPU-native SelectedRows equivalent.
+
+The reference represents an embedding gradient as SelectedRows
+(framework/selected_rows.h:1): a (rows, values) pair covering only the
+looked-up vocabulary rows, and its sparse Adam updates moments for those
+rows only (operators/optimizers/adam_op.h:464, lazy_mode).
+
+TPU-first redesign: everything is STATIC-SHAPED. The row list is the
+flattened lookup index tensor (length = batch·seq, duplicates included);
+``merged()`` combines duplicates with a fixed-size ``jnp.unique`` padded by
+an out-of-range sentinel row, so optimizer updates lower to gather →
+per-row math → scatter(mode='drop') — O(touched rows · dim) work and
+traffic, never O(vocab · dim).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["RowSparseGrad"]
+
+
+class RowSparseGrad:
+    """(rows, values) gradient for a [num_rows, dim] parameter.
+
+    ``rows``: int array [n] (may contain duplicates); ``values``: [n, dim]
+    matching grads. ``rows`` entries equal to ``num_rows`` are padding and
+    are dropped by scatter updates.
+    """
+
+    __slots__ = ("rows", "values", "num_rows", "_merged")
+
+    def __init__(self, rows, values, num_rows: int, merged: bool = False):
+        self.rows = rows
+        self.values = values
+        self.num_rows = int(num_rows)
+        self._merged = merged
+
+    # -- Tensor-ish surface (what optimizer/engine code touches) ------------
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def shape(self):
+        return (self.num_rows,) + tuple(self.values.shape[1:])
+
+    @property
+    def is_sparse_grad(self):
+        return True
+
+    def astype(self, dtype):
+        return RowSparseGrad(self.rows, self.values.astype(dtype),
+                             self.num_rows, self._merged)
+
+    # -- core ops -----------------------------------------------------------
+    def merged(self) -> "RowSparseGrad":
+        """Combine duplicate rows (static shapes: unique padded with the
+        sentinel row ``num_rows``; matching values segment-summed)."""
+        if self._merged:
+            return self
+        n = self.rows.shape[0]
+        rows = self.rows.astype(jnp.int32)
+        uniq = jnp.unique(rows, size=n, fill_value=jnp.int32(self.num_rows))
+        seg = jnp.searchsorted(uniq, rows).astype(jnp.int32)
+        vals = jax.ops.segment_sum(self.values, seg, num_segments=n)
+        return RowSparseGrad(uniq, vals, self.num_rows, merged=True)
+
+    def to_dense(self):
+        z = jnp.zeros(self.shape, self.values.dtype)
+        return z.at[self.rows].add(self.values, mode="drop")
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseGrad):
+            return RowSparseGrad(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]),
+                self.num_rows,
+            )
+        if other is None:
+            return self
+        # sparse + dense densifies (reference: SelectedRows + LoDTensor sum)
+        dense = other._value if hasattr(other, "_value") else other
+        return self.to_dense() + dense
+
+    __radd__ = __add__
+
+    def scale(self, coeff):
+        return RowSparseGrad(self.rows, self.values * coeff, self.num_rows,
+                             self._merged)
+
+    def sq_l2norm(self):
+        """Σ values² of the MERGED gradient (for global-norm clipping —
+        duplicates must be combined first or the norm overcounts; sentinel
+        padding rows are excluded, matching the dense path where masked
+        positions contribute zero)."""
+        m = self.merged()
+        valid = (m.rows < self.num_rows)[:, None].astype(jnp.float32)
+        return jnp.sum(jnp.square(m.values.astype(jnp.float32)) * valid)
+
+    def numpy(self):
+        return jax.device_get(self.to_dense())
+
+    def __repr__(self):
+        return (f"RowSparseGrad(rows={self.rows.shape}, "
+                f"values={self.values.shape}, num_rows={self.num_rows})")

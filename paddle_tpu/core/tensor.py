@@ -719,7 +719,9 @@ def backward(tensor: Tensor, grad_tensor=None, retain_graph=False):
             if getattr(g, "dtype", None) is not None and g.dtype == jax.dtypes.float0:
                 continue
             for hook in inp._grad_hooks:
-                res = hook(wrap_raw(g))
+                from .selected_rows import RowSparseGrad
+
+                res = hook(g if isinstance(g, RowSparseGrad) else wrap_raw(g))
                 if res is not None:
                     g = res._value if isinstance(res, Tensor) else res
             if inp._node is not None:
@@ -740,8 +742,19 @@ def backward(tensor: Tensor, grad_tensor=None, retain_graph=False):
 
 
 def _accum_leaf(t: Tensor, g):
+    from .selected_rows import RowSparseGrad
+
+    if isinstance(g, RowSparseGrad):
+        # SelectedRows-equivalent: keep the sparse form on the leaf; the
+        # optimizer's sparse path consumes it. sparse+sparse concatenates,
+        # sparse+dense densifies (to a Tensor).
+        acc = g + t.grad if t.grad is not None else g
+        t.grad = acc if isinstance(acc, RowSparseGrad) else wrap_raw(acc)
+        return
     if t.grad is None:
         t.grad = wrap_raw(g)
+    elif isinstance(t.grad, RowSparseGrad):
+        t.grad = wrap_raw(t.grad.to_dense() + g)
     else:
         t.grad = wrap_raw(t.grad._value + g)
 

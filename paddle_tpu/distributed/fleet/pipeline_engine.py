@@ -34,12 +34,23 @@ __all__ = ["PipelineTrainStep", "pipeline_forward_loss"]
 
 def pipeline_forward_loss(embed_fn, block_fn, head_loss_fn, pp_axis, dp_axis,
                           num_micro, embed_params, blocks_params, head_params,
-                          inputs, labels, h_shape_dtype):
+                          inputs, labels, h_shape_dtype, tie_keys=()):
     """Inside shard_map: runs the microbatch ring and returns mean loss.
 
     inputs/labels: [num_micro, micro_batch_local, ...] (already dp-split by
     shard_map). blocks_params: stacked [layers_per_stage, ...] local shard.
+
+    ``tie_keys``: embed-param entries the head also reads (weight tying —
+    the reference shares the embedding matrix between first and last stage
+    and allreduces its gradient between them; here the tied entries are
+    injected into the head's param dict, and the first↔last gradient sync
+    falls out of shard_map's transpose, which psums the per-stage
+    cotangents of replicated inputs).
     """
+    if tie_keys:
+        head_params = dict(head_params)
+        for k in tie_keys:
+            head_params[k] = embed_params[k]
     pp_size = jax.lax.psum(1, pp_axis)
     stage = jax.lax.axis_index(pp_axis)
     fwd_perm = [(i, (i + 1) % pp_size) for i in range(pp_size)]
@@ -90,15 +101,27 @@ class PipelineTrainStep:
     """Jitted pp×dp training step for uniform-stage models (e.g. GPT).
 
     ``layer_param_stack``: pytree stacked over num_layers (leading dim),
-    sharded over 'pp'. Embed/head params replicated across stages (memory
-    note: fine at GPT-2 scale; stage-resident placement is a planned
-    optimization). Gradients: psum over 'dp'; the pp backward is jax's
+    sharded over 'pp'. With ``tie_keys`` (e.g. ``("wte",)`` for GPT) the
+    embedding matrix is SHARED between the first stage's lookup and the
+    last stage's logits — no stage holds a second [vocab, hidden] copy
+    (the largest single tensor), and the reference's first↔last
+    tied-embedding gradient allreduce (section_worker.cc runs per-stage
+    programs; Megatron-style sync) falls out of the shard_map transpose.
+    Remaining embed/head leaves (positions, final LN) are small and stay
+    replicated. Gradients: psum over 'dp'; the pp backward is jax's
     transpose of the forward ring.
     """
 
     def __init__(self, embed_fn, block_fn, head_loss_fn, optimizer, mesh: Mesh,
                  embed_params, layer_param_stack, head_params, num_micro,
-                 h_shape_dtype, pp_axis="pp", dp_axis="dp", recompute=True):
+                 h_shape_dtype, pp_axis="pp", dp_axis="dp", recompute=True,
+                 tie_keys=()):
+        for k in tie_keys:
+            if k in head_params:
+                raise ValueError(
+                    f"tied key {k!r} must not also be in head_params — pass "
+                    "the head WITHOUT its own copy (gpt_split_params(tied"
+                    "=True))")
         self._optimizer = optimizer
         self._mesh = mesh
         self._num_micro = num_micro
@@ -130,6 +153,8 @@ class PipelineTrainStep:
             pipeline_forward_loss, embed_fn, block_fn, head_loss_fn,
             pp_axis, dp_axis, num_micro,
         )
+        if tie_keys:
+            core = functools.partial(core, tie_keys=tuple(tie_keys))
         if recompute:
             core = jax.checkpoint(core)
 

@@ -91,12 +91,52 @@ UNDEF = _Undefined()
 
 
 def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, args=()):
-    """Dispatch an ``if``: python branch for concrete predicates, static.cond
-    for tracers/recorded programs (ifelse_transformer.py semantics)."""
-    if _is_dynamic(pred):
+    """Dispatch an ``if``: python branch for concrete predicates, staged
+    select/cond for tracers/recorded programs (ifelse_transformer.py
+    semantics).
+
+    Staged under jit, both branches are traced and the assigned-variable
+    tuple is combined leafwise with ``where`` — a name bound in only ONE
+    branch arrives as UNDEF from the other and is filled with a typed zero
+    (the documented created-undefined-var deviation, matching
+    convert_while's zero-trip staging); a name unbound in BOTH stays UNDEF."""
+    if _recording():
         from ..static.control_flow import cond
 
         return cond(pred, lambda: true_fn(*args), lambda: false_fn(*args))
+    if _is_dynamic(pred):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import wrap_raw
+
+        t_out = true_fn(*args)
+        f_out = false_fn(*args)
+        is_leaf = lambda x: isinstance(x, (_Undefined, Tensor))
+        flat_t, tdef = jax.tree_util.tree_flatten(t_out, is_leaf=is_leaf)
+        flat_f, fdef = jax.tree_util.tree_flatten(f_out, is_leaf=is_leaf)
+        if tdef != fdef or len(flat_t) != len(flat_f):
+            raise ValueError(
+                "converted if/else branches produced different structures")
+        praw = pred._value if isinstance(pred, Tensor) else pred
+
+        def pick(a, b):
+            if isinstance(a, _Undefined) and isinstance(b, _Undefined):
+                return a
+            if isinstance(a, _Undefined) or isinstance(b, _Undefined):
+                bound = b if isinstance(a, _Undefined) else a
+                braw = bound._value if isinstance(bound, Tensor) else \
+                    jnp.asarray(bound)
+                zero = jnp.zeros(jnp.shape(braw), braw.dtype)
+                a_, b_ = (zero, braw) if isinstance(a, _Undefined) \
+                    else (braw, zero)
+                return wrap_raw(jnp.where(praw, a_, b_))
+            araw = a._value if isinstance(a, Tensor) else jnp.asarray(a)
+            braw = b._value if isinstance(b, Tensor) else jnp.asarray(b)
+            return wrap_raw(jnp.where(praw, araw, braw))
+
+        out = [pick(a, b) for a, b in zip(flat_t, flat_f)]
+        return jax.tree_util.tree_unflatten(tdef, out)
     taken = bool(pred.numpy()) if isinstance(pred, Tensor) else bool(pred)
     return true_fn(*args) if taken else false_fn(*args)
 
@@ -336,6 +376,268 @@ def _has_escape(nodes: List[ast.stmt]) -> bool:
 _HELPER = "_jst"
 
 
+# ---------------------------------------------------------------------------
+# escape rewriting (reference: return_transformer.py,
+# break_continue_transformer.py)
+# ---------------------------------------------------------------------------
+def _has_return(nodes) -> bool:
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, n):
+            self.found = True
+
+        def visit_FunctionDef(self, n):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return v.found
+
+
+def _return_inside_loop(nodes) -> bool:
+    """A Return whose nearest enclosing loop within this subtree is a loop
+    (we cannot elseify those)."""
+    class V(ast.NodeVisitor):
+        found = False
+        depth = 0
+
+        def visit_Return(self, n):
+            if self.depth > 0:
+                self.found = True
+
+        def visit_While(self, n):
+            self.depth += 1
+            self.generic_visit(n)
+            self.depth -= 1
+
+        visit_For = visit_While
+
+        def visit_FunctionDef(self, n):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return v.found
+
+
+def _has_loop_escape(nodes, kinds) -> bool:
+    """break/continue at THIS loop's level (not inside nested loops/defs)."""
+    class V(ast.NodeVisitor):
+        found = False
+
+        def generic_visit(self, n):
+            if isinstance(n, kinds):
+                self.found = True
+            if not isinstance(n, (ast.While, ast.For, ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                super().generic_visit(n)
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return v.found
+
+
+def _assign(name, value_node):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=value_node)
+
+
+class _EscapeRewriter(ast.NodeTransformer):
+    """Rewrites ``return``/``break``/``continue`` inside convertible control
+    flow into value/flag threading, so the staging transformers see
+    escape-free blocks (the reference's return_transformer.py and
+    break_continue_transformer.py).
+
+    - ``return`` inside ``if``: the function is ELSE-IFIED — the statements
+      after an early-return guard move into its other branch, so every path
+      ends assigning one return slot and falls to a single tail ``return``.
+      Exact python semantics (including types) and, staged, both
+      ``lax.cond`` branches produce the path's own value. Returns inside
+      loops keep python form (as in eager).
+    - ``break``/``continue``: lowered to boolean flags — the loop test
+      gains ``not <brk>``, the statements following the escape are guarded
+      by ``if not <flag>:``, and the flags thread through the loop carry.
+    """
+
+    _n = 0
+
+    @classmethod
+    def _name(cls, base):
+        _EscapeRewriter._n += 1
+        return f"__dy2s_{base}{cls._n}"
+
+    # -- return elseification ----------------------------------------------
+    def visit_FunctionDef(self, node):
+        self.generic_visit(node)  # nested defs / loops first
+        if not _needs_elseify(node.body) or _return_inside_loop(node.body):
+            return node
+        ret = self._name("ret")
+        ok, new_body = _elseify(list(node.body), ret)
+        if not ok:
+            return node
+        node.body = new_body + [ast.Return(value=ast.Name(id=ret,
+                                                          ctx=ast.Load()))]
+        ast.fix_missing_locations(node)
+        return node
+
+    # -- break/continue flags ----------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        return self._rewrite_loop(node)
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        return self._rewrite_loop(node)
+
+    def _rewrite_loop(self, node):
+        has_b = _has_loop_escape(node.body, ast.Break)
+        has_c = _has_loop_escape(node.body, ast.Continue)
+        if not (has_b or has_c):
+            return node
+        if node.orelse or _has_return(node.body):
+            return node  # loop-else interplay / returns: keep python form
+        if isinstance(node, ast.For):
+            # only range() for-loops lower to convert_while and consume the
+            # break flag; other iterables keep python form — rewriting their
+            # break would silently disable it
+            it = node.iter
+            if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                    and it.func.id == "range" and not it.keywords
+                    and 1 <= len(it.args) <= 3
+                    and isinstance(node.target, ast.Name)):
+                return node
+        flags = []
+        pre = []
+        brk = cont = None
+        if has_b:
+            brk = self._name("brk")
+            flags.append(brk)
+            pre.append(_assign(brk, ast.Constant(value=False)))
+        if has_c:
+            cont = self._name("cont")
+            flags.append(cont)
+        body, _ = _rewrite_escapes(list(node.body), brk, cont, flags)
+        if has_c:
+            body = [_assign(cont, ast.Constant(value=False))] + body
+        node.body = body
+        if has_b:
+            if isinstance(node, ast.While):
+                node.test = ast.BoolOp(
+                    op=ast.And(),
+                    values=[ast.UnaryOp(op=ast.Not(),
+                                        operand=ast.Name(id=brk,
+                                                         ctx=ast.Load())),
+                            node.test])
+            else:
+                node._dy2s_brk = brk  # for-range lowering ANDs it in
+        ast.fix_missing_locations(node)
+        if pre:
+            for p in pre:
+                ast.copy_location(p, node)
+                ast.fix_missing_locations(p)
+            return pre + [node]
+        return node
+
+
+_ELSEIFY_MAX_DEPTH = 5  # ≤ 2^5 tail copies; deeper keeps python form
+
+
+def _needs_elseify(stmts) -> bool:
+    """A Return that is NOT a top-level statement of the function body."""
+    for st in stmts:
+        if isinstance(st, ast.Return):
+            continue
+        if _has_return([st]):
+            return True
+    return False
+
+
+def _elseify(stmts, ret, depth=0):
+    """Rewrite so every path ends with ``<ret> = value``; returns (ok, new).
+    Statements after a return-containing ``if`` are duplicated into the
+    branch continuations (each staged branch then yields its own path's
+    value — the only structure lax.cond can type; a single return-done
+    flag, the reference's approach, cannot type the first guard's branches
+    when the early value and the unset slot differ). Duplication doubles
+    per sequential guard, so conversion bails past ``_ELSEIFY_MAX_DEPTH``
+    guards (the function keeps python form, as before)."""
+    import copy
+
+    if depth > _ELSEIFY_MAX_DEPTH:
+        return False, stmts
+    out = []
+    for i, st in enumerate(stmts):
+        if isinstance(st, ast.Return):
+            out.append(_assign(ret, st.value if st.value is not None
+                               else ast.Constant(value=None)))
+            return True, out  # rest unreachable
+        if isinstance(st, ast.If) and (_has_return(st.body)
+                                       or _has_return(st.orelse)):
+            if _return_inside_loop(st.body) or _return_inside_loop(st.orelse):
+                return False, stmts
+            cont = stmts[i + 1:]
+            okb, nb = _elseify(list(st.body) + copy.deepcopy(cont), ret,
+                               depth + 1)
+            oke, ne = _elseify(list(st.orelse) + cont, ret, depth + 1)
+            if not (okb and oke):
+                return False, stmts
+            new_if = ast.If(test=st.test, body=nb, orelse=ne)
+            ast.copy_location(new_if, st)
+            out.append(new_if)
+            return True, out
+        out.append(st)
+    out.append(_assign(ret, ast.Constant(value=None)))
+    return True, out
+
+
+def _rewrite_escapes(stmts, brk, cont, flags):
+    """Replace break/continue with flag sets; guard the statements that
+    follow a potentially-escaping statement with ``if not <flags>:``.
+    Returns (new_stmts, may_escape). Does not descend into nested loops or
+    function defs (their escapes are their own)."""
+    out = []
+    for i, st in enumerate(stmts):
+        if isinstance(st, ast.Break):
+            out.append(_assign(brk, ast.Constant(value=True)))
+            return out, True
+        if isinstance(st, ast.Continue):
+            out.append(_assign(cont, ast.Constant(value=True)))
+            return out, True
+        if isinstance(st, ast.If):
+            nb, sb = _rewrite_escapes(list(st.body), brk, cont, flags)
+            ne, se = _rewrite_escapes(list(st.orelse), brk, cont, flags)
+            new_if = ast.If(test=st.test, body=nb or [ast.Pass()], orelse=ne)
+            ast.copy_location(new_if, st)
+            out.append(new_if)
+            if sb or se:
+                rest, _ = _rewrite_escapes(stmts[i + 1:], brk, cont, flags)
+                if rest:
+                    test = None
+                    for f in flags:
+                        notf = ast.UnaryOp(op=ast.Not(),
+                                           operand=ast.Name(id=f,
+                                                            ctx=ast.Load()))
+                        test = notf if test is None else ast.BoolOp(
+                            op=ast.And(), values=[test, notf])
+                    guard = ast.If(test=test, body=rest, orelse=[])
+                    ast.copy_location(guard, st)
+                    out.append(guard)
+                return out, True
+            continue
+        out.append(st)
+    return out, False
+
+
 def _undef_guards(names: List[str]) -> List[ast.stmt]:
     """Per name: ``try: <name>\nexcept NameError: <name> = _jst.UNDEF`` so a
     converted block can thread names that were unbound before it (the
@@ -448,6 +750,27 @@ class _ForRangeTransformer(_LoopLowering):
                                attr="convert_range_check", ctx=ast.Load()),
             args=[name_l(counter), name_l(stop_name), name_l(step_name)],
             keywords=[])
+        brk = getattr(node, "_dy2s_brk", None)
+        if brk is not None:
+            # break-rewritten body (escape rewriter): stop iterating once
+            # the flag is set — convert_logical_and stages over tensors
+            cond_expr = ast.Call(
+                func=ast.Attribute(value=ast.Name(id=_HELPER, ctx=ast.Load()),
+                                   attr="convert_logical_and",
+                                   ctx=ast.Load()),
+                args=[ast.Call(
+                    func=ast.Attribute(
+                        value=ast.Name(id=_HELPER, ctx=ast.Load()),
+                        attr="convert_logical_not", ctx=ast.Load()),
+                    args=[name_l(brk)], keywords=[]),
+                    ast.Lambda(
+                        args=ast.arguments(posonlyargs=[], args=[],
+                                           kwonlyargs=[], kw_defaults=[],
+                                           defaults=[]),
+                        body=cond_expr)],
+                keywords=[])
+            if brk not in loop_vars:
+                loop_vars.append(brk)
         body_stmts = (
             [assign(ivar, name_l(counter))] + list(node.body) +
             [assign(counter, ast.BinOp(left=name_l(counter), op=ast.Add(),
@@ -586,6 +909,8 @@ def convert_to_static(fn: Callable) -> Callable:
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return fn
     fdef.decorator_list = []  # strip @to_static etc. — we call the raw result
+    tree = _EscapeRewriter().visit(tree)
+    ast.fix_missing_locations(tree)
     new_tree = _Dy2StaticTransformer().visit(tree)
     ast.fix_missing_locations(new_tree)
     try:

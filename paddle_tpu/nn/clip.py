@@ -20,10 +20,20 @@ class ClipGradByValue(ClipGradBase):
         self.min = float(min) if min is not None else -self.max
 
     def _clip(self, params_grads):
+        from ..core.selected_rows import RowSparseGrad
+
         out = []
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
+                continue
+            if isinstance(g, RowSparseGrad):
+                # clip the merged values (duplicates combine first, like the
+                # dense path clipping the summed gradient)
+                m = g.merged()
+                out.append((p, RowSparseGrad(
+                    m.rows, jnp.clip(m.values, self.min, self.max),
+                    m.num_rows, merged=True)))
                 continue
             out.append((p, wrap_raw(jnp.clip(g._value, self.min, self.max))))
         return out
@@ -34,10 +44,18 @@ class ClipGradByNorm(ClipGradBase):
         self.clip_norm = float(clip_norm)
 
     def _clip(self, params_grads):
+        from ..core.selected_rows import RowSparseGrad
+
         out = []
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
+                continue
+            if isinstance(g, RowSparseGrad):
+                norm = jnp.sqrt(g.sq_l2norm())
+                scale = jnp.minimum(
+                    self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+                out.append((p, g.scale(scale.astype(g.dtype))))
                 continue
             norm = jnp.sqrt(jnp.sum(g._value.astype(jnp.float32) ** 2))
             scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
@@ -51,13 +69,21 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self.group_name = group_name
 
     def _clip(self, params_grads):
+        from ..core.selected_rows import RowSparseGrad
+
         sq = 0.0
         any_clip = False
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 continue
             any_clip = True
-            sq = sq + jnp.sum(g._value.astype(jnp.float32) ** 2)
+            if isinstance(g, RowSparseGrad):
+                # reference merges SelectedRows before the norm
+                # (gradient_clip merge_selected_rows); duplicates must be
+                # combined or the norm overcounts
+                sq = sq + g.sq_l2norm()
+            else:
+                sq = sq + jnp.sum(g._value.astype(jnp.float32) ** 2)
         if not any_clip:
             return params_grads
         global_norm = jnp.sqrt(sq)
@@ -66,6 +92,9 @@ class ClipGradByGlobalNorm(ClipGradBase):
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
+                continue
+            if isinstance(g, RowSparseGrad):
+                out.append((p, g.scale(scale.astype(g.dtype))))
                 continue
             out.append((p, wrap_raw((g._value * scale).astype(g._value.dtype))))
         return out

@@ -98,10 +98,15 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    """Gather rows of ``weight``. ``sparse`` is accepted for parity; on TPU a
-    dense gather + dense grad is the fast path (XLA scatter-add for the vjp),
-    replacing the reference's SelectedRows sparse gradient
-    (operators/lookup_table_v2_op.*)."""
+    """Gather rows of ``weight``.
+
+    ``sparse=True`` in eager mode produces a ``RowSparseGrad`` for the
+    weight — the TPU-native SelectedRows equivalent
+    (framework/selected_rows.h:1, operators/lookup_table_v2_op.*): the
+    gradient stays (rows, values) through the optimizer, whose sparse path
+    updates only touched rows (O(batch·seq·dim), not O(vocab·dim)).
+    Under jit/tracing the dense gather + XLA scatter-add vjp is the fast
+    path (the engines consume dense grads)."""
 
     def f(idx, w):
         out = jnp.take(w, idx, axis=0)
@@ -110,7 +115,39 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             out = out * mask
         return out
 
-    return apply_op(lambda idx, w: f(idx, w), _t(x).detach(), weight)
+    xt = _t(x).detach()
+    if sparse:
+        from ...core import tensor as tensor_mod
+        from ...core.selected_rows import RowSparseGrad
+
+        eager = not tensor_mod._is_tracer(xt._value)
+        record = (tensor_mod._grad_mode.enabled and eager
+                  and isinstance(weight, Tensor) and not weight.stop_gradient
+                  and tensor_mod._op_recorder is None)
+        if record:
+            idx_raw = xt._value
+            w_raw = weight._value
+            num_rows, dim = w_raw.shape
+            out_raw = f(idx_raw, w_raw)
+
+            def vjp_fn(ct):
+                rows = idx_raw.reshape(-1).astype(jnp.int32)
+                vals = ct.reshape(-1, dim)
+                if padding_idx is not None and padding_idx >= 0:
+                    # mask padded positions out of the sparse update
+                    rows = jnp.where(rows == padding_idx,
+                                     jnp.int32(num_rows), rows)
+                return (RowSparseGrad(rows, vals, num_rows),)
+
+            node = tensor_mod.Node([weight], vjp_fn,
+                                   [(out_raw.shape, out_raw.dtype)],
+                                   name="embedding_sparse_grad")
+            out = Tensor(out_raw, stop_gradient=False)
+            out._node = node
+            out._idx = 0
+            return out
+
+    return apply_op(lambda idx, w: f(idx, w), xt, weight)
 
 
 def one_hot(x, num_classes, name=None):

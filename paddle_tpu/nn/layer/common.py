@@ -61,7 +61,7 @@ class Embedding(Layer):
             default_initializer=I.XavierNormal(),
         )
         if self._padding_idx is not None:
-            val = self.weight.numpy()
+            val = self.weight.numpy().copy()  # numpy() view is read-only
             val[self._padding_idx] = 0
             self.weight.set_value(val)
 

@@ -86,6 +86,8 @@ class Optimizer:
 
     # -- main entry points ---------------------------------------------------
     def step(self):
+        from ..core.selected_rows import RowSparseGrad
+
         with no_grad():
             params_grads = [
                 (p, p.grad) for p in self._parameter_list
@@ -97,14 +99,28 @@ class Optimizer:
             for p, g in params_grads:
                 if g is None:
                     continue
+                state = self._get_state(p)
+                if isinstance(g, RowSparseGrad):
+                    new_value, new_state = self._update_sparse(
+                        p, g, state, self._lr_for(p))
+                    p._value = new_value
+                    self._accumulators[id(p)] = new_state
+                    continue
                 graw = g._value.astype(p._value.dtype) if g.dtype != p.dtype else g._value
                 graw = self._apply_decay_to_grad(p, graw)
-                state = self._get_state(p)
                 new_value, new_state = self._update(
                     p._value, graw, state, self._lr_for(p)
                 )
                 p._value = new_value
                 self._accumulators[id(p)] = new_state
+
+    def _update_sparse(self, p, g, state, lr):
+        """Row-sparse (SelectedRows-equivalent) update. Base fallback
+        densifies — correct for every optimizer; SGD/Adam override with
+        true O(touched rows) paths (reference sparse kernels:
+        operators/optimizers/adam_op.h:464, sgd_op.h SelectedRows branch)."""
+        graw = self._apply_decay_to_grad(p, g.to_dense().astype(p._value.dtype))
+        return self._update(p._value, graw, state, lr)
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
         # static mode: attach to the active Program — the Executor compiles
@@ -194,6 +210,13 @@ class SGD(Optimizer):
     def _update(self, param, grad, state, lr):
         return param - lr * grad, state
 
+    def _update_sparse(self, p, g, state, lr):
+        if self._decay_coeff(p):
+            return super()._update_sparse(p, g, state, lr)
+        # duplicates are fine under scatter-add; sentinel rows drop
+        vals = (lr * g.values.astype(jnp.float32)).astype(p._value.dtype)
+        return p._value.at[g.rows].add(-vals, mode="drop"), state
+
 
 class Momentum(Optimizer):
     _state_names = ["velocity"]
@@ -276,6 +299,7 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy = bool(lazy_mode)
 
     def _init_state(self, value):
         return {
@@ -294,6 +318,56 @@ class Adam(Optimizer):
         lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
         new_p = param - (lr_t * m1 / (jnp.sqrt(m2) + eps)).astype(param.dtype)
         return new_p, {"moment1": m1, "moment2": m2, "beta1_pow": b1p, "beta2_pow": b2p}
+
+    def _update_sparse(self, p, g, state, lr):
+        """Sparse (SelectedRows-equivalent) Adam, both reference modes
+        (operators/optimizers/adam_op.h:464):
+
+        - ``lazy_mode=False`` (default): the merged sparse grad is
+          numerically a dense grad that is zero off the touched rows, so
+          moments decay everywhere and ONLY touched rows receive the
+          (1-β)·g increment — bit-matches the dense path while never
+          materializing the [vocab, dim] gradient;
+        - ``lazy_mode=True``: moments and the parameter are read, updated,
+          and written back ONLY at the looked-up rows — O(touched·dim)
+          work and traffic; untouched rows keep their moments.
+        Works over the MERGED gradient: duplicates must combine before the
+        moment update or β-decay applies more than once."""
+        if self._decay_coeff(p):
+            return super()._update_sparse(p, g, state, lr)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = g.merged()
+        rows, vals = m.rows, m.values.astype(jnp.float32)
+        param = p._value
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        if not self._lazy:
+            m1 = (b1 * state["moment1"]).at[rows].add(
+                ((1 - b1) * vals).astype(state["moment1"].dtype),
+                mode="drop")
+            m2 = (b2 * state["moment2"]).at[rows].add(
+                ((1 - b2) * vals * vals).astype(state["moment2"].dtype),
+                mode="drop")
+            new_p = param - (lr_t * m1 / (jnp.sqrt(m2) + eps)).astype(
+                param.dtype)
+            return new_p, {"moment1": m1, "moment2": m2,
+                           "beta1_pow": b1p, "beta2_pow": b2p}
+        m1_r = jnp.take(state["moment1"], rows, axis=0, mode="fill",
+                        fill_value=0).astype(jnp.float32)
+        m2_r = jnp.take(state["moment2"], rows, axis=0, mode="fill",
+                        fill_value=0).astype(jnp.float32)
+        p_r = jnp.take(param, rows, axis=0, mode="fill", fill_value=0)
+        m1n = b1 * m1_r + (1 - b1) * vals
+        m2n = b2 * m2_r + (1 - b2) * vals * vals
+        p_new = p_r - (lr_t * m1n / (jnp.sqrt(m2n) + eps)).astype(param.dtype)
+        new_param = param.at[rows].set(p_new, mode="drop")
+        mom1 = state["moment1"].at[rows].set(
+            m1n.astype(state["moment1"].dtype), mode="drop")
+        mom2 = state["moment2"].at[rows].set(
+            m2n.astype(state["moment2"].dtype), mode="drop")
+        return new_param, {"moment1": mom1, "moment2": mom2,
+                           "beta1_pow": b1p, "beta2_pow": b2p}
 
 
 class AdamW(Adam):
@@ -314,6 +388,8 @@ class AdamW(Adam):
         return graw  # decoupled: applied in _update via param scale
 
     def step(self):
+        from ..core.selected_rows import RowSparseGrad
+
         with no_grad():
             params_grads = [
                 (p, p.grad) for p in self._parameter_list
@@ -323,7 +399,6 @@ class AdamW(Adam):
                 params_grads = self._grad_clip(params_grads)
             self._global_step += 1
             for p, g in params_grads:
-                graw = g._value.astype(p._value.dtype) if g.dtype != p.dtype else g._value
                 decay = True
                 if self._apply_decay_param_fun is not None:
                     decay = self._apply_decay_param_fun(p.name)
@@ -333,7 +408,13 @@ class AdamW(Adam):
                     lr = lr * self._lr_ratio(p)
                 if decay and self._coeff:
                     p._value = p._value * (1.0 - lr * self._coeff)
-                new_value, new_state = self._update(p._value, graw, state, lr)
+                if isinstance(g, RowSparseGrad):
+                    new_value, new_state = self._update_sparse(p, g, state, lr)
+                else:
+                    graw = (g._value.astype(p._value.dtype)
+                            if g.dtype != p.dtype else g._value)
+                    new_value, new_state = self._update(p._value, graw,
+                                                        state, lr)
                 p._value = new_value
                 self._accumulators[id(p)] = new_state
 

@@ -232,9 +232,15 @@ def gpt_functional_fns(config: GPTConfig, sp_axis=None):
     return embed_fn, block_fn, head_loss_fn
 
 
-def gpt_split_params(model: "GPTForCausalLM"):
+def gpt_split_params(model: "GPTForCausalLM", tied: bool = False):
     """Split a GPTForCausalLM's params into (embed, stacked blocks, head)
-    pytrees for the pipeline engine. Block params are stacked over layers."""
+    pytrees for the pipeline engine. Block params are stacked over layers.
+
+    ``tied=True`` matches the Layer model's weight tying: the head gets NO
+    wte copy — pass ``tie_keys=("wte",)`` to PipelineTrainStep, which
+    injects the embedding matrix into the head and syncs its first↔last
+    gradients (the reference's Megatron-style tied-embedding allreduce).
+    ``tied=False`` unties the LM head (its own trainable copy)."""
     from paddle_tpu.jit.functionalize import get_params
 
     params = get_params(model)
@@ -250,11 +256,10 @@ def gpt_split_params(model: "GPTForCausalLM"):
     head = {
         "ln_f.weight": params["gpt.ln_f.weight"],
         "ln_f.bias": params["gpt.ln_f.bias"],
-        # pipeline mode unties the LM head (its own copy; the reference's
-        # Megatron-style tied-embedding grad allreduce between first/last
-        # stage is a round-2 item). Copy also keeps donation buffers unique.
-        "wte": jnp.array(params["gpt.wte.weight"]),
     }
+    if not tied:
+        # copy keeps donation buffers unique
+        head["wte"] = jnp.array(params["gpt.wte.weight"])
     return embed, blocks, head
 
 

@@ -458,3 +458,201 @@ def test_while_with_module_call_in_test_stages():
 
     out = f(paddle.to_tensor(np.array([2.0, 1.0], np.float32)))
     np.testing.assert_allclose(out.numpy(), [0.0, -1.0])
+
+
+class TestEscapeConversion:
+    """return/break/continue in staged blocks (reference:
+    return_transformer.py, break_continue_transformer.py)."""
+
+    def test_tensor_dependent_early_return_eager(self):
+        @paddle.jit.to_static
+        def f(x):
+            if paddle.sum(x) > 0:
+                return x * 2.0
+            return x - 1.0
+
+        hi = f(paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+        lo = f(paddle.to_tensor(np.array([-1.0, -2.0], np.float32)))
+        np.testing.assert_allclose(hi.numpy(), [2.0, 4.0])
+        np.testing.assert_allclose(lo.numpy(), [-2.0, -3.0])
+
+    def test_early_return_stages_under_jit(self):
+        def f(x):
+            if paddle.sum(x) > 0:
+                return x * 2.0
+            return x - 1.0
+
+        conv = paddle.jit.dy2static.convert_to_static(f)
+        assert conv._dy2static_converted
+        jf = jax.jit(lambda a: conv(paddle.to_tensor(a))._value)
+        np.testing.assert_allclose(
+            np.asarray(jf(np.array([1.0, 2.0], np.float32))), [2.0, 4.0])
+        np.testing.assert_allclose(
+            np.asarray(jf(np.array([-1.0, -2.0], np.float32))), [-2.0, -3.0])
+
+    def test_early_return_with_code_after_if(self):
+        @paddle.jit.to_static
+        def f(x):
+            if paddle.sum(x) > 4.0:
+                return x * 10.0
+            y = x + 1.0
+            if paddle.sum(y) > 3.0:
+                return y * 2.0
+            return y - 1.0
+
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.array([3.0, 3.0], np.float32))).numpy(),
+            [30.0, 30.0])
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.array([1.0, 1.0], np.float32))).numpy(),
+            [4.0, 4.0])
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.array([0.0, 0.0], np.float32))).numpy(),
+            [0.0, 0.0])
+
+    def test_break_in_while(self):
+        @paddle.jit.to_static
+        def f(x):
+            i = 0
+            while i < 10:
+                x = x + 1.0
+                if paddle.sum(x) > 5.0:
+                    break
+                i = i + 1
+            return x
+
+        # x starts [0,0]; each iter adds [1,1] (sum +2): break when sum>5
+        out = f(paddle.to_tensor(np.array([0.0, 0.0], np.float32)))
+        np.testing.assert_allclose(out.numpy(), [3.0, 3.0])
+
+    def test_break_in_while_under_jit(self):
+        def f(x):
+            i = paddle.to_tensor(0)
+            while i < 10:
+                x = x + 1.0
+                if paddle.sum(x) > 5.0:
+                    break
+                i = i + 1
+            return x
+
+        conv = paddle.jit.dy2static.convert_to_static(f)
+        jf = jax.jit(lambda a: conv(paddle.to_tensor(a))._value)
+        np.testing.assert_allclose(
+            np.asarray(jf(np.array([0.0, 0.0], np.float32))), [3.0, 3.0])
+
+    def test_continue_in_for_range(self):
+        @paddle.jit.to_static
+        def f(x):
+            for i in range(6):
+                if i % 2 == 0:
+                    continue
+                x = x + i.astype("float32") if hasattr(i, "astype") else x + i
+            return x
+
+        # adds 1 + 3 + 5 = 9
+        out = f(paddle.to_tensor(np.array([0.0], np.float32)))
+        np.testing.assert_allclose(out.numpy(), [9.0])
+
+    def test_break_in_for_range_tensor_condition(self):
+        @paddle.jit.to_static
+        def f(x):
+            for i in range(100):
+                x = x + 1.0
+                if paddle.sum(x) > 6.0:
+                    break
+            return x
+
+        out = f(paddle.to_tensor(np.array([0.0, 0.0], np.float32)))
+        np.testing.assert_allclose(out.numpy(), [4.0, 4.0])
+
+    def test_break_in_for_range_under_jit(self):
+        def f(x):
+            for i in range(100):
+                x = x + 1.0
+                if paddle.sum(x) > 6.0:
+                    break
+            return x
+
+        conv = paddle.jit.dy2static.convert_to_static(f)
+        jf = jax.jit(lambda a: conv(paddle.to_tensor(a))._value)
+        np.testing.assert_allclose(
+            np.asarray(jf(np.array([0.0, 0.0], np.float32))), [4.0, 4.0])
+
+    def test_break_and_continue_same_loop(self):
+        @paddle.jit.to_static
+        def f(x):
+            i = 0
+            while i < 10:
+                i = i + 1
+                if i % 2 == 0:
+                    continue
+                if i > 5:
+                    break
+                x = x + i
+            return x
+
+        # odd i <= 5: 1+3+5 = 9, then i=7 breaks
+        out = f(paddle.to_tensor(np.array([0.0], np.float32)))
+        np.testing.assert_allclose(out.numpy(), [9.0])
+
+    def test_return_in_loop_keeps_python_form(self):
+        @paddle.jit.to_static
+        def f(x):
+            for i in range(3):
+                if i == 1:
+                    return x * 2.0
+            return x
+
+        out = f(paddle.to_tensor(np.array([1.0], np.float32)))
+        np.testing.assert_allclose(out.numpy(), [2.0])
+
+    def test_program_recording_with_early_return(self):
+        from paddle_tpu import static
+
+        prog = static.Program()
+        with static.program_guard(prog, static.Program()):
+            x = static.data("x", [2], "float32")
+
+            @paddle.jit.dy2static.convert_to_static
+            def f(x):
+                if paddle.sum(x) > 0:
+                    return x * 2.0
+                return x - 1.0
+
+            out = f(x)
+        exe = static.Executor()
+        r = exe.run(prog, feed={"x": np.array([1.0, 2.0], np.float32)},
+                    fetch_list=[out])[0]
+        np.testing.assert_allclose(r, [2.0, 4.0])
+        r = exe.run(prog, feed={"x": np.array([-1.0, -2.0], np.float32)},
+                    fetch_list=[out])[0]
+        np.testing.assert_allclose(r, [-2.0, -3.0])
+
+
+def test_break_in_non_range_for_keeps_python_semantics():
+    # non-range iterables are host-side: their break must stay a REAL
+    # python break (a flag rewrite would silently run every iteration)
+    def f(x):
+        for item in [1.0, 2.0, 3.0]:
+            x = x + item
+            if paddle.sum(x) > 0:
+                break
+        return x
+
+    conv = convert_to_static(f)
+    out = conv(paddle.to_tensor(np.array([-2.5], np.float32)))
+    # -2.5+1 = -1.5; -1.5+2 = 0.5 > 0 -> break (3.0 never added)
+    np.testing.assert_allclose(out.numpy(), [0.5])
+
+
+def test_many_sequential_early_returns_keep_python_form():
+    src = ["def f(x):"]
+    for i in range(8):
+        src.append(f"    if paddle.sum(x) > {i}.0:")
+        src.append(f"        return x * {i}.0")
+    src.append("    return x")
+    ns = {"paddle": paddle}
+    exec("\n".join(src), ns)
+    conv = paddle.jit.dy2static.convert_to_static(ns["f"])
+    out = conv(paddle.to_tensor(np.array([0.4, 0.4], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [0.0, 0.0])  # branch i=0 wins
