@@ -74,6 +74,7 @@ from .hapi import Model  # noqa: E402,F401
 from . import inference  # noqa: E402
 from . import incubate  # noqa: E402
 from . import quant  # noqa: E402
+from . import distribution  # noqa: E402
 from .hapi.summary import summary  # noqa: E402,F401
 
 

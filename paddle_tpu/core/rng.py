@@ -22,11 +22,16 @@ import numpy as np
 
 
 class Generator:
-    """Stateful facade over a functional JAX PRNG key chain."""
+    """Stateful facade over a functional JAX PRNG key chain.
+
+    The root key is created LAZILY: touching the backend at import time
+    would break ``jax.distributed.initialize`` (init_parallel_env must be
+    callable after ``import paddle_tpu``, like the reference's
+    paddle.distributed.init_parallel_env)."""
 
     def __init__(self, seed: int = 0):
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        self._key = None
         self._lock = threading.Lock()
 
     def manual_seed(self, seed: int):
@@ -39,11 +44,16 @@ class Generator:
 
     def next_key(self):
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
             self._key, sub = jax.random.split(self._key)
             return sub
 
     def get_state(self):
-        return jax.random.key_data(self._key)
+        with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
+            return jax.random.key_data(self._key)
 
     def set_state(self, state):
         self._key = jax.random.wrap_key_data(np.asarray(state))

@@ -28,13 +28,14 @@ class RowSparseGrad:
     are dropped by scatter updates.
     """
 
-    __slots__ = ("rows", "values", "num_rows", "_merged")
+    __slots__ = ("rows", "values", "num_rows", "_merged", "_mcache")
 
     def __init__(self, rows, values, num_rows: int, merged: bool = False):
         self.rows = rows
         self.values = values
         self.num_rows = int(num_rows)
         self._merged = merged
+        self._mcache = None  # memoized merged() (clip + optimizer both use it)
 
     # -- Tensor-ish surface (what optimizer/engine code touches) ------------
     @property
@@ -59,12 +60,15 @@ class RowSparseGrad:
         sentinel row ``num_rows``; matching values segment-summed)."""
         if self._merged:
             return self
+        if self._mcache is not None:
+            return self._mcache
         n = self.rows.shape[0]
         rows = self.rows.astype(jnp.int32)
         uniq = jnp.unique(rows, size=n, fill_value=jnp.int32(self.num_rows))
         seg = jnp.searchsorted(uniq, rows).astype(jnp.int32)
         vals = jax.ops.segment_sum(self.values, seg, num_segments=n)
-        return RowSparseGrad(uniq, vals, self.num_rows, merged=True)
+        self._mcache = RowSparseGrad(uniq, vals, self.num_rows, merged=True)
+        return self._mcache
 
     def to_dense(self):
         z = jnp.zeros(self.shape, self.values.dtype)

@@ -223,13 +223,9 @@ class ParallelTrainStep:
                 opt, named, params, grads, opt_state, lr)
             return new_params, new_buffers, new_opt, loss
 
-        in_shardings = (
-            self._param_shardings,
-            {n: repl for n in buffers_host},
-            self._opt_shardings,
-            repl,
-            ((self._batch_sharding,) * 1, (self._batch_sharding,) * 1),
-        )
+        # input placement is handled by the explicit device_put in __call__
+        # (batch arity varies per model, so a static in_shardings tuple
+        # cannot describe it); outputs pin the persistent state's shardings
         out_shardings = (
             self._param_shardings,
             {n: repl for n in buffers_host},

@@ -8,21 +8,30 @@ import numpy as np
 
 from ..core.tensor import Tensor, to_tensor, wrap_raw
 
-__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+from .detection import DetectionMAP  # noqa: E402,F401
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy",
+           "DetectionMAP"]
 
 
 def accuracy(input, label, k=1, correct=None, total=None, name=None):
-    """Functional top-k accuracy (parity operators/metrics/accuracy_op)."""
+    """Functional top-k accuracy (parity operators/metrics/accuracy_op).
+
+    Device-side (lax.top_k, no host pull), so it composes into jitted
+    steps and in-step fetches without a device→host sync per call."""
+    import jax
     import jax.numpy as jnp
 
-    pred = input.numpy() if isinstance(input, Tensor) else np.asarray(input)
-    lbl = label.numpy() if isinstance(label, Tensor) else np.asarray(label)
-    topk = np.argsort(-pred, axis=-1)[..., :k]
-    if lbl.ndim == topk.ndim - 1:
-        lbl = lbl[..., None]
-    correct_mask = (topk == lbl).any(axis=-1)
-    acc = correct_mask.mean(dtype=np.float32)
-    return wrap_raw(jnp.asarray(np.float32(acc)))
+    from ..core.tensor import apply_op
+
+    def f(pred, lbl):
+        idx = jax.lax.top_k(pred, k)[1]
+        if lbl.ndim == idx.ndim - 1:
+            lbl = lbl[..., None]
+        hit = jnp.any(idx == lbl.astype(idx.dtype), axis=-1)
+        return hit.astype(jnp.float32).mean()
+
+    return apply_op(f, to_tensor(input).detach(), to_tensor(label).detach())
 
 
 class Metric(abc.ABC):

@@ -1,0 +1,125 @@
+"""DetectionMAP — mAP metric for detection outputs.
+
+Reference: operators/detection/detection_map_op.cc / fluid
+evaluator.DetectionMAP. Host-side accumulation (metrics aggregate on the
+host; the per-batch detection outputs are already small, fixed-size NMS
+blocks).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["DetectionMAP"]
+
+
+class DetectionMAP:
+    """Accumulates (detections, ground truths) and computes mAP.
+
+    ``update(dets, gts)`` per image:
+    - dets: [D, 6] rows (label, score, x1, y1, x2, y2) — the padded NMS
+      output; rows with label < 0 are ignored.
+    - gts:  [G, 5] rows (label, x1, y1, x2, y2); optionally [G, 6] with a
+      trailing is_difficult flag.
+    ``accumulate()`` returns mAP over classes, 11-point interpolated or
+    integral (the reference's two ap_type modes).
+    """
+
+    def __init__(self, overlap_threshold=0.5, ap_type="integral",
+                 evaluate_difficult=False, class_num=None, name=None):
+        if ap_type not in ("integral", "11point"):
+            raise ValueError("ap_type must be 'integral' or '11point'")
+        self._thr = float(overlap_threshold)
+        self._ap_type = ap_type
+        self._eval_difficult = bool(evaluate_difficult)
+        self.reset()
+
+    def reset(self):
+        self._images = []  # list of (dets, gts, difficult)
+
+    # -- update -------------------------------------------------------------
+    def update(self, dets, gts):
+        dets = np.asarray(dets, np.float64).reshape(-1, 6)
+        gts = np.asarray(gts, np.float64)
+        if gts.size == 0:
+            gts = gts.reshape(0, 5)
+        if gts.shape[1] == 5:
+            diff = np.zeros(len(gts), bool)
+        else:
+            diff = gts[:, 5] > 0
+            gts = gts[:, :5]
+        dets = dets[dets[:, 0] >= 0]
+        self._images.append((dets, gts, diff))
+
+    # -- accumulate ---------------------------------------------------------
+    @staticmethod
+    def _iou(a, b):
+        iw = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        ih = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = iw * ih
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def accumulate(self):
+        labels = set()
+        for dets, gts, _ in self._images:
+            labels.update(int(l) for l in dets[:, 0])
+            labels.update(int(l) for l in gts[:, 0])
+        aps = []
+        for c in sorted(labels):
+            scores, matches = [], []
+            npos = 0
+            for dets, gts, diff in self._images:
+                g = gts[gts[:, 0] == c]
+                gd = diff[gts[:, 0] == c]
+                if self._eval_difficult:
+                    npos += len(g)
+                else:
+                    npos += int((~gd).sum())
+                d = dets[dets[:, 0] == c]
+                d = d[np.argsort(-d[:, 1])]
+                used = np.zeros(len(g), bool)
+                for row in d:
+                    best, bi = 0.0, -1
+                    for j in range(len(g)):
+                        iou = self._iou(row[2:6], g[j, 1:5])
+                        if iou > best:
+                            best, bi = iou, j
+                    if best >= self._thr and bi >= 0:
+                        if not self._eval_difficult and gd[bi]:
+                            continue  # difficult matches are ignored
+                        if not used[bi]:
+                            used[bi] = True
+                            scores.append(row[1]); matches.append(1)
+                        else:
+                            scores.append(row[1]); matches.append(0)
+                    else:
+                        scores.append(row[1]); matches.append(0)
+            if npos == 0:
+                continue
+            order = np.argsort(-np.asarray(scores)) if scores else []
+            tp = np.asarray(matches, np.float64)[order] if scores else \
+                np.zeros(0)
+            fp = 1.0 - tp
+            tp_cum = np.cumsum(tp)
+            fp_cum = np.cumsum(fp)
+            recall = tp_cum / npos
+            precision = tp_cum / np.maximum(tp_cum + fp_cum, 1e-12)
+            if self._ap_type == "11point":
+                ap = 0.0
+                for t in np.linspace(0, 1, 11):
+                    mask = recall >= t
+                    ap += (precision[mask].max() if mask.any() else 0.0) / 11
+            else:
+                # integral: Σ precision·Δrecall (the reference's ap_type=
+                # 'integral' accumulates raw precision, no interpolation)
+                ap = 0.0
+                prev_r = 0.0
+                for i in range(len(recall)):
+                    ap += precision[i] * (recall[i] - prev_r)
+                    prev_r = recall[i]
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0
+
+    def name(self):
+        return "detection_map"

@@ -121,8 +121,12 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
         from ...core.selected_rows import RowSparseGrad
 
         eager = not tensor_mod._is_tracer(xt._value)
+        # leaf weights only: a RowSparseGrad cotangent cannot flow through
+        # an upstream jax vjp (e.g. weight.astype(...) under AMP) — those
+        # take the dense path
         record = (tensor_mod._grad_mode.enabled and eager
                   and isinstance(weight, Tensor) and not weight.stop_gradient
+                  and weight._node is None
                   and tensor_mod._op_recorder is None)
         if record:
             idx_raw = xt._value

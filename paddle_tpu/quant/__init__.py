@@ -23,15 +23,34 @@ import numpy as np
 from paddle_tpu.core.tensor import Tensor, apply_op
 from paddle_tpu.nn.layer_base import Layer
 from paddle_tpu.nn.layer.common import Linear
+from paddle_tpu.nn.layer.conv import Conv2D
 
 __all__ = [
-    "QuantConfig", "FakeQuantDequant", "QuantedLinear", "quant_aware",
-    "convert", "Int8Linear", "PostTrainingQuantization", "quant_dequant",
+    "QuantConfig", "FakeQuantDequant", "QuantedLinear", "QuantedConv2D",
+    "quant_aware", "convert", "Int8Linear", "Int8Conv2D",
+    "PostTrainingQuantization", "quant_dequant",
 ]
 
 
 def _absmax_scale(x, bits=8):
     return jnp.maximum(jnp.max(jnp.abs(x)), 1e-8) / (2 ** (bits - 1) - 1)
+
+
+def _absmax_scale_channel(w, channel_axis, bits=8):
+    """Per-channel scales (reference 'channel_wise_abs_max'): reduce every
+    axis except ``channel_axis``."""
+    axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+    return jnp.maximum(jnp.max(jnp.abs(w), axis=axes), 1e-8) \
+        / (2 ** (bits - 1) - 1)
+
+
+def _weight_scale(w, quant_type, channel_axis, bits):
+    if quant_type == "channel_wise_abs_max":
+        s = _absmax_scale_channel(w, channel_axis, bits)
+        shape = [1] * w.ndim
+        shape[channel_axis] = s.shape[0]
+        return s.reshape(shape)
+    return _absmax_scale(w, bits)
 
 
 def quant_dequant(x, scale, bits=8):
@@ -45,11 +64,16 @@ def quant_dequant(x, scale, bits=8):
 
 class QuantConfig:
     def __init__(self, weight_bits=8, activation_bits=8, ema_decay=0.99,
-                 quantizable_layer_type=("Linear",)):
+                 quantizable_layer_type=("Linear", "Conv2D"),
+                 weight_quantize_type="channel_wise_abs_max"):
+        if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
+            raise ValueError(f"unknown weight_quantize_type "
+                             f"{weight_quantize_type!r}")
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
         self.ema_decay = ema_decay
         self.quantizable_layer_type = tuple(quantizable_layer_type)
+        self.weight_quantize_type = weight_quantize_type
 
 
 class FakeQuantDequant(Layer):
@@ -95,12 +119,44 @@ class QuantedLinear(Layer):
         from paddle_tpu.nn import functional as F
 
         x = self.act_quant(x)
+        cfg = self.config
         w = apply_op(
-            lambda a: quant_dequant(a, _absmax_scale(a, self.config.weight_bits),
-                                    self.config.weight_bits),
+            lambda a: quant_dequant(
+                a, _weight_scale(a, cfg.weight_quantize_type, 1,
+                                 cfg.weight_bits), cfg.weight_bits),
             self.inner.weight,
         )
         return F.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(Layer):
+    """QAT wrapper around a Conv2D (reference: QuantizedConv2D) — weight
+    fake-quant per OUTPUT channel (axis 0 of [O, I/g, kh, kw]), activation
+    observer as for Linear."""
+
+    def __init__(self, conv: Conv2D, config: QuantConfig):
+        super().__init__()
+        self.inner = conv
+        self.config = config
+        self.act_quant = FakeQuantDequant(config.activation_bits,
+                                          config.ema_decay)
+
+    def forward(self, x):
+        from paddle_tpu.nn import functional as F
+
+        x = self.act_quant(x)
+        cfg = self.config
+        w = apply_op(
+            lambda a: quant_dequant(
+                a, _weight_scale(a, cfg.weight_quantize_type, 0,
+                                 cfg.weight_bits), cfg.weight_bits),
+            self.inner.weight,
+        )
+        inner = self.inner
+        return F.conv2d(x, w, inner.bias, stride=inner._stride,
+                        padding=inner._padding, dilation=inner._dilation,
+                        groups=inner._groups,
+                        data_format=inner._data_format)
 
 
 def quant_aware(model: Layer, config: QuantConfig | None = None) -> Layer:
@@ -111,27 +167,33 @@ def quant_aware(model: Layer, config: QuantConfig | None = None) -> Layer:
         if type(child).__name__ in config.quantizable_layer_type and \
                 isinstance(child, Linear):
             model.add_sublayer(name, QuantedLinear(child, config))
-        elif not isinstance(child, (QuantedLinear, FakeQuantDequant)):
+        elif type(child).__name__ in config.quantizable_layer_type and \
+                isinstance(child, Conv2D):
+            model.add_sublayer(name, QuantedConv2D(child, config))
+        elif not isinstance(child, (QuantedLinear, QuantedConv2D,
+                                    FakeQuantDequant)):
             quant_aware(child, config)
     return model
 
 
 class Int8Linear(Layer):
-    """Converted inference layer: int8 weights + per-tensor scales, real
-    int8 dot on the MXU (preferred_element_type=int32)."""
+    """Converted inference layer: int8 weights + per-tensor or per-channel
+    scales, real int8 dot on the MXU (preferred_element_type=int32)."""
 
-    def __init__(self, w_int8: np.ndarray, w_scale: float, act_scale: float,
+    def __init__(self, w_int8: np.ndarray, w_scale, act_scale: float,
                  bias=None, act_bits=8):
         super().__init__()
         self.w_int8 = self.register_buffer(
             "w_int8", Tensor(w_int8.astype(np.int8)))
-        self.w_scale = float(w_scale)
+        # scalar (per-tensor) or [out] vector (per-channel)
+        self.w_scale = np.asarray(w_scale, np.float32)
         self.act_scale = float(act_scale)
         self.bias = bias  # Tensor or None
         self.act_bits = act_bits
 
     def forward(self, x):
-        w_scale, act_scale, bits = self.w_scale, self.act_scale, self.act_bits
+        w_scale = jnp.asarray(self.w_scale)
+        act_scale, bits = self.act_scale, self.act_bits
 
         def int8_matmul(a, w_q, b=None):
             qmax = 2 ** (bits - 1) - 1
@@ -151,18 +213,106 @@ class Int8Linear(Layer):
         return apply_op(int8_matmul, *args)
 
 
+class Int8Conv2D(Layer):
+    """Converted int8 conv: int8 weights (+ per-output-channel scales),
+    int8 activations, conv accumulates in int32 on the MXU then rescales —
+    the TPU-native counterpart of the reference's cuDNN/TensorRT int8
+    convolution (mkldnn_quantizer.cc / trt_int8_calibrator.cc intent)."""
+
+    def __init__(self, w_int8: np.ndarray, w_scale, act_scale: float,
+                 bias=None, act_bits=8, stride=(1, 1), padding=0,
+                 dilation=(1, 1), groups=1, data_format="NCHW"):
+        super().__init__()
+        self.w_int8 = self.register_buffer(
+            "w_int8", Tensor(w_int8.astype(np.int8)))
+        self.w_scale = np.asarray(w_scale, np.float32).reshape(-1)  # [O]
+        self.act_scale = float(act_scale)
+        self.bias = bias
+        self.act_bits = act_bits
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+
+    def forward(self, x):
+        from paddle_tpu.nn.functional.conv import _norm_padding, _norm_tuple
+
+        w_scale = jnp.asarray(self.w_scale)
+        act_scale, bits = self.act_scale, self.act_bits
+        stride = _norm_tuple(self._stride, 2, "stride")
+        dilation = _norm_tuple(self._dilation, 2, "dilation")
+        pad = _norm_padding(self._padding, 2)
+        groups = self._groups
+        channel_last = self._data_format == "NHWC"
+        dn = ("NHWC", "HWIO", "NHWC") if channel_last else \
+            ("NCHW", "OIHW", "NCHW")
+
+        def int8_conv(a, w_q, b=None):
+            qmax = 2 ** (bits - 1) - 1
+            a_q = jnp.clip(jnp.round(a / act_scale), -qmax - 1, qmax
+                           ).astype(jnp.int8)
+            if channel_last:
+                # stored weights are paddle [O, I/g, kh, kw]
+                w_q = jnp.moveaxis(w_q, (0, 1), (-1, -2))
+            acc = jax.lax.conv_general_dilated(
+                a_q, w_q, window_strides=stride, padding=pad,
+                rhs_dilation=dilation, feature_group_count=groups,
+                dimension_numbers=dn,
+                preferred_element_type=jnp.int32,
+            )
+            oscale = (w_scale[None, None, None, :] if channel_last
+                      else w_scale[None, :, None, None])
+            out = acc.astype(jnp.float32) * (act_scale * oscale)
+            if b is not None:
+                bshape = (1, 1, 1, -1) if channel_last else (1, -1, 1, 1)
+                out = out + b.reshape(bshape)
+            return out
+
+        args = (x, self.w_int8) + ((self.bias,) if self.bias is not None else ())
+        return apply_op(int8_conv, *args)
+
+
+def _np_weight_scale(w, quant_type, channel_axis, bits):
+    qmax = 2 ** (bits - 1) - 1
+    if quant_type == "channel_wise_abs_max":
+        axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+        return np.maximum(np.abs(w).max(axis=axes), 1e-8) / qmax
+    return np.maximum(np.abs(w).max(), 1e-8) / qmax
+
+
 def convert(model: Layer) -> Layer:
     """Snapshot QAT wrappers into int8 inference layers (parity:
-    ImperativeQuantAware.save_quantized_model conversion step)."""
+    ImperativeQuantAware.save_quantized_model conversion step). The result
+    is a normal Layer — ``paddle.jit.save`` + the inference Predictor run
+    it as int8 StableHLO."""
     for name, child in list(model.named_children()):
         if isinstance(child, QuantedLinear):
+            cfg = child.config
             w = child.inner.weight.numpy()
-            w_scale = float(np.maximum(np.abs(w).max(), 1e-8) /
-                            (2 ** (child.config.weight_bits - 1) - 1))
+            w_scale = _np_weight_scale(w, cfg.weight_quantize_type, 1,
+                                       cfg.weight_bits)
             w_int8 = np.clip(np.round(w / w_scale), -128, 127)
             model.add_sublayer(name, Int8Linear(
                 w_int8, w_scale, float(child.act_quant.scale.numpy()),
-                bias=child.inner.bias, act_bits=child.config.activation_bits,
+                bias=child.inner.bias, act_bits=cfg.activation_bits,
+            ))
+        elif isinstance(child, QuantedConv2D):
+            cfg = child.config
+            inner = child.inner
+            w = inner.weight.numpy()
+            w_scale = _np_weight_scale(w, cfg.weight_quantize_type, 0,
+                                       cfg.weight_bits)
+            sc = w_scale.reshape(-1, 1, 1, 1) if np.ndim(w_scale) else w_scale
+            w_int8 = np.clip(np.round(w / sc), -128, 127)
+            model.add_sublayer(name, Int8Conv2D(
+                w_int8,
+                w_scale if np.ndim(w_scale) else
+                np.full(w.shape[0], float(w_scale), np.float32),
+                float(child.act_quant.scale.numpy()), bias=inner.bias,
+                act_bits=cfg.activation_bits, stride=inner._stride,
+                padding=inner._padding, dilation=inner._dilation,
+                groups=inner._groups, data_format=inner._data_format,
             ))
         else:
             convert(child)

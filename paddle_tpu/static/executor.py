@@ -21,12 +21,43 @@ from .program import Program, default_main_program
 __all__ = ["Executor", "global_scope", "scope_guard"]
 
 
+class _ScopeTensor:
+    """Minimal LoDTensor facade held by a scope variable."""
+
+    def __init__(self):
+        self._array = None
+
+    def set(self, array, place=None):
+        self._array = np.asarray(array)
+
+    def shape(self):
+        return [] if self._array is None else list(self._array.shape)
+
+    def __array__(self, dtype=None):
+        a = self._array if self._array is not None else np.zeros(0)
+        return a.astype(dtype) if dtype else a
+
+
+class _ScopeVar:
+    def __init__(self, name):
+        self.name = name
+        self._tensor = _ScopeTensor()
+
+    def get_tensor(self):
+        return self._tensor
+
+
 class _Scope:
+    """Name → variable store (reference framework::Scope, minimal eager
+    form: ``var`` creates-or-gets a variable holding a host tensor)."""
+
     def __init__(self):
         self._vars = {}
 
     def var(self, name):
-        return self._vars.setdefault(name, None)
+        if name not in self._vars:
+            self._vars[name] = _ScopeVar(name)
+        return self._vars[name]
 
     def find_var(self, name):
         return self._vars.get(name)
@@ -70,6 +101,10 @@ class Executor:
         for name, v in feed.items():
             if isinstance(v, Tensor):
                 feed_raw[name] = v._value
+            elif isinstance(v, jax.Array):
+                # already on device (e.g. train_from_dataset's async
+                # prefetch) — never round-trip through host numpy
+                feed_raw[name] = v
             else:
                 feed_raw[name] = jnp.asarray(np.asarray(v))
         fetch_ids = []
@@ -111,36 +146,60 @@ class Executor:
         )
         fetch_list = fetch_list or []
         feed_names = list(program.feed_vars)
-        last = None
-        step = 0
-        for batch in dataset:
+
+        def build_feed(batch):
             feed = {}
             for name in feed_names:
                 if name in batch:
                     # a genuine dataset slot always wins — including one
                     # that happens to be named '<x>_length'
-                    feed[name] = self._slot_to_array(
+                    arr = self._slot_to_array(
                         batch[name], program.feed_vars[name],
                         program.declared_shapes.get(name))
-                    continue
-                if name.endswith("_length") and name[:-7] in batch:
+                elif name.endswith("_length") and name[:-7] in batch:
                     # synthesized lengths: padded form alone loses the row
                     # lengths, so a feed var '<slot>_length' (with no slot
                     # of its own) receives the base slot's true lengths —
                     # clamped to the padded time dim so mask-aware programs
                     # never index past truncated rows
-                    feed[name] = self._row_lengths(
-                        batch[name[:-7]], program, name[:-7])
-                    continue
-                raise InvalidArgumentError(
-                    f"dataset batch has no slot '{name}' for feed var "
-                    f"(slots: {sorted(batch)})")
-            last = self.run(program, feed=feed, fetch_list=fetch_list)
+                    arr = self._row_lengths(batch[name[:-7]], program,
+                                            name[:-7])
+                else:
+                    raise InvalidArgumentError(
+                        f"dataset batch has no slot '{name}' for feed var "
+                        f"(slots: {sorted(batch)})")
+                # async H2D now — the transfer overlaps the in-flight step
+                # (the trainer-thread parse/H2D/compute overlap of the
+                # reference's multithreaded DeviceWorker, trainer.h:97,
+                # expressed as double buffering on the dispatch queue)
+                feed[name] = jax.device_put(arr)
+            return feed
+
+        last = None
+        step = 0
+        it = iter(dataset)
+        try:
+            pending = build_feed(next(it))
+        except StopIteration:
+            return None
+        done = False
+        while not done:
+            try:
+                nxt = build_feed(next(it))  # prefetch while step runs
+            except StopIteration:
+                nxt, done = None, True
+            # async: keep fetches as device Tensors; materialize only when
+            # printing or at the end — the loop never blocks on the device
+            last = self.run(program, feed=pending, fetch_list=fetch_list,
+                            return_numpy=False)
+            pending = nxt
             step += 1
             if debug or (fetch_list and step % print_period == 0):
-                vals = ", ".join(f"{float(np.asarray(v).ravel()[0]):.6f}"
+                vals = ", ".join(f"{float(np.asarray(v.numpy()).ravel()[0]):.6f}"
                                  for v in last)
                 print(f"[train_from_dataset] step {step}: {vals}")
+        if last is not None:
+            last = [np.asarray(v.numpy()) for v in last]
         return last
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,

@@ -1,0 +1,411 @@
+"""Detection ops — TPU-first re-design of the reference's
+operators/detection/ family (yolo_box_op.cc, prior_box_op.cc,
+box_coder_op.cc, multiclass_nms_op.cc, roi_align_op.cc,
+iou_similarity_op.cc).
+
+Every op is STATIC-SHAPED (XLA requirement): NMS returns a fixed
+[keep_top_k] padded detection block plus a valid count instead of the
+reference's LoD output (same content as its multiclass_nms2 variant), and
+suppression runs as a ``lax.scan`` over score-sorted candidates rather than
+data-dependent loops.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply_op
+
+__all__ = [
+    "yolo_box", "prior_box", "box_coder", "multiclass_nms", "roi_align",
+    "iou_similarity", "box_iou",
+]
+
+
+def _t(x):
+    from ..core.tensor import to_tensor
+
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+# ---------------------------------------------------------------------------
+# yolo_box (reference: operators/detection/yolo_box_op.h GetYoloBox)
+# ---------------------------------------------------------------------------
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0):
+    """Decode one YOLOv3 head.
+
+    x: [N, an*(5+class_num), H, W]; img_size: [N, 2] (h, w).
+    Returns (boxes [N, an*H*W, 4] in x1y1x2y2 image coords,
+    scores [N, an*H*W, class_num]). Predictions whose objectness confidence
+    is below ``conf_thresh`` produce zero boxes and scores (the reference
+    skips them, leaving zeros)."""
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    an = anchors.shape[0]
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+
+    def f(xr, img):
+        n, c, h, w = xr.shape
+        xr = xr.reshape(n, an, 5 + class_num, h, w)
+        img_h = img[:, 0].astype(jnp.float32)[:, None, None, None]
+        img_w = img[:, 1].astype(jnp.float32)[:, None, None, None]
+        in_h = float(downsample_ratio * h)
+        in_w = float(downsample_ratio * w)
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        aw = jnp.asarray(anchors[:, 0])[None, :, None, None]
+        ah = jnp.asarray(anchors[:, 1])[None, :, None, None]
+
+        cx = (gx + jax.nn.sigmoid(xr[:, :, 0]) * scale + bias) * img_w / w
+        cy = (gy + jax.nn.sigmoid(xr[:, :, 1]) * scale + bias) * img_h / h
+        bw = jnp.exp(xr[:, :, 2]) * aw * img_w / in_w
+        bh = jnp.exp(xr[:, :, 3]) * ah * img_h / in_h
+        x1, y1 = cx - bw / 2, cy - bh / 2
+        x2, y2 = cx + bw / 2, cy + bh / 2
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0.0, img_w - 1.0)
+            y1 = jnp.clip(y1, 0.0, img_h - 1.0)
+            x2 = jnp.clip(x2, 0.0, img_w - 1.0)
+            y2 = jnp.clip(y2, 0.0, img_h - 1.0)
+        conf = jax.nn.sigmoid(xr[:, :, 4])  # [n, an, h, w]
+        keep = (conf >= conf_thresh).astype(xr.dtype)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep[..., None]
+        cls = jax.nn.sigmoid(xr[:, :, 5:])  # [n, an, C, h, w]
+        scores = cls * (conf * keep)[:, :, None]
+        boxes = boxes.reshape(n, an * h * w, 4)
+        scores = scores.transpose(0, 1, 3, 4, 2).reshape(
+            n, an * h * w, class_num)
+        return boxes, scores
+
+    return apply_op(f, _t(x), _t(img_size).detach(), multi_out=True)
+
+
+# ---------------------------------------------------------------------------
+# prior_box (reference: operators/detection/prior_box_op.h)
+# ---------------------------------------------------------------------------
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    """SSD prior (anchor) boxes for one feature map.
+
+    Returns (boxes [H, W, P, 4] normalized x1y1x2y2,
+    variances [H, W, P, 4]). Prior order per cell matches the reference:
+    for each min_size — ar=1 box, extra aspect-ratio boxes, then the
+    sqrt(min·max) box (or the min/max-first order when
+    ``min_max_aspect_ratios_order=True``)."""
+    min_sizes = [float(s) for s in np.atleast_1d(min_sizes)]
+    max_sizes = [float(s) for s in np.atleast_1d(max_sizes)] if max_sizes \
+        else []
+    # ExpandAspectRatios: 1.0 first, dedup, flip adds reciprocals
+    ars = [1.0]
+    for ar in aspect_ratios:
+        ar = float(ar)
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    def f(feat, img):
+        h, w = feat.shape[2], feat.shape[3]
+        img_h, img_w = float(img.shape[2]), float(img.shape[3])
+        step_w = float(steps[0]) or img_w / w
+        step_h = float(steps[1]) or img_h / h
+        cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+        cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+        cxg, cyg = jnp.meshgrid(cx, cy)  # [h, w]
+        whs = []
+        for k, ms in enumerate(min_sizes):
+            per = []
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    per.append((ms, ms))
+                else:
+                    per.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+            if max_sizes:
+                s = math.sqrt(ms * max_sizes[k])
+                sq = (s, s)
+                if min_max_aspect_ratios_order:
+                    per = [per[0], sq] + per[1:]
+                else:
+                    per = per + [sq]
+            whs.extend(per)
+        bw = jnp.asarray([p[0] for p in whs], jnp.float32) / img_w / 2
+        bh = jnp.asarray([p[1] for p in whs], jnp.float32) / img_h / 2
+        ncx = (cxg / img_w)[..., None]
+        ncy = (cyg / img_h)[..., None]
+        boxes = jnp.stack([ncx - bw, ncy - bh, ncx + bw, ncy + bh], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               boxes.shape)
+        return boxes, var
+
+    return apply_op(f, _t(input).detach(), _t(image).detach(),
+                    multi_out=True)
+
+
+# ---------------------------------------------------------------------------
+# box_coder (reference: operators/detection/box_coder_op.h)
+# ---------------------------------------------------------------------------
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, name=None,
+              axis=0):
+    """Encode targets against priors / decode deltas with priors.
+
+    encode: target [N, 4], prior [M, 4] → [N, M, 4].
+    decode: target [N, M, 4], prior broadcast on ``axis`` → [N, M, 4].
+    ``prior_box_var`` may be None, a [M, 4] tensor, or 4 floats."""
+    norm = 0.0 if box_normalized else 1.0
+    var_is_list = isinstance(prior_box_var, (list, tuple))
+    var_list = [float(v) for v in prior_box_var] if var_is_list else None
+
+    def split_prior(p):
+        pw = p[..., 2] - p[..., 0] + norm
+        ph = p[..., 3] - p[..., 1] + norm
+        px = p[..., 0] + pw / 2
+        py = p[..., 1] + ph / 2
+        return px, py, pw, ph
+
+    def f(prior, target, *maybe_var):
+        var = maybe_var[0] if maybe_var else (
+            jnp.asarray(var_list, jnp.float32) if var_list is not None
+            else None)
+        px, py, pw, ph = split_prior(prior)
+        if code_type == "encode_center_size":
+            tw = target[:, 2] - target[:, 0] + norm
+            th = target[:, 3] - target[:, 1] + norm
+            tx = target[:, 0] + tw / 2
+            ty = target[:, 1] + th / 2
+            ox = (tx[:, None] - px[None, :]) / pw[None, :]
+            oy = (ty[:, None] - py[None, :]) / ph[None, :]
+            ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+            oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+            out = jnp.stack([ox, oy, ow, oh], axis=-1)
+            if var is not None:
+                out = out / jnp.broadcast_to(var, out.shape)
+            return out
+        # decode_center_size: target [N, M, 4]; prior broadcasts on `axis`
+        # (axis=0: prior per column [1, M]; axis=1: prior per row [N, 1])
+        bc = (lambda a: a[None, :]) if axis == 0 else (lambda a: a[:, None])
+        px, py, pw, ph = (bc(v) for v in (px, py, pw, ph))
+        t = target
+        if var is not None:
+            if var.ndim == 1:  # 4 floats
+                v = var[None, None, :]
+            else:  # [M, 4] or [N, 4] aligned with the prior axis
+                v = bc(var)
+            t = t * v
+        ox = pw * t[..., 0] + px
+        oy = ph * t[..., 1] + py
+        ow = jnp.exp(t[..., 2]) * pw
+        oh = jnp.exp(t[..., 3]) * ph
+        return jnp.stack([ox - ow / 2, oy - oh / 2,
+                          ox + ow / 2 - norm, oy + oh / 2 - norm], axis=-1)
+
+    args = [_t(prior_box), _t(target_box)]
+    if prior_box_var is not None and not var_is_list:
+        args.append(_t(prior_box_var))
+    return apply_op(f, *args)
+
+
+# ---------------------------------------------------------------------------
+# IOU
+# ---------------------------------------------------------------------------
+def _iou_matrix(a, b, normalized=True):
+    """a [..., A, 4], b [..., B, 4] → [..., A, B]."""
+    norm = 0.0 if normalized else 1.0
+    ax1, ay1, ax2, ay2 = (a[..., :, None, i] for i in range(4))
+    bx1, by1, bx2, by2 = (b[..., None, :, i] for i in range(4))
+    iw = jnp.clip(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1) + norm,
+                  0.0, None)
+    ih = jnp.clip(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1) + norm,
+                  0.0, None)
+    inter = iw * ih
+    area_a = jnp.clip(ax2 - ax1 + norm, 0.0, None) * \
+        jnp.clip(ay2 - ay1 + norm, 0.0, None)
+    area_b = jnp.clip(bx2 - bx1 + norm, 0.0, None) * \
+        jnp.clip(by2 - by1 + norm, 0.0, None)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU (reference: iou_similarity_op): x [N,4], y [M,4] →
+    [N, M]."""
+    return apply_op(partial(_iou_matrix, normalized=box_normalized),
+                    _t(x), _t(y))
+
+
+box_iou = iou_similarity
+
+
+# ---------------------------------------------------------------------------
+# multiclass_nms (reference: operators/detection/multiclass_nms_op.cc)
+# ---------------------------------------------------------------------------
+def _nms_class(boxes, scores, score_threshold, nms_top_k, nms_threshold,
+               nms_eta, normalized):
+    """One class, one image: returns (keep mask [K], scores [K], idx [K])
+    for the nms_top_k score-sorted candidates."""
+    k = nms_top_k
+    order = jnp.argsort(-scores)[:k]
+    s = scores[order]
+    b = boxes[order]
+    valid = s > score_threshold
+    iou = _iou_matrix(b, b, normalized=normalized)  # [K, K]
+
+    def step(carry, i):
+        keep, thr = carry
+        # suppressed if any already-kept earlier candidate overlaps > thr
+        earlier = jnp.arange(k) < i
+        sup = jnp.any(earlier & keep & (iou[i] > thr))
+        ki = valid[i] & ~sup
+        keep = keep.at[i].set(ki)
+        thr = jnp.where(ki & (nms_eta < 1.0) & (thr > 0.5), thr * nms_eta,
+                        thr)
+        return (keep, thr), None
+
+    keep0 = jnp.zeros((k,), bool)
+    (keep, _), _ = jax.lax.scan(step, (keep0, jnp.float32(nms_threshold)),
+                                jnp.arange(k))
+    return keep, s, order
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None, return_index=False):
+    """Static-shape multiclass NMS.
+
+    bboxes: [N, M, 4]; scores: [N, C, M]. Returns
+    (out [N, keep_top_k, 6] rows = (label, score, x1, y1, x2, y2) padded
+    with label -1, nms_rois_num [N]) — the fixed-size form of the
+    reference's LoD output (content matches multiclass_nms2, which also
+    returns per-image counts). Suppression is a ``lax.scan`` over the
+    nms_top_k score-sorted candidates per class — fully batched on the
+    accelerator, no host loop."""
+    kt = int(keep_top_k)
+
+    def f(bb, sc):
+        n, m, _ = bb.shape
+        c = sc.shape[1]
+        ktk = min(int(nms_top_k), m)
+
+        def per_image(boxes, scores_ci):
+            keeps, ss, idxs = jax.vmap(
+                lambda s_c: _nms_class(boxes, s_c, score_threshold, ktk,
+                                       nms_threshold, nms_eta, normalized)
+            )(scores_ci)  # [C, K] each
+            labels = jnp.broadcast_to(jnp.arange(c)[:, None],
+                                      keeps.shape)
+            if background_label >= 0:
+                keeps = keeps & (labels != background_label)
+            flat_keep = keeps.reshape(-1)
+            flat_s = jnp.where(flat_keep, ss.reshape(-1), -jnp.inf)
+            flat_lab = labels.reshape(-1)
+            flat_idx = idxs.reshape(-1)
+            top = jnp.argsort(-flat_s)[:kt]
+            sel_valid = flat_keep[top]
+            sel_s = ss.reshape(-1)[top]
+            sel_lab = flat_lab[top].astype(jnp.float32)
+            sel_box = boxes[flat_idx[top]]
+            row = jnp.concatenate(
+                [jnp.where(sel_valid, sel_lab, -1.0)[:, None],
+                 jnp.where(sel_valid, sel_s, 0.0)[:, None],
+                 sel_box * sel_valid[:, None].astype(boxes.dtype)], axis=1)
+            return row, sel_valid.sum().astype(jnp.int32), flat_idx[top]
+
+        rows, counts, indices = jax.vmap(per_image)(bb, sc)
+        return rows, counts, indices
+
+    out, counts, idx = apply_op(f, _t(bboxes).detach(), _t(scores).detach(),
+                                multi_out=True)
+    if return_index:
+        return out, counts, idx
+    return out, counts
+
+
+# ---------------------------------------------------------------------------
+# roi_align (reference: operators/detection/roi_align_op.cc)
+# ---------------------------------------------------------------------------
+def roi_align(input, boxes, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, boxes_num=None, aligned=True, name=None):
+    """RoIAlign: input [N, C, H, W], boxes [R, 4] (x1, y1, x2, y2),
+    boxes_num [N] (rois per image, in order) → [R, C, ph, pw].
+
+    TPU-first: ``sampling_ratio=-1`` uses a FIXED 2×2 sample grid per bin
+    (the detectron default) instead of the reference's per-roi adaptive
+    count — XLA needs static shapes; pass an explicit ratio for parity
+    with adaptive cases. ``aligned=True`` applies the -0.5 half-pixel
+    offset (roi_align_op.cc's continuous coordinate mode)."""
+    if isinstance(output_size, int):
+        ph = pw = int(output_size)
+    else:
+        ph, pw = int(output_size[0]), int(output_size[1])
+    sr = int(sampling_ratio) if int(sampling_ratio) > 0 else 2
+
+    def f(feat, rois, rois_n):
+        n, ch, h, w = feat.shape
+        r = rois.shape[0]
+        # rois_n -> per-roi batch index (static total length R)
+        cum = jnp.cumsum(rois_n)
+        batch_idx = jnp.searchsorted(cum, jnp.arange(r), side="right")
+        off = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - off
+        y1 = rois[:, 1] * spatial_scale - off
+        x2 = rois[:, 2] * spatial_scale - off
+        y2 = rois[:, 3] * spatial_scale - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample points: y = y1 + (iy + (s + .5)/sr) * bin_h
+        gy = (jnp.arange(ph)[:, None] +
+              (jnp.arange(sr)[None, :] + 0.5) / sr).reshape(-1)  # [ph*sr]
+        gx = (jnp.arange(pw)[:, None] +
+              (jnp.arange(sr)[None, :] + 0.5) / sr).reshape(-1)
+        sy = y1[:, None] + gy[None, :] * bin_h[:, None]  # [R, ph*sr]
+        sx = x1[:, None] + gx[None, :] * bin_w[:, None]
+
+        def bilinear(img, yy, xx):
+            # img [C, H, W]; yy [P], xx [Q] -> [C, P, Q]
+            y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+            y0i = y0.astype(jnp.int32)
+            x0i = x0.astype(jnp.int32)
+            y1i = jnp.clip(y0i + 1, 0, h - 1)
+            x1i = jnp.clip(x0i + 1, 0, w - 1)
+            wy1 = jnp.clip(yy - y0, 0.0, 1.0)
+            wx1 = jnp.clip(xx - x0, 0.0, 1.0)
+            wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+            # out-of-range samples contribute 0 (reference: empty when
+            # y < -1 or y > H)
+            oob_y = (yy < -1.0) | (yy > h)
+            oob_x = (xx < -1.0) | (xx > w)
+            g = lambda yi, xi: img[:, yi][:, :, xi]
+            out = (g(y0i, x0i) * (wy0[:, None] * wx0[None, :])[None]
+                   + g(y0i, x1i) * (wy0[:, None] * wx1[None, :])[None]
+                   + g(y1i, x0i) * (wy1[:, None] * wx0[None, :])[None]
+                   + g(y1i, x1i) * (wy1[:, None] * wx1[None, :])[None])
+            mask = (~oob_y)[:, None] & (~oob_x)[None, :]
+            return out * mask[None]
+
+        def per_roi(bi, yy, xx):
+            img = feat[bi]
+            samples = bilinear(img, yy, xx)  # [C, ph*sr, pw*sr]
+            samples = samples.reshape(ch, ph, sr, pw, sr)
+            return samples.mean(axis=(2, 4))
+
+        return jax.vmap(per_roi)(batch_idx, sy, sx)
+
+    if boxes_num is None:
+        bn = jnp.asarray([_t(boxes).shape[0]], jnp.int32)
+        return apply_op(lambda ft, ro: f(ft, ro, bn), _t(input), _t(boxes))
+    return apply_op(f, _t(input), _t(boxes), _t(boxes_num).detach())

@@ -1,0 +1,326 @@
+"""Detection ops vs independent numpy goldens (reference test pattern:
+test_yolo_box_op.py / test_multiclass_nms_op.py / test_prior_box_op.py /
+test_box_coder_op.py / test_roi_align_op.py numpy references)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.metric import DetectionMAP
+from paddle_tpu.vision import ops as V
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+# ---------------------------------------------------------------------------
+# numpy goldens
+# ---------------------------------------------------------------------------
+def np_yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample,
+                clip_bbox=True, scale_x_y=1.0):
+    n, c, h, w = x.shape
+    an = len(anchors) // 2
+    x = x.reshape(n, an, 5 + class_num, h, w)
+    bias = -0.5 * (scale_x_y - 1.0)
+    boxes = np.zeros((n, an, h, w, 4), np.float32)
+    scores = np.zeros((n, an, h, w, class_num), np.float32)
+    for b in range(n):
+        ih, iw = img_size[b]
+        for a in range(an):
+            for i in range(h):
+                for j in range(w):
+                    conf = sigmoid(x[b, a, 4, i, j])
+                    if conf < conf_thresh:
+                        continue
+                    cx = (j + sigmoid(x[b, a, 0, i, j]) * scale_x_y
+                          + bias) * iw / w
+                    cy = (i + sigmoid(x[b, a, 1, i, j]) * scale_x_y
+                          + bias) * ih / h
+                    bw = (math.exp(x[b, a, 2, i, j]) * anchors[2 * a] * iw
+                          / (downsample * w))
+                    bh = (math.exp(x[b, a, 3, i, j]) * anchors[2 * a + 1]
+                          * ih / (downsample * h))
+                    box = [cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2]
+                    if clip_bbox:
+                        box[0] = min(max(box[0], 0), iw - 1)
+                        box[1] = min(max(box[1], 0), ih - 1)
+                        box[2] = min(max(box[2], 0), iw - 1)
+                        box[3] = min(max(box[3], 0), ih - 1)
+                    boxes[b, a, i, j] = box
+                    scores[b, a, i, j] = conf * sigmoid(x[b, a, 5:, i, j])
+    return (boxes.reshape(n, -1, 4), scores.reshape(n, -1, class_num))
+
+
+def np_iou(a, b, normalized=True):
+    norm = 0.0 if normalized else 1.0
+    iw = max(0.0, min(a[2], b[2]) - max(a[0], b[0]) + norm)
+    ih = max(0.0, min(a[3], b[3]) - max(a[1], b[1]) + norm)
+    inter = iw * ih
+    ua = (max(a[2] - a[0] + norm, 0) * max(a[3] - a[1] + norm, 0)
+          + max(b[2] - b[0] + norm, 0) * max(b[3] - b[1] + norm, 0) - inter)
+    return inter / ua if ua > 0 else 0.0
+
+
+def np_nms_per_class(boxes, scores, score_thr, top_k, iou_thr):
+    order = np.argsort(-scores)[:top_k]
+    kept = []
+    for i in order:
+        if scores[i] <= score_thr:
+            continue
+        ok = True
+        for j in kept:
+            if np_iou(boxes[i], boxes[j]) > iou_thr:
+                ok = False
+                break
+        if ok:
+            kept.append(i)
+    return kept
+
+
+# ---------------------------------------------------------------------------
+# tests
+# ---------------------------------------------------------------------------
+class TestYoloBox:
+    def test_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        anchors = [10, 13, 16, 30]
+        class_num = 3
+        x = rng.randn(2, 2 * (5 + class_num), 4, 5).astype(np.float32)
+        img = np.array([[64, 96], [32, 48]], np.int32)
+        b, s = V.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                          anchors, class_num, 0.3, 16)
+        gb, gs = np_yolo_box(x, img, anchors, class_num, 0.3, 16)
+        np.testing.assert_allclose(b.numpy(), gb, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(s.numpy(), gs, rtol=1e-5, atol=1e-5)
+
+    def test_no_clip_scale(self):
+        rng = np.random.RandomState(1)
+        anchors = [8, 8]
+        x = rng.randn(1, 1 * 6, 3, 3).astype(np.float32)
+        img = np.array([[40, 40]], np.int32)
+        b, s = V.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                          anchors, 1, 0.0, 8, clip_bbox=False, scale_x_y=1.2)
+        gb, gs = np_yolo_box(x, img, anchors, 1, 0.0, 8, clip_bbox=False,
+                             scale_x_y=1.2)
+        np.testing.assert_allclose(b.numpy(), gb, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(s.numpy(), gs, rtol=1e-5, atol=1e-5)
+
+
+class TestPriorBox:
+    def test_shapes_and_centers(self):
+        feat = np.zeros((1, 8, 4, 6), np.float32)
+        img = np.zeros((1, 3, 32, 48), np.float32)
+        boxes, var = V.prior_box(paddle.to_tensor(feat),
+                                 paddle.to_tensor(img),
+                                 min_sizes=[4.0], max_sizes=[8.0],
+                                 aspect_ratios=[2.0], flip=True, clip=True)
+        # priors: ar=1, ar=2, ar=.5, sqrt(min*max) => 4
+        assert boxes.shape == [4, 6, 4, 4]
+        bn = boxes.numpy()
+        # cell (0,0): center (0.5*8/48, 0.5*8/32) = (1/12, 1/8)
+        c = bn[0, 0, 0]
+        np.testing.assert_allclose([(c[0] + c[2]) / 2, (c[1] + c[3]) / 2],
+                                   [1 / 12, 1 / 8], atol=1e-6)
+        # ar=1 min box: w = 4/48, h = 4/32
+        np.testing.assert_allclose([c[2] - c[0], c[3] - c[1]],
+                                   [4 / 48, 4 / 32], atol=1e-6)
+        # sqrt box is last: w = sqrt(32)/48
+        sq = bn[0, 0, 3]
+        np.testing.assert_allclose(sq[2] - sq[0], math.sqrt(32) / 48,
+                                   atol=1e-6)
+        np.testing.assert_allclose(var.numpy()[0, 0, 0],
+                                   [0.1, 0.1, 0.2, 0.2])
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.RandomState(0)
+        prior = np.abs(rng.rand(5, 4)).astype(np.float32)
+        prior[:, 2:] += prior[:, :2] + 0.5  # valid boxes
+        target = np.abs(rng.rand(3, 4)).astype(np.float32)
+        target[:, 2:] += target[:, :2] + 0.5
+        var = [0.1, 0.1, 0.2, 0.2]
+        enc = V.box_coder(paddle.to_tensor(prior), var,
+                          paddle.to_tensor(target),
+                          code_type="encode_center_size")
+        assert enc.shape == [3, 5, 4]
+        dec = V.box_coder(paddle.to_tensor(prior), var, enc,
+                          code_type="decode_center_size", axis=0)
+        # decoding the encoding reproduces the target (broadcast over M)
+        for m in range(5):
+            np.testing.assert_allclose(dec.numpy()[:, m], target, rtol=1e-4,
+                                       atol=1e-4)
+
+    def test_encode_golden(self):
+        prior = np.array([[0.0, 0.0, 2.0, 2.0]], np.float32)
+        target = np.array([[1.0, 1.0, 3.0, 3.0]], np.float32)
+        enc = V.box_coder(paddle.to_tensor(prior), None,
+                          paddle.to_tensor(target)).numpy()
+        # prior center (1,1) wh (2,2); target center (2,2) wh (2,2)
+        np.testing.assert_allclose(enc[0, 0], [0.5, 0.5, 0.0, 0.0],
+                                   atol=1e-6)
+
+
+class TestIouSimilarity:
+    def test_golden(self):
+        a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+        b = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], np.float32)
+        out = V.iou_similarity(paddle.to_tensor(a),
+                               paddle.to_tensor(b)).numpy()
+        expect = np.array([[np_iou(a[0], b[0]), np_iou(a[0], b[1])],
+                           [np_iou(a[1], b[0]), np_iou(a[1], b[1])]])
+        np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+class TestMulticlassNMS:
+    def test_matches_numpy_greedy(self):
+        rng = np.random.RandomState(0)
+        n, m, c = 2, 12, 3
+        boxes = np.zeros((n, m, 4), np.float32)
+        for i in range(n):
+            xy = rng.rand(m, 2) * 10
+            wh = rng.rand(m, 2) * 4 + 1
+            boxes[i] = np.concatenate([xy, xy + wh], axis=1)
+        scores = rng.rand(n, c, m).astype(np.float32)
+        out, counts = V.multiclass_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.3, nms_top_k=10, keep_top_k=8,
+            nms_threshold=0.4, background_label=0)
+        out = out.numpy()
+        counts = counts.numpy()
+        for i in range(n):
+            expected = []
+            for cls in range(c):
+                if cls == 0:  # background
+                    continue
+                kept = np_nms_per_class(boxes[i], scores[i, cls], 0.3, 10,
+                                        0.4)
+                expected += [(cls, scores[i, cls, k], k) for k in kept]
+            expected.sort(key=lambda t: -t[1])
+            expected = expected[:8]
+            assert counts[i] == len(expected)
+            for r, (cls, sc, k) in enumerate(expected):
+                assert out[i, r, 0] == cls
+                np.testing.assert_allclose(out[i, r, 1], sc, rtol=1e-6)
+                np.testing.assert_allclose(out[i, r, 2:], boxes[i, k],
+                                           rtol=1e-6)
+            # padding rows
+            for r in range(len(expected), 8):
+                assert out[i, r, 0] == -1
+
+    def test_all_below_threshold(self):
+        boxes = np.array([[[0, 0, 1, 1]]], np.float32)
+        scores = np.array([[[0.1]]], np.float32)
+        out, counts = V.multiclass_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.5, nms_top_k=1, keep_top_k=1,
+            background_label=-1)
+        assert counts.numpy()[0] == 0
+        assert out.numpy()[0, 0, 0] == -1
+
+
+class TestRoiAlign:
+    def np_roi_align(self, feat, rois, batch_idx, ph, pw, scale, sr,
+                     aligned):
+        r = rois.shape[0]
+        n, c, h, w = feat.shape
+        out = np.zeros((r, c, ph, pw), np.float64)
+        off = 0.5 if aligned else 0.0
+        for ri in range(r):
+            img = feat[batch_idx[ri]]
+            x1, y1, x2, y2 = rois[ri] * scale - off
+            rw, rh = x2 - x1, y2 - y1
+            if not aligned:
+                rw, rh = max(rw, 1.0), max(rh, 1.0)
+            bw, bh = rw / pw, rh / ph
+            for py in range(ph):
+                for px in range(pw):
+                    acc = np.zeros(c)
+                    for sy in range(sr):
+                        for sx in range(sr):
+                            yy = y1 + (py + (sy + 0.5) / sr) * bh
+                            xx = x1 + (px + (sx + 0.5) / sr) * bw
+                            if yy < -1.0 or yy > h or xx < -1.0 or xx > w:
+                                continue
+                            y0 = min(max(int(np.floor(yy)), 0), h - 1)
+                            x0 = min(max(int(np.floor(xx)), 0), w - 1)
+                            y1i = min(y0 + 1, h - 1)
+                            x1i = min(x0 + 1, w - 1)
+                            wy = min(max(yy - y0, 0.0), 1.0)
+                            wx = min(max(xx - x0, 0.0), 1.0)
+                            acc += ((1 - wy) * (1 - wx) * img[:, y0, x0]
+                                    + (1 - wy) * wx * img[:, y0, x1i]
+                                    + wy * (1 - wx) * img[:, y1i, x0]
+                                    + wy * wx * img[:, y1i, x1i])
+                    out[ri, :, py, px] = acc / (sr * sr)
+        return out
+
+    @pytest.mark.parametrize("aligned", [True, False])
+    def test_matches_numpy(self, aligned):
+        rng = np.random.RandomState(0)
+        feat = rng.randn(2, 3, 8, 8).astype(np.float32)
+        rois = np.array([[1.0, 1.0, 6.0, 6.0],
+                         [0.0, 0.0, 4.0, 7.5],
+                         [2.0, 3.0, 7.0, 5.0]], np.float32)
+        boxes_num = np.array([2, 1], np.int32)
+        out = V.roi_align(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                          output_size=4, spatial_scale=0.5,
+                          sampling_ratio=2, boxes_num=paddle.to_tensor(
+                              boxes_num), aligned=aligned)
+        gold = self.np_roi_align(feat, rois, [0, 0, 1], 4, 4, 0.5, 2,
+                                 aligned)
+        np.testing.assert_allclose(out.numpy(), gold, rtol=1e-4, atol=1e-5)
+
+    def test_gradient_flows(self):
+        import jax
+
+        feat = np.ones((1, 1, 4, 4), np.float32)
+        rois = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+
+        def loss(f):
+            t = paddle.to_tensor(f, stop_gradient=False)
+            out = V.roi_align(t, paddle.to_tensor(rois), output_size=2,
+                              sampling_ratio=1)
+            return t, out.sum()
+
+        t, l = loss(feat)
+        l.backward()
+        assert t.grad is not None
+        assert float(np.abs(t.grad.numpy()).sum()) > 0
+
+
+class TestDetectionMAP:
+    def test_perfect_detections(self):
+        m = DetectionMAP(overlap_threshold=0.5)
+        gts = np.array([[0, 0, 0, 2, 2], [1, 4, 4, 6, 6]], np.float32)
+        dets = np.array([[0, 0.9, 0, 0, 2, 2], [1, 0.8, 4, 4, 6, 6]],
+                        np.float32)
+        m.update(dets, gts)
+        assert m.accumulate() == pytest.approx(1.0)
+
+    def test_half_detected(self):
+        m = DetectionMAP(overlap_threshold=0.5, ap_type="11point")
+        gts = np.array([[0, 0, 0, 2, 2], [0, 4, 4, 6, 6]], np.float32)
+        dets = np.array([[0, 0.9, 0, 0, 2, 2]], np.float32)
+        m.update(dets, gts)
+        # precision 1 up to recall .5, zero beyond: 11pt = 6/11
+        assert m.accumulate() == pytest.approx(6 / 11, abs=1e-6)
+
+    def test_false_positive_ranking(self):
+        m = DetectionMAP()
+        gts = np.array([[0, 0, 0, 2, 2]], np.float32)
+        dets = np.array([[0, 0.9, 8, 8, 9, 9],   # FP ranked first
+                         [0, 0.5, 0, 0, 2, 2]], np.float32)
+        m.update(dets, gts)
+        # integral: precision at the TP = 1/2, delta recall 1
+        assert m.accumulate() == pytest.approx(0.5)
+
+    def test_padding_rows_ignored(self):
+        m = DetectionMAP()
+        gts = np.array([[0, 0, 0, 2, 2]], np.float32)
+        dets = np.array([[0, 0.9, 0, 0, 2, 2],
+                         [-1, 0.0, 0, 0, 0, 0]], np.float32)
+        m.update(dets, gts)
+        assert m.accumulate() == pytest.approx(1.0)

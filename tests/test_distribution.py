@@ -1,0 +1,118 @@
+"""paddle.distribution golden tests (reference: test_distribution.py —
+numpy closed forms for pdf/entropy/kl)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import Categorical, Normal, Uniform, kl_divergence
+
+
+class TestNormal:
+    def test_log_prob_golden(self):
+        d = Normal(1.0, 2.0)
+        v = np.array([0.0, 1.0, 3.0], np.float32)
+        got = d.log_prob(paddle.to_tensor(v)).numpy()
+        expect = (-((v - 1.0) ** 2) / (2 * 4.0) - math.log(2.0)
+                  - 0.5 * math.log(2 * math.pi))
+        np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+    def test_entropy_golden(self):
+        d = Normal(np.zeros(3, np.float32),
+                   np.array([1.0, 2.0, 0.5], np.float32))
+        expect = 0.5 + 0.5 * math.log(2 * math.pi) + np.log([1.0, 2.0, 0.5])
+        np.testing.assert_allclose(d.entropy().numpy(), expect, rtol=1e-6)
+
+    def test_kl_closed_form(self):
+        p = Normal(0.0, 1.0)
+        q = Normal(1.0, 2.0)
+        got = float(kl_divergence(p, q).numpy())
+        expect = math.log(2.0) + (1.0 + 1.0) / (2 * 4.0) - 0.5
+        assert got == pytest.approx(expect, rel=1e-6)
+        assert float(kl_divergence(p, p).numpy()) == pytest.approx(0.0,
+                                                                   abs=1e-7)
+
+    def test_sampling_moments_and_seeding(self):
+        paddle.seed(0)
+        d = Normal(3.0, 0.5)
+        s = d.sample((20000,)).numpy()
+        assert s.mean() == pytest.approx(3.0, abs=0.02)
+        assert s.std() == pytest.approx(0.5, abs=0.02)
+        paddle.seed(0)
+        s2 = Normal(3.0, 0.5).sample((20000,)).numpy()
+        np.testing.assert_array_equal(s, s2)  # paddle.seed reproducibility
+
+    def test_probs_matches_exp_log_prob(self):
+        d = Normal(0.0, 1.5)
+        v = paddle.to_tensor(np.array([0.3], np.float32))
+        np.testing.assert_allclose(d.probs(v).numpy(),
+                                   np.exp(d.log_prob(v).numpy()), rtol=1e-6)
+
+
+class TestUniform:
+    def test_log_prob_inside_outside(self):
+        d = Uniform(1.0, 3.0)
+        got = d.log_prob(paddle.to_tensor(
+            np.array([0.0, 2.0, 3.5], np.float32))).numpy()
+        assert got[0] == -np.inf and got[2] == -np.inf
+        assert got[1] == pytest.approx(-math.log(2.0), rel=1e-6)
+
+    def test_entropy(self):
+        assert float(Uniform(0.0, 4.0).entropy().numpy()) == pytest.approx(
+            math.log(4.0), rel=1e-6)
+
+    def test_sample_range_and_mean(self):
+        paddle.seed(1)
+        s = Uniform(-2.0, 2.0).sample((20000,)).numpy()
+        assert s.min() >= -2.0 and s.max() < 2.0
+        assert s.mean() == pytest.approx(0.0, abs=0.05)
+
+
+class TestCategorical:
+    def test_entropy_golden(self):
+        p = np.array([0.1, 0.2, 0.7], np.float32)
+        d = Categorical(paddle.to_tensor(p))
+        expect = -(p * np.log(p)).sum()
+        assert float(d.entropy().numpy()) == pytest.approx(expect, rel=1e-5)
+
+    def test_unnormalized_input(self):
+        d1 = Categorical(paddle.to_tensor(np.array([1.0, 2.0, 7.0],
+                                                   np.float32)))
+        d2 = Categorical(paddle.to_tensor(np.array([0.1, 0.2, 0.7],
+                                                   np.float32)))
+        np.testing.assert_allclose(d1.entropy().numpy(),
+                                   d2.entropy().numpy(), rtol=1e-6)
+
+    def test_kl_closed_form(self):
+        p = np.array([0.3, 0.7], np.float32)
+        q = np.array([0.5, 0.5], np.float32)
+        d = Categorical(paddle.to_tensor(p))
+        e = Categorical(paddle.to_tensor(q))
+        expect = (p * np.log(p / q)).sum()
+        assert float(kl_divergence(d, e).numpy()) == pytest.approx(
+            expect, rel=1e-5)
+
+    def test_sample_frequencies(self):
+        paddle.seed(3)
+        p = np.array([0.2, 0.8], np.float32)
+        s = Categorical(paddle.to_tensor(p)).sample((20000,)).numpy()
+        assert str(s.dtype) == "int64"
+        freq = np.bincount(s, minlength=2) / len(s)
+        np.testing.assert_allclose(freq, p, atol=0.02)
+
+    def test_probs_and_log_prob(self):
+        p = np.array([0.25, 0.75], np.float32)
+        d = Categorical(paddle.to_tensor(p))
+        v = paddle.to_tensor(np.array([0, 1, 1], np.int64))
+        np.testing.assert_allclose(d.probs(v).numpy(), [0.25, 0.75, 0.75],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(d.log_prob(v).numpy(),
+                                   np.log([0.25, 0.75, 0.75]), rtol=1e-6)
+
+    def test_batched_probs(self):
+        p = np.array([[0.25, 0.75], [0.5, 0.5]], np.float32)
+        d = Categorical(paddle.to_tensor(p))
+        v = paddle.to_tensor(np.array([1, 0], np.int64))
+        np.testing.assert_allclose(d.probs(v).numpy(), [0.75, 0.5],
+                                   rtol=1e-6)

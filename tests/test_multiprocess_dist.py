@@ -1,0 +1,107 @@
+"""Real 2-process jax.distributed parity (VERDICT r1 item 9).
+
+Launches 2 subprocess ranks through distributed/launch.py with
+jax.distributed.initialize on the CPU backend (shared coordinator) and
+asserts (a) an all_reduce across processes and (b) a 2-rank DP
+ParallelTrainStep reproduce single-process numerics — the reference's
+TestDistBase multi-process methodology (test_collective_base.py:141,
+test_dist_base.py:682)."""
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.launch import launch
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+dist.init_parallel_env()
+rank = dist.get_rank()
+assert dist.get_world_size() == 2, dist.get_world_size()
+assert jax.device_count() == 2  # one CPU device contributed per process
+
+# ---- (a) cross-process allreduce --------------------------------------
+x = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+dist.all_reduce(x)
+out = x.numpy()
+
+# ---- (b) 2-rank DP train step vs recorded global batch ----------------
+from jax.sharding import Mesh
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.fleet.engine import ParallelTrainStep
+
+paddle.seed(7)
+net = nn.Linear(8, 4)
+opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+step = ParallelTrainStep(net, loss_fn=nn.CrossEntropyLoss(), optimizer=opt,
+                         mesh=mesh)
+rng = np.random.RandomState(0)
+losses = []
+for _ in range(3):
+    xb = rng.randn(8, 8).astype(np.float32)
+    yb = rng.randint(0, 4, 8).astype(np.int64)
+    losses.append(float(step((xb,), (yb,)).numpy()))
+
+if rank == 0:
+    with open(os.environ["RESULT_FILE"], "w") as f:
+        json.dump({"allreduce": out.tolist(), "losses": losses}, f)
+"""
+
+
+def test_two_process_allreduce_and_dp_step(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(WORKER))
+    result_file = str(tmp_path / "result.json")
+    rc = launch(str(script), [], nproc_per_node=2,
+                log_dir=str(tmp_path / "logs"),
+                extra_env={"JAX_PLATFORMS": "cpu",
+                           # the pytest process forces an 8-device host
+                           # platform (conftest); ranks must contribute ONE
+                           # cpu device each
+                           "XLA_FLAGS": "",
+                           "PYTHONPATH": _REPO + ":" + os.environ.get(
+                               "PYTHONPATH", ""),
+                           "RESULT_FILE": result_file})
+    if rc != 0:
+        logs = ""
+        for i in (0, 1):
+            p = tmp_path / "logs" / f"workerlog.{i}"
+            if p.exists():
+                logs += f"--- rank {i} ---\\n" + p.read_text()[-3000:]
+        raise AssertionError(f"launch rc={rc}\\n{logs}")
+    with open(result_file) as f:
+        res = json.load(f)
+    # (a) sum over ranks: 1 + 2 = 3
+    np.testing.assert_allclose(res["allreduce"], [3.0] * 4)
+
+    # (b) single-process reference on the identical global batches
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.jit.train_step import TrainStep
+
+    paddle.seed(7)
+    net = nn.Linear(8, 4)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    step = TrainStep(net, loss_fn=nn.CrossEntropyLoss(), optimizer=opt)
+    rng = np.random.RandomState(0)
+    ref = []
+    for _ in range(3):
+        xb = rng.randn(8, 8).astype(np.float32)
+        yb = rng.randint(0, 4, 8).astype(np.int64)
+        ref.append(float(step((paddle.to_tensor(xb),),
+                              (paddle.to_tensor(yb),)).numpy()))
+    np.testing.assert_allclose(res["losses"], ref, rtol=1e-5, atol=1e-6)
