@@ -56,7 +56,8 @@ class Generator:
             return jax.random.key_data(self._key)
 
     def set_state(self, state):
-        self._key = jax.random.wrap_key_data(np.asarray(state))
+        with self._lock:
+            self._key = jax.random.wrap_key_data(np.asarray(state))
 
 
 _default_generator = Generator(0)

@@ -24,6 +24,7 @@ from .parallel import (  # noqa: F401
 )
 
 from . import fleet  # noqa: F401
+from . import heter  # noqa: F401
 from . import launch  # noqa: F401
 from . import ps  # noqa: F401
 from .fleet import mesh_utils  # noqa: F401

@@ -1,0 +1,261 @@
+"""Heterogeneous parameter-server pieces: HeterClient/HeterServer and the
+graph table.
+
+Reference: fluid/distributed/service/heter_client.h:38 / heter_server.h
+(CPU↔accelerator split training — trainers on one device type call
+``SendAndRecv`` against workers on another, shipping named variables and
+getting computed variables back) and table/common_graph_table.h (node/edge
+storage with k-neighbor sampling for graph learning).
+
+TPU-first re-design:
+- the transport is a small length-prefixed TCP protocol (the reference uses
+  brpc); payloads are named numpy arrays, so a TPU trainer exchanges host
+  arrays with CPU-side workers without touching the XLA runtime;
+- the heter worker runs registered PYTHON handlers (the reference executes
+  program sections) — the natural form here, where host-side stages are
+  plain functions over numpy;
+- graph sampling returns STATIC shapes: [n, k] neighbor blocks padded with
+  -1 plus true counts, so downstream jitted code never sees ragged output.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+__all__ = ["HeterServer", "HeterClient", "GraphTable"]
+
+_MAGIC = b"PTHS"
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=4)
+    sock.sendall(_MAGIC + struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket):
+    head = _recv_exact(sock, 12)
+    if head[:4] != _MAGIC:
+        raise ConnectionError("bad frame magic")
+    (n,) = struct.unpack("<Q", head[4:])
+    return pickle.loads(_recv_exact(sock, n))
+
+
+# ---------------------------------------------------------------------------
+# graph table (common_graph_table.h counterpart)
+# ---------------------------------------------------------------------------
+class GraphTable:
+    """Adjacency + optional node features, with padded k-neighbor sampling.
+
+    The reference shards this across PS nodes; here one table serves a
+    process (shard across HeterServers by node id at the caller if needed).
+    """
+
+    def __init__(self, seed: int = 0):
+        self._adj: Dict[int, list] = {}
+        self._feat: Dict[int, np.ndarray] = {}
+        self._rng = np.random.RandomState(seed)
+        self._lock = threading.Lock()
+
+    # -- construction -------------------------------------------------------
+    def add_edges(self, src, dst, bidirectional: bool = False):
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        with self._lock:
+            for s, d in zip(src, dst):
+                self._adj.setdefault(int(s), []).append(int(d))
+                if bidirectional:
+                    self._adj.setdefault(int(d), []).append(int(s))
+
+    def set_node_feat(self, node_ids, feats):
+        node_ids = np.asarray(node_ids, np.int64).reshape(-1)
+        feats = np.asarray(feats, np.float32)
+        with self._lock:
+            for i, nid in enumerate(node_ids):
+                self._feat[int(nid)] = feats[i]
+
+    # -- queries ------------------------------------------------------------
+    def all_nodes(self) -> np.ndarray:
+        with self._lock:
+            return np.asarray(sorted(self._adj), np.int64)
+
+    def random_sample_nodes(self, n: int) -> np.ndarray:
+        nodes = self.all_nodes()
+        if len(nodes) == 0:
+            return np.zeros(0, np.int64)
+        idx = self._rng.randint(0, len(nodes), int(n))
+        return nodes[idx]
+
+    def sample_neighbors(self, node_ids, k: int):
+        """→ (neighbors [n, k] int64 padded with -1, counts [n] int32).
+
+        Sampling is WITHOUT replacement when a node has ≥ k neighbors,
+        with replacement below (the reference's sample_k semantics)."""
+        node_ids = np.asarray(node_ids, np.int64).reshape(-1)
+        n = len(node_ids)
+        out = np.full((n, int(k)), -1, np.int64)
+        cnt = np.zeros(n, np.int32)
+        with self._lock:
+            for i, nid in enumerate(node_ids):
+                nbrs = self._adj.get(int(nid))
+                if not nbrs:
+                    continue
+                if len(nbrs) >= k:
+                    pick = self._rng.choice(len(nbrs), size=k, replace=False)
+                else:
+                    pick = self._rng.randint(0, len(nbrs), size=k)
+                out[i] = np.asarray(nbrs, np.int64)[pick]
+                cnt[i] = min(len(nbrs), k)
+        return out, cnt
+
+    def get_node_feat(self, node_ids, dim: Optional[int] = None):
+        node_ids = np.asarray(node_ids, np.int64).reshape(-1)
+        if dim is None:
+            dim = next(iter(self._feat.values())).shape[-1] if self._feat \
+                else 0
+        out = np.zeros((len(node_ids), dim), np.float32)
+        with self._lock:
+            for i, nid in enumerate(node_ids):
+                f = self._feat.get(int(nid))
+                if f is not None:
+                    out[i] = f
+        return out
+
+
+# ---------------------------------------------------------------------------
+# heter server / client
+# ---------------------------------------------------------------------------
+class HeterServer:
+    """Serves registered python handlers and graph tables over TCP.
+
+    ``handlers``: name → fn(dict[str, np.ndarray]) → dict[str, np.ndarray]
+    (the reference registers program sections under message names and the
+    trainer calls SendAndRecv on them). Graph tables get built-in
+    endpoints: ``graph.<table>.<op>``.
+    """
+
+    def __init__(self, port: int = 0,
+                 handlers: Optional[Dict[str, Callable]] = None):
+        self._handlers = dict(handlers or {})
+        self._graphs: Dict[str, GraphTable] = {}
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        req = _recv_msg(self.request)
+                        _send_msg(self.request, outer._dispatch(req))
+                except (ConnectionError, OSError):
+                    pass
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = _Server(("127.0.0.1", int(port)), _Handler)
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    def register(self, name: str, fn: Callable):
+        self._handlers[name] = fn
+
+    def add_graph_table(self, name: str, table: Optional[GraphTable] = None
+                        ) -> GraphTable:
+        table = table or GraphTable()
+        self._graphs[name] = table
+        return table
+
+    def _dispatch(self, req):
+        try:
+            name = req["name"]
+            payload = req.get("vars", {})
+            if name.startswith("graph."):
+                _, tname, op = name.split(".", 2)
+                g = self._graphs[tname]
+                if op == "add_edges":
+                    g.add_edges(payload["src"], payload["dst"],
+                                bool(payload.get("bidirectional", False)))
+                    return {"ok": np.asarray(1)}
+                if op == "set_node_feat":
+                    g.set_node_feat(payload["ids"], payload["feats"])
+                    return {"ok": np.asarray(1)}
+                if op == "sample_neighbors":
+                    nbrs, cnt = g.sample_neighbors(
+                        payload["ids"], int(payload["k"]))
+                    return {"neighbors": nbrs, "counts": cnt}
+                if op == "get_node_feat":
+                    return {"feats": g.get_node_feat(payload["ids"])}
+                if op == "random_sample_nodes":
+                    return {"ids": g.random_sample_nodes(int(payload["n"]))}
+                raise KeyError(f"unknown graph op {op!r}")
+            return self._handlers[name](payload)
+        except Exception as e:  # errors travel to the caller, not the log
+            return {"__error__": repr(e)}
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class HeterClient:
+    """send_and_recv against a HeterServer (heter_client.h:38 SendAndRecv:
+    ship named variables, run the remote section, get named results)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._sock = socket.create_connection((host, int(port)))
+        self._lock = threading.Lock()
+
+    def send_and_recv(self, name: str, send_vars: Optional[dict] = None
+                      ) -> Dict[str, np.ndarray]:
+        with self._lock:
+            _send_msg(self._sock, {"name": name,
+                                   "vars": dict(send_vars or {})})
+            out = _recv_msg(self._sock)
+        if "__error__" in out:
+            raise RuntimeError(f"heter handler {name!r} failed: "
+                               f"{out['__error__']}")
+        return out
+
+    # -- graph sugar --------------------------------------------------------
+    def sample_neighbors(self, table: str, ids, k: int):
+        out = self.send_and_recv(f"graph.{table}.sample_neighbors",
+                                 {"ids": np.asarray(ids, np.int64),
+                                  "k": np.asarray(k)})
+        return out["neighbors"], out["counts"]
+
+    def get_node_feat(self, table: str, ids):
+        return self.send_and_recv(f"graph.{table}.get_node_feat",
+                                  {"ids": np.asarray(ids, np.int64)})["feats"]
+
+    def add_graph_edges(self, table: str, src, dst, bidirectional=False):
+        self.send_and_recv(f"graph.{table}.add_edges",
+                           {"src": np.asarray(src, np.int64),
+                            "dst": np.asarray(dst, np.int64),
+                            "bidirectional": np.asarray(bidirectional)})
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -52,13 +52,16 @@ class DetectionMAP:
 
     # -- accumulate ---------------------------------------------------------
     @staticmethod
-    def _iou(a, b):
-        iw = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
-        ih = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    def _iou_matrix(d, g):
+        """d [D, 4], g [G, 4] → [D, G] (vectorized — COCO-scale evals make
+        millions of pairs; a python per-pair loop takes minutes)."""
+        dx1, dy1, dx2, dy2 = (d[:, None, i] for i in range(4))
+        gx1, gy1, gx2, gy2 = (g[None, :, i] for i in range(4))
+        iw = np.clip(np.minimum(dx2, gx2) - np.maximum(dx1, gx1), 0, None)
+        ih = np.clip(np.minimum(dy2, gy2) - np.maximum(dy1, gy1), 0, None)
         inter = iw * ih
-        ua = ((a[2] - a[0]) * (a[3] - a[1])
-              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
-        return inter / ua if ua > 0 else 0.0
+        ua = ((dx2 - dx1) * (dy2 - dy1) + (gx2 - gx1) * (gy2 - gy1) - inter)
+        return np.where(ua > 0, inter / np.maximum(ua, 1e-12), 0.0)
 
     def accumulate(self):
         labels = set()
@@ -79,12 +82,11 @@ class DetectionMAP:
                 d = dets[dets[:, 0] == c]
                 d = d[np.argsort(-d[:, 1])]
                 used = np.zeros(len(g), bool)
-                for row in d:
-                    best, bi = 0.0, -1
-                    for j in range(len(g)):
-                        iou = self._iou(row[2:6], g[j, 1:5])
-                        if iou > best:
-                            best, bi = iou, j
+                iou = self._iou_matrix(d[:, 2:6], g[:, 1:5]) if len(g) \
+                    else np.zeros((len(d), 0))
+                for r, row in enumerate(d):
+                    bi = int(np.argmax(iou[r])) if iou.shape[1] else -1
+                    best = float(iou[r, bi]) if bi >= 0 else 0.0
                     if best >= self._thr and bi >= 0:
                         if not self._eval_difficult and gd[bi]:
                             continue  # difficult matches are ignored

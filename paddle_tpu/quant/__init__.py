@@ -274,11 +274,12 @@ class Int8Conv2D(Layer):
 
 
 def _np_weight_scale(w, quant_type, channel_axis, bits):
-    qmax = 2 ** (bits - 1) - 1
+    """Numpy view of the SAME scale the QAT path used — one formula
+    (_weight_scale) so training and convert() can never disagree."""
     if quant_type == "channel_wise_abs_max":
-        axes = tuple(i for i in range(w.ndim) if i != channel_axis)
-        return np.maximum(np.abs(w).max(axis=axes), 1e-8) / qmax
-    return np.maximum(np.abs(w).max(), 1e-8) / qmax
+        s = _absmax_scale_channel(jnp.asarray(w), channel_axis, bits)
+        return np.asarray(s)
+    return float(_absmax_scale(jnp.asarray(w), bits))
 
 
 def convert(model: Layer) -> Layer:

@@ -288,12 +288,12 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
     returns per-image counts). Suppression is a ``lax.scan`` over the
     nms_top_k score-sorted candidates per class — fully batched on the
     accelerator, no host loop."""
-    kt = int(keep_top_k)
-
     def f(bb, sc):
         n, m, _ = bb.shape
         c = sc.shape[1]
         ktk = min(int(nms_top_k), m)
+        # keep_top_k = -1 (reference: keep everything) → all candidates
+        kt = c * ktk if int(keep_top_k) < 0 else int(keep_top_k)
 
         def per_image(boxes, scores_ci):
             keeps, ss, idxs = jax.vmap(
@@ -406,6 +406,11 @@ def roi_align(input, boxes, output_size, spatial_scale=1.0,
         return jax.vmap(per_roi)(batch_idx, sy, sx)
 
     if boxes_num is None:
+        if _t(input).shape[0] != 1:
+            raise ValueError(
+                "roi_align: boxes_num is required when the input batch has "
+                "more than one image (otherwise every RoI would silently "
+                "pool from image 0)")
         bn = jnp.asarray([_t(boxes).shape[0]], jnp.int32)
         return apply_op(lambda ft, ro: f(ft, ro, bn), _t(input), _t(boxes))
     return apply_op(f, _t(input), _t(boxes), _t(boxes_num).detach())

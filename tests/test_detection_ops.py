@@ -324,3 +324,22 @@ class TestDetectionMAP:
                          [-1, 0.0, 0, 0, 0, 0]], np.float32)
         m.update(dets, gts)
         assert m.accumulate() == pytest.approx(1.0)
+
+
+def test_multiclass_nms_keep_all():
+    boxes = np.array([[[0, 0, 1, 1], [5, 5, 6, 6]]], np.float32)
+    scores = np.array([[[0.9, 0.8]]], np.float32)
+    out, counts = V.multiclass_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.1, nms_top_k=2, keep_top_k=-1,
+        background_label=-1)
+    assert out.shape[1] == 2  # keep_top_k=-1 -> all C*nms_top_k slots
+    assert counts.numpy()[0] == 2
+
+
+def test_roi_align_multi_image_requires_boxes_num():
+    feat = np.zeros((2, 1, 4, 4), np.float32)
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+    with pytest.raises(ValueError, match="boxes_num"):
+        V.roi_align(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                    output_size=2)

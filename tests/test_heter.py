@@ -1,0 +1,108 @@
+"""Heterogeneous PS pieces: HeterClient/HeterServer send_and_recv and the
+graph table (reference: heter_client.h:38 SendAndRecv, heter_server.h
+registered handlers, common_graph_table.h k-neighbor sampling)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.heter import GraphTable, HeterClient, HeterServer
+
+
+@pytest.fixture
+def server():
+    s = HeterServer()
+    yield s
+    s.stop()
+
+
+class TestHeterRPC:
+    def test_send_and_recv_handler(self, server):
+        def pool_embedding(v):
+            # the CPU-side "section": lookup + mean-pool
+            table = np.arange(20, dtype=np.float32).reshape(10, 2)
+            emb = table[v["ids"]]
+            return {"pooled": emb.mean(axis=1)}
+
+        server.register("pool", pool_embedding)
+        c = HeterClient(port=server.port)
+        out = c.send_and_recv("pool", {"ids": np.array([[1, 3], [0, 2]])})
+        np.testing.assert_allclose(out["pooled"],
+                                   [[4.0, 5.0], [2.0, 3.0]])
+        c.close()
+
+    def test_handler_error_propagates(self, server):
+        server.register("boom", lambda v: 1 / 0)
+        c = HeterClient(port=server.port)
+        with pytest.raises(RuntimeError, match="boom"):
+            c.send_and_recv("boom", {})
+        c.close()
+
+    def test_heter_split_training_flow(self, server):
+        """CPU worker computes the sparse stage, TPU-side trainer runs the
+        dense net on the returned activations — the reference's
+        CPU/accelerator split (heter pipeline) in miniature."""
+        rng = np.random.RandomState(0)
+        emb_table = rng.randn(50, 8).astype(np.float32)
+
+        def sparse_stage(v):
+            return {"h": emb_table[v["ids"]].mean(axis=1)}
+
+        server.register("sparse_stage", sparse_stage)
+        c = HeterClient(port=server.port)
+
+        from paddle_tpu import nn, optimizer
+
+        paddle.seed(0)
+        net = nn.Linear(8, 2)
+        opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        losses = []
+        for step in range(4):
+            ids = rng.randint(0, 50, (16, 5))
+            h = c.send_and_recv("sparse_stage", {"ids": ids})["h"]
+            y = (h.sum(axis=1) > 0).astype(np.int64)
+            loss = nn.functional.cross_entropy(net(paddle.to_tensor(h)),
+                                               paddle.to_tensor(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+        c.close()
+
+
+class TestGraphTable:
+    def test_sampling_padded_static_shape(self):
+        g = GraphTable(seed=0)
+        g.add_edges([0, 0, 0, 1], [10, 11, 12, 20])
+        nbrs, cnt = g.sample_neighbors([0, 1, 5], k=2)
+        assert nbrs.shape == (3, 2) and cnt.tolist() == [2, 1, 0]
+        assert set(nbrs[0]) <= {10, 11, 12}
+        assert (nbrs[1] == 20).all()  # with replacement below k
+        assert (nbrs[2] == -1).all()  # isolated node: all padding
+
+    def test_without_replacement_when_enough(self):
+        g = GraphTable(seed=1)
+        g.add_edges([0] * 5, [1, 2, 3, 4, 5])
+        nbrs, cnt = g.sample_neighbors([0], k=5)
+        assert sorted(nbrs[0].tolist()) == [1, 2, 3, 4, 5]
+
+    def test_node_feats_and_bidirectional(self):
+        g = GraphTable()
+        g.add_edges([0], [1], bidirectional=True)
+        nbrs, _ = g.sample_neighbors([1], k=1)
+        assert nbrs[0, 0] == 0
+        g.set_node_feat([0, 1], np.eye(2, 3, dtype=np.float32))
+        np.testing.assert_allclose(g.get_node_feat([1, 0]),
+                                   [[0, 1, 0], [1, 0, 0]])
+
+    def test_graph_over_rpc(self, server):
+        server.add_graph_table("g")
+        c = HeterClient(port=server.port)
+        c.add_graph_edges("g", [0, 1], [1, 2], bidirectional=True)
+        nbrs, cnt = c.sample_neighbors("g", [1], k=2)
+        assert cnt[0] == 2 and set(nbrs[0]) == {0, 2}
+        c.send_and_recv("graph.g.set_node_feat",
+                        {"ids": np.array([2]),
+                         "feats": np.array([[7.0, 8.0]], np.float32)})
+        np.testing.assert_allclose(c.get_node_feat("g", [2]), [[7.0, 8.0]])
+        c.close()

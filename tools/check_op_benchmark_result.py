@@ -1,0 +1,66 @@
+"""Relative op-benchmark regression gate.
+
+Counterpart of the reference's tools/check_op_benchmark_result.py:20 —
+compares a PR run against a baseline run of ``op_benchmark.py`` and fails
+when any case slows down beyond the tolerance (the reference's CI gates
+perf PR-vs-develop, never on absolute numbers).
+
+Usage:
+  python tools/op_benchmark.py --out develop.json      # on the base commit
+  python tools/op_benchmark.py --out pr.json           # on the PR
+  python tools/check_op_benchmark_result.py develop.json pr.json [--tol 1.10]
+Exit code 0 = pass, 8 = regression found (mirrors the reference's fail
+code path).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("baseline")
+    ap.add_argument("candidate")
+    ap.add_argument("--tol", type=float, default=1.10,
+                    help="max allowed ms ratio candidate/baseline")
+    args = ap.parse_args()
+    with open(args.baseline) as f:
+        base = json.load(f)
+    with open(args.candidate) as f:
+        cand = json.load(f)
+    if base.get("backend") != cand.get("backend"):
+        print(f"[check_op_benchmark] backend mismatch: "
+              f"{base.get('backend')} vs {cand.get('backend')}")
+        return 8
+    regressions = []
+    for name, b in base.get("cases", {}).items():
+        c = cand.get("cases", {}).get(name)
+        if c is None:
+            print(f"[check_op_benchmark] MISSING  {name} (case removed?)")
+            regressions.append(name)
+            continue
+        if "error" in c and "error" not in b:
+            print(f"[check_op_benchmark] BROKE    {name}: {c['error']}")
+            regressions.append(name)
+            continue
+        if "error" in b or "error" in c:
+            continue
+        ratio = c["ms"] / max(b["ms"], 1e-9)
+        tag = "REGRESS " if ratio > args.tol else ("improve " if ratio < 0.95
+                                                   else "same    ")
+        print(f"[check_op_benchmark] {tag} {name:28s} "
+              f"{b['ms']:9.4f} -> {c['ms']:9.4f} ms  x{ratio:.3f}")
+        if ratio > args.tol:
+            regressions.append(name)
+    if regressions:
+        print(f"[check_op_benchmark] FAILED: {len(regressions)} "
+              f"regression(s): {', '.join(regressions)}")
+        return 8
+    print("[check_op_benchmark] PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
