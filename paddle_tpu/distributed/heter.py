@@ -98,7 +98,8 @@ class GraphTable:
         nodes = self.all_nodes()
         if len(nodes) == 0:
             return np.zeros(0, np.int64)
-        idx = self._rng.randint(0, len(nodes), int(n))
+        with self._lock:  # RandomState is not thread-safe
+            idx = self._rng.randint(0, len(nodes), int(n))
         return nodes[idx]
 
     def sample_neighbors(self, node_ids, k: int):
@@ -150,7 +151,9 @@ class HeterServer:
     """
 
     def __init__(self, port: int = 0,
-                 handlers: Optional[Dict[str, Callable]] = None):
+                 handlers: Optional[Dict[str, Callable]] = None,
+                 host: str = "127.0.0.1"):
+        # host="0.0.0.0" for the documented cross-machine split
         self._handlers = dict(handlers or {})
         self._graphs: Dict[str, GraphTable] = {}
         outer = self
@@ -168,7 +171,7 @@ class HeterServer:
             allow_reuse_address = True
             daemon_threads = True
 
-        self._srv = _Server(("127.0.0.1", int(port)), _Handler)
+        self._srv = _Server((host, int(port)), _Handler)
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
         self._thread.start()

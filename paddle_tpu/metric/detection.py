@@ -87,7 +87,8 @@ class DetectionMAP:
                 for r, row in enumerate(d):
                     bi = int(np.argmax(iou[r])) if iou.shape[1] else -1
                     best = float(iou[r, bi]) if bi >= 0 else 0.0
-                    if best >= self._thr and bi >= 0:
+                    # a zero-overlap pair is never a match, even at thr=0
+                    if bi >= 0 and best > 0.0 and best >= self._thr:
                         if not self._eval_difficult and gd[bi]:
                             continue  # difficult matches are ignored
                         if not used[bi]:

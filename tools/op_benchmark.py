@@ -25,7 +25,9 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _cases():
@@ -45,9 +47,12 @@ def _cases():
         from paddle_tpu.nn import functional as F
 
         x, w = f32(8, 64, 56, 56), f32(128, 64, 3, 3)
-        return (lambda a, b: F.conv2d(a, b, padding=1)._value
-                if hasattr(F.conv2d(a, b, padding=1), "_value")
-                else F.conv2d(a, b, padding=1)), (x, w)
+
+        def f(a, b):
+            out = F.conv2d(a, b, padding=1)
+            return getattr(out, "_value", out)
+
+        return f, (x, w)
 
     def case_attention():
         from paddle_tpu.ops.attention import xla_attention
