@@ -60,16 +60,23 @@ def cross_entropy(
                 lbl_i = jnp.squeeze(lbl_i, axis=axis)
             # loss = logsumexp - picked logit. Avoids materializing the full
             # [N, V] log-probs the log_softmax+gather form writes (for an LM
-            # head V is 50k+ — that tensor is HBM bandwidth, not compute);
-            # XLA fuses the exp into the reduce.
+            # head V is 50k+ — that tensor is HBM bandwidth, not compute).
+            # The SUM accumulates in f32 (a bf16 sum over a 50k vocab
+            # carries ~2 digits) while the exp values stay in the input
+            # dtype — upcasting them would double the saved residual's HBM
+            # bytes (measured -8% end-to-end on the GPT bench).
             m2 = jax.lax.stop_gradient(
                 jnp.max(logits, axis=axis, keepdims=True))
-            lse = jnp.log(jnp.sum(jnp.exp(logits - m2), axis=axis)) \
-                + jnp.squeeze(m2, axis=axis)
+            lse = jnp.log(jnp.sum(jnp.exp(logits - m2), axis=axis,
+                                  dtype=jnp.float32)) \
+                + jnp.squeeze(m2, axis=axis).astype(jnp.float32)
             lbl_exp = jnp.expand_dims(lbl_i, axis)
             picked = jnp.take_along_axis(logits, jnp.clip(lbl_exp, 0, None),
                                          axis=axis)
-            loss = lse - jnp.squeeze(picked, axis=axis)
+            loss = lse - jnp.squeeze(picked, axis=axis).astype(jnp.float32)
+            # dtype contract: every cross_entropy path returns the input
+            # dtype (the f32 accumulation above is internal)
+            loss = loss.astype(logits.dtype)
             mask = (lbl_i != ignore_index).astype(loss.dtype)
             return loss * mask, mask
         logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(

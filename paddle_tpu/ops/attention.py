@@ -501,6 +501,11 @@ def dot_product_attention(q, k, v, causal=False, bias=None, sp_axis=None,
     if layout == "blhd":
         if sp_axis is None and bias is None and not _FORCE_BHLD:
             impl = _resolve_impl(q.shape[1], bias, use_flash, causal)
+            if impl == "flash_tpu" and not _flash_tpu_fits(q, k, blhd=True):
+                # auto picked the kernel but the shape doesn't tile: keep
+                # the MEMORY-SAFE streaming path (the kernel's own fallback
+                # is the materialized O(L²) form — wrong for long L)
+                impl = "blockwise"
             if impl == "flash_tpu":
                 from .flash_tpu import flash_attention_blhd
 
@@ -515,6 +520,8 @@ def dot_product_attention(q, k, v, causal=False, bias=None, sp_axis=None,
     if sp_axis is not None:
         return ring_attention(q, k, v, sp_axis, causal=causal)
     impl = _resolve_impl(q.shape[2], bias, use_flash, causal)
+    if impl == "flash_tpu" and not _flash_tpu_fits(q, k, blhd=False):
+        impl = "blockwise"
     if impl == "flash_tpu":
         from .flash_tpu import flash_attention_blhd
 
@@ -529,6 +536,21 @@ def dot_product_attention(q, k, v, causal=False, bias=None, sp_axis=None,
     return blockwise_attention(q, k, v, causal=causal, bias=bias)
 
 
+def _flash_tpu_fits(q, k, blhd):
+    """Shape gate for routing AUTO dispatch into the flash_tpu kernel:
+    self-attention only (Lq == Lk — the kernel reshapes k to q's length)
+    and the kernel's own tiling constraints."""
+    from .flash_tpu import _fits
+
+    if blhd:
+        b, L, H, d = q.shape
+        Lk = k.shape[1]
+    else:
+        b, H, L, d = q.shape
+        Lk = k.shape[2]
+    return Lk == L and _fits(b, L, H, d, 256)
+
+
 def _resolve_impl(L, bias, use_flash, causal=True):
     """Single source of truth for the impl a [b,h,l,d] dispatch will take
     (the blhd fast path consults it too, so both layouts always agree).
@@ -536,11 +558,14 @@ def _resolve_impl(L, bias, use_flash, causal=True):
     auto: ``use_flash=False`` keeps the exact f32 blockwise recurrence (the
     model-level flag selects numerics, not just a kernel); on TPU short/mid
     sequences take the materialized XLA path (measured fastest at GPT-class
-    shapes — the Mosaic kernels are opt-in via 'pallas'/'flash_tpu'), long
-    ones stream blockwise; off-TPU flash_attention safely degrades to
-    blockwise. The kernel tiers gate on SHAPE at trace time; a rig whose
-    Mosaic compile service itself fails surfaces that at jit-compile time —
-    select 'auto'/'xla' there."""
+    shapes — L=1024/d=64: 53k vs 40k for the kernels), while LONG causal
+    sequences take the repo's Pallas flash kernel (flash_tpu.py): past
+    ~4k the scan-based blockwise path is 8-10x slower (measured L=8192
+    f+b: 100ms vs 13ms) and the materialized path's O(L²) residuals
+    exhaust HBM. Off-TPU flash_attention safely degrades to blockwise.
+    The kernel tiers gate on SHAPE at trace time; a rig whose Mosaic
+    compile service itself fails surfaces that at jit-compile time —
+    select 'xla'/'blockwise' there."""
     on_tpu = jax.default_backend() == "tpu"
     if _IMPL == "flash_tpu":
         return "flash_tpu" if (on_tpu and bias is None and causal) else "xla"
@@ -555,5 +580,9 @@ def _resolve_impl(L, bias, use_flash, causal=True):
     if not use_flash:
         return "blockwise"
     if on_tpu:
-        return "xla" if L <= _XLA_MAX_SEQ else "blockwise"
+        if L <= _XLA_MAX_SEQ:
+            return "xla"
+        if causal and bias is None:
+            return "flash_tpu"
+        return "blockwise"
     return "blockwise" if bias is not None else "flash"

@@ -71,7 +71,9 @@ class GPTAttention(nn.Layer):
         def qkv_attend(xr, w, bias):
             from paddle_tpu.amp.auto_cast import maybe_cast_inputs
 
-            xr, w = maybe_cast_inputs("matmul", xr, w)
+            # 'linear': the projection must honor the same AMP white/black
+            # list entry as every other nn.Linear in the model
+            xr, w = maybe_cast_inputs("linear", xr, w)
             b, l, h = xr.shape
             # three separate projections from slices of the fused weight:
             # each of q/k/v is then BORN in the layout its attention einsum

@@ -95,3 +95,29 @@ class TestDispatch:
             assert out.shape == q.shape
         finally:
             att.set_attention_impl("auto")
+
+
+def test_auto_long_sequence_resolves_to_flash_kernel(monkeypatch):
+    """Past _XLA_MAX_SEQ, auto causal no-bias dispatch must pick the Pallas
+    flash kernel on TPU (measured 8-10x over blockwise at L=8192) and
+    blockwise for biased/non-causal (memory-safe)."""
+    monkeypatch.setattr(att.jax, "default_backend", lambda: "tpu")
+    assert att._resolve_impl(8192, None, True, causal=True) == "flash_tpu"
+    assert att._resolve_impl(8192, object(), True, causal=True) == "blockwise"
+    assert att._resolve_impl(8192, None, True, causal=False) == "blockwise"
+    assert att._resolve_impl(1024, None, True, causal=True) == "xla"
+
+
+def test_auto_long_nonfitting_falls_back_to_blockwise(monkeypatch):
+    """Shapes the kernel can't tile (L % 256, Lq != Lk) must stream via
+    blockwise, not materialize O(L^2) through the kernel's internal
+    fallback."""
+    import jax.numpy as jnp
+
+    monkeypatch.setattr(att.jax, "default_backend", lambda: "tpu")
+    q = jnp.zeros((1, 9000, 4, 64), jnp.float32)   # 9000 % 256 != 0
+    assert not att._flash_tpu_fits(q, q, blhd=True)
+    k = jnp.zeros((1, 4096, 4, 64), jnp.float32)   # cross-attention
+    q2 = jnp.zeros((1, 8192, 4, 64), jnp.float32)
+    assert not att._flash_tpu_fits(q2, k, blhd=True)
+    assert att._flash_tpu_fits(q2, q2, blhd=True)
