@@ -16,8 +16,26 @@ __all__ = [
     "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
     "full_like", "empty_like", "arange", "linspace", "logspace", "eye", "diag",
     "diagflat", "tril", "triu", "meshgrid", "assign", "clone", "numel",
-    "complex", "tril_indices", "triu_indices", "one_hot",
+    "complex", "tril_indices", "triu_indices", "one_hot", "create_parameter",
 ]
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Create a learnable Parameter (parity paddle.create_parameter,
+    reference python/paddle/fluid/layers/tensor.py:77). Delegates to
+    Layer.create_parameter so attr semantics (trainable, need_clip,
+    attr=False → None, initializer precedence) stay in one place."""
+    from ..nn.layer_base import Layer
+
+    shim = Layer.__new__(Layer)
+    shim._dtype = dtype_mod.convert_dtype(dtype) or "float32"
+    p = Layer.create_parameter(shim, _shape(shape), attr=attr, dtype=dtype,
+                               is_bias=is_bias,
+                               default_initializer=default_initializer)
+    if p is not None and p.name is None and name:
+        p.name = name
+    return p
 
 
 def _shape(shape):

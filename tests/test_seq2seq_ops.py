@@ -1,0 +1,219 @@
+"""Seq2seq/CRF op tail: linear_chain_crf, crf_decoding, edit_distance,
+beam search. Goldens are independent numpy reimplementations of the
+reference kernels (linear_chain_crf_op.h ForwardOneSequence,
+crf_decoding_op.h, edit_distance_op.h, beam_search_op.h semantics)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.text.crf import crf_decoding, linear_chain_crf
+
+
+# ---------------------------------------------------------------------------
+# numpy goldens
+# ---------------------------------------------------------------------------
+def np_crf_cost(em, lbl, trans, length):
+    """log Z - score for one sequence (brute force over all tag paths)."""
+    import itertools
+
+    D = em.shape[1]
+    a, b, w = trans[0], trans[1], trans[2:]
+    em = em[:length]
+    lbl = lbl[:length]
+
+    def path_score(path):
+        s = a[path[0]] + b[path[-1]] + sum(em[i, path[i]] for i in range(len(path)))
+        s += sum(w[path[i - 1], path[i]] for i in range(1, len(path)))
+        return s
+
+    scores = [path_score(p) for p in itertools.product(range(D), repeat=length)]
+    log_z = np.log(np.sum(np.exp(np.asarray(scores) - max(scores)))) + max(scores)
+    return log_z - path_score(list(lbl))
+
+
+def np_viterbi(em, trans, length):
+    a, b, w = trans[0], trans[1], trans[2:]
+    em = em[:length]
+    dp = a + em[0]
+    back = []
+    for t in range(1, length):
+        cand = dp[:, None] + w
+        back.append(cand.argmax(axis=0))
+        dp = cand.max(axis=0) + em[t]
+    dp = dp + b
+    best = int(dp.argmax())
+    path = [best]
+    for bp in reversed(back):
+        best = int(bp[best])
+        path.append(best)
+    return path[::-1]
+
+
+def np_edit_distance(h, r):
+    m, n = len(h), len(r)
+    dp = np.zeros((m + 1, n + 1))
+    dp[:, 0] = np.arange(m + 1)
+    dp[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            cost = 0 if h[i - 1] == r[j - 1] else 1
+            dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                           dp[i - 1, j - 1] + cost)
+    return dp[m, n]
+
+
+# ---------------------------------------------------------------------------
+# linear_chain_crf
+# ---------------------------------------------------------------------------
+class TestLinearChainCRF:
+    def test_matches_bruteforce(self, rng):
+        B, S, D = 4, 5, 3
+        em = rng.randn(B, S, D).astype(np.float32)
+        trans = (0.1 * rng.randn(D + 2, D)).astype(np.float32)
+        lbl = rng.randint(0, D, (B, S)).astype(np.int64)
+        lengths = np.array([5, 3, 4, 1], np.int64)
+        out = linear_chain_crf(paddle.to_tensor(em), paddle.to_tensor(lbl),
+                               paddle.to_tensor(trans),
+                               length=paddle.to_tensor(lengths))
+        got = out.numpy().ravel()
+        want = [np_crf_cost(em[i], lbl[i], trans, lengths[i]) for i in range(B)]
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_no_length_and_2d(self, rng):
+        S, D = 4, 3
+        em = rng.randn(S, D).astype(np.float32)
+        trans = (0.1 * rng.randn(D + 2, D)).astype(np.float32)
+        lbl = rng.randint(0, D, (S,)).astype(np.int64)
+        out = linear_chain_crf(paddle.to_tensor(em[None]),
+                               paddle.to_tensor(lbl[None]),
+                               paddle.to_tensor(trans))
+        want = np_crf_cost(em, lbl, trans, S)
+        np.testing.assert_allclose(out.numpy().ravel()[0], want, rtol=1e-4)
+
+    def test_gradients_numeric(self, rng):
+        """Autodiff through the scan replaces linear_chain_crf_grad —
+        check against numeric differentiation."""
+        B, S, D = 2, 3, 3
+        em = rng.randn(B, S, D).astype(np.float64)
+        trans = (0.1 * rng.randn(D + 2, D)).astype(np.float64)
+        lbl = rng.randint(0, D, (B, S)).astype(np.int64)
+
+        import jax
+        import jax.numpy as jnp
+
+        def cost(em_, trans_):
+            from paddle_tpu.text.crf import linear_chain_crf as crf
+
+            out = crf(em_, jnp.asarray(lbl), trans_)
+            return out._value.sum() if hasattr(out, "_value") else out.sum()
+
+        g_em, g_tr = jax.grad(cost, argnums=(0, 1))(jnp.asarray(em),
+                                                    jnp.asarray(trans))
+        eps = 1e-5
+        for idx in [(0, 1, 2), (1, 0, 0)]:
+            d = np.zeros_like(em)
+            d[idx] = eps
+            num = (cost(jnp.asarray(em + d), jnp.asarray(trans))
+                   - cost(jnp.asarray(em - d), jnp.asarray(trans))) / (2 * eps)
+            np.testing.assert_allclose(np.asarray(g_em)[idx], num, rtol=1e-4)
+        for idx in [(0, 1), (3, 2)]:
+            d = np.zeros_like(trans)
+            d[idx] = eps
+            num = (cost(jnp.asarray(em), jnp.asarray(trans + d))
+                   - cost(jnp.asarray(em), jnp.asarray(trans - d))) / (2 * eps)
+            np.testing.assert_allclose(np.asarray(g_tr)[idx], num, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# crf_decoding
+# ---------------------------------------------------------------------------
+class TestCRFDecoding:
+    def test_matches_numpy_viterbi(self, rng):
+        B, S, D = 5, 6, 4
+        em = rng.randn(B, S, D).astype(np.float32)
+        trans = rng.randn(D + 2, D).astype(np.float32)
+        lengths = np.array([6, 4, 1, 5, 6], np.int64)
+        path = crf_decoding(paddle.to_tensor(em), paddle.to_tensor(trans),
+                            length=paddle.to_tensor(lengths)).numpy()
+        for i in range(B):
+            want = np_viterbi(em[i], trans, lengths[i])
+            np.testing.assert_array_equal(path[i, :lengths[i]], want)
+            assert (path[i, lengths[i]:] == 0).all()
+
+    def test_label_mode_correctness_mask(self, rng):
+        B, S, D = 3, 4, 3
+        em = rng.randn(B, S, D).astype(np.float32)
+        trans = rng.randn(D + 2, D).astype(np.float32)
+        paths = crf_decoding(paddle.to_tensor(em), paddle.to_tensor(trans)).numpy()
+        # use the decoded path itself as label for row 0 → all ones
+        lbl = paths.copy()
+        lbl[1:] = (lbl[1:] + 1) % D  # perturb others
+        ok = crf_decoding(paddle.to_tensor(em), paddle.to_tensor(trans),
+                          label=paddle.to_tensor(lbl)).numpy()
+        assert (ok[0] == 1).all()
+        assert (ok[1:] == 0).all()
+
+    def test_decode_agrees_with_crf_cost(self, rng):
+        """The viterbi path must be the argmin of the linear_chain_crf cost."""
+        import itertools
+
+        S, D = 4, 3
+        em = rng.randn(1, S, D).astype(np.float32)
+        trans = rng.randn(D + 2, D).astype(np.float32)
+        path = crf_decoding(paddle.to_tensor(em), paddle.to_tensor(trans)).numpy()[0]
+        costs = {
+            p: linear_chain_crf(
+                paddle.to_tensor(em),
+                paddle.to_tensor(np.asarray(p, np.int64)[None]),
+                paddle.to_tensor(trans),
+            ).numpy().ravel()[0]
+            for p in itertools.product(range(D), repeat=S)
+        }
+        best = min(costs, key=costs.get)
+        np.testing.assert_array_equal(path, best)
+
+
+# ---------------------------------------------------------------------------
+# edit_distance
+# ---------------------------------------------------------------------------
+class TestEditDistance:
+    def test_reference_docstring_example(self):
+        inp = paddle.to_tensor([[1, 2, 3], [4, 5, 6], [4, 4, 4], [1, 1, 1]],
+                               dtype="int64")
+        lab = paddle.to_tensor([[1, 3, 4, 1], [4, 5, 8, 1], [7, 7, 7, 1],
+                                [1, 1, 1, 1]], dtype="int64")
+        il = paddle.to_tensor([3, 3, 3, 3], dtype="int64")
+        ll = paddle.to_tensor([4, 4, 4, 4], dtype="int64")
+        d, n = F.edit_distance(inp, lab, input_length=il, label_length=ll,
+                               normalized=False)
+        np.testing.assert_allclose(d.numpy().ravel(), [3, 2, 4, 1])
+        np.testing.assert_allclose(n.numpy(), [4.0])
+
+    def test_random_vs_numpy(self, rng):
+        B, L1, L2 = 6, 8, 7
+        inp = rng.randint(0, 5, (B, L1)).astype(np.int64)
+        lab = rng.randint(0, 5, (B, L2)).astype(np.int64)
+        il = rng.randint(1, L1 + 1, (B,)).astype(np.int64)
+        ll = rng.randint(1, L2 + 1, (B,)).astype(np.int64)
+        d, _ = F.edit_distance(paddle.to_tensor(inp), paddle.to_tensor(lab),
+                               input_length=paddle.to_tensor(il),
+                               label_length=paddle.to_tensor(ll),
+                               normalized=False)
+        want = [np_edit_distance(inp[i, :il[i]], lab[i, :ll[i]])
+                for i in range(B)]
+        np.testing.assert_allclose(d.numpy().ravel(), want)
+
+    def test_normalized_and_ignored_tokens(self, rng):
+        inp = np.array([[1, 0, 2, 0], [3, 3, 0, 0]], np.int64)
+        lab = np.array([[1, 2, 9, 9], [3, 0, 0, 9]], np.int64)
+        il = np.array([4, 3], np.int64)
+        ll = np.array([3, 4], np.int64)
+        d, _ = F.edit_distance(paddle.to_tensor(inp), paddle.to_tensor(lab),
+                               ignored_tokens=[0],
+                               input_length=paddle.to_tensor(il),
+                               label_length=paddle.to_tensor(ll),
+                               normalized=True)
+        # row0: [1,2] vs [1,2,9] -> 1 sub/ins; label len after removal 3
+        # row1: [3,3] vs [3,9] -> 1; label len after removal 2
+        np.testing.assert_allclose(d.numpy().ravel(), [1 / 3, 1 / 2])

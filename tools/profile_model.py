@@ -1,0 +1,115 @@
+"""Profile one jitted train step of the headline GPT config and print the
+per-op-category time breakdown (ms/step), sorted.
+
+Mirrors the reference's profiler-driven tuning loop
+(tools/test_model_benchmark.sh + platform/profiler) at the XLA level: trace
+N steps with jax.profiler, parse the exported trace.json.gz, aggregate
+complete events on the TPU op lanes by fusion name.
+
+Usage: python tools/profile_model.py [--steps 5] [--top 40]
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import re
+import shutil
+import time
+
+
+def build_step():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.fleet.engine import ParallelTrainStep
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    config = GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                       max_position_embeddings=1024, hidden_dropout=0.0,
+                       attention_dropout=0.0)
+    batch, seq = 8, 1024
+    paddle.seed(0)
+    model = GPTForCausalLM(config)
+    opt = paddle.optimizer.Adam(learning_rate=1e-4,
+                                parameters=model.parameters())
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    step = ParallelTrainStep(model, loss_fn=model.loss_fn, optimizer=opt,
+                             mesh=mesh, recompute=False,
+                             compute_dtype=jnp.bfloat16)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, config.vocab_size, (batch, seq)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1).astype(np.int32)
+    return step, ids, labels
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--logdir", default="/tmp/xplane_bench")
+    args = ap.parse_args()
+
+    import jax
+
+    step, ids, labels = build_step()
+    loss = step((ids,), (labels,))
+    float(loss.numpy())  # block: materialize a scalar (block_until_ready lies)
+
+    shutil.rmtree(args.logdir, ignore_errors=True)
+    with jax.profiler.trace(args.logdir):
+        for _ in range(args.steps):
+            loss = step((ids,), (labels,))
+        float(loss.numpy())
+
+    time.sleep(1)
+    paths = sorted(glob.glob(f"{args.logdir}/plugins/profile/*/*.trace.json.gz"))
+    if not paths:
+        raise SystemExit(f"no trace found under {args.logdir}")
+    with gzip.open(paths[-1]) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    procs = {}
+    op_lanes = set()  # (pid, tid) of "XLA Ops" lanes — the device pid also
+    # carries an "XLA Modules" lane spanning each module execution; summing
+    # both would double-count every op
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            procs[e["pid"]] = e["args"]["name"]
+        elif (e.get("name") == "thread_name"
+              and "XLA Ops" in e["args"].get("name", "")):
+            op_lanes.add((e["pid"], e.get("tid")))
+    tpu_pids = {p for p, n in procs.items()
+                if "TPU" in n or "xla" in n.lower() or "/device" in n.lower()}
+    tot = collections.Counter()
+    cat = collections.Counter()
+    n = collections.Counter()
+    for e in events:
+        if (e.get("ph") != "X" or e.get("pid") not in tpu_pids
+                or (e.get("pid"), e.get("tid")) not in op_lanes):
+            continue
+        name = e.get("name", "")
+        dur = e.get("dur", 0) / 1000.0  # us -> ms
+        tot[name] += dur
+        n[name] += 1
+        cat[re.sub(r"[.\d]+$", "", name)] += dur
+    steps = args.steps
+    total_ms = sum(tot.values()) / steps
+    print(f"== total device time: {total_ms:.1f} ms/step over {steps} steps ==")
+    print("\n-- by category --")
+    for name, ms in cat.most_common(args.top):
+        print(f"{ms/steps:9.3f} ms/step  {name}")
+    print("\n-- top individual ops --")
+    for name, ms in tot.most_common(args.top):
+        print(f"{ms/steps:9.3f} ms/step x{n[name]//steps:4d}  {name}")
+
+
+if __name__ == "__main__":
+    main()
