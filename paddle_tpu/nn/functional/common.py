@@ -28,7 +28,12 @@ def _t(x):
 
 def linear(x, weight, bias=None, name=None):
     """y = x @ W + b with paddle's [in, out] weight layout — lowers to a
-    single MXU matmul; XLA fuses the bias add."""
+    single MXU matmul; XLA fuses the bias add.
+
+    NOTE (profiled, v5e GPT-2 345M): leave the bias grad to jax's native
+    vjp. A custom_vjp that reformulates db as a rank-1 MXU dot measured
+    3k tok/s SLOWER end-to-end — the custom_vjp boundary breaks XLA's
+    dW-matmul+Adam kOutput fusions, which outweighs the faster reduce."""
     from ...amp.auto_cast import maybe_cast_inputs
 
     if bias is None:

@@ -451,7 +451,7 @@ def _causal_chunked(q, k, v, blhd: bool):
         else:
             e = jnp.exp(s - m)
         l_sum = jnp.maximum(e.sum(axis=-1, dtype=jnp.float32), 1e-30)
-        o = jnp.einsum(eq[1], e, vi)
+        o = jnp.einsum(eq[1], e.astype(q.dtype), vi)
         inv = (1.0 / l_sum).astype(q.dtype)
         outs.append(o * (inv[..., None] if not blhd
                          else inv.transpose(0, 2, 1)[..., None]))

@@ -18,11 +18,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.enforce import enforce
 from ..core.tensor import Tensor, apply_op
 
 __all__ = [
     "yolo_box", "prior_box", "box_coder", "multiclass_nms", "roi_align",
-    "iou_similarity", "box_iou",
+    "iou_similarity", "box_iou", "psroi_pool", "deform_conv2d",
 ]
 
 
@@ -414,3 +415,159 @@ def roi_align(input, boxes, output_size, spatial_scale=1.0,
         bn = jnp.asarray([_t(boxes).shape[0]], jnp.int32)
         return apply_op(lambda ft, ro: f(ft, ro, bn), _t(input), _t(boxes))
     return apply_op(f, _t(input), _t(boxes), _t(boxes_num).detach())
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pooling (R-FCN).
+
+    Parity with the reference's psroi_pool op
+    (/root/reference/paddle/fluid/operators/psroi_pool_op.h CPUPSROIPoolOpKernel):
+    ``x`` [N, C, H, W] with C = out_channels·ph·pw, ``boxes`` [R, 4]
+    (x1, y1, x2, y2), ``boxes_num`` [N] → [R, out_channels, ph, pw]. Roi
+    coords are rounded then scaled, bins use floor/ceil edges, empty bins
+    yield 0 — matching the kernel exactly.
+
+    TPU-first: instead of per-roi scalar loops over dynamic [hstart, hend)
+    ranges, each bin is a MASKED mean over the full H/W extent — row/col
+    membership masks [R, ph, H] / [R, pw, W] contracted against the
+    (c, i, j)-factorized feature map in one einsum. Static shapes,
+    vectorized over rois, differentiable.
+    """
+    if isinstance(output_size, int):
+        ph = pw = int(output_size)
+    else:
+        ph, pw = int(output_size[0]), int(output_size[1])
+
+    def f(feat, rois, rois_n):
+        n, cin, h, w = feat.shape
+        r = rois.shape[0]
+        enforce(cin % (ph * pw) == 0,
+                f"psroi_pool: C={cin} must be out_channels*{ph}*{pw}")
+        cout = cin // (ph * pw)
+        cum = jnp.cumsum(rois_n)
+        batch_idx = jnp.searchsorted(cum, jnp.arange(r), side="right")
+
+        x1 = jnp.round(rois[:, 0]) * spatial_scale
+        y1 = jnp.round(rois[:, 1]) * spatial_scale
+        x2 = (jnp.round(rois[:, 2]) + 1.0) * spatial_scale
+        y2 = (jnp.round(rois[:, 3]) + 1.0) * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bh = rh / ph
+        bw = rw / pw
+
+        ivec = jnp.arange(ph, dtype=feat.dtype)
+        jvec = jnp.arange(pw, dtype=feat.dtype)
+        hstart = jnp.clip(jnp.floor(ivec[None] * bh[:, None] + y1[:, None]),
+                          0, h).astype(jnp.int32)          # [R, ph]
+        hend = jnp.clip(jnp.ceil((ivec[None] + 1) * bh[:, None] + y1[:, None]),
+                        0, h).astype(jnp.int32)
+        wstart = jnp.clip(jnp.floor(jvec[None] * bw[:, None] + x1[:, None]),
+                          0, w).astype(jnp.int32)          # [R, pw]
+        wend = jnp.clip(jnp.ceil((jvec[None] + 1) * bw[:, None] + x1[:, None]),
+                        0, w).astype(jnp.int32)
+
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+        mask_y = ((ys[None, None, :] >= hstart[..., None])
+                  & (ys[None, None, :] < hend[..., None])).astype(feat.dtype)
+        mask_x = ((xs[None, None, :] >= wstart[..., None])
+                  & (xs[None, None, :] < wend[..., None])).astype(feat.dtype)
+
+        # channel axis factorizes as (c, i, j): input_channel = (c*ph+i)*pw+j
+        featr = feat[batch_idx].reshape(r, cout, ph, pw, h, w)
+        s = jnp.einsum("rcijhw,rih,rjw->rcij", featr, mask_y, mask_x)
+        area = ((hend - hstart)[:, None, :, None]
+                * (wend - wstart)[:, None, None, :]).astype(feat.dtype)
+        return jnp.where(area > 0, s / jnp.maximum(area, 1.0), 0.0)
+
+    return apply_op(f, _t(x), _t(boxes), _t(boxes_num).detach())
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1 (``mask=None``) / v2 (modulated).
+
+    Parity with the reference's deformable_conv ops
+    (/root/reference/paddle/fluid/operators/deformable_conv_op.cc, v1 op and
+    python/paddle/vision/ops.py:397 deform_conv2d): ``x`` [N, Cin, H, W],
+    ``offset`` [N, dg·2·kh·kw, Ho, Wo] with per-kernel-position (Δh, Δw)
+    channel pairs, ``mask`` [N, dg·kh·kw, Ho, Wo], ``weight``
+    [Cout, Cin/g, kh, kw] → [N, Cout, Ho, Wo].
+
+    TPU-first: the reference's deformable_im2col CUDA kernel becomes a
+    batched bilinear GATHER building sampled columns [N, K, Cin, Ho·Wo]
+    (vectorized over kernel positions and rois via take + arithmetic — no
+    scalar loops), followed by ONE grouped MXU contraction with the weight.
+    Differentiable in x, offset, mask, and weight through jax autodiff —
+    the hand-written col2im/col2im_coord backward kernels are subsumed.
+    """
+    sh, sw = (stride, stride) if isinstance(stride, int) else map(int, stride)
+    ph_, pw_ = (padding, padding) if isinstance(padding, int) else map(int, padding)
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else map(int, dilation)
+
+    def f(xv, off, wv, *rest):
+        rest = list(rest)
+        bv = rest.pop(0) if bias is not None else None
+        mv = rest.pop(0) if mask is not None else None
+        n, cin, h, w = xv.shape
+        cout, cin_g, kh, kw = wv.shape
+        dg = deformable_groups
+        K = kh * kw
+        ho = (h + 2 * ph_ - (dh * (kh - 1) + 1)) // sh + 1
+        wo = (w + 2 * pw_ - (dw * (kw - 1) + 1)) // sw + 1
+
+        # base sampling grid per kernel position k and output location p
+        oy = jnp.arange(ho) * sh - ph_
+        ox = jnp.arange(wo) * sw - pw_
+        ky, kx = jnp.meshgrid(jnp.arange(kh) * dh, jnp.arange(kw) * dw,
+                              indexing="ij")
+        base_y = oy[None, :, None] + ky.reshape(-1)[:, None, None]  # [K,Ho,1]
+        base_x = ox[None, None, :] + kx.reshape(-1)[:, None, None]  # [K,1,Wo]
+
+        off = off.reshape(n, dg, K, 2, ho, wo)
+        sy = base_y + off[:, :, :, 0]                    # [N,dg,K,Ho,Wo]
+        sx = base_x + off[:, :, :, 1]
+
+        def bilinear(img, yy, xx):
+            # img [C_dg, H, W]; yy/xx [K, Ho, Wo] -> [C_dg, K, Ho, Wo]
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy1 = (yy - y0).astype(img.dtype)
+            wx1 = (xx - x0).astype(img.dtype)
+            out = 0.0
+            for iy, wyy in ((y0, 1.0 - wy1), (y0 + 1, wy1)):
+                for ix, wxx in ((x0, 1.0 - wx1), (x0 + 1, wx1)):
+                    inside = ((iy >= 0) & (iy <= h - 1)
+                              & (ix >= 0) & (ix <= w - 1))
+                    yi = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+                    xi = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+                    v = img[:, yi, xi]                   # [C_dg, K, Ho, Wo]
+                    wgt = (wyy * wxx * inside.astype(img.dtype))[None]
+                    out = out + v * wgt
+            return out
+
+        # vmap over batch and deformable groups
+        xg = xv.reshape(n, dg, cin // dg, h, w)
+        cols = jax.vmap(jax.vmap(bilinear))(xg, sy, sx)  # [N,dg,C/dg,K,Ho,Wo]
+        if mv is not None:
+            cols = cols * mv.reshape(n, dg, 1, K, ho, wo)
+        cols = cols.reshape(n, cin, K, ho, wo)
+
+        # grouped contraction: out[n,m,p] = sum_{c_g,k} w[m,c_g,k]·cols
+        cols = cols.reshape(n, groups, cin // groups, K, ho, wo)
+        wg = wv.reshape(groups, cout // groups, cin_g, K)
+        out = jnp.einsum("ngckhw,gmck->ngmhw", cols, wg)
+        out = out.reshape(n, cout, ho, wo)
+        if bv is not None:
+            out = out + bv.reshape(1, -1, 1, 1).astype(out.dtype)
+        return out
+
+    args = [_t(x), _t(offset), _t(weight)]
+    if bias is not None:
+        args.append(_t(bias))
+    if mask is not None:
+        args.append(_t(mask))
+    return apply_op(f, *args)

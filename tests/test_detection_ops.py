@@ -343,3 +343,169 @@ def test_roi_align_multi_image_requires_boxes_num():
     with pytest.raises(ValueError, match="boxes_num"):
         V.roi_align(paddle.to_tensor(feat), paddle.to_tensor(rois),
                     output_size=2)
+
+
+# ---------------------------------------------------------------------------
+# psroi_pool / deform_conv2d (round-3 detection tail)
+# ---------------------------------------------------------------------------
+def np_psroi_pool(x, rois, batch_idx, cout, ph, pw, scale):
+    R = rois.shape[0]
+    _, cin, H, W = x.shape
+    out = np.zeros((R, cout, ph, pw), np.float32)
+    for n in range(R):
+        x1 = round(rois[n, 0]) * scale
+        y1 = round(rois[n, 1]) * scale
+        x2 = (round(rois[n, 2]) + 1.0) * scale
+        y2 = (round(rois[n, 3]) + 1.0) * scale
+        rh = max(y2 - y1, 0.1)
+        rw = max(x2 - x1, 0.1)
+        bh, bw = rh / ph, rw / pw
+        for c in range(cout):
+            for i in range(ph):
+                for j in range(pw):
+                    hs = int(np.floor(i * bh + y1))
+                    ws = int(np.floor(j * bw + x1))
+                    he = int(np.ceil((i + 1) * bh + y1))
+                    we = int(np.ceil((j + 1) * bw + x1))
+                    hs, he = np.clip([hs, he], 0, H)
+                    ws, we = np.clip([ws, we], 0, W)
+                    chan = (c * ph + i) * pw + j
+                    if he <= hs or we <= ws:
+                        continue
+                    patch = x[batch_idx[n], chan, hs:he, ws:we]
+                    out[n, c, i, j] = patch.mean()
+    return out
+
+
+class TestPSRoIPool:
+    def test_matches_numpy_golden(self, rng):
+        from paddle_tpu.vision.ops import psroi_pool
+
+        N, cout, ph, pw, H, W = 2, 3, 2, 2, 8, 8
+        cin = cout * ph * pw
+        x = rng.randn(N, cin, H, W).astype(np.float32)
+        rois = np.array([[0, 0, 7, 7], [2, 2, 6, 5], [1, 0, 3, 3]],
+                        np.float32)
+        boxes_num = np.array([2, 1], np.int32)
+        got = psroi_pool(paddle.to_tensor(x), paddle.to_tensor(rois),
+                         paddle.to_tensor(boxes_num), (ph, pw),
+                         spatial_scale=0.5).numpy()
+        want = np_psroi_pool(x, rois, [0, 0, 1], cout, ph, pw, 0.5)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_differentiable(self, rng):
+        from paddle_tpu.vision.ops import psroi_pool
+
+        x = paddle.to_tensor(rng.randn(1, 8, 6, 6).astype(np.float32))
+        x.stop_gradient = False
+        rois = paddle.to_tensor(np.array([[0, 0, 5, 5]], np.float32))
+        out = psroi_pool(x, rois, paddle.to_tensor(np.array([1], np.int32)),
+                         2)
+        out.sum().backward()
+        assert x.grad is not None
+        assert float(np.abs(x.grad.numpy()).sum()) > 0
+
+
+def np_deform_conv2d(x, offset, weight, stride, pad, dil, dg, groups,
+                     mask=None):
+    N, Cin, H, W = x.shape
+    Cout, cin_g, kh, kw = weight.shape
+    Ho = (H + 2 * pad - (dil * (kh - 1) + 1)) // stride + 1
+    Wo = (W + 2 * pad - (dil * (kw - 1) + 1)) // stride + 1
+    K = kh * kw
+    out = np.zeros((N, Cout, Ho, Wo), np.float32)
+
+    def sample(n, c, y, x_):
+        y0, x0 = int(np.floor(y)), int(np.floor(x_))
+        v = 0.0
+        for iy, wy in ((y0, 1 - (y - y0)), (y0 + 1, y - y0)):
+            for ix, wx in ((x0, 1 - (x_ - x0)), (x0 + 1, x_ - x0)):
+                if 0 <= iy <= H - 1 and 0 <= ix <= W - 1:
+                    v += wy * wx * x[n, c, iy, ix]
+        return v
+
+    cpg = Cin // dg  # channels per deformable group
+    for n in range(N):
+        for m in range(Cout):
+            g = m // (Cout // groups)
+            for ho in range(Ho):
+                for wo in range(Wo):
+                    acc = 0.0
+                    for cg in range(cin_g):
+                        c = g * cin_g + cg
+                        d = c // cpg
+                        for i in range(kh):
+                            for j in range(kw):
+                                k = i * kw + j
+                                dy = offset[n, d * 2 * K + 2 * k, ho, wo]
+                                dx = offset[n, d * 2 * K + 2 * k + 1, ho, wo]
+                                y = ho * stride - pad + i * dil + dy
+                                x_ = wo * stride - pad + j * dil + dx
+                                v = sample(n, c, y, x_)
+                                if mask is not None:
+                                    v *= mask[n, d * K + k, ho, wo]
+                                acc += v * weight[m, cg, i, j]
+                    out[n, m, ho, wo] = acc
+    return out
+
+
+class TestDeformConv2d:
+    def test_v1_matches_numpy(self, rng):
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        N, Cin, H, W, Cout, k = 2, 4, 6, 6, 3, 3
+        Ho = Wo = H - k + 1
+        x = rng.randn(N, Cin, H, W).astype(np.float32)
+        off = (0.5 * rng.randn(N, 2 * k * k, Ho, Wo)).astype(np.float32)
+        wgt = rng.randn(Cout, Cin, k, k).astype(np.float32)
+        got = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                            paddle.to_tensor(wgt)).numpy()
+        want = np_deform_conv2d(x, off, wgt, 1, 0, 1, 1, 1)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_v2_mask_groups_stride(self, rng):
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        N, Cin, H, W, Cout, k = 1, 4, 7, 7, 4, 3
+        stride, pad, dg, groups = 2, 1, 2, 2
+        Ho = Wo = (H + 2 * pad - k) // stride + 1
+        x = rng.randn(N, Cin, H, W).astype(np.float32)
+        off = (0.7 * rng.randn(N, dg * 2 * k * k, Ho, Wo)).astype(np.float32)
+        msk = rng.rand(N, dg * k * k, Ho, Wo).astype(np.float32)
+        wgt = rng.randn(Cout, Cin // groups, k, k).astype(np.float32)
+        got = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                            paddle.to_tensor(wgt), stride=stride, padding=pad,
+                            deformable_groups=dg, groups=groups,
+                            mask=paddle.to_tensor(msk)).numpy()
+        want = np_deform_conv2d(x, off, wgt, stride, pad, 1, dg, groups, msk)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_zero_offset_equals_conv(self, rng):
+        """With zero offsets and no mask, deform_conv2d == plain conv2d."""
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        N, Cin, H, W, Cout, k = 1, 3, 8, 8, 2, 3
+        x = rng.randn(N, Cin, H, W).astype(np.float32)
+        wgt = rng.randn(Cout, Cin, k, k).astype(np.float32)
+        off = np.zeros((N, 2 * k * k, H - k + 1, W - k + 1), np.float32)
+        got = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                            paddle.to_tensor(wgt)).numpy()
+        want = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(wgt)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_grads_flow_to_offset_and_weight(self, rng):
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        x = paddle.to_tensor(rng.randn(1, 2, 5, 5).astype(np.float32))
+        off = paddle.to_tensor(
+            (0.3 * rng.randn(1, 8, 4, 4)).astype(np.float32))
+        wgt = paddle.to_tensor(rng.randn(2, 2, 2, 2).astype(np.float32))
+        bias = paddle.to_tensor(rng.randn(2).astype(np.float32))
+        for t in (x, off, wgt, bias):
+            t.stop_gradient = False
+        out = deform_conv2d(x, off, wgt, bias=bias)
+        out.sum().backward()
+        for t in (x, off, wgt, bias):
+            assert t.grad is not None
+            assert float(np.abs(t.grad.numpy()).sum()) > 0

@@ -217,3 +217,140 @@ class TestEditDistance:
         # row0: [1,2] vs [1,2,9] -> 1 sub/ins; label len after removal 3
         # row1: [3,3] vs [3,9] -> 1; label len after removal 2
         np.testing.assert_allclose(d.numpy().ravel(), [1 / 3, 1 / 2])
+
+
+# ---------------------------------------------------------------------------
+# beam search
+# ---------------------------------------------------------------------------
+def np_gather_tree(ids, parents):
+    T, B, K = ids.shape
+    out = np.zeros_like(ids)
+    for b in range(B):
+        for k in range(K):
+            beam = k
+            for t in range(T - 1, -1, -1):
+                out[t, b, k] = ids[t, b, beam]
+                beam = parents[t, b, beam]
+    return out
+
+
+class TestGatherTree:
+    def test_matches_numpy(self, rng):
+        T, B, K = 5, 3, 4
+        ids = rng.randint(0, 9, (T, B, K)).astype(np.int64)
+        parents = rng.randint(0, K, (T, B, K)).astype(np.int64)
+        from paddle_tpu.nn import gather_tree
+
+        got = gather_tree(paddle.to_tensor(ids), paddle.to_tensor(parents))
+        np.testing.assert_array_equal(got.numpy(), np_gather_tree(ids, parents))
+
+
+class TestBeamSearchFunctional:
+    def test_one_step_topk(self):
+        from paddle_tpu.nn import beam_search
+
+        # batch 1, beam 2, 3 candidates each; accumulated scores
+        pre_ids = paddle.to_tensor(np.array([[5, 7]], np.int64))
+        pre_scores = paddle.to_tensor(np.array([[0.0, -0.1]], np.float32))
+        scores = paddle.to_tensor(np.array(
+            [[[0.5, 0.4, 0.1], [0.45, 0.2, 0.3]]], np.float32))
+        sel_ids, sel_scores, parent = beam_search(
+            pre_ids, pre_scores, None, scores, beam_size=2, end_id=0,
+            return_parent_idx=True)
+        np.testing.assert_array_equal(sel_ids.numpy(), [[0, 0]])
+        np.testing.assert_allclose(sel_scores.numpy(), [[0.5, 0.45]])
+        np.testing.assert_array_equal(parent.numpy(), [[0, 1]])
+
+    def test_ended_beam_frozen(self):
+        from paddle_tpu.nn import beam_search
+
+        end = 9
+        pre_ids = paddle.to_tensor(np.array([[end, 3]], np.int64))
+        pre_scores = paddle.to_tensor(np.array([[2.0, 0.0]], np.float32))
+        scores = paddle.to_tensor(np.array(
+            [[[1.5, 1.4], [0.6, 0.2]]], np.float32))
+        sel_ids, sel_scores, parent = beam_search(
+            pre_ids, pre_scores, None, scores, beam_size=2, end_id=end,
+            return_parent_idx=True)
+        # ended beam keeps score 2.0 and proposes only end_id
+        np.testing.assert_array_equal(sel_ids.numpy(), [[end, 0]])
+        np.testing.assert_allclose(sel_scores.numpy(), [[2.0, 0.6]])
+        np.testing.assert_array_equal(parent.numpy(), [[0, 1]])
+
+
+class TestBeamSearchDecoder:
+    def _greedy_path(self, logits_table, start, end, max_t):
+        """Follow argmax transitions of a fixed per-token logits table."""
+        tok, out = start, []
+        for _ in range(max_t):
+            tok = int(np.argmax(logits_table[tok]))
+            out.append(tok)
+            if tok == end:
+                break
+        return out
+
+    def test_decodes_deterministic_chain(self, rng):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn import BeamSearchDecoder, dynamic_decode
+
+        V, E, H, B, K = 12, 8, 16, 2, 3
+        end = V - 1
+
+        class TableCell(nn.Layer):
+            """Cell whose logits depend only on the input token embedding —
+            makes the optimal decode independently computable."""
+
+            def __init__(self):
+                super().__init__()
+                self.table = paddle.to_tensor(
+                    rng.randn(V, V).astype(np.float32) * 3)
+                self.emb = nn.Embedding(V, V)
+                # identity-ish embedding: one-hot rows select table rows
+                self.emb.weight.set_value(np.eye(V, dtype=np.float32))
+
+            def forward(self, inputs, states):
+                logits = paddle.matmul(inputs, self.table)
+                return logits, states
+
+        cell = TableCell()
+        decoder = BeamSearchDecoder(
+            cell, start_token=0, end_token=end, beam_size=K,
+            embedding_fn=cell.emb)
+        init_state = paddle.zeros([B, 1])
+        ids, final_states, lengths = dynamic_decode(
+            decoder, inits=init_state, max_step_num=8, return_length=True)
+        assert ids.shape[0] == B and ids.shape[1] == K
+        table = np.asarray(cell.table.numpy())
+        got = ids.numpy()[0, 0, :int(lengths.numpy()[0, 0])]
+        # verify the decoded top beam scores at least as high as greedy
+        def score(path):
+            logp, tok, s = 0.0, 0, 0.0
+            t = table - np.log(np.exp(table).sum(-1, keepdims=True))
+            for p in path:
+                s += t[tok, p]
+                tok = p
+                if p == end:
+                    break
+            return s
+
+        greedy = self._greedy_path(
+            table - np.log(np.exp(table).sum(-1, keepdims=True)), 0, end, 8)
+        assert score(list(got)) >= score(greedy) - 1e-4
+
+    def test_all_rows_identical_across_batch(self, rng):
+        """Batch rows with identical params must decode identically."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn import BeamSearchDecoder, dynamic_decode
+
+        V, H, B, K = 10, 12, 3, 2
+        cell = nn.GRUCell(input_size=H, hidden_size=H)
+        emb = nn.Embedding(V, H)
+        out = nn.Linear(H, V)
+        decoder = BeamSearchDecoder(cell, start_token=1, end_token=2,
+                                    beam_size=K, embedding_fn=emb,
+                                    output_fn=out)
+        init = paddle.zeros([B, H])
+        ids, _ = dynamic_decode(decoder, inits=init, max_step_num=6)
+        got = ids.numpy()
+        for b in range(1, B):
+            np.testing.assert_array_equal(got[0], got[b])

@@ -1,0 +1,71 @@
+"""Relative MODEL-benchmark regression gate.
+
+Counterpart of the reference's tools/test_model_benchmark.sh:18-40 (which
+rebuilds the base commit, reruns the model benchmark, and fails the CI on a
+slowdown) — here, as with the op gate, runs are compared relative so no
+absolute numbers need publishing.
+
+Usage:
+  python bench_all.py                    # writes BENCH_extra.json
+  python tools/check_model_benchmark_result.py prev/BENCH_extra.json \
+         BENCH_extra.json [--tol 0.05]
+Exit code 0 = pass, 8 = any config's samples/sec dropped more than --tol
+(default 5%) vs the previous round. New configs pass; removed configs fail.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _index(path):
+    with open(path) as f:
+        rows = json.load(f)
+    return {r["metric"]: r for r in rows}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("baseline")
+    ap.add_argument("candidate")
+    ap.add_argument("--tol", type=float, default=0.05,
+                    help="max allowed fractional throughput drop")
+    args = ap.parse_args()
+    base = _index(args.baseline)
+    cand = _index(args.candidate)
+    failures = []
+    for name, b in base.items():
+        c = cand.get(name)
+        if c is None:
+            print(f"[check_model_benchmark] MISSING  {name} (config removed?)")
+            failures.append(name)
+            continue
+        if b.get("smoke") or c.get("smoke"):
+            print(f"[check_model_benchmark] skip     {name} (smoke run)")
+            continue
+        if b.get("backend") != c.get("backend"):
+            print(f"[check_model_benchmark] skip     {name} (backend "
+                  f"{b.get('backend')} vs {c.get('backend')})")
+            continue
+        ratio = c["value"] / max(b["value"], 1e-9)
+        tag = ("REGRESS " if ratio < 1.0 - args.tol
+               else ("improve " if ratio > 1.05 else "same    "))
+        extra = ""
+        if "mfu_pct" in c:
+            extra = f"  mfu {c['mfu_pct']:.1f}%"
+        print(f"[check_model_benchmark] {tag} {name:46s} "
+              f"{b['value']:10.2f} -> {c['value']:10.2f} {c.get('unit', '')}"
+              f"  x{ratio:.3f}{extra}")
+        if ratio < 1.0 - args.tol:
+            failures.append(name)
+    if failures:
+        print(f"[check_model_benchmark] FAILED: {len(failures)} "
+              f"regression(s): {', '.join(failures)}")
+        return 8
+    print("[check_model_benchmark] PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
