@@ -120,7 +120,11 @@ def bench_resnet50():
     yv = paddle.to_tensor(rng.randint(0, 100, (b, 1)).astype(np.int64))
 
     def one(i):
-        return exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])[0]
+        # return_numpy=False: a numpy fetch would BLOCK on the device every
+        # step (serializing dispatch with the host link's round-trip);
+        # _rate materializes once per window
+        return exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss],
+                       return_numpy=False)[0]
 
     sps = _rate(one, 2, 3 if SMOKE else 20) * b
     out = {"metric": "resnet50_static_executor_samples_per_sec_per_chip",

@@ -115,7 +115,8 @@ class PipelineTrainStep:
     def __init__(self, embed_fn, block_fn, head_loss_fn, optimizer, mesh: Mesh,
                  embed_params, layer_param_stack, head_params, num_micro,
                  h_shape_dtype, pp_axis="pp", dp_axis="dp", recompute=True,
-                 tie_keys=()):
+                 tie_keys=(), param_specs=None, zero_stage=0,
+                 sharding_axis="sharding"):
         for k in tie_keys:
             if k in head_params:
                 raise ValueError(
@@ -127,26 +128,72 @@ class PipelineTrainStep:
         self._num_micro = num_micro
         pp_size = mesh.shape[pp_axis]
 
-        stack_spec = jax.tree_util.tree_map(
-            lambda a: P(pp_axis), layer_param_stack
-        )
-        repl_spec = jax.tree_util.tree_map(lambda a: P(), embed_params)
-        head_spec = jax.tree_util.tree_map(lambda a: P(), head_params)
+        # ``param_specs``: optional (embed, blocks, head) PartitionSpec
+        # trees — the 4D hybrid hook (reference
+        # sharding_optimizer.py:120-138 composes mp×sharding×pp×dp the same
+        # way): block weights may add an 'mp' dim split (with the matching
+        # mp-aware fns, e.g. gpt_mp_param_specs + gpt_functional_fns
+        # (mp_axis=...)), embeddings may be vocab-parallel. Default is the
+        # pp-only placement.
+        if param_specs is not None:
+            repl_spec, stack_spec, head_spec = param_specs
+        else:
+            stack_spec = jax.tree_util.tree_map(
+                lambda a: P(pp_axis), layer_param_stack
+            )
+            repl_spec = jax.tree_util.tree_map(lambda a: P(), embed_params)
+            head_spec = jax.tree_util.tree_map(lambda a: P(), head_params)
         batch_spec = P(None, dp_axis)  # [num_micro, batch, ...]
 
-        self._embed_params = jax.device_put(
-            embed_params, NamedSharding(mesh, P()))
+        self._embed_params = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            embed_params, repl_spec)
         self._stack = jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, NamedSharding(mesh, P(pp_axis))),
-            layer_param_stack,
-        )
-        self._head_params = jax.device_put(head_params, NamedSharding(mesh, P()))
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            layer_param_stack, stack_spec)
+        self._head_params = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            head_params, head_spec)
         # one params pytree (embed, stacked blocks, head) for the optimizer;
         # opt state mirrors it with a state-dict at every array leaf
         self._params = {"embed": self._embed_params, "blocks": self._stack,
                         "head": self._head_params}
+        all_specs = {"embed": repl_spec, "blocks": stack_spec,
+                     "head": head_spec}
+
+        def opt_leaf_sharding(p, spec):
+            """ZeRO: shard param-shaped optimizer-state tensors over the
+            'sharding' axis on the first still-free divisible dim (the
+            reference sharding_optimizer's stage-1 placement)."""
+            st = optimizer._init_state(p)
+            out = {}
+            zeroable = (zero_stage >= 1 and sharding_axis in mesh.axis_names
+                        and mesh.shape[sharding_axis] > 1)
+            for k, s in st.items():
+                if hasattr(s, "shape") and s.shape == p.shape and zeroable:
+                    dims = list(spec) + [None] * (len(p.shape) - len(spec))
+                    for i, (d, used) in enumerate(zip(p.shape, dims)):
+                        if used is None and d % mesh.shape[sharding_axis] == 0:
+                            dims[i] = sharding_axis
+                            break
+                    out[k] = NamedSharding(mesh, P(*dims))
+                elif hasattr(s, "shape") and s.shape == p.shape:
+                    out[k] = NamedSharding(mesh, spec)
+                else:
+                    out[k] = NamedSharding(mesh, P())
+            return out
+
+        opt_shardings = jax.tree_util.tree_map(
+            opt_leaf_sharding, self._params, all_specs)
         self._opt_state = jax.tree_util.tree_map(
-            lambda a: optimizer._init_state(a), self._params
+            lambda p, sh: {k: jax.device_put(s, sh[k])
+                           for k, s in optimizer._init_state(p).items()},
+            self._params, opt_shardings)
+        self._out_shardings = (
+            jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), all_specs),
+            opt_shardings,
+            NamedSharding(mesh, P()),
         )
 
         core = functools.partial(

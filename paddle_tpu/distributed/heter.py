@@ -19,7 +19,8 @@ TPU-first re-design:
 """
 from __future__ import annotations
 
-import pickle
+import io
+import json
 import socket
 import socketserver
 import struct
@@ -30,12 +31,70 @@ import numpy as np
 
 __all__ = ["HeterServer", "HeterClient", "GraphTable"]
 
-_MAGIC = b"PTHS"
+# Wire format: MAGIC + u64 header-len + JSON header + concatenated npy
+# blobs. DATA-ONLY on purpose — the first version used pickle, which hands
+# arbitrary code execution to anything that can reach the socket (and the
+# cross-machine split puts this on a network port). JSON carries the
+# structure; ndarrays ride as np.save blobs loaded with
+# allow_pickle=False.
+_MAGIC = b"PTH2"
+
+
+def _encode(obj, blobs):
+    if isinstance(obj, np.ndarray):
+        buf = io.BytesIO()
+        np.save(buf, obj, allow_pickle=False)
+        blobs.append(buf.getvalue())
+        return {"__nd__": len(blobs) - 1}
+    if isinstance(obj, (bytes, bytearray)):
+        blobs.append(bytes(obj))
+        return {"__bytes__": len(blobs) - 1}
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    if isinstance(obj, dict):
+        return {"__dict__": [[_encode(k, blobs), _encode(v, blobs)]
+                             for k, v in obj.items()]}
+    if isinstance(obj, tuple):
+        return {"__tuple__": [_encode(v, blobs) for v in obj]}
+    if isinstance(obj, list):
+        return {"__list__": [_encode(v, blobs) for v in obj]}
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return {"__v__": obj}
+    raise TypeError(f"heter message cannot carry {type(obj).__name__} "
+                    "(data-only wire format)")
+
+
+def _decode(node, blobs):
+    if "__nd__" in node:
+        arr = np.load(io.BytesIO(blobs[node["__nd__"]]), allow_pickle=False)
+        return arr
+    if "__bytes__" in node:
+        return blobs[node["__bytes__"]]
+    if "__dict__" in node:
+        return {_freeze(_decode(k, blobs)): _decode(v, blobs)
+                for k, v in node["__dict__"]}
+    if "__tuple__" in node:
+        return tuple(_decode(v, blobs) for v in node["__tuple__"])
+    if "__list__" in node:
+        return [_decode(v, blobs) for v in node["__list__"]]
+    return node["__v__"]
+
+
+def _freeze(k):
+    # dict keys must be hashable; ndarrays can't be keys on this wire
+    if isinstance(k, np.ndarray):
+        raise TypeError("ndarray dict keys unsupported")
+    return k
 
 
 def _send_msg(sock: socket.socket, obj) -> None:
-    payload = pickle.dumps(obj, protocol=4)
-    sock.sendall(_MAGIC + struct.pack("<Q", len(payload)) + payload)
+    blobs: list = []
+    header = json.dumps(
+        [_encode(obj, blobs), [len(b) for b in blobs]]).encode()
+    parts = [_MAGIC + struct.pack("<Q", len(header)) + header] + blobs
+    sock.sendall(b"".join(parts))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -51,9 +110,12 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def _recv_msg(sock: socket.socket):
     head = _recv_exact(sock, 12)
     if head[:4] != _MAGIC:
-        raise ConnectionError("bad frame magic")
+        raise ConnectionError("bad frame magic (peer speaks an older or "
+                              "foreign protocol)")
     (n,) = struct.unpack("<Q", head[4:])
-    return pickle.loads(_recv_exact(sock, n))
+    tree, sizes = json.loads(_recv_exact(sock, n))
+    blobs = [_recv_exact(sock, s) for s in sizes]
+    return _decode(tree, blobs)
 
 
 # ---------------------------------------------------------------------------

@@ -131,7 +131,17 @@ class Categorical(Distribution):
 
     def __init__(self, logits, name=None):
         raw = _raw(logits)
-        # reference semantics: `logits` holds unnormalized PROBABILITIES
+        # reference semantics: `logits` holds unnormalized NON-NEGATIVE
+        # probabilities for probs()/sample() (distribution.py Categorical),
+        # while entropy()/kl_divergence() run softmax over the same values
+        # as if they were log-space logits (distribution.py:812-860) —
+        # both faithfully mirrored, including the asymmetry.
+        if bool(jnp.any(raw < 0)):
+            raise ValueError(
+                "Categorical expects non-negative unnormalized "
+                "probabilities (negative entries would produce negative "
+                "'probabilities' in probs()/sample())")
+        self._raw = raw
         self._probs = raw / jnp.sum(raw, axis=-1, keepdims=True)
         self._log_probs = jnp.log(jnp.maximum(self._probs, 1e-38))
 
@@ -143,13 +153,16 @@ class Categorical(Distribution):
         return wrap_raw(out.astype(jnp.int64))
 
     def entropy(self):
-        return wrap_raw(-jnp.sum(self._probs * self._log_probs, axis=-1))
+        # softmax-over-raw semantics, like the reference's entropy()
+        logp = jax.nn.log_softmax(self._raw, axis=-1)
+        return wrap_raw(-jnp.sum(jnp.exp(logp) * logp, axis=-1))
 
     def kl_divergence(self, other):
         if not isinstance(other, Categorical):
             raise TypeError("kl_divergence target must be Categorical")
-        return wrap_raw(jnp.sum(
-            self._probs * (self._log_probs - other._log_probs), axis=-1))
+        logp = jax.nn.log_softmax(self._raw, axis=-1)
+        logq = jax.nn.log_softmax(other._raw, axis=-1)
+        return wrap_raw(jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1))
 
     def probs(self, value):
         v = _raw(value).astype(jnp.int32)

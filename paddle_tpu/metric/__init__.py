@@ -25,7 +25,9 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
     from ..core.tensor import apply_op
 
     def f(pred, lbl):
-        idx = jax.lax.top_k(pred, k)[1]
+        # clamp: lax.top_k raises for k > class count (the old np.argsort
+        # form tolerated any k and returned all-correct)
+        idx = jax.lax.top_k(pred, min(k, pred.shape[-1]))[1]
         if lbl.ndim == idx.ndim - 1:
             lbl = lbl[..., None]
         hit = jnp.any(idx == lbl.astype(idx.dtype), axis=-1)

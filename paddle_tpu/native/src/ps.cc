@@ -7,11 +7,18 @@
 // TPU hosts pulling/pushing over DCN.
 //
 // Wire protocol (little-endian):
-//   request : [u32 op][u32 table][u64 a][u64 b][payload]
+//   request : [u32 op][u32 table][u64 a][u64 b][u64 client_id][u64 seq][payload]
 //   response: [u32 status][u64 nbytes][payload]
 // ops: 1 pull_dense  2 push_dense_grad  3 pull_sparse  4 push_sparse_grad
 //      5 barrier     6 save             7 load         8 shutdown
 //      9 set_clock (a=worker_id)
+//
+// Fault tolerance (reference brpc_ps_client.h retries + keepalive):
+// connections carry SO_KEEPALIVE; the client transparently RECONNECTS with
+// exponential backoff on transport failures and re-sends the request.
+// Pushes are made IDEMPOTENT by (client_id, seq) dedup on the server — a
+// push whose response was lost is re-sent with the same seq and acked
+// without re-applying the gradient, so retry never double-applies.
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -99,6 +106,22 @@ struct Server {
   int bar_count = 0;
   uint64_t bar_gen = 0;
 
+  // push idempotence: highest applied seq per client (survives reconnects
+  // — keyed by the client's random id, not the connection)
+  std::mutex dedup_mu;
+  std::unordered_map<uint64_t, uint64_t> last_push_seq;
+
+  // returns true if this (client, seq) was already applied; records it
+  // otherwise
+  bool seen_push(uint64_t client_id, uint64_t seq) {
+    if (client_id == 0 || seq == 0) return false;
+    std::lock_guard<std::mutex> g(dedup_mu);
+    uint64_t& last = last_push_seq[client_id];
+    if (seq <= last) return true;
+    last = seq;
+    return false;
+  }
+
   ~Server() {
     stop.store(true);
     if (listen_fd >= 0) {
@@ -185,16 +208,19 @@ void apply_grad(int opt, float lr, float* w, float* m0, float* m1, int64_t step,
 void handle_conn(Server* sv, int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
   std::vector<char> payload;
   for (;;) {
-    char hdr[24];
-    if (!read_full(fd, hdr, 24)) break;
+    char hdr[40];
+    if (!read_full(fd, hdr, 40)) break;
     uint32_t op, table;
-    uint64_t a, b;
+    uint64_t a, b, client_id, seq;
     memcpy(&op, hdr, 4);
     memcpy(&table, hdr + 4, 4);
     memcpy(&a, hdr + 8, 8);
     memcpy(&b, hdr + 16, 8);
+    memcpy(&client_id, hdr + 24, 8);
+    memcpy(&seq, hdr + 32, 8);
 
     switch (op) {
       case kPullDense: {
@@ -215,6 +241,10 @@ void handle_conn(Server* sv, int fd) {
       case kPushDenseGrad: {
         payload.resize(a * 4);
         if (!read_full(fd, payload.data(), payload.size())) return;
+        if (sv->seen_push(client_id, seq)) {  // duplicate of an applied push
+          send_resp(fd, 0, nullptr, 0);
+          break;
+        }
         auto it = sv->dense.find(table);
         if (it == sv->dense.end()) {
           send_resp(fd, 1, nullptr, 0);
@@ -259,6 +289,10 @@ void handle_conn(Server* sv, int fd) {
         uint64_t dim = b;
         payload.resize(a * 8 + a * dim * 4);
         if (!read_full(fd, payload.data(), payload.size())) return;
+        if (sv->seen_push(client_id, seq)) {  // duplicate of an applied push
+          send_resp(fd, 0, nullptr, 0);
+          break;
+        }
         if (it == sv->sparse.end()) {
           send_resp(fd, 1, nullptr, 0);
           break;
@@ -417,26 +451,73 @@ void handle_conn(Server* sv, int fd) {
 
 struct Client {
   int fd = -1;
+  std::string host;
+  int port = 0;
+  uint64_t client_id = 0;
+  uint64_t seq = 0;  // per-push sequence for server-side dedup
 };
 
-bool client_req(Client* c, uint32_t op, uint32_t table, uint64_t a, uint64_t b,
-                const void* payload, uint64_t pn, std::vector<char>* reply) {
-  char hdr[24];
+int dial(const char* host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  inet_pton(AF_INET, host, &addr.sin_addr);
+  if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+  return fd;
+}
+
+bool send_once(Client* c, uint32_t op, uint32_t table, uint64_t a, uint64_t b,
+               uint64_t seq, const void* payload, uint64_t pn,
+               std::vector<char>* reply, uint32_t* status_out) {
+  char hdr[40];
   memcpy(hdr, &op, 4);
   memcpy(hdr + 4, &table, 4);
   memcpy(hdr + 8, &a, 8);
   memcpy(hdr + 16, &b, 8);
-  if (!write_full(c->fd, hdr, 24)) return false;
+  memcpy(hdr + 24, &c->client_id, 8);
+  memcpy(hdr + 32, &seq, 8);
+  if (!write_full(c->fd, hdr, 40)) return false;
   if (pn && !write_full(c->fd, payload, pn)) return false;
   char rhdr[12];
   if (!read_full(c->fd, rhdr, 12)) return false;
-  uint32_t status;
   uint64_t n;
-  memcpy(&status, rhdr, 4);
+  memcpy(status_out, rhdr, 4);
   memcpy(&n, rhdr + 4, 8);
   reply->resize(n);
   if (n && !read_full(c->fd, reply->data(), n)) return false;
-  return status == 0;
+  return true;
+}
+
+// Transport failures reconnect with exponential backoff and re-send
+// (pushes carry a seq, so the server drops any duplicate apply). A
+// response with non-zero STATUS is a server-side verdict — returned as-is,
+// never retried. ``retriable=false`` (barrier: re-entering could deadlock
+// the generation; shutdown: the close is expected) fails straight through.
+bool client_req(Client* c, uint32_t op, uint32_t table, uint64_t a, uint64_t b,
+                const void* payload, uint64_t pn, std::vector<char>* reply,
+                bool retriable = true, uint64_t seq = 0) {
+  const int kAttempts = 5;
+  for (int attempt = 0; attempt < kAttempts; ++attempt) {
+    if (c->fd >= 0) {
+      uint32_t status = 1;
+      if (send_once(c, op, table, a, b, seq, payload, pn, reply, &status))
+        return status == 0;
+    }
+    if (!retriable) return false;
+    // reconnect with backoff: 50ms * 2^attempt
+    if (c->fd >= 0) close(c->fd);
+    c->fd = -1;
+    usleep(50000u << attempt);
+    c->fd = dial(c->host.c_str(), c->port);
+  }
+  return false;
 }
 
 }  // namespace
@@ -518,18 +599,16 @@ void pt_ps_server_destroy(void* server) { delete static_cast<Server*>(server); }
 void* pt_ps_connect(const char* host, int port) {
   Client* c = new (std::nothrow) Client();
   if (!c) return nullptr;
-  c->fd = socket(AF_INET, SOCK_STREAM, 0);
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_port = htons(port);
-  inet_pton(AF_INET, host, &addr.sin_addr);
-  if (connect(c->fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
-    close(c->fd);
+  c->host = host;
+  c->port = port;
+  std::random_device rd;
+  c->client_id = (uint64_t(rd()) << 32) ^ rd();
+  if (c->client_id == 0) c->client_id = 1;  // 0 = "no dedup" on the wire
+  c->fd = dial(host, port);
+  if (c->fd < 0) {
     delete c;
     return nullptr;
   }
-  int one = 1;
-  setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   return c;
 }
 
@@ -544,9 +623,10 @@ int pt_ps_pull_dense(void* client, uint32_t table, float* out, uint64_t n) {
 
 int pt_ps_push_dense(void* client, uint32_t table, const float* grad,
                      uint64_t n) {
+  Client* c = static_cast<Client*>(client);
   std::vector<char> reply;
-  return client_req(static_cast<Client*>(client), kPushDenseGrad, table, n, 0,
-                    grad, n * 4, &reply)
+  return client_req(c, kPushDenseGrad, table, n, 0, grad, n * 4, &reply,
+                    /*retriable=*/true, ++c->seq)
              ? 0
              : -1;
 }
@@ -566,17 +646,20 @@ int pt_ps_push_sparse(void* client, uint32_t table, const int64_t* keys,
   std::vector<char> payload(n * 8 + n * dim * 4);
   memcpy(payload.data(), keys, n * 8);
   memcpy(payload.data() + n * 8, grads, n * dim * 4);
+  Client* c = static_cast<Client*>(client);
   std::vector<char> reply;
-  return client_req(static_cast<Client*>(client), kPushSparseGrad, table, n,
-                    dim, payload.data(), payload.size(), &reply)
+  return client_req(c, kPushSparseGrad, table, n, dim, payload.data(),
+                    payload.size(), &reply, /*retriable=*/true, ++c->seq)
              ? 0
              : -1;
 }
 
 int pt_ps_barrier(void* client) {
+  // no retry: re-entering a barrier whose ack was lost would hang a
+  // second generation
   std::vector<char> reply;
   return client_req(static_cast<Client*>(client), kBarrier, 0, 0, 0, nullptr, 0,
-                    &reply)
+                    &reply, /*retriable=*/false)
              ? 0
              : -1;
 }
@@ -602,7 +685,7 @@ int pt_ps_load(void* client, const char* path) {
 int pt_ps_shutdown(void* client) {
   std::vector<char> reply;
   return client_req(static_cast<Client*>(client), kShutdown, 0, 0, 0, nullptr,
-                    0, &reply)
+                    0, &reply, /*retriable=*/false)
              ? 0
              : -1;
 }

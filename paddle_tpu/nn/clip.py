@@ -28,6 +28,13 @@ class ClipGradByValue(ClipGradBase):
                 out.append((p, g))
                 continue
             if isinstance(g, RowSparseGrad):
+                if self.min > 0.0 or self.max < 0.0:
+                    # an asymmetric range that excludes 0 moves UNTOUCHED
+                    # rows too (dense clip turns their 0 grad into min/max);
+                    # only the dense path can express that
+                    out.append((p, wrap_raw(
+                        jnp.clip(g.to_dense(), self.min, self.max))))
+                    continue
                 # clip the merged values (duplicates combine first, like the
                 # dense path clipping the summed gradient)
                 m = g.merged()

@@ -29,15 +29,19 @@ __all__ = [
 ]
 
 # Attention implementation selector. 'auto' (default) picks per context:
-# ring for sp-sharded, blockwise for biased/very-long sequences, and the
-# materialized XLA path on TPU for moderate lengths — measured fastest
-# end-to-end on v5e for GPT-2 345M (L=1024, d=64): the big batched einsums
-# tile onto the MXU better than per-head Pallas kernel ops at these shapes,
-# beating both the scan-based blockwise path (2.8x) and the Mosaic flash
-# kernels ('pallas' = the jax-shipped kernel, 'flash_tpu' = the repo's
-# layout-native kernel in flash_tpu.py — both opt-in; some TPU rigs compile
-# Mosaic through a service plain XLA doesn't need, so auto never risks it).
+# ring for sp-sharded, the materialized XLA path on TPU for moderate
+# lengths — measured fastest end-to-end on v5e for GPT-2 345M (L=1024,
+# d=64): the big batched einsums tile onto the MXU better than per-head
+# Pallas kernel ops at these shapes — and for LONG causal sequences
+# (L > PADDLE_TPU_ATTENTION_MAX_SEQ) the repo's flash_tpu Mosaic kernel
+# (past ~4k the O(L²) materialized path exhausts HBM and blockwise is
+# 8-10x slower). 'pallas' (the jax-shipped kernel) and 'flash_tpu' can
+# also be forced explicitly. Rigs whose Mosaic compile service fails —
+# plain XLA needs no such service — would die at jit-compile time on
+# auto's long-sequence route: set PADDLE_TPU_ATTN_NO_MOSAIC=1 to keep
+# auto on the streaming blockwise path instead.
 _IMPL = os.environ.get("PADDLE_TPU_ATTENTION", "auto")
+_NO_MOSAIC = os.environ.get("PADDLE_TPU_ATTN_NO_MOSAIC", "") == "1"
 # beyond this length the materialized [L, L] scores dominate HBM; stream
 # instead
 _XLA_MAX_SEQ = int(os.environ.get("PADDLE_TPU_ATTENTION_MAX_SEQ", "4096"))
@@ -588,7 +592,7 @@ def _resolve_impl(L, bias, use_flash, causal=True):
     if on_tpu:
         if L <= _XLA_MAX_SEQ:
             return "xla"
-        if causal and bias is None:
+        if causal and bias is None and not _NO_MOSAIC:
             return "flash_tpu"
         return "blockwise"
     return "blockwise" if bias is not None else "flash"

@@ -27,14 +27,21 @@ __all__ = [
 
 
 class OpRecord:
-    __slots__ = ("fn", "args", "out_ids", "multi_out", "name")
+    __slots__ = ("fn", "args", "out_ids", "multi_out", "name", "amp")
 
-    def __init__(self, fn, args, out_ids, multi_out, name=""):
+    def __init__(self, fn, args, out_ids, multi_out, name="", amp=None):
         self.fn = fn
         self.args = args  # mix of ("var", id) refs and raw constants
         self.out_ids = out_ids
         self.multi_out = multi_out
         self.name = name
+        # amp state SNAPSHOT at record time (dtype, level, white, black) —
+        # ops recorded inside paddle.amp.auto_cast must replay with the
+        # same casts even though replay happens outside the context (the
+        # reference bakes AMP into the program via the
+        # mixed_precision.decorate rewrite pass; recording the ambient
+        # state achieves the same program-carries-its-AMP property)
+        self.amp = amp
 
 
 class Program:
@@ -65,7 +72,12 @@ class Program:
         for o in outs:
             self._var_refs[id(o)] = o
             out_ids.append(id(o))
-        self.ops.append(OpRecord(fn, ref_args, out_ids, multi_out, name))
+        from ..amp.auto_cast import amp_state
+
+        st = amp_state()
+        amp = ((st.dtype, st.level, tuple(st.custom_white),
+                tuple(st.custom_black)) if st.enabled else None)
+        self.ops.append(OpRecord(fn, ref_args, out_ids, multi_out, name, amp))
 
     def add_feed_var(self, name, t: Tensor):
         self.feed_vars[name] = t
@@ -96,9 +108,18 @@ class Program:
                 # recorded concrete value
                 return self._var_refs[v]._value
 
+            from ..amp.auto_cast import auto_cast
+
             for op in ops:
                 vals = [resolve(r) for r in op.args]
-                out = op.fn(*vals)
+                if op.amp is not None:
+                    dt, level, white, black = op.amp
+                    with auto_cast(True, custom_white_list=white,
+                                   custom_black_list=black, level=level,
+                                   dtype=dt):
+                        out = op.fn(*vals)
+                else:
+                    out = op.fn(*vals)
                 if op.multi_out:
                     for uid, o in zip(op.out_ids, out):
                         env[uid] = o

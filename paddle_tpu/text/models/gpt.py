@@ -186,11 +186,20 @@ def _transposed(w: Tensor) -> Tensor:
 # ---------------------------------------------------------------------------
 # Pure functional forms for the pipeline / sp engines
 # ---------------------------------------------------------------------------
-def gpt_functional_fns(config: GPTConfig, sp_axis=None):
+def gpt_functional_fns(config: GPTConfig, sp_axis=None, mp_axis=None):
     """Pure-jnp (embed_fn, block_fn, head_loss_fn) matching the Layer math
     (dropout-free; use hidden_dropout=0 for exact parity). Used by
     fleet.pipeline_engine (pp over stacked blocks) and the sp ring-attention
-    path (sp_axis set → attention rotates K/V around the 'sp' mesh axis)."""
+    path (sp_axis set → attention rotates K/V around the 'sp' mesh axis).
+
+    ``mp_axis`` set → Megatron-style tensor parallelism INSIDE shard_map
+    (the 4D pp×mp×sharding×dp composition the reference builds in
+    sharding_optimizer.py:120-138 + tensor_parallel_optimizer.py): the fns
+    expect the mp param layout of ``gpt_split_params(..., mp=True)`` —
+    head-split qkv [3, h, h/mp], row-parallel proj [h/mp, h], column/row
+    mlp, vocab-parallel wte [V/mp, h] — and insert the explicit
+    psum/pmax collectives (the reference's _mp_allreduce / vocab-parallel
+    cross-entropy) that GSPMD would otherwise derive."""
     nh = config.num_heads
     hd = config.hidden_size // nh
     eps = config.layer_norm_epsilon
@@ -199,6 +208,9 @@ def gpt_functional_fns(config: GPTConfig, sp_axis=None):
         mean = jnp.mean(x, axis=-1, keepdims=True)
         var = jnp.var(x, axis=-1, keepdims=True)
         return (x - mean) * jax.lax.rsqrt(var + eps) * w + b
+
+    if mp_axis is not None:
+        return _gpt_mp_fns(config, ln, sp_axis, mp_axis)
 
     def embed_fn(p, tokens):
         l = tokens.shape[-1]
@@ -241,7 +253,74 @@ def gpt_functional_fns(config: GPTConfig, sp_axis=None):
     return embed_fn, block_fn, head_loss_fn
 
 
-def gpt_split_params(model: "GPTForCausalLM", tied: bool = False):
+def _gpt_mp_fns(config: GPTConfig, ln, sp_axis, mp_axis):
+    """Tensor-parallel functional forms (see gpt_functional_fns)."""
+    hd = config.hidden_size // config.num_heads
+    V = config.vocab_size
+
+    def embed_fn(p, tokens):
+        size = jax.lax.psum(1, mp_axis)
+        vloc = p["wte"].shape[0]
+        off = jax.lax.axis_index(mp_axis) * vloc
+        rel = tokens - off
+        ok = (rel >= 0) & (rel < vloc)
+        emb = p["wte"][jnp.clip(rel, 0, vloc - 1)] * ok[..., None]
+        emb = jax.lax.psum(emb, mp_axis)  # vocab-parallel lookup
+        l = tokens.shape[-1]
+        seq_off = (jax.lax.axis_index(sp_axis) * l) if sp_axis is not None else 0
+        return emb + p["wpe"][seq_off + jnp.arange(l)]
+
+    def block_fn(p, h):
+        x = ln(h, p["ln_1.weight"], p["ln_1.bias"])
+        # column-parallel qkv: head-split [3, h, h/mp] + local bias
+        q = x @ p["attn.qkv.w3"][0] + p["attn.qkv.b3"][0]
+        k = x @ p["attn.qkv.w3"][1] + p["attn.qkv.b3"][1]
+        v = x @ p["attn.qkv.w3"][2] + p["attn.qkv.b3"][2]
+        b, l, hl = q.shape
+        q = q.reshape(b, l, hl // hd, hd)
+        k = k.reshape(b, l, hl // hd, hd)
+        v = v.reshape(b, l, hl // hd, hd)
+        o = dot_product_attention(q, k, v, causal=True, sp_axis=sp_axis,
+                                  use_flash=config.use_flash_attention,
+                                  layout="blhd")
+        o = o.reshape(b, l, hl)
+        # row-parallel out-projection: partial sums → one psum, bias once
+        h = h + jax.lax.psum(o @ p["attn.proj.weight"], mp_axis) \
+            + p["attn.proj.bias"]
+        x = ln(h, p["ln_2.weight"], p["ln_2.bias"])
+        x = jax.nn.gelu(x @ p["mlp.fc.weight"] + p["mlp.fc.bias"],
+                        approximate=True)
+        h = h + jax.lax.psum(x @ p["mlp.proj.weight"], mp_axis) \
+            + p["mlp.proj.bias"]
+        return h
+
+    def head_loss_fn(p, h, labels):
+        x = ln(h, p["ln_f.weight"], p["ln_f.bias"])
+        logits = x @ p["wte"].T                       # [b, l, V/mp] local
+        vloc = p["wte"].shape[0]
+        off = jax.lax.axis_index(mp_axis) * vloc
+        # vocab-parallel cross-entropy (reference
+        # parallel_cross_entropy): global max via pmax, global sum-exp and
+        # picked logit via psum
+        m = jax.lax.pmax(jax.lax.stop_gradient(logits.max(axis=-1)), mp_axis)
+        se = jax.lax.psum(
+            jnp.sum(jnp.exp(logits - m[..., None]), axis=-1), mp_axis)
+        lse = jnp.log(se) + m
+        rel = labels - off
+        ok = (rel >= 0) & (rel < vloc)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(rel, 0, vloc - 1)[..., None], axis=-1)[..., 0]
+        picked = jax.lax.psum(picked * ok, mp_axis)
+        loss = (lse - picked).mean()
+        if sp_axis is not None:
+            loss = jax.lax.pmean(loss, sp_axis)
+        return loss.astype(jnp.float32)
+
+    return embed_fn, block_fn, head_loss_fn
+
+
+def gpt_split_params(model: "GPTForCausalLM", tied: bool = False,
+                     mp: bool = False):
     """Split a GPTForCausalLM's params into (embed, stacked blocks, head)
     pytrees for the pipeline engine. Block params are stacked over layers.
 
@@ -249,7 +328,14 @@ def gpt_split_params(model: "GPTForCausalLM", tied: bool = False):
     wte copy — pass ``tie_keys=("wte",)`` to PipelineTrainStep, which
     injects the embedding matrix into the head and syncs its first↔last
     gradients (the reference's Megatron-style tied-embedding allreduce).
-    ``tied=False`` unties the LM head (its own trainable copy)."""
+    ``tied=False`` unties the LM head (its own trainable copy).
+
+    ``mp=True`` reshapes the attention projections into the
+    tensor-parallel layout ``_gpt_mp_fns`` expects: the fused qkv weight
+    [h, 3h] becomes head-split "attn.qkv.w3" [L, 3, h, h] (so sharding the
+    LAST dim over 'mp' splits each of q/k/v by heads, never mixing them),
+    and its bias "attn.qkv.b3" [L, 3, h]. Use with
+    ``gpt_mp_param_specs`` as the pipeline engine's param specs."""
     from paddle_tpu.jit.functionalize import get_params
 
     params = get_params(model)
@@ -262,6 +348,13 @@ def gpt_split_params(model: "GPTForCausalLM", tied: bool = False):
         key: jnp.stack([params[f"gpt.h.{i}.{key}"] for i in range(n_layers)])
         for key in keys
     }
+    if mp:
+        h = model.config.hidden_size
+        w = blocks.pop("attn.qkv.weight")          # [L, h, 3h]
+        blocks["attn.qkv.w3"] = w.reshape(
+            n_layers, h, 3, h).transpose(0, 2, 1, 3)  # [L, 3, h, h]
+        b = blocks.pop("attn.qkv.bias")            # [L, 3h]
+        blocks["attn.qkv.b3"] = b.reshape(n_layers, 3, h)
     head = {
         "ln_f.weight": params["gpt.ln_f.weight"],
         "ln_f.bias": params["gpt.ln_f.bias"],
@@ -269,6 +362,32 @@ def gpt_split_params(model: "GPTForCausalLM", tied: bool = False):
     if not tied:
         # copy keeps donation buffers unique
         head["wte"] = jnp.array(params["gpt.wte.weight"])
+    return embed, blocks, head
+
+
+def gpt_mp_param_specs(pp_axis="pp", mp_axis="mp"):
+    """(embed, blocks, head) PartitionSpec trees for the mp param layout
+    of ``gpt_split_params(mp=True)`` — column-parallel qkv/fc, row-parallel
+    projections, vocab-parallel wte (Megatron placement, matching the
+    tp_spec annotations the Layer model carries for the GSPMD engine)."""
+    from jax.sharding import PartitionSpec as P
+
+    embed = {"wte": P(mp_axis, None), "wpe": P()}
+    blocks = {
+        "attn.qkv.w3": P(pp_axis, None, None, mp_axis),
+        "attn.qkv.b3": P(pp_axis, None, mp_axis),
+        "attn.proj.weight": P(pp_axis, mp_axis, None),
+        "attn.proj.bias": P(pp_axis, None),
+        "mlp.fc.weight": P(pp_axis, None, mp_axis),
+        "mlp.fc.bias": P(pp_axis, mp_axis),
+        "mlp.proj.weight": P(pp_axis, mp_axis, None),
+        "mlp.proj.bias": P(pp_axis, None),
+        "ln_1.weight": P(pp_axis, None),
+        "ln_1.bias": P(pp_axis, None),
+        "ln_2.weight": P(pp_axis, None),
+        "ln_2.bias": P(pp_axis, None),
+    }
+    head = {"ln_f.weight": P(), "ln_f.bias": P()}
     return embed, blocks, head
 
 

@@ -393,3 +393,49 @@ class TestPipelineTied:
             untied.append(float(step(x.reshape(num_micro, bs, seq),
                                      y.reshape(num_micro, bs, seq)).numpy()))
         assert abs(untied[-1] - tied[-1]) > 1e-5  # different training dynamics
+
+
+class TestHybrid4D:
+    """pp × mp × sharding × dp on one mesh — the reference's flagship
+    composition (sharding_optimizer.py:120-138 hybrid-dp + tensor_parallel
+    + pipeline; BASELINE config #5 ERNIE pp+tp), virtually on 8 devices."""
+
+    def _losses(self, mesh_shape, names, mp_axis=None, zero_stage=0,
+                n_steps=3):
+        from paddle_tpu.distributed.fleet.pipeline_engine import PipelineTrainStep
+        from paddle_tpu.text.models.gpt import gpt_mp_param_specs
+
+        model, cfg = tiny_model(seed=77, num_layers=4)
+        embed_fn, block_fn, head_loss_fn = gpt_functional_fns(
+            cfg, mp_axis=mp_axis)
+        embed, blocks, head = gpt_split_params(model, tied=True,
+                                               mp=mp_axis is not None)
+        specs = gpt_mp_param_specs() if mp_axis is not None else None
+        opt = optimizer.Adam(1e-3, parameters=model.parameters())
+        mesh = mesh_of(mesh_shape, names)
+        bs, seq, num_micro = 4, 16, 2
+        dp = mesh.shape.get("dp", 1)
+        step = PipelineTrainStep(
+            embed_fn, block_fn, head_loss_fn, opt, mesh, embed, blocks, head,
+            num_micro,
+            jax.ShapeDtypeStruct((bs, seq, cfg.hidden_size), jnp.float32),
+            recompute=False, tie_keys=("wte",), param_specs=specs,
+            zero_stage=zero_stage,
+        )
+        losses = []
+        for i in range(n_steps):
+            x, y = batch(bs * num_micro, seq, seed=500 + i)
+            losses.append(float(step(x.reshape(num_micro, bs, seq),
+                                     y.reshape(num_micro, bs, seq)).numpy()))
+        return losses
+
+    def test_pp_mp_sharding_dp_matches_pp1(self):
+        ref = self._losses((1, 1), ("pp", "dp"))
+        out = self._losses((1, 2, 2, 2), ("dp", "pp", "mp", "sharding"),
+                           mp_axis="mp", zero_stage=1)
+        np.testing.assert_allclose(ref, out, rtol=2e-4)
+
+    def test_pp_mp_dp_matches_pp1(self):
+        ref = self._losses((1, 1), ("pp", "dp"))
+        out = self._losses((2, 2, 2), ("dp", "pp", "mp"), mp_axis="mp")
+        np.testing.assert_allclose(ref, out, rtol=2e-4)

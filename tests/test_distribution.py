@@ -71,25 +71,40 @@ class TestUniform:
 
 class TestCategorical:
     def test_entropy_golden(self):
+        # reference semantics (distribution.py:812-860): entropy runs
+        # softmax over the raw values, NOT over the normalized
+        # probabilities probs()/sample() use
         p = np.array([0.1, 0.2, 0.7], np.float32)
         d = Categorical(paddle.to_tensor(p))
-        expect = -(p * np.log(p)).sum()
+        sm = np.exp(p) / np.exp(p).sum()
+        expect = -(sm * np.log(sm)).sum()
         assert float(d.entropy().numpy()) == pytest.approx(expect, rel=1e-5)
 
     def test_unnormalized_input(self):
+        # probs() normalizes by the sum, so scaling the input leaves the
+        # sampling distribution unchanged
         d1 = Categorical(paddle.to_tensor(np.array([1.0, 2.0, 7.0],
                                                    np.float32)))
         d2 = Categorical(paddle.to_tensor(np.array([0.1, 0.2, 0.7],
                                                    np.float32)))
-        np.testing.assert_allclose(d1.entropy().numpy(),
-                                   d2.entropy().numpy(), rtol=1e-6)
+        np.testing.assert_allclose(
+            d1.probs(paddle.to_tensor(np.array(2))).numpy(),
+            d2.probs(paddle.to_tensor(np.array(2))).numpy(), rtol=1e-6)
+
+    def test_negative_input_rejected(self):
+        with pytest.raises(ValueError):
+            Categorical(paddle.to_tensor(np.array([0.5, -0.1], np.float32)))
 
     def test_kl_closed_form(self):
+        # softmax-over-values semantics, mirroring the reference's
+        # kl_divergence
         p = np.array([0.3, 0.7], np.float32)
         q = np.array([0.5, 0.5], np.float32)
         d = Categorical(paddle.to_tensor(p))
         e = Categorical(paddle.to_tensor(q))
-        expect = (p * np.log(p / q)).sum()
+        sp = np.exp(p) / np.exp(p).sum()
+        sq = np.exp(q) / np.exp(q).sum()
+        expect = (sp * np.log(sp / sq)).sum()
         assert float(kl_divergence(d, e).numpy()) == pytest.approx(
             expect, rel=1e-5)
 

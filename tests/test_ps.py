@@ -289,3 +289,90 @@ class TestGeoCommunicator:
         merged = geo.sync(p)
         np.testing.assert_allclose(merged, np.full(size, 2.0), rtol=1e-6)
         c.disconnect()
+
+
+class TestFaultTolerance:
+    """Reconnect-with-backoff + idempotent pushes (the reference's
+    brpc_ps_client retry/keepalive, brpc_ps_client.h)."""
+
+    def test_kill_and_resume(self, tmp_path):
+        """Server dies mid-training; a new server on the SAME port restores
+        saved state and the existing client resumes transparently."""
+        import socket
+
+        from paddle_tpu.distributed.ps import OPT_SGD, PsClient, PsServer
+
+        # pre-pick a free port so the replacement server can reuse it
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+
+        init = np.arange(8, dtype=np.float32)
+        srv = PsServer(port=port, n_workers=1)
+        srv.add_dense_table(0, 8, init=init.copy(), optimizer=OPT_SGD, lr=0.1)
+        cli = PsClient("127.0.0.1", port)
+        g = np.ones(8, np.float32)
+        cli.push_dense_grad(0, g)
+        w1 = cli.pull_dense(0, 8)
+        np.testing.assert_allclose(w1, init - 0.1)
+        path = str(tmp_path / "ckpt.bin")
+        cli.save(path)
+
+        # kill the server (client keeps its socket — next call hits a dead
+        # connection and must reconnect+retry against the replacement)
+        srv.destroy()
+        srv2 = PsServer(port=port, n_workers=1)
+        srv2.add_dense_table(0, 8, optimizer=OPT_SGD, lr=0.1)
+        cli2 = PsClient("127.0.0.1", port)
+        cli2.load(path)
+
+        w2 = cli.pull_dense(0, 8)  # OLD client: transparent reconnect
+        np.testing.assert_allclose(w2, w1)
+        cli.push_dense_grad(0, g)  # resumed training continues
+        np.testing.assert_allclose(cli.pull_dense(0, 8), init - 0.2)
+        srv2.destroy()
+
+    def test_duplicate_push_not_reapplied(self, ps):
+        """The (client_id, seq) dedup: a replayed push frame (what a
+        retry-after-lost-response sends) acks OK without double-applying,
+        while a fresh seq applies."""
+        import socket
+        import struct
+
+        from paddle_tpu.distributed.ps import OPT_SGD
+
+        ps.add_dense_table(0, 4, init=np.zeros(4, np.float32),
+                           optimizer=OPT_SGD, lr=1.0)
+
+        def raw_req(sock, op, table, a, b, cid, seq, payload=b""):
+            sock.sendall(struct.pack("<IIQQQQ", op, table, a, b, cid, seq)
+                         + payload)
+            status, n = struct.unpack("<IQ", _read(sock, 12))
+            return status, _read(sock, n)
+
+        def _read(sock, n):
+            buf = b""
+            while len(buf) < n:
+                c = sock.recv(n - len(buf))
+                assert c, "peer closed"
+                buf += c
+            return buf
+
+        sock = socket.create_connection(("127.0.0.1", ps.port))
+        g = np.ones(4, np.float32).tobytes()
+        cid = 0xBEEF
+        st, _ = raw_req(sock, 2, 0, 4, 0, cid, 1, g)   # push seq=1
+        assert st == 0
+        st, w = raw_req(sock, 1, 0, 4, 0, cid, 0)      # pull
+        np.testing.assert_allclose(np.frombuffer(w, np.float32), -1.0)
+        st, _ = raw_req(sock, 2, 0, 4, 0, cid, 1, g)   # DUPLICATE seq=1
+        assert st == 0                                  # acked...
+        st, w = raw_req(sock, 1, 0, 4, 0, cid, 0)
+        np.testing.assert_allclose(np.frombuffer(w, np.float32), -1.0,
+                                   err_msg="duplicate push was re-applied")
+        st, _ = raw_req(sock, 2, 0, 4, 0, cid, 2, g)   # fresh seq=2
+        assert st == 0
+        st, w = raw_req(sock, 1, 0, 4, 0, cid, 0)
+        np.testing.assert_allclose(np.frombuffer(w, np.float32), -2.0)
+        sock.close()
