@@ -20,6 +20,7 @@
 // push whose response was lost is re-sent with the same seq and acked
 // without re-applying the gradient, so retry never double-applies.
 #include <arpa/inet.h>
+#include <cerrno>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -111,15 +112,28 @@ struct Server {
   std::mutex dedup_mu;
   std::unordered_map<uint64_t, uint64_t> last_push_seq;
 
-  // returns true if this (client, seq) was already applied; records it
-  // otherwise
-  bool seen_push(uint64_t client_id, uint64_t seq) {
-    if (client_id == 0 || seq == 0) return false;
+  // claim-then-rollback dedup: claim_push atomically records the seq (so a
+  // concurrently retried frame can never double-apply — the claim IS the
+  // at-most-once guarantee), and the error paths roll the claim back
+  // (rollback_push) so a push rejected with an error status (missing
+  // table, dim mismatch) is re-processed when retried instead of being
+  // falsely acked as an applied duplicate.
+  bool claim_push(uint64_t client_id, uint64_t seq, uint64_t* prev) {
+    *prev = 0;
+    if (client_id == 0 || seq == 0) return true;  // unsequenced: always run
     std::lock_guard<std::mutex> g(dedup_mu);
     uint64_t& last = last_push_seq[client_id];
-    if (seq <= last) return true;
+    if (seq <= last) return false;  // duplicate of an applied/in-flight push
+    *prev = last;
     last = seq;
-    return false;
+    return true;
+  }
+
+  void rollback_push(uint64_t client_id, uint64_t seq, uint64_t prev) {
+    if (client_id == 0 || seq == 0) return;
+    std::lock_guard<std::mutex> g(dedup_mu);
+    uint64_t& last = last_push_seq[client_id];
+    if (last == seq) last = prev;  // undo only our own claim
   }
 
   ~Server() {
@@ -144,7 +158,8 @@ bool read_full(int fd, void* buf, size_t n) {
   char* p = static_cast<char*>(buf);
   while (n) {
     ssize_t got = recv(fd, p, n, 0);
-    if (got <= 0) return false;
+    if (got < 0 && errno == EINTR) continue;
+    if (got <= 0) return false;  // closed, error, or SO_RCVTIMEO deadline
     p += got;
     n -= got;
   }
@@ -241,21 +256,25 @@ void handle_conn(Server* sv, int fd) {
       case kPushDenseGrad: {
         payload.resize(a * 4);
         if (!read_full(fd, payload.data(), payload.size())) return;
-        if (sv->seen_push(client_id, seq)) {  // duplicate of an applied push
+        uint64_t prev;
+        if (!sv->claim_push(client_id, seq, &prev)) {  // applied duplicate
           send_resp(fd, 0, nullptr, 0);
           break;
         }
         auto it = sv->dense.find(table);
         if (it == sv->dense.end()) {
+          sv->rollback_push(client_id, seq, prev);  // retry must re-process
           send_resp(fd, 1, nullptr, 0);
           break;
         }
         DenseTable* t = it->second;
-        std::lock_guard<std::mutex> g(t->mu);
-        uint64_t n = std::min<uint64_t>(a, t->w.size());
-        t->step += 1;
-        apply_grad(t->opt, t->lr, t->w.data(), t->m0.data(), t->m1.data(),
-                   t->step, reinterpret_cast<float*>(payload.data()), n);
+        {
+          std::lock_guard<std::mutex> g(t->mu);
+          uint64_t n = std::min<uint64_t>(a, t->w.size());
+          t->step += 1;
+          apply_grad(t->opt, t->lr, t->w.data(), t->m0.data(), t->m1.data(),
+                     t->step, reinterpret_cast<float*>(payload.data()), n);
+        }
         send_resp(fd, 0, nullptr, 0);
         break;
       }
@@ -289,16 +308,19 @@ void handle_conn(Server* sv, int fd) {
         uint64_t dim = b;
         payload.resize(a * 8 + a * dim * 4);
         if (!read_full(fd, payload.data(), payload.size())) return;
-        if (sv->seen_push(client_id, seq)) {  // duplicate of an applied push
+        uint64_t prev;
+        if (!sv->claim_push(client_id, seq, &prev)) {  // applied duplicate
           send_resp(fd, 0, nullptr, 0);
           break;
         }
         if (it == sv->sparse.end()) {
+          sv->rollback_push(client_id, seq, prev);
           send_resp(fd, 1, nullptr, 0);
           break;
         }
         SparseTable* t = it->second;
         if (dim != t->dim) {
+          sv->rollback_push(client_id, seq, prev);
           send_resp(fd, 2, nullptr, 0);
           break;
         }
@@ -343,7 +365,15 @@ void handle_conn(Server* sv, int fd) {
         payload.resize(a);
         if (!read_full(fd, payload.data(), a)) return;
         std::string path(payload.data(), a);
-        FILE* fp = fopen(path.c_str(), "wb");
+        // write to a per-request temp file and atomically rename: a client
+        // whose recv deadline expired retries the save, and two concurrent
+        // handlers must never interleave fwrites into one truncated file —
+        // the last COMPLETED snapshot wins instead
+        char tmp[32];
+        snprintf(tmp, sizeof(tmp), ".tmp.%d.%lx", fd,
+                 (unsigned long)(uintptr_t)&payload);
+        std::string tmp_path = path + tmp;
+        FILE* fp = fopen(tmp_path.c_str(), "wb");
         if (!fp) {
           send_resp(fd, 1, nullptr, 0);
           break;
@@ -377,7 +407,11 @@ void handle_conn(Server* sv, int fd) {
             }
           }
         }
-        fclose(fp);
+        if (fclose(fp) != 0 || rename(tmp_path.c_str(), path.c_str()) != 0) {
+          remove(tmp_path.c_str());
+          send_resp(fd, 1, nullptr, 0);
+          break;
+        }
         send_resp(fd, 0, nullptr, 0);
         break;
       }
@@ -455,7 +489,17 @@ struct Client {
   int port = 0;
   uint64_t client_id = 0;
   uint64_t seq = 0;  // per-push sequence for server-side dedup
+  long deadline_ms = 15000;  // recv/send deadline set on the socket
 };
+
+long env_deadline_ms() {
+  long ms = 15000;
+  if (const char* env = getenv("PADDLE_TPU_PS_RECV_TIMEOUT_MS")) {
+    long v = atol(env);
+    if (v > 0) ms = v;
+  }
+  return ms;
+}
 
 int dial(const char* host, int port) {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
@@ -470,6 +514,14 @@ int dial(const char* host, int port) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+  // receive/send deadline: a connected-but-unresponsive server (accepted
+  // socket, no reply) must surface as a retriable transport failure, not
+  // an infinite read_full() hang — the reference's brpc client gets this
+  // from per-RPC timeouts (brpc_ps_client.h). Overridable for tests.
+  long ms = env_deadline_ms();
+  timeval tv{ms / 1000, (ms % 1000) * 1000};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
   return fd;
 }
 
@@ -500,15 +552,26 @@ bool send_once(Client* c, uint32_t op, uint32_t table, uint64_t a, uint64_t b,
 // response with non-zero STATUS is a server-side verdict — returned as-is,
 // never retried. ``retriable=false`` (barrier: re-entering could deadlock
 // the generation; shutdown: the close is expected) fails straight through.
+void set_rcv_deadline(int fd, long ms) {  // 0 = wait forever
+  timeval tv{ms / 1000, (ms % 1000) * 1000};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
 bool client_req(Client* c, uint32_t op, uint32_t table, uint64_t a, uint64_t b,
                 const void* payload, uint64_t pn, std::vector<char>* reply,
                 bool retriable = true, uint64_t seq = 0) {
   const int kAttempts = 5;
   for (int attempt = 0; attempt < kAttempts; ++attempt) {
     if (c->fd >= 0) {
+      // a barrier legitimately blocks until EVERY worker arrives — worker
+      // skew must not trip the transport deadline (the deadline exists to
+      // catch dead/unresponsive servers on retriable ops)
+      if (op == kBarrier) set_rcv_deadline(c->fd, 0);
       uint32_t status = 1;
-      if (send_once(c, op, table, a, b, seq, payload, pn, reply, &status))
-        return status == 0;
+      bool ok = send_once(c, op, table, a, b, seq, payload, pn, reply,
+                          &status);
+      if (op == kBarrier && c->fd >= 0) set_rcv_deadline(c->fd, c->deadline_ms);
+      if (ok) return status == 0;
     }
     if (!retriable) return false;
     // reconnect with backoff: 50ms * 2^attempt
@@ -601,6 +664,7 @@ void* pt_ps_connect(const char* host, int port) {
   if (!c) return nullptr;
   c->host = host;
   c->port = port;
+  c->deadline_ms = env_deadline_ms();
   std::random_device rd;
   c->client_id = (uint64_t(rd()) << 32) ^ rd();
   if (c->client_id == 0) c->client_id = 1;  // 0 = "no dedup" on the wire

@@ -362,6 +362,17 @@ _FORCE_BHLD = os.environ.get("PADDLE_TPU_ATTN_LAYOUT", "") == "bhld"
 # numerically ~equivalent (~1 ulp of bf16 either way) while halving the
 # O(L²) tensor's bytes. Set =0 for f32 score storage.
 _SCORE_BF16 = os.environ.get("PADDLE_TPU_ATTN_SCORE_BF16", "1") == "1"
+# sweep knob: hand-written chunked-attention backward (custom_vjp) vs
+# autodiff of the same forward. Default OFF — measured end-to-end on v5e
+# GPT-2 345M the manual rule is ~3% SLOWER (52.4k vs 53.9k tok/s/chip):
+# its per-chunk dk/dv pad+sum accumulation costs more than autodiff's
+# cotangent accumulation saves, and the backward's contract-q dots hit the
+# same ~43 TFLOP/s emitter ceiling either way (every orientation rewrite —
+# 'bhdk' outputs, pre-transposed operands, optimization barriers — was
+# canonicalized by XLA to the identical dot and measured identical).
+# Kept as an opt-in: it halves residual memory bookkeeping for long-L
+# sweeps and documents the measured negative result.
+_MANUAL_ATTN_VJP = os.environ.get("PADDLE_TPU_ATTN_MANUAL_VJP", "0") == "1"
 
 
 def _einsum_eqs(blhd: bool):
@@ -405,22 +416,24 @@ def _causal_chunk_size(Lq: int):
     return c
 
 
-def _causal_chunked(q, k, v, blhd: bool):
-    """Causal self-attention, q-chunked: chunk i attends to keys [0, (i+1)·c)
-    under a static top-left tril mask — upper-triangle blocks are never
-    computed (~45% of attention compute+bandwidth at 8 chunks).
+# backward einsum equations per layout: dP ('dO,V->P-shape'), dq
+# ('dS,K->q-shape'), dk ('dS,Q->k-shape'), dv ('E,dO->v-shape'), delta
+# ('dO,O->rows')
+_BWD_EQS = {
+    True: ("bqhd,bkhd->bhqk", "bhqk,bkhd->bqhd", "bhqk,bqhd->bkhd",
+           "bhqk,bqhd->bkhd", "bqhd,bqhd->bhq"),
+    False: ("bhqd,bhkd->bhqk", "bhqk,bhkd->bhqd", "bhqk,bhqd->bhkd",
+            "bhqk,bhqd->bhkd", "bhqd,bhqd->bhq"),
+}
 
-    TPU-first structure (profile-driven, v5e):
-    - the softmax NORMALIZATION is deferred until after the PV matmul: the
-      unnormalized exp weights feed the MXU and the divide runs on the
-      [.., c, d] output instead of the [.., c, L] score tensor — one full
-      O(L²) elementwise pass (read+write) removed per chunk (flash's trick,
-      expressed at the XLA level);
-    - the 1/sqrt(d) scale folds into the [.., c, d] query chunk, not the
-      score tensor;
-    - einsums contract the native [b, l, h, d] layout directly (blhd=True):
-      no [b,h,l,d] transpose copies.
-    """
+
+def _inv_rows(inv, blhd):
+    """Broadcast a [b,h,q] row statistic against [.., q-axis, .., d]."""
+    return inv.transpose(0, 2, 1)[..., None] if blhd else inv[..., None]
+
+
+def _causal_chunked_fwd_impl(q, k, v, blhd: bool):
+    """Forward pass; returns (out, residuals per chunk)."""
     axis_l = 1 if blhd else 2
     Lq = q.shape[axis_l]
     c = _causal_chunk_size(Lq)
@@ -433,7 +446,7 @@ def _causal_chunked(q, k, v, blhd: bool):
 
     sdt = q.dtype if (_SCORE_BF16 and bf) else jnp.float32
     neg = jnp.asarray(_NEG_INF if sdt == jnp.float32 else -3e38, sdt)
-    outs = []
+    outs, es, invs = [], [], []
     for i in range(n):
         qi = sl(q, i * c, (i + 1) * c) * jnp.asarray(scale, q.dtype)
         ub = (i + 1) * c
@@ -457,9 +470,89 @@ def _causal_chunked(q, k, v, blhd: bool):
         l_sum = jnp.maximum(e.sum(axis=-1, dtype=jnp.float32), 1e-30)
         o = jnp.einsum(eq[1], e.astype(q.dtype), vi)
         inv = (1.0 / l_sum).astype(q.dtype)
-        outs.append(o * (inv[..., None] if not blhd
-                         else inv.transpose(0, 2, 1)[..., None]))
-    return jnp.concatenate(outs, axis=axis_l)
+        outs.append(o * _inv_rows(inv, blhd))
+        es.append(e)
+        invs.append(inv)
+    out = jnp.concatenate(outs, axis=axis_l)
+    return out, (q, k, v, out, tuple(es), tuple(invs))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _causal_chunked(q, k, v, blhd: bool):
+    """Causal self-attention, q-chunked: chunk i attends to keys [0, (i+1)·c)
+    under a static top-left tril mask — upper-triangle blocks are never
+    computed (~45% of attention compute+bandwidth at 8 chunks).
+
+    TPU-first structure (profile-driven, v5e):
+    - the softmax NORMALIZATION is deferred until after the PV matmul: the
+      unnormalized exp weights feed the MXU and the divide runs on the
+      [.., c, d] output instead of the [.., c, L] score tensor — one full
+      O(L²) elementwise pass (read+write) removed per chunk (flash's trick,
+      expressed at the XLA level);
+    - the 1/sqrt(d) scale folds into the [.., c, d] query chunk, not the
+      score tensor;
+    - einsums contract the native [b, l, h, d] layout directly (blhd=True):
+      no [b,h,l,d] transpose copies;
+    - the BACKWARD is hand-written (custom_vjp, `_causal_chunked_bwd`):
+      autodiff's transposed einsums pick degenerate per-head layouts on TPU
+      (profiled 18 ms/step of ~1%-MFU dots + 13 ms of relayout copies at
+      GPT-2 345M). The manual rule keeps every backward contraction in the
+      same layout family as the forward and folds the 1/l normalization
+      into the [.., c, d] dO chunk (flash's backward trick at the XLA
+      level), so no O(L²) divide pass exists in either direction.
+    """
+    out, _ = _causal_chunked_fwd_impl(q, k, v, blhd)
+    return out
+
+
+def _causal_chunked_fwd(q, k, v, blhd):
+    return _causal_chunked_fwd_impl(q, k, v, blhd)
+
+
+def _causal_chunked_bwd(blhd, res, g):
+    q, k, v, out, es, invs = res
+    axis_l = 1 if blhd else 2
+    Lq = q.shape[axis_l]
+    c = _causal_chunk_size(Lq)
+    n = Lq // c
+    sl = functools.partial(jax.lax.slice_in_dim, axis=axis_l)
+    d = q.shape[-1]
+    scale = jnp.asarray(1.0 / math.sqrt(d), q.dtype)
+    dP_eq, dq_eq, dk_eq, dv_eq, delta_eq = _BWD_EQS[blhd]
+
+    dqs, dks, dvs = [], [], []
+    for i in range(n):
+        ub = (i + 1) * c
+        qi = sl(q, i * c, ub)
+        ki, vi = sl(k, 0, ub), sl(v, 0, ub)
+        gi = sl(g, i * c, ub)
+        oi = sl(out, i * c, ub)
+        e, inv = es[i], invs[i]
+        # softmax backward with the normalization folded into dO:
+        #   P = e·inv;  dS = P ⊙ (dP − rowsum(dP ⊙ P))
+        #             = e ⊙ (dP·inv − rowsum(dO ⊙ O)·inv)
+        # rowsum(dP ⊙ P) collapses to rowsum(dO ⊙ O) — computed on the
+        # [.., c, d] output, never touching the [.., c, L] score tensor
+        g_inv = (gi * _inv_rows(inv, blhd)).astype(q.dtype)
+        delta = jnp.einsum(delta_eq, gi, oi,
+                           preferred_element_type=jnp.float32)
+        dP = jnp.einsum(dP_eq, g_inv, vi, preferred_element_type=jnp.float32)
+        dS = (e.astype(jnp.float32)
+              * (dP - (delta * inv.astype(jnp.float32))[..., None])
+              ).astype(q.dtype)
+        # masked positions need no re-masking: e is exactly 0 there
+        dqs.append(jnp.einsum(dq_eq, dS, ki) * scale)
+        pad = [(0, 0)] * q.ndim
+        pad[axis_l] = (0, Lq - ub)
+        dks.append(jnp.pad(jnp.einsum(dk_eq, dS, qi) * scale, pad))
+        dvs.append(jnp.pad(jnp.einsum(dv_eq, e.astype(q.dtype), g_inv), pad))
+    dq = jnp.concatenate(dqs, axis=axis_l)
+    dk = sum(dks[1:], dks[0])
+    dv = sum(dvs[1:], dvs[0])
+    return dq, dk, dv
+
+
+_causal_chunked.defvjp(_causal_chunked_fwd, _causal_chunked_bwd)
 
 
 def xla_attention(q, k, v, causal=False, bias=None, layout="bhld"):
@@ -488,7 +581,9 @@ def xla_attention(q, k, v, causal=False, bias=None, layout="bhld"):
             and _causal_chunk_size(Lq) is not None):
         # chunk-count cap keeps the emitted program small (some TPU compile
         # services reject huge ones)
-        return _causal_chunked(q, k, v, blhd)
+        if _MANUAL_ATTN_VJP:
+            return _causal_chunked(q, k, v, blhd)
+        return _causal_chunked_fwd_impl(q, k, v, blhd)[0]
     mask = jnp.tril(jnp.ones((Lq, Lk), bool)) if causal else None
     # causal mask is top-left aligned (k_pos <= q_pos), matching
     # blockwise/flash so the dispatch tiers agree for Lq != Lk

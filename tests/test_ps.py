@@ -311,6 +311,7 @@ class TestFaultTolerance:
         init = np.arange(8, dtype=np.float32)
         srv = PsServer(port=port, n_workers=1)
         srv.add_dense_table(0, 8, init=init.copy(), optimizer=OPT_SGD, lr=0.1)
+        srv.start()
         cli = PsClient("127.0.0.1", port)
         g = np.ones(8, np.float32)
         cli.push_dense_grad(0, g)
@@ -324,6 +325,7 @@ class TestFaultTolerance:
         srv.destroy()
         srv2 = PsServer(port=port, n_workers=1)
         srv2.add_dense_table(0, 8, optimizer=OPT_SGD, lr=0.1)
+        srv2.start()
         cli2 = PsClient("127.0.0.1", port)
         cli2.load(path)
 
@@ -344,6 +346,7 @@ class TestFaultTolerance:
 
         ps.add_dense_table(0, 4, init=np.zeros(4, np.float32),
                            optimizer=OPT_SGD, lr=1.0)
+        ps.start()
 
         def raw_req(sock, op, table, a, b, cid, seq, payload=b""):
             sock.sendall(struct.pack("<IIQQQQ", op, table, a, b, cid, seq)
@@ -376,3 +379,87 @@ class TestFaultTolerance:
         st, w = raw_req(sock, 1, 0, 4, 0, cid, 0)
         np.testing.assert_allclose(np.frombuffer(w, np.float32), -2.0)
         sock.close()
+
+    def test_failed_push_seq_not_recorded(self, ps):
+        """Check-then-commit: a push REJECTED with an error status (missing
+        table) must not record its seq — the retry of that seq against a
+        healthy target must apply, not be falsely acked as a duplicate."""
+        import socket
+        import struct
+
+        from paddle_tpu.distributed.ps import OPT_SGD
+
+        ps.add_dense_table(0, 4, init=np.zeros(4, np.float32),
+                           optimizer=OPT_SGD, lr=1.0)
+        ps.start()
+
+        def _read(sock, n):
+            buf = b""
+            while len(buf) < n:
+                c = sock.recv(n - len(buf))
+                assert c, "peer closed"
+                buf += c
+            return buf
+
+        def raw_req(sock, op, table, a, b, cid, seq, payload=b""):
+            sock.sendall(struct.pack("<IIQQQQ", op, table, a, b, cid, seq)
+                         + payload)
+            status, n = struct.unpack("<IQ", _read(sock, 12))
+            return status, _read(sock, n)
+
+        sock = socket.create_connection(("127.0.0.1", ps.port))
+        g = np.ones(4, np.float32).tobytes()
+        cid = 0xCAFE
+        st, _ = raw_req(sock, 2, 99, 4, 0, cid, 1, g)  # missing table
+        assert st == 1
+        st, _ = raw_req(sock, 2, 0, 4, 0, cid, 1, g)   # same seq, valid table
+        assert st == 0
+        st, w = raw_req(sock, 1, 0, 4, 0, cid, 0)
+        np.testing.assert_allclose(
+            np.frombuffer(w, np.float32), -1.0,
+            err_msg="seq recorded on a FAILED push; valid retry was dropped")
+        sock.close()
+
+    def test_recv_timeout_unresponsive_server(self, monkeypatch):
+        """A server that accepts but never replies must surface as an error
+        after the receive deadline + retries, not an infinite hang (the
+        reference brpc client's RPC timeout, brpc_ps_client.h)."""
+        import socket
+        import threading
+        import time
+
+        from paddle_tpu.distributed.ps import PsClient
+
+        monkeypatch.setenv("PADDLE_TPU_PS_RECV_TIMEOUT_MS", "150")
+        silent = socket.socket()
+        silent.bind(("127.0.0.1", 0))
+        silent.listen(8)
+        port = silent.getsockname()[1]
+        stop = threading.Event()
+
+        def acceptor():
+            silent.settimeout(0.1)
+            conns = []
+            while not stop.is_set():
+                try:
+                    c, _ = silent.accept()
+                    conns.append(c)  # accept, then stay silent
+                except socket.timeout:
+                    pass
+            for c in conns:
+                c.close()
+
+        t = threading.Thread(target=acceptor, daemon=True)
+        t.start()
+        try:
+            cli = PsClient("127.0.0.1", port)
+            t0 = time.monotonic()
+            with pytest.raises(RuntimeError):
+                cli.pull_dense(0, 4)
+            # 5 attempts x 150ms deadline + backoff: finite, well under a min
+            assert time.monotonic() - t0 < 30.0
+            cli.disconnect()
+        finally:
+            stop.set()
+            t.join()
+            silent.close()
