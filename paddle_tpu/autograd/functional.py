@@ -37,15 +37,17 @@ def grad(
     # snapshot leaf grads so we can restore (grad() must not pollute .grad)
     all_leaves = _collect_leaves(outputs)
     saved = {id(t): t.grad for t in all_leaves}
-    retain = bool(retain_graph) if retain_graph is not None else create_graph
     for t in inputs:
         t._retain_grads = True
         t.grad = None
     gouts = grad_outputs or [None] * len(outputs)
     for o, g in zip(outputs, gouts):
         # always retain during the sweep; the graph is freed by GC when the
-        # output tensors die (create_graph/double-grad: TODO round 2)
-        _tensor_backward(o, g, retain_graph=True)
+        # output tensors die. create_graph=True runs the DIFFERENTIABLE
+        # sweep: the returned grads carry tape nodes and can be
+        # differentiated again (PartialGradEngine parity,
+        # imperative/partial_grad_engine.cc)
+        _tensor_backward(o, g, retain_graph=True, create_graph=create_graph)
     results = []
     for t in inputs:
         if t.grad is None:

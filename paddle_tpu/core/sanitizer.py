@@ -1,0 +1,67 @@
+"""FLAGS_check_nan_inf inside COMPILED steps.
+
+The reference instruments every executor so the flag catches NaN/Inf where
+real training runs (paddle/fluid/framework/details/nan_inf_utils_detail.cc
+sweeps each op's outputs per step). Under XLA the step is one compiled
+program, so the TPU-native equivalent is a post-step finite sweep: when the
+flag is set at BUILD time, the jitted step computes an `isfinite().all()`
+flag per loss/grad/param leaf (cheap fused reduces, stacked into one bool
+vector so the host fetches a single tiny array) and the host raises a
+`FloatingPointError` naming the offending tensors.
+
+The flag is snapshotted when the compiled step is BUILT (same policy as the
+static-graph AMP snapshot, static/program.py): flipping it later does not
+retroactively instrument an already-compiled step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .flags import flag_value
+
+__all__ = ["jit_check_enabled", "finite_flags", "raise_if_nonfinite"]
+
+
+def jit_check_enabled() -> bool:
+    """Read FLAGS_check_nan_inf at compiled-step build time."""
+    return bool(flag_value("check_nan_inf"))
+
+
+def _float_leaf(leaf) -> bool:
+    return hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.inexact)
+
+
+def finite_flags(names_out: list, **groups):
+    """Trace-time sweep: one `isfinite().all()` per floating leaf.
+
+    ``groups`` maps a prefix (e.g. ``grad``) to a pytree. Appends the leaf
+    names to ``names_out`` (a mutable list captured by the caller — filled
+    during tracing, read back on the host after execution) and returns the
+    stacked bool vector, or None when nothing to check.
+    """
+    names_out.clear()
+    flags = []
+    for gname, tree in groups.items():
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        for path, leaf in flat:
+            if _float_leaf(leaf):
+                names_out.append(f"{gname}{jax.tree_util.keystr(path)}")
+                flags.append(jnp.isfinite(leaf).all())
+    return jnp.stack(flags) if flags else None
+
+
+def raise_if_nonfinite(names, flags):
+    """Host side: fetch the flag vector (one tiny transfer) and raise a
+    located error listing every non-finite tensor."""
+    if flags is None:
+        return
+    ok = np.asarray(flags)
+    if ok.all():
+        return
+    bad = [n for n, f in zip(names, ok) if not f]
+    shown = ", ".join(bad[:8]) + (f" (+{len(bad) - 8} more)" if len(bad) > 8
+                                  else "")
+    raise FloatingPointError(
+        f"FLAGS_check_nan_inf: NaN or Inf detected in compiled step: {shown}")

@@ -112,17 +112,31 @@ def enable_grad():
 # autograd DAG node
 # ---------------------------------------------------------------------------
 class Node:
-    """One recorded eager op: inputs, pullback, and output metadata."""
+    """One recorded eager op: inputs, pullback, and output metadata.
 
-    __slots__ = ("inputs", "vjp_fn", "out_avals", "out_grads", "n_outs", "name")
+    ``fwd_fn`` (when present) is the pure forward closure over the node's
+    differentiable inputs — kept so a ``create_graph=True`` backward can
+    RE-LINEARIZE the op at its original inputs (vjp-of-vjp), capturing the
+    second-order dependence of the gradient on the inputs that the stored
+    first-order ``vjp_fn``'s residuals hide (the eager equivalent of the
+    reference's PartialGradEngine double-grad,
+    imperative/partial_grad_engine.cc)."""
 
-    def __init__(self, inputs, vjp_fn, out_avals, name=""):
+    __slots__ = ("inputs", "vjp_fn", "out_avals", "out_grads", "n_outs",
+                 "name", "fwd_fn", "tuple_out")
+
+    def __init__(self, inputs, vjp_fn, out_avals, name="", fwd_fn=None,
+                 tuple_out=False):
         self.inputs: List[Tensor] = inputs
         self.vjp_fn = vjp_fn
         self.out_avals = out_avals  # list of (shape, dtype)
         self.out_grads: Optional[List[Any]] = None
         self.n_outs = len(out_avals)
         self.name = name
+        self.fwd_fn = fwd_fn
+        # True when the recorded fn returned a TUPLE (multi_out): the vjp's
+        # cotangent must then be a tuple even for a single output
+        self.tuple_out = tuple_out
 
     def seed_zero_grads(self):
         if self.out_grads is None:
@@ -606,6 +620,8 @@ def apply_op(fn: Callable, *args, multi_out: bool = False, op_name: str = ""):
         vjp_fn,
         [(o.shape, o.dtype) for o in outs],
         name=op_name or getattr(fn, "__name__", "op"),
+        fwd_fn=f,
+        tuple_out=multi_out,
     )
     wrapped = []
     for i, o in enumerate(outs):
@@ -680,9 +696,13 @@ def _topo_nodes(root: Node) -> List[Node]:
     return order
 
 
-def backward(tensor: Tensor, grad_tensor=None, retain_graph=False):
+def backward(tensor: Tensor, grad_tensor=None, retain_graph=False,
+             create_graph=False):
     """Reverse-mode sweep — parity with BasicEngine::Execute
-    (imperative/basic_engine.cc:305)."""
+    (imperative/basic_engine.cc:305). ``create_graph=True`` runs the
+    DIFFERENTIABLE sweep (see ``_backward_create_graph``)."""
+    if create_graph:
+        return _backward_create_graph(tensor, grad_tensor)
     if grad_tensor is None:
         seed = jnp.ones(tensor._value.shape, tensor._value.dtype)
     else:
@@ -705,7 +725,8 @@ def backward(tensor: Tensor, grad_tensor=None, retain_graph=False):
             g if g is not None else jnp.zeros(shape, dtype)
             for g, (shape, dtype) in zip(node.out_grads, node.out_avals)
         ]
-        ct = tuple(cotangents) if node.n_outs > 1 else cotangents[0]
+        ct = (tuple(cotangents) if (node.n_outs > 1 or node.tuple_out)
+              else cotangents[0])
         if node.vjp_fn is None:
             raise RuntimeError(
                 "trying to backward through a graph that has already been "
@@ -736,14 +757,98 @@ def backward(tensor: Tensor, grad_tensor=None, retain_graph=False):
 
     if not retain_graph:
         # Drop graph edges so memory is reclaimed; mirrors the reference's
-        # retain_graph=False default behavior.
+        # retain_graph=False default behavior. fwd_fn goes too: its closure
+        # pins the captured input arrays, and a later create_graph sweep
+        # must hit the freed-graph guard, not silently see empty inputs.
         for node in order:
             node.inputs = []
+            node.fwd_fn = None
+
+
+def _backward_create_graph(tensor: Tensor, grad_tensor=None):
+    """Differentiable reverse sweep: every backward computation is itself
+    recorded on the tape, so the produced ``.grad`` Tensors can be
+    differentiated again (``paddle.grad(..., create_graph=True)``, WGAN-GP
+    style gradient penalties). Parity:
+    /root/reference/paddle/fluid/imperative/partial_grad_engine.cc.
+
+    Each node is RE-LINEARIZED at its original inputs through ``apply_op``
+    (grads = vjp(fwd_fn, *xs)(ct) as one recorded op): the resulting tape
+    op depends on BOTH the cotangent and the original inputs, so
+    second-order terms survive. Ops recorded without a forward closure
+    (PyLayer custom backwards) are rejected explicitly. The graph is always
+    retained (the second backward needs it)."""
+    if grad_tensor is None:
+        seed = wrap_raw(jnp.ones(tensor._value.shape, tensor._value.dtype))
+    elif isinstance(grad_tensor, Tensor):
+        seed = grad_tensor
+    else:
+        seed = wrap_raw(jnp.asarray(grad_tensor))
+
+    if tensor._node is None:
+        if not tensor.stop_gradient:
+            _accum_leaf(tensor, seed)
+        return
+
+    order = _topo_nodes(tensor._node)
+    tensor._node.seed_zero_grads()
+    tensor._node.accumulate(tensor._idx, seed)
+
+    for node in reversed(order):
+        if node.out_grads is None or all(g is None for g in node.out_grads):
+            node.out_grads = None
+            continue
+        if node.fwd_fn is None:
+            if not node.inputs and node.vjp_fn is None:
+                raise RuntimeError(
+                    "trying to backward through a graph that has already "
+                    "been freed; call backward(retain_graph=True) if you "
+                    "need to backward through it a second time")
+            raise NotImplementedError(
+                f"create_graph=True cannot differentiate through op "
+                f"{node.name!r}: it was recorded without a replayable "
+                "forward (PyLayer custom backward). Express it with "
+                "differentiable tensor ops to use double-grad.")
+        cotangents = [
+            g if g is not None else wrap_raw(jnp.zeros(shape, dtype))
+            for g, (shape, dtype) in zip(node.out_grads, node.out_avals)
+        ]
+        n_in = len(node.inputs)
+        fwd_fn, n_outs = node.fwd_fn, node.n_outs
+
+        tup = node.tuple_out
+
+        def replay(*xs_and_cts, _fwd=fwd_fn, _n_in=n_in, _n_outs=n_outs,
+                   _tup=tup):
+            xs, cts = xs_and_cts[:_n_in], xs_and_cts[_n_in:]
+            _, vjp = jax.vjp(_fwd, *xs)
+            ct = tuple(cts) if (_n_outs > 1 or _tup) else cts[0]
+            return vjp(ct)  # tuple of len(xs) grads
+
+        in_grads = apply_op(replay, *node.inputs, *cotangents,
+                            multi_out=True, op_name=f"grad({node.name})")
+        for inp, g in zip(node.inputs, in_grads):
+            if inp.stop_gradient:
+                continue
+            for hook in inp._grad_hooks:
+                res = hook(g)
+                if res is not None:
+                    g = res
+            if inp._node is not None:
+                inp._node.accumulate(inp._idx, g)
+                if inp._retain_grads:
+                    _accum_leaf(inp, g)
+            else:
+                _accum_leaf(inp, g)
+        node.out_grads = None
 
 
 def _accum_leaf(t: Tensor, g):
     from .selected_rows import RowSparseGrad
 
+    if isinstance(g, Tensor):  # differentiable sweep: keep the tape alive
+        t.grad = g if t.grad is None else t.grad + g
+        return
     if isinstance(g, RowSparseGrad):
         # SelectedRows-equivalent: keep the sparse form on the leaf; the
         # optimizer's sparse path consumes it. sparse+sparse concatenates,

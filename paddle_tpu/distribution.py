@@ -25,6 +25,8 @@ __all__ = ["Distribution", "Uniform", "Normal", "Categorical",
 def _raw(x):
     if isinstance(x, Tensor):
         return x._value
+    if isinstance(x, jax.core.Tracer) or isinstance(x, jnp.ndarray):
+        return x  # already a jax value (possibly traced): no host round-trip
     return jnp.asarray(np.asarray(x, np.float32))
 
 
@@ -136,7 +138,12 @@ class Categorical(Distribution):
         # while entropy()/kl_divergence() run softmax over the same values
         # as if they were log-space logits (distribution.py:812-860) —
         # both faithfully mirrored, including the asymmetry.
-        if bool(jnp.any(raw < 0)):
+        # validate only when concrete: a traced value (inside jit/grad/vmap)
+        # cannot be bool()'d, and forcing it eagerly would device-sync every
+        # construction — skip the check there (the reference does no
+        # validation at all; entropy()/kl run softmax so log-space logits
+        # are legitimate inputs for those methods)
+        if not isinstance(raw, jax.core.Tracer) and bool(jnp.any(raw < 0)):
             raise ValueError(
                 "Categorical expects non-negative unnormalized "
                 "probabilities (negative entries would produce negative "

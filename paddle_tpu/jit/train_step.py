@@ -45,6 +45,10 @@ class TrainStep:
         self._dirty = True
 
         opt = optimizer
+        from ..core.sanitizer import finite_flags, jit_check_enabled
+
+        self._check_nan = jit_check_enabled()  # snapshot at build time
+        self._nan_names: list = []
 
         def step_fn(params, buffers, opt_state, lr, batch):
             inputs, labels = batch
@@ -78,7 +82,10 @@ class TrainStep:
                 np_, ns = opt._update(p, g, opt_state[name], lr)
                 new_params[name] = np_
                 new_opt_state[name] = ns
-            return new_params, new_buffers, new_opt_state, loss
+            flags = (finite_flags(self._nan_names, loss=loss, grad=grads,
+                                  param=new_params)
+                     if self._check_nan else None)
+            return new_params, new_buffers, new_opt_state, loss, flags
 
         self._jitted = jax.jit(step_fn, donate_argnums=(0, 2) if donate else ())
 
@@ -90,10 +97,14 @@ class TrainStep:
             a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in labels
         )
         lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
-        self._params, self._buffers, self._opt_state, loss = self._jitted(
+        self._params, self._buffers, self._opt_state, loss, flags = self._jitted(
             self._params, self._buffers, self._opt_state, lr,
             (raw_inputs, raw_labels),
         )
+        if self._check_nan:
+            from ..core.sanitizer import raise_if_nonfinite
+
+            raise_if_nonfinite(self._nan_names, flags)
         self._optimizer._global_step += 1
         self._dirty = True
         return Tensor(loss)

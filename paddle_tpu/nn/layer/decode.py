@@ -251,12 +251,21 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
 
     inputs, states, finished = decoder.initialize(inits)
     step_outputs = []
+    # matches the reference's implicit bound (fluid/layers/rnn.py
+    # dynamic_decode loops until finished); a custom decoder that never
+    # finishes stops at this many steps rather than looping forever
     max_steps = max_step_num if max_step_num is not None else 256
     for t in range(int(max_steps)):
         out, states, inputs, step_finished = decoder.step(
             to_tensor(np.array([t], np.int64)), inputs, states, **kwargs)
         step_outputs.append(out)
-        finished = step_finished
+        if getattr(decoder, "tracks_own_finished", False):
+            finished = step_finished
+        else:
+            # reference semantics (rnn.py): OR the step flags into the
+            # global finished — a decoder emitting per-step-only flags must
+            # not be able to un-finish a sequence
+            finished = apply_op(jnp.logical_or, finished, step_finished)
         if bool(np.asarray(_raw(finished)).all()):
             break
 

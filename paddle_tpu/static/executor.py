@@ -313,6 +313,11 @@ class Executor:
         optimizer, loss_t = program._optimize
         loss_id = id(loss_t)
         opt = optimizer
+        from ..core.sanitizer import (finite_flags, jit_check_enabled,
+                                      raise_if_nonfinite)
+
+        check_nan = jit_check_enabled()  # snapshot at compile time
+        nan_names: list = []
         if id(program) not in self._opt_states:
             self._opt_states[id(program)] = {
                 uid: opt._init_state(p._value) for uid, p in param_items
@@ -350,19 +355,35 @@ class Executor:
             for uid in param_uids:
                 if uid not in new_state:
                     new_state[uid] = opt_state[uid]
-            return [env[i] for i in fetch_ids], new_params, new_state
+            if check_nan:
+                # uid keys -> variable names so the error locates the tensor
+                pname = lambda uid: getattr(named[uid], "name", None) or str(uid)
+                flags = finite_flags(
+                    nan_names, loss=loss,
+                    grad={pname(u): g for u, g in grads.items()},
+                    param={pname(u): v for u, v in new_params.items()})
+            else:
+                flags = None
+            return [env[i] for i in fetch_ids], new_params, new_state, flags
 
         jitted = jax.jit(step, donate_argnums=(1, 2))
 
         def runner(feed_raw):
             params_raw = {uid: p._value for uid, p in param_items}
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
-            outs, new_params, new_state = jitted(
+            outs, new_params, new_state, flags = jitted(
                 feed_raw, params_raw, self._opt_states[id(program)], lr
             )
+            # commit BEFORE any NaN raise: the jit donated the old
+            # param/opt-state buffers, so the post-step values (valid, just
+            # possibly non-finite) are the only live ones — leaving the
+            # Parameters pointing at deleted arrays would break post-mortem
+            # inspection and retries
             for uid, p in param_items:
                 p._value = new_params[uid]
             self._opt_states[id(program)] = new_state
+            if check_nan:
+                raise_if_nonfinite(nan_names, flags)
             opt._global_step += 1
             return outs
 

@@ -131,3 +131,28 @@ class TestCategorical:
         v = paddle.to_tensor(np.array([1, 0], np.int64))
         np.testing.assert_allclose(d.probs(v).numpy(), [0.75, 0.5],
                                    rtol=1e-6)
+
+
+class TestCategoricalTracing:
+    def test_constructible_under_jit(self):
+        """Constructing from a TRACED value must not concretize (the
+        validation is skipped under tracing; eager keeps it)."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.distribution import Categorical
+
+        @jax.jit
+        def ent(raw):
+            return Categorical(raw).entropy()._value
+
+        out = ent(jnp.asarray([1.0, 2.0, 3.0]))
+        assert bool(jnp.isfinite(out))
+
+    def test_eager_negative_still_rejected(self):
+        import pytest as _pytest
+
+        from paddle_tpu.distribution import Categorical
+
+        with _pytest.raises(ValueError):
+            Categorical(np.array([0.5, -0.5]))

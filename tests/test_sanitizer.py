@@ -1,0 +1,97 @@
+"""FLAGS_check_nan_inf under COMPILED steps (jit.TrainStep, static
+Executor, fleet ParallelTrainStep) — parity with the reference's executor
+instrumentation (paddle/fluid/framework/details/nan_inf_utils_detail.cc),
+which this repo previously only had on the eager path."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.flags import set_flags
+
+
+@pytest.fixture
+def nan_flag():
+    set_flags({"FLAGS_check_nan_inf": True})
+    yield
+    set_flags({"FLAGS_check_nan_inf": False})
+
+
+def _mk_model():
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 3)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    return net, opt
+
+
+class TestTrainStepSanitizer:
+    def test_inf_input_raises_located(self, nan_flag):
+        from paddle_tpu.jit.train_step import TrainStep
+
+        net, opt = _mk_model()
+        step = TrainStep(net, paddle.nn.MSELoss(), opt)
+        x = np.ones((2, 4), np.float32)
+        x[0, 0] = np.inf
+        y = np.zeros((2, 3), np.float32)
+        with pytest.raises(FloatingPointError) as ei:
+            step((paddle.to_tensor(x),), (paddle.to_tensor(y),))
+        assert "loss" in str(ei.value) or "grad" in str(ei.value)
+
+    def test_finite_step_passes(self, nan_flag):
+        from paddle_tpu.jit.train_step import TrainStep
+
+        net, opt = _mk_model()
+        step = TrainStep(net, paddle.nn.MSELoss(), opt)
+        x = np.ones((2, 4), np.float32)
+        y = np.zeros((2, 3), np.float32)
+        loss = step((paddle.to_tensor(x),), (paddle.to_tensor(y),))
+        assert np.isfinite(float(loss.numpy()))
+
+    def test_flag_off_no_check(self):
+        from paddle_tpu.jit.train_step import TrainStep
+
+        net, opt = _mk_model()
+        step = TrainStep(net, paddle.nn.MSELoss(), opt)
+        x = np.ones((2, 4), np.float32)
+        x[0, 0] = np.inf
+        y = np.zeros((2, 3), np.float32)
+        loss = step((paddle.to_tensor(x),), (paddle.to_tensor(y),))
+        assert not np.isfinite(float(loss.numpy()))  # silently non-finite
+
+
+class TestFleetEngineSanitizer:
+    def test_inf_grad_raises_located(self, nan_flag):
+        import jax
+        from jax.sharding import Mesh
+
+        from paddle_tpu.distributed.fleet.engine import ParallelTrainStep
+
+        net, opt = _mk_model()
+        mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+        step = ParallelTrainStep(net, loss_fn=paddle.nn.MSELoss(),
+                                 optimizer=opt, mesh=mesh)
+        x = np.ones((2, 4), np.float32)
+        x[1, 2] = np.nan
+        y = np.zeros((2, 3), np.float32)
+        with pytest.raises(FloatingPointError):
+            step((paddle.to_tensor(x),), (paddle.to_tensor(y),))
+
+
+class TestStaticExecutorSanitizer:
+    def test_inf_feed_raises_located(self, nan_flag):
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 4], "float32")
+            y = paddle.static.data("y", [None, 1], "float32")
+            pred = paddle.static.nn.fc(x, 1)
+            loss = paddle.mean((pred - y) ** 2)
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        feed_x = np.ones((2, 4), np.float32)
+        feed_x[0, 0] = np.inf
+        with pytest.raises(FloatingPointError):
+            exe.run(main, feed={"x": feed_x,
+                                "y": np.zeros((2, 1), np.float32)},
+                    fetch_list=[loss])

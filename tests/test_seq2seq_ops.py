@@ -354,3 +354,34 @@ class TestBeamSearchDecoder:
         got = ids.numpy()
         for b in range(1, B):
             np.testing.assert_array_equal(got[0], got[b])
+
+
+class TestDynamicDecodeFinished:
+    def test_step_only_flags_cannot_unfinish(self):
+        """A custom decoder (tracks_own_finished False) emitting per-step
+        flags: once a sequence finishes it must STAY finished (reference
+        ORs step flags into the global state, fluid/layers/rnn.py)."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.nn import dynamic_decode
+
+        class FlickerDecoder:
+            tracks_own_finished = False
+
+            def initialize(self, inits):
+                z = paddle.to_tensor(np.zeros((2, 1), np.float32))
+                fin = paddle.to_tensor(np.array([False, False]))
+                return z, {"t": 0}, fin
+
+            def step(self, time, inputs, states, **kw):
+                t = int(np.asarray(time.numpy())[0])
+                # seq 0 signals finished ONLY at t==1 (flickers off after);
+                # seq 1 finishes from t==3 on
+                fin = np.array([t == 1, t >= 3])
+                out = paddle.to_tensor(np.full((2, 1), float(t), np.float32))
+                return out, {"t": t}, inputs, paddle.to_tensor(fin)
+
+        outs, _ = dynamic_decode(FlickerDecoder(), max_step_num=10)
+        # finished must latch: loop ends at t==3 (both finished), 4 steps
+        assert outs.shape[1] == 4
