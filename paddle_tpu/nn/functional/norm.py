@@ -20,6 +20,66 @@ def _t(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
+def _bn_fwd_impl(a, w, b, ch_axis, axes, epsilon):
+    af = a.astype(jnp.float32)
+    mu = jnp.mean(af, axis=axes, keepdims=True)
+    var = jnp.var(af, axis=axes, keepdims=True)
+    rstd = jax.lax.rsqrt(var + epsilon)
+    shape = [1] * a.ndim
+    shape[ch_axis] = a.shape[ch_axis]
+    out = (((af - mu) * rstd).astype(a.dtype) * w.reshape(shape)
+           + b.reshape(shape))
+    return (out, mu.reshape(-1), var.reshape(-1)), (a, w, b, mu, rstd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _bn_manual(a, w, b, ch_axis, axes, epsilon):
+    """Training-mode affine BatchNorm with a hand-written backward.
+
+    Returns ``(out, batch_mean, batch_var)`` (stats feed the imperative
+    running-stat update). Same rationale as ``_ln_manual``: autodiff's
+    backward through the separate mean/var ops fuses poorly on TPU; the
+    manual rule recomputes xhat from the saved f32 stats and produces
+    dx/dw/db from one pass structure, with stats accumulated in f32."""
+    out, _ = _bn_fwd_impl(a, w, b, ch_axis, axes, epsilon)
+    return out
+
+
+def _bn_manual_fwd(a, w, b, ch_axis, axes, epsilon):
+    return _bn_fwd_impl(a, w, b, ch_axis, axes, epsilon)
+
+
+def _bn_manual_bwd(ch_axis, axes, epsilon, res, cts):
+    a, w, b, mu, rstd = res
+    dy, dmu_ct, dvar_ct = cts
+    af = a.astype(jnp.float32)
+    xh = (af - mu) * rstd
+    shape = [1] * a.ndim
+    shape[ch_axis] = a.shape[ch_axis]
+    n = 1
+    for ax in axes:
+        n *= a.shape[ax]
+    g = dy.astype(jnp.float32) * w.astype(jnp.float32).reshape(shape)
+    c1 = jnp.mean(g, axis=axes, keepdims=True)
+    c2 = jnp.mean(g * xh, axis=axes, keepdims=True)
+    dx = rstd * (g - c1 - xh * c2)
+    # cotangents of the returned batch stats (the running-stat update is
+    # imperative and sends none, but a caller differentiating through the
+    # stats outputs gets the exact terms)
+    if dmu_ct is not None:
+        dx = dx + dmu_ct.reshape(shape).astype(jnp.float32) / n
+    if dvar_ct is not None:
+        dx = dx + (dvar_ct.reshape(shape).astype(jnp.float32)
+                   * 2.0 * (af - mu) / n)
+    dyf = dy.astype(jnp.float32)
+    dw = jnp.sum(dyf * xh, axis=axes).astype(w.dtype)
+    db = jnp.sum(dyf, axis=axes).astype(b.dtype)
+    return dx.astype(a.dtype), dw, db
+
+
+_bn_manual.defvjp(_bn_manual_fwd, _bn_manual_bwd)
+
+
 def batch_norm(
     x,
     running_mean,
@@ -39,6 +99,18 @@ def batch_norm(
     use_batch_stats = training and not use_global_stats
 
     if use_batch_stats:
+        if (weight is not None and bias is not None
+                and os.environ.get("PADDLE_TPU_MANUAL_BN", "1") == "1"):
+            out, mean, var = apply_op(
+                lambda a, w, b: _bn_manual(a, w, b, ch_axis, reduce_axes,
+                                           epsilon),
+                x, weight, bias, multi_out=True)
+            if running_mean is not None:
+                running_mean._value = (momentum * running_mean._value
+                                       + (1.0 - momentum) * mean._value)
+                running_var._value = (momentum * running_var._value
+                                      + (1.0 - momentum) * var._value)
+            return out
         # compute batch stats; update running stats imperatively (momentum
         # semantics match the reference: r = m*r + (1-m)*batch)
         mean = apply_op(lambda a: jnp.mean(a, axis=reduce_axes), x)

@@ -308,6 +308,7 @@ class Executor:
                 params_raw = {uid: p._value for uid, p in param_items}
                 return fwd(feed_raw, params_raw)
 
+            self._last_jitted = fwd  # profiling/introspection handle
             return runner
 
         optimizer, loss_t = program._optimize
@@ -387,4 +388,5 @@ class Executor:
             opt._global_step += 1
             return outs
 
+        self._last_jitted = jitted  # profiling/introspection handle
         return runner

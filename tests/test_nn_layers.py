@@ -320,3 +320,49 @@ class TestTransformer:
         x = paddle.to_tensor(_rand(1, 4, 8), stop_gradient=False)
         layer(x).sum().backward()
         assert x.grad is not None
+
+
+class TestBatchNormManualVjp:
+    def test_grad_parity_with_autodiff(self):
+        """The manual BN backward must match autodiff of the plain
+        stats+normalize formulation for dx/dw/db (training mode)."""
+        import os
+
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.nn.functional.norm import _bn_manual
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(4, 3, 5, 5), jnp.float32)
+        w = jnp.asarray(rng.randn(3), jnp.float32)
+        b = jnp.asarray(rng.randn(3), jnp.float32)
+        axes, eps = (0, 2, 3), 1e-5
+
+        def ref(x_, w_, b_):
+            mu = jnp.mean(x_, axis=axes, keepdims=True)
+            var = jnp.var(x_, axis=axes, keepdims=True)
+            xh = (x_ - mu) * jax.lax.rsqrt(var + eps)
+            return xh * w_.reshape(1, 3, 1, 1) + b_.reshape(1, 3, 1, 1)
+
+        def man(x_, w_, b_):
+            return _bn_manual(x_, w_, b_, 1, axes, eps)[0]
+
+        cot = jnp.asarray(rng.randn(4, 3, 5, 5), jnp.float32)
+        om, vm = jax.vjp(man, x, w, b)
+        orf, vr = jax.vjp(ref, x, w, b)
+        np.testing.assert_allclose(np.asarray(om), np.asarray(orf),
+                                   rtol=1e-5, atol=1e-6)
+        for gm, gr, nme in zip(vm(cot), vr(cot), "xwb"):
+            np.testing.assert_allclose(np.asarray(gm), np.asarray(gr),
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=f"d{nme}")
+
+    def test_running_stats_updated(self):
+        paddle.seed(0)
+        bn = paddle.nn.BatchNorm2D(3)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 3, 5, 5).astype(np.float32))
+        bn.train()
+        bn(x)
+        assert not np.allclose(bn._mean.numpy(), 0.0)

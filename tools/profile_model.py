@@ -6,7 +6,7 @@ Mirrors the reference's profiler-driven tuning loop
 N steps with jax.profiler, parse the exported trace.json.gz, aggregate
 complete events on the TPU op lanes by fusion name.
 
-Usage: python tools/profile_model.py [--steps 5] [--top 40]
+Usage: python tools/profile_model.py [--model gpt|resnet] [--steps 5] [--top 40]
 """
 from __future__ import annotations
 
@@ -48,8 +48,43 @@ def build_step():
     return step, ids, labels
 
 
+def build_resnet_step():
+    """ResNet-50 static-Executor step (BENCH config #2), one callable."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    b, size = 64, 224
+    main = static.Program()
+    start = static.Program()
+    with static.program_guard(main, start):
+        x = static.data("x", [None, 3, size, size], "float32")
+        y = static.data("y", [None, 1], "int64")
+        model = resnet50(num_classes=1000)
+        with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
+            logits = model(x)
+            loss = paddle.nn.functional.cross_entropy(logits, y.reshape([-1]))
+        opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(start)
+    rng = np.random.RandomState(0)
+    xv = paddle.to_tensor(rng.randn(b, 3, size, size).astype(np.float32))
+    yv = paddle.to_tensor(rng.randint(0, 1000, (b, 1)).astype(np.int64))
+
+    def step(_i, _l):  # fetch is a Tensor (return_numpy=False): .numpy()
+        return exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss],
+                       return_numpy=False)[0]
+
+    return step, None, None
+
+
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gpt", choices=["gpt", "resnet"])
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--top", type=int, default=40)
     ap.add_argument("--logdir", default="/tmp/xplane_bench")
@@ -57,7 +92,8 @@ def main():
 
     import jax
 
-    step, ids, labels = build_step()
+    step, ids, labels = (build_step() if args.model == "gpt"
+                         else build_resnet_step())
     loss = step((ids,), (labels,))
     float(loss.numpy())  # block: materialize a scalar (block_until_ready lies)
 
