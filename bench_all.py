@@ -86,25 +86,30 @@ def bench_lenet():
             "value": round(sps, 2), "unit": "samples/sec"}
 
 
-def bench_resnet50():
+def build_resnet50_train(smoke=False):
+    """BENCH config #2's step, shared with tools/profile_model.py so the
+    profiler measures EXACTLY the benchmarked program. Returns
+    ``(step, batch_size)``; ``step(_)`` runs one Executor iteration and
+    returns the loss fetch (``return_numpy=False``: a numpy fetch would
+    block the device every step)."""
     import paddle_tpu as paddle
     from paddle_tpu import static
     from paddle_tpu.vision.models import resnet50
 
     paddle.seed(0)
-    b = 8 if SMOKE else 64
-    size = 32 if SMOKE else 224
+    b = 8 if smoke else 64
+    size = 32 if smoke else 224
     main = static.Program()
     start = static.Program()
     with static.program_guard(main, start):
         x = static.data("x", [None, 3, size, size], "float32")
         y = static.data("y", [None, 1], "int64")
-        model = resnet50(num_classes=100 if SMOKE else 1000)
+        model = resnet50(num_classes=100 if smoke else 1000)
         # static AMP O1: convs/matmuls recorded bf16, BN/softmax fp32
         # (the reference decorates the static optimizer with
         # mixed_precision.decorate; recording under auto_cast bakes the
         # same casts into the program). bf16 needs no loss scaling.
-        with paddle.amp.auto_cast(enable=not SMOKE, dtype="bfloat16"):
+        with paddle.amp.auto_cast(enable=not smoke, dtype="bfloat16"):
             logits = model(x)
             loss = paddle.nn.functional.cross_entropy(
                 logits, y.reshape([-1]))
@@ -117,15 +122,18 @@ def bench_resnet50():
     # (~40MB of images/step would measure the tunnel, not the chip); real
     # input pipelines keep batches device-side via double-buffered device_put
     xv = paddle.to_tensor(rng.randn(b, 3, size, size).astype(np.float32))
-    yv = paddle.to_tensor(rng.randint(0, 100, (b, 1)).astype(np.int64))
+    yv = paddle.to_tensor(
+        rng.randint(0, 100 if smoke else 1000, (b, 1)).astype(np.int64))
 
-    def one(i):
-        # return_numpy=False: a numpy fetch would BLOCK on the device every
-        # step (serializing dispatch with the host link's round-trip);
-        # _rate materializes once per window
+    def step(_i=None):
         return exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss],
                        return_numpy=False)[0]
 
+    return step, b
+
+
+def bench_resnet50():
+    one, b = build_resnet50_train(smoke=SMOKE)
     sps = _rate(one, 2, 3 if SMOKE else 20) * b
     out = {"metric": "resnet50_static_executor_samples_per_sec_per_chip",
            "value": round(sps, 2), "unit": "samples/sec"}

@@ -82,6 +82,17 @@ class Config:
         self._layer = layer
         self._input_spec = input_spec
 
+    # --- model decryption (reference: analysis_config cipher hooks over
+    # framework/io/crypto) ---
+    def set_cipher_key(self, key: bytes):
+        """AES key for an encrypted ``.pdexport`` (framework.io_crypto)."""
+        self._cipher_key = key
+
+    def set_cipher_key_file(self, path: str):
+        from ..framework.io_crypto import CipherUtils
+
+        self._cipher_key = CipherUtils.read_key_from_file(path)
+
 
 class _IOHandle:
     """Zero-copy tensor handle (reference: ZeroCopyTensor / get_input_handle)."""
@@ -130,8 +141,18 @@ class Predictor:
                 f"{export_path} not found — produce it with "
                 "paddle_tpu.jit.save(layer, prefix, input_spec=[...])"
             )
-        with open(export_path, "rb") as f:
-            blob = pickle.load(f)
+        from ..framework.io_crypto import AESCipher, is_encrypted
+
+        if is_encrypted(export_path):
+            key = getattr(self._config, "_cipher_key", None)
+            if key is None:
+                raise ValueError(
+                    f"{export_path} is encrypted; supply the key via "
+                    "Config.set_cipher_key(key) or set_cipher_key_file(path)")
+            blob = pickle.loads(AESCipher(key).decrypt_from_file(export_path))
+        else:
+            with open(export_path, "rb") as f:
+                blob = pickle.load(f)
         from jax import export as jax_export
 
         exported = jax_export.deserialize(blob["serialized"])

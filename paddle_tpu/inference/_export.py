@@ -68,7 +68,8 @@ def export_fn(closed_fn, shapes_dtypes):
 def write_pdexport(path_prefix: str, exported, input_names: List[str],
                    output_names: List[str],
                    in_specs: List[Tuple[list, str]],
-                   pinned_dynamic_dims: bool = False):
+                   pinned_dynamic_dims: bool = False,
+                   encrypt_key: bytes | None = None):
     d = os.path.dirname(path_prefix)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -79,6 +80,14 @@ def write_pdexport(path_prefix: str, exported, input_names: List[str],
         "in_specs": in_specs,
         "pinned_dynamic_dims": pinned_dynamic_dims,
     }
+    if encrypt_key is not None:
+        # at-rest protection (reference framework/io/crypto/aes_cipher.cc);
+        # loaders auto-detect the PDENC magic and require the key
+        from ..framework.io_crypto import AESCipher
+
+        AESCipher(encrypt_key).encrypt_to_file(
+            pickle.dumps(blob), path_prefix + ".pdexport")
+        return blob
     with open(path_prefix + ".pdexport", "wb") as f:
         pickle.dump(blob, f)
     return blob

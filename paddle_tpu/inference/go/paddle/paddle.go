@@ -1,0 +1,234 @@
+// Package paddle is the Go inference client for the paddle_tpu framework.
+//
+// Counterpart of the reference Go client (go/paddle/{config,predictor,
+// tensor,common}.go) rebuilt over THIS repo's C inference ABI
+// (paddle_tpu/inference/capi/pd_inference_api.h): the C library embeds the
+// Python/XLA runtime, so a Go service gets the same StableHLO-AOT predictor
+// the C API exposes. One file instead of four — the surface is compact
+// because the TPU runtime needs no GPU/IR-pass/MKLDNN knobs.
+//
+// Usage:
+//
+//	cfg := paddle.NewConfig()
+//	defer cfg.Destroy()
+//	cfg.SetModel("/models/resnet50_export", "")
+//	pred, err := paddle.NewPredictor(cfg)
+//	if err != nil { ... }
+//	defer pred.Destroy()
+//	in := pred.InputHandle(pred.InputNames()[0])
+//	defer in.Destroy()
+//	in.Reshape([]int32{1, 3, 224, 224})
+//	in.CopyFromFloat32(data)
+//	if err := pred.Run(); err != nil { ... }
+//	out := pred.OutputHandle(pred.OutputNames()[0])
+//	defer out.Destroy()
+//	logits := out.CopyToFloat32()
+package paddle
+
+// #cgo LDFLAGS: -L${SRCDIR}/../../capi/build -lpd_inference_c
+// #cgo CFLAGS: -I${SRCDIR}/../../capi
+// #include <stdlib.h>
+// #include "pd_inference_api.h"
+import "C"
+
+import (
+	"errors"
+	"unsafe"
+)
+
+// Config mirrors the reference AnalysisConfig (config.go): model location
+// plus the execution knobs the TPU runtime honors. GPU/IR knobs exist for
+// signature parity and are accepted as no-ops by the C layer.
+type Config struct {
+	c *C.PD_Config
+}
+
+func NewConfig() *Config {
+	return &Config{c: C.PD_ConfigCreate()}
+}
+
+func (cfg *Config) Destroy() {
+	if cfg.c != nil {
+		C.PD_ConfigDestroy(cfg.c)
+		cfg.c = nil
+	}
+}
+
+// SetModel points at a jit.save / save_inference_model export prefix.
+// paramsPath may be "" (single-artifact exports).
+func (cfg *Config) SetModel(modelPrefix, paramsPath string) {
+	m := C.CString(modelPrefix)
+	defer C.free(unsafe.Pointer(m))
+	var p *C.char
+	if paramsPath != "" {
+		p = C.CString(paramsPath)
+		defer C.free(unsafe.Pointer(p))
+	}
+	C.PD_ConfigSetModel(cfg.c, m, p)
+}
+
+func (cfg *Config) EnableUseGpu(memoryPoolMB uint64, deviceID int32) {
+	C.PD_ConfigEnableUseGpu(cfg.c, C.uint64_t(memoryPoolMB), C.int32_t(deviceID))
+}
+
+func (cfg *Config) DisableGpu() {
+	C.PD_ConfigDisableGpu(cfg.c)
+}
+
+func (cfg *Config) SetCpuMathLibraryNumThreads(n int32) {
+	C.PD_ConfigSetCpuMathLibraryNumThreads(cfg.c, C.int32_t(n))
+}
+
+func (cfg *Config) SwitchIrOptim(on bool) {
+	C.PD_ConfigSwitchIrOptim(cfg.c, cbool(on))
+}
+
+func (cfg *Config) EnableMemoryOptim(on bool) {
+	C.PD_ConfigEnableMemoryOptim(cfg.c, cbool(on))
+}
+
+// Predictor mirrors the reference Predictor (predictor.go) over the
+// pd_predictor C surface.
+type Predictor struct {
+	c *C.PD_Predictor
+}
+
+// NewPredictor builds a predictor from cfg. Unlike the reference (which
+// aborts the process on a bad model), failures surface as a Go error taken
+// from PD_GetLastError.
+func NewPredictor(cfg *Config) (*Predictor, error) {
+	p := C.PD_PredictorCreate(cfg.c)
+	if p == nil {
+		return nil, lastError("PD_PredictorCreate failed")
+	}
+	return &Predictor{c: p}, nil
+}
+
+func (p *Predictor) Destroy() {
+	if p.c != nil {
+		C.PD_PredictorDestroy(p.c)
+		p.c = nil
+	}
+}
+
+func (p *Predictor) InputNum() int  { return int(C.PD_PredictorGetInputNum(p.c)) }
+func (p *Predictor) OutputNum() int { return int(C.PD_PredictorGetOutputNum(p.c)) }
+
+func (p *Predictor) InputNames() []string {
+	names := make([]string, p.InputNum())
+	for i := range names {
+		names[i] = C.GoString(C.PD_PredictorGetInputName(p.c, C.size_t(i)))
+	}
+	return names
+}
+
+func (p *Predictor) OutputNames() []string {
+	names := make([]string, p.OutputNum())
+	for i := range names {
+		names[i] = C.GoString(C.PD_PredictorGetOutputName(p.c, C.size_t(i)))
+	}
+	return names
+}
+
+func (p *Predictor) InputHandle(name string) *Tensor {
+	n := C.CString(name)
+	defer C.free(unsafe.Pointer(n))
+	return &Tensor{c: C.PD_PredictorGetInputHandle(p.c, n)}
+}
+
+func (p *Predictor) OutputHandle(name string) *Tensor {
+	n := C.CString(name)
+	defer C.free(unsafe.Pointer(n))
+	return &Tensor{c: C.PD_PredictorGetOutputHandle(p.c, n)}
+}
+
+// Run executes the compiled forward; feed inputs first via CopyFrom*.
+func (p *Predictor) Run() error {
+	if C.PD_PredictorRun(p.c) == 0 {
+		return lastError("PD_PredictorRun failed")
+	}
+	return nil
+}
+
+// Tensor mirrors the reference ZeroCopyTensor (tensor.go) over pd_tensor:
+// reshape, host copies in/out, shape query.
+type Tensor struct {
+	c *C.PD_Tensor
+}
+
+func (t *Tensor) Destroy() {
+	if t.c != nil {
+		C.PD_TensorDestroy(t.c)
+		t.c = nil
+	}
+}
+
+func (t *Tensor) Reshape(shape []int32) {
+	C.PD_TensorReshape(t.c, C.size_t(len(shape)),
+		(*C.int32_t)(unsafe.Pointer(&shape[0])))
+}
+
+func (t *Tensor) Shape() []int32 {
+	nd := C.size_t(16)
+	buf := make([]int32, 16)
+	C.PD_TensorGetShape(t.c, &nd, (*C.int32_t)(unsafe.Pointer(&buf[0])))
+	return buf[:int(nd)]
+}
+
+func (t *Tensor) numel() int {
+	n := 1
+	for _, d := range t.Shape() {
+		n *= int(d)
+	}
+	return n
+}
+
+func (t *Tensor) CopyFromFloat32(data []float32) {
+	C.PD_TensorCopyFromCpuFloat(t.c, (*C.float)(unsafe.Pointer(&data[0])))
+}
+
+func (t *Tensor) CopyFromInt64(data []int64) {
+	C.PD_TensorCopyFromCpuInt64(t.c, (*C.int64_t)(unsafe.Pointer(&data[0])))
+}
+
+func (t *Tensor) CopyFromInt32(data []int32) {
+	C.PD_TensorCopyFromCpuInt32(t.c, (*C.int32_t)(unsafe.Pointer(&data[0])))
+}
+
+func (t *Tensor) CopyToFloat32() []float32 {
+	out := make([]float32, t.numel())
+	if len(out) > 0 {
+		C.PD_TensorCopyToCpuFloat(t.c, (*C.float)(unsafe.Pointer(&out[0])))
+	}
+	return out
+}
+
+func (t *Tensor) CopyToInt64() []int64 {
+	out := make([]int64, t.numel())
+	if len(out) > 0 {
+		C.PD_TensorCopyToCpuInt64(t.c, (*C.int64_t)(unsafe.Pointer(&out[0])))
+	}
+	return out
+}
+
+func (t *Tensor) CopyToInt32() []int32 {
+	out := make([]int32, t.numel())
+	if len(out) > 0 {
+		C.PD_TensorCopyToCpuInt32(t.c, (*C.int32_t)(unsafe.Pointer(&out[0])))
+	}
+	return out
+}
+
+func cbool(b bool) C.PD_Bool {
+	if b {
+		return 1
+	}
+	return 0
+}
+
+func lastError(fallback string) error {
+	if msg := C.PD_GetLastError(); msg != nil {
+		return errors.New(C.GoString(msg))
+	}
+	return errors.New(fallback)
+}

@@ -1,0 +1,46 @@
+// Smoke test for the Go inference client. Needs a model export:
+//
+//	python -c "import paddle_tpu as paddle, numpy as np; \
+//	  net = paddle.nn.Linear(4, 2); \
+//	  paddle.jit.save(paddle.jit.to_static(net), '/tmp/go_smoke/model', \
+//	                  input_spec=[paddle.static.InputSpec([1, 4], 'float32')])"
+//
+// then: PD_GO_SMOKE_MODEL=/tmp/go_smoke/model go test ./...
+package paddle
+
+import (
+	"os"
+	"testing"
+)
+
+func TestPredictorSmoke(t *testing.T) {
+	prefix := os.Getenv("PD_GO_SMOKE_MODEL")
+	if prefix == "" {
+		t.Skip("PD_GO_SMOKE_MODEL not set")
+	}
+	cfg := NewConfig()
+	defer cfg.Destroy()
+	cfg.SetModel(prefix, "")
+	pred, err := NewPredictor(cfg)
+	if err != nil {
+		t.Fatalf("NewPredictor: %v", err)
+	}
+	defer pred.Destroy()
+	if pred.InputNum() < 1 || pred.OutputNum() < 1 {
+		t.Fatalf("expected >=1 inputs/outputs, got %d/%d",
+			pred.InputNum(), pred.OutputNum())
+	}
+	in := pred.InputHandle(pred.InputNames()[0])
+	defer in.Destroy()
+	in.Reshape([]int32{1, 4})
+	in.CopyFromFloat32([]float32{1, 2, 3, 4})
+	if err := pred.Run(); err != nil {
+		t.Fatalf("Run: %v", err)
+	}
+	out := pred.OutputHandle(pred.OutputNames()[0])
+	defer out.Destroy()
+	vals := out.CopyToFloat32()
+	if len(vals) != 2 {
+		t.Fatalf("expected 2 outputs, got %d", len(vals))
+	}
+}

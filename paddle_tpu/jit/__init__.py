@@ -148,7 +148,11 @@ def save(layer, path, input_spec=None, **configs):
     if isinstance(layer, StaticFunction):
         layer = layer._layer
     state = layer.state_dict()
-    _save_state(state, path + ".pdiparams")
+    encrypt_key = configs.get("encrypt_key")
+    # with a key, EVERY artifact that reveals the model is protected:
+    # weights (.pdiparams), compiled program (.pdexport), and the StableHLO
+    # text is withheld from the plaintext metadata below
+    _save_state(state, path + ".pdiparams", cipher_key=encrypt_key)
     meta = {"class": type(layer).__name__}
     if input_spec:
         try:
@@ -185,8 +189,10 @@ def save(layer, path, input_spec=None, **configs):
                 path, exported, input_names,
                 [f"output{i}" for i in range(n_out)], in_specs,
                 pinned_dynamic_dims=pinned,
+                encrypt_key=encrypt_key,
             )
-            meta["stablehlo"] = exported.mlir_module()
+            if encrypt_key is None:
+                meta["stablehlo"] = exported.mlir_module()
             meta["in_specs"] = blob["in_specs"]
         except Exception as e:  # export is best-effort; state always saved
             meta["export_error"] = repr(e)
@@ -197,10 +203,12 @@ def save(layer, path, input_spec=None, **configs):
 def load(path, **configs):
     """Load a jit-saved model for inference: returns a predictor-like object
     exposing the saved state; pair with the original Layer class via
-    set_state_dict, or run through paddle_tpu.inference."""
+    set_state_dict, or run through paddle_tpu.inference.
+    ``configs['cipher_key']``: key for artifacts saved with encrypt_key."""
     from ..framework.io import load as _load_state
 
-    state = _load_state(path + ".pdiparams")
+    state = _load_state(path + ".pdiparams",
+                        cipher_key=configs.get("cipher_key"))
     meta = {}
     model_f = path + ".pdmodel"
     if os.path.exists(model_f):

@@ -29,18 +29,21 @@ def _bn_fwd_impl(a, w, b, ch_axis, axes, epsilon):
     shape[ch_axis] = a.shape[ch_axis]
     out = (((af - mu) * rstd).astype(a.dtype) * w.reshape(shape)
            + b.reshape(shape))
-    return (out, mu.reshape(-1), var.reshape(-1)), (a, w, b, mu, rstd)
+    return out, (a, w, b, mu, rstd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _bn_manual(a, w, b, ch_axis, axes, epsilon):
     """Training-mode affine BatchNorm with a hand-written backward.
 
-    Returns ``(out, batch_mean, batch_var)`` (stats feed the imperative
-    running-stat update). Same rationale as ``_ln_manual``: autodiff's
-    backward through the separate mean/var ops fuses poorly on TPU; the
-    manual rule recomputes xhat from the saved f32 stats and produces
-    dx/dw/db from one pass structure, with stats accumulated in f32."""
+    Same rationale as ``_ln_manual``: autodiff's backward through the
+    separate mean/var ops fuses poorly on TPU; the manual rule recomputes
+    xhat from the saved f32 stats and produces dx/dw/db from one pass
+    structure, with stats accumulated in f32. Batch stats for the
+    running-stat update are NOT outputs — the caller computes them as
+    separate grad-free reductions that CSE with this forward's own under
+    jit (stat cotangents would otherwise ride every backward as
+    unfoldable zero passes in eager mode)."""
     out, _ = _bn_fwd_impl(a, w, b, ch_axis, axes, epsilon)
     return out
 
@@ -49,32 +52,20 @@ def _bn_manual_fwd(a, w, b, ch_axis, axes, epsilon):
     return _bn_fwd_impl(a, w, b, ch_axis, axes, epsilon)
 
 
-def _bn_manual_bwd(ch_axis, axes, epsilon, res, cts):
+def _bn_manual_bwd(ch_axis, axes, epsilon, res, dy):
     a, w, b, mu, rstd = res
-    dy, dmu_ct, dvar_ct = cts
     af = a.astype(jnp.float32)
     xh = (af - mu) * rstd
     shape = [1] * a.ndim
     shape[ch_axis] = a.shape[ch_axis]
-    n = 1
-    for ax in axes:
-        n *= a.shape[ax]
     g = dy.astype(jnp.float32) * w.astype(jnp.float32).reshape(shape)
     c1 = jnp.mean(g, axis=axes, keepdims=True)
     c2 = jnp.mean(g * xh, axis=axes, keepdims=True)
-    dx = rstd * (g - c1 - xh * c2)
-    # cotangents of the returned batch stats (the running-stat update is
-    # imperative and sends none, but a caller differentiating through the
-    # stats outputs gets the exact terms)
-    if dmu_ct is not None:
-        dx = dx + dmu_ct.reshape(shape).astype(jnp.float32) / n
-    if dvar_ct is not None:
-        dx = dx + (dvar_ct.reshape(shape).astype(jnp.float32)
-                   * 2.0 * (af - mu) / n)
+    dx = (rstd * (g - c1 - xh * c2)).astype(a.dtype)
     dyf = dy.astype(jnp.float32)
     dw = jnp.sum(dyf * xh, axis=axes).astype(w.dtype)
     db = jnp.sum(dyf, axis=axes).astype(b.dtype)
-    return dx.astype(a.dtype), dw, db
+    return dx, dw, db
 
 
 _bn_manual.defvjp(_bn_manual_fwd, _bn_manual_bwd)
@@ -99,22 +90,16 @@ def batch_norm(
     use_batch_stats = training and not use_global_stats
 
     if use_batch_stats:
-        if (weight is not None and bias is not None
-                and os.environ.get("PADDLE_TPU_MANUAL_BN", "1") == "1"):
-            out, mean, var = apply_op(
-                lambda a, w, b: _bn_manual(a, w, b, ch_axis, reduce_axes,
-                                           epsilon),
-                x, weight, bias, multi_out=True)
-            if running_mean is not None:
-                running_mean._value = (momentum * running_mean._value
-                                       + (1.0 - momentum) * mean._value)
-                running_var._value = (momentum * running_var._value
-                                      + (1.0 - momentum) * var._value)
-            return out
-        # compute batch stats; update running stats imperatively (momentum
-        # semantics match the reference: r = m*r + (1-m)*batch)
-        mean = apply_op(lambda a: jnp.mean(a, axis=reduce_axes), x)
-        var = apply_op(lambda a: jnp.var(a, axis=reduce_axes), x)
+        manual = (weight is not None and bias is not None
+                  and os.environ.get("PADDLE_TPU_MANUAL_BN", "1") == "1")
+        # batch stats; update running stats imperatively (momentum
+        # semantics match the reference: r = m*r + (1-m)*batch). On the
+        # manual path these reductions CSE with _bn_manual's internal ones
+        # under jit (identical expressions over the same operand).
+        stat_in = ((lambda a: a.astype(jnp.float32)) if manual
+                   else (lambda a: a))
+        mean = apply_op(lambda a: jnp.mean(stat_in(a), axis=reduce_axes), x)
+        var = apply_op(lambda a: jnp.var(stat_in(a), axis=reduce_axes), x)
         if running_mean is not None:
             running_mean._value = (
                 momentum * running_mean._value + (1.0 - momentum) * mean._value
@@ -122,6 +107,11 @@ def batch_norm(
             running_var._value = (
                 momentum * running_var._value + (1.0 - momentum) * var._value
             )
+        if manual:
+            return apply_op(
+                lambda a, w, b: _bn_manual(a, w, b, ch_axis, reduce_axes,
+                                           epsilon),
+                x, weight, bias)
     else:
         mean, var = running_mean, running_var
 
@@ -212,8 +202,12 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
             from paddle_tpu.ops.fused import fused_layer_norm
 
             return fused_layer_norm(a, wb[0], wb[1], epsilon)
+        # opt-in per workload: measured +2.2% end-to-end on GPT-2 345M
+        # (bench.py sets it) but -24% on BERT-base under the fleet engine —
+        # the custom_vjp blocks a fusion BERT's step depends on (isolated
+        # microbenches win at BOTH shapes; the effect is context-specific)
         if (len(axes) == 1 and weight is not None and bias is not None
-                and os.environ.get("PADDLE_TPU_MANUAL_LN", "1") == "1"):
+                and os.environ.get("PADDLE_TPU_MANUAL_LN", "0") == "1"):
             return _ln_manual(a, wb[0], wb[1], epsilon)
         mean = jnp.mean(a, axis=axes, keepdims=True)
         var = jnp.var(a, axis=axes, keepdims=True)

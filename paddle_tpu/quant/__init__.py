@@ -76,8 +76,105 @@ class QuantConfig:
         self.weight_quantize_type = weight_quantize_type
 
 
+class HistogramObserver:
+    """Host-side |x| histogram across calibration batches (reference
+    post_training_quantization.py 'hist'/'KL' collection): a fixed bin
+    count over a GROWING range — when a batch exceeds the seen max, the
+    accumulated histogram is redistributed into the wider bins
+    proportionally, so earlier batches keep contributing. Calibration is
+    offline, so numpy on host is the honest tool (one device fetch per
+    batch per observer)."""
+
+    def __init__(self, bins=2048):
+        self.bins = bins
+        self.hist = np.zeros(bins, np.float64)
+        self.amax = 0.0
+
+    def update(self, x: np.ndarray):
+        ax = np.abs(np.asarray(x, np.float32)).ravel()
+        amax = float(ax.max()) if ax.size else 0.0
+        if amax == 0.0 and self.amax == 0.0:
+            return
+        if amax > self.amax:
+            if self.amax > 0.0 and self.hist.sum() > 0:
+                # stretch old bins into the new range: old bin i covers
+                # [i, i+1)*old_w; spread its mass over the new bins it maps to
+                old_edges = np.linspace(0, self.amax, self.bins + 1)
+                new_hist = np.zeros(self.bins, np.float64)
+                pos = old_edges / amax * self.bins  # old edges in new-bin units
+                for i in range(self.bins):
+                    lo, hi = pos[i], pos[i + 1]
+                    j0, j1 = int(lo), min(int(np.ceil(hi)) - 1, self.bins - 1)
+                    if j0 == j1:
+                        new_hist[j0] += self.hist[i]
+                    else:  # split proportionally across covered new bins
+                        span = hi - lo
+                        for j in range(j0, j1 + 1):
+                            seg = min(hi, j + 1) - max(lo, j)
+                            new_hist[j] += self.hist[i] * seg / span
+                self.hist = new_hist
+            self.amax = amax
+        h, _ = np.histogram(ax, bins=self.bins, range=(0.0, self.amax))
+        self.hist += h
+
+    def scale_abs_max(self, bits=8):
+        # 1e-8 floor matches _absmax_scale: an all-zero calibration stream
+        # must not write a zero scale into the converted model (div-by-zero
+        # at inference)
+        return max(self.amax / (2 ** (bits - 1) - 1), 1e-8)
+
+    def scale_hist(self, percentile=0.99999, bits=8):
+        """Reference 'hist' algo: threshold at the |x| percentile."""
+        total = self.hist.sum()
+        if total == 0:
+            return self.scale_abs_max(bits)
+        cum = np.cumsum(self.hist) / total
+        idx = int(np.searchsorted(cum, percentile))
+        thr = (idx + 0.5) / self.bins * self.amax
+        return max(thr / (2 ** (bits - 1) - 1), 1e-8)
+
+    def scale_kl(self, bits=8):
+        """TensorRT-style KL calibration (reference 'KL' algo,
+        post_training_quantization.py cal_kl_threshold): sweep clip
+        thresholds, quantize the clipped distribution to 2^(bits-1) levels,
+        keep the threshold minimizing KL(P||Q)."""
+        levels = 2 ** (bits - 1)  # 128 for int8
+        total = self.hist.sum()
+        if total == 0:
+            return self.scale_abs_max(bits)
+        best_kl, best_i = np.inf, self.bins
+        hist = self.hist / total
+        for i in range(levels, self.bins + 1):
+            p = hist[:i].copy()
+            p[i - 1] += hist[i:].sum()  # clip tail mass into the edge
+            if p.sum() == 0:
+                continue
+            # quantize the i bins down to `levels` DISJOINT buckets, then
+            # expand back (overlapping ranges would let a later bucket
+            # overwrite the shared boundary bin and lose its mass)
+            edges = [int(round(j * i / levels)) for j in range(levels + 1)]
+            q = np.zeros(i)
+            for j in range(levels):
+                lo, hi = edges[j], edges[j + 1]
+                mass = hist[lo:hi].sum()
+                nz = np.count_nonzero(hist[lo:hi])
+                if nz:
+                    q[lo:hi] = np.where(hist[lo:hi] > 0, mass / nz, 0)
+            pn, qn = p / p.sum(), q / q.sum() if q.sum() else q
+            mask = (pn > 0) & (qn > 0)
+            if not mask.any():
+                continue
+            kl = float(np.sum(pn[mask] * np.log(pn[mask] / qn[mask])))
+            if kl < best_kl:
+                best_kl, best_i = kl, i
+        thr = (best_i + 0.5) / self.bins * self.amax
+        return max(thr / (levels - 1), 1e-8)
+
+
 class FakeQuantDequant(Layer):
-    """Activation observer + fake-quant (moving_average_abs_max parity)."""
+    """Activation observer + fake-quant (moving_average_abs_max parity).
+    An attached ``HistogramObserver`` (PTQ 'hist'/'KL'/'abs_max' algos)
+    additionally collects the |x| distribution during calibration."""
 
     def __init__(self, bits=8, ema_decay=0.99):
         super().__init__()
@@ -86,9 +183,12 @@ class FakeQuantDequant(Layer):
         self.scale = self.register_buffer(
             "scale", Tensor(np.asarray(1.0, np.float32)))
         self._seen = False  # first batch seeds the scale; then EMA
+        self.observer: HistogramObserver | None = None
 
     def forward(self, x):
         if self.training:
+            if self.observer is not None:
+                self.observer.update(np.asarray(x.numpy()))
             cur = apply_op(lambda a: _absmax_scale(a, self.bits), x)
             if not self._seen:
                 new_scale = cur
@@ -322,11 +422,32 @@ def convert(model: Layer) -> Layer:
 
 class PostTrainingQuantization:
     """PTQ (parity: PostTrainingQuantization in slim): calibrate activation
-    ranges on sample data with observers, then produce the converted model."""
+    ranges on sample data with observers, then produce the converted model.
 
-    def __init__(self, model: Layer, config: QuantConfig | None = None):
+    ``algo`` selects the activation-scale calibration (reference
+    post_training_quantization.py):
+    - ``'avg'`` (default): moving-average abs-max observer (EMA);
+    - ``'abs_max'``: global max over all calibration batches;
+    - ``'hist'``: percentile threshold of the |x| histogram
+      (``hist_percent``);
+    - ``'KL'``: TensorRT-style KL-divergence threshold sweep.
+    """
+
+    def __init__(self, model: Layer, config: QuantConfig | None = None,
+                 algo: str = "avg", hist_percent: float = 0.99999,
+                 hist_bins: int = 2048):
+        if algo not in ("avg", "abs_max", "hist", "KL", "kl"):
+            raise ValueError(f"unknown PTQ algo {algo!r}")
         self.config = config or QuantConfig(ema_decay=0.9)
+        self.algo = "KL" if algo == "kl" else algo
+        self.hist_percent = hist_percent
         self.model = quant_aware(model, self.config)
+        self._observers: list[tuple[FakeQuantDequant, HistogramObserver]] = []
+        if self.algo != "avg":
+            for layer in self.model.sublayers(include_self=True):
+                if isinstance(layer, FakeQuantDequant):
+                    layer.observer = HistogramObserver(bins=hist_bins)
+                    self._observers.append((layer, layer.observer))
 
     def calibrate(self, data_iter, num_batches=10):
         self.model.train()  # observers update in training mode
@@ -339,4 +460,14 @@ class PostTrainingQuantization:
         return self
 
     def quantize(self) -> Layer:
+        for fq, obs in self._observers:
+            bits = fq.bits
+            if self.algo == "abs_max":
+                s = obs.scale_abs_max(bits)
+            elif self.algo == "hist":
+                s = obs.scale_hist(self.hist_percent, bits)
+            else:  # KL
+                s = obs.scale_kl(bits)
+            fq.scale.set_value(Tensor(np.asarray(s, np.float32)))
+            fq.observer = None  # calibration done; drop host state
         return convert(self.model)

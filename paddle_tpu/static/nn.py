@@ -36,7 +36,7 @@ __all__ = ["fc", "conv2d", "batch_norm", "embedding", "cond", "case",
            "sequence_pool", "sequence_softmax", "sequence_reverse",
            "sequence_expand", "sequence_expand_as", "sequence_concat",
            "sequence_first_step", "sequence_last_step", "sequence_slice",
-           "sequence_enumerate"]
+           "sequence_enumerate", "bilinear_tensor_product", "conv_shift"]
 
 
 def _make_param(shape, attr, is_bias, dtype="float32"):
@@ -102,3 +102,44 @@ def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
               dtype="float32"):
     w = _make_param(list(size), param_attr, False, dtype)
     return F.embedding(input, w, padding_idx=padding_idx, sparse=is_sparse)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    """out_i = x · W_i · yᵀ for i in [0, size) — parity with
+    fluid.layers.bilinear_tensor_product
+    (/root/reference/python/paddle/fluid/layers/nn.py:13159,
+    bilinear_tensor_product_op.cc). One batched einsum on the MXU via
+    F.bilinear; W is [size, M, N], bias [1, size]."""
+    m, n = int(x.shape[-1]), int(y.shape[-1])
+    w = _make_param([size, m, n], param_attr, False)
+    b = _make_param([1, size], bias_attr, True)
+    out = F.bilinear(x, y, w, b)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv_shift(x, y, name=None):
+    """Circular convolution (correlation) of two batched vectors — parity
+    with fluid.layers.conv_shift
+    (/root/reference/paddle/fluid/operators/conv_shift_op.cc):
+    ``out[b, i] = sum_j x[b, (i + j - (N-1)//2) mod M] * y[b, j]`` for
+    x:[B, M], y:[B, N] with odd N <= M. Expressed as one gather +
+    contraction (static index matrix, no mod arithmetic on device)."""
+    import jax.numpy as jnp
+
+    from ..core.enforce import InvalidArgumentError, enforce
+    from ..core.tensor import apply_op
+
+    M, N = int(x.shape[-1]), int(y.shape[-1])
+    enforce(N % 2 == 1, "conv_shift: y width must be odd")
+    enforce(N <= M, "conv_shift: y wider than x")
+    half = (N - 1) // 2
+    idx = (np.arange(M)[:, None] + np.arange(N)[None, :] - half) % M  # [M, N]
+
+    def f(a, b):
+        gathered = a[:, idx]              # [B, M, N]
+        return jnp.einsum("bmn,bn->bm", gathered, b)
+
+    return apply_op(f, x, y)

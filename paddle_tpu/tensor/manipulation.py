@@ -12,7 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.enforce import InvalidArgumentError, enforce
-from ..core.tensor import Tensor, apply_op, to_tensor, wrap_raw
+from ..core.tensor import Tensor, _is_tracer, apply_op, to_tensor, wrap_raw
 
 __all__ = [
     "reshape", "reshape_", "transpose", "flatten", "squeeze", "squeeze_",
@@ -23,7 +23,7 @@ __all__ = [
     "unbind", "unique", "unique_consecutive", "pad", "repeat_interleave",
     "take_along_axis", "put_along_axis", "moveaxis", "swapaxes", "unstack",
     "flip", "cast", "crop", "tensordot", "as_complex", "as_real", "tolist",
-    "nonzero", "index_sample", "masked_fill", "shard_index",
+    "nonzero", "index_sample", "masked_fill", "shard_index", "multiplex",
 ]
 
 
@@ -498,3 +498,29 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         return jnp.where(in_shard, a % shard_size, ignore_value)
 
     return apply_op(f, _t(input))
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select across ``m`` same-shaped tensors: ``out[i] =
+    inputs[index[i]][i]``. Parity: paddle.multiplex
+    (/root/reference/python/paddle/fluid/layers/nn.py:5722, multiplex_op.cc).
+    One stacked gather — XLA lowers it to a select chain over static
+    shapes, no host loop."""
+    enforce(len(inputs) >= 2,
+            "multiplex needs at least 2 input tensors")
+    ts = [_t(x) for x in inputs]
+    idx = _t(index)
+    # reject out-of-range indices when concrete (the reference multiplex_op
+    # errors; jax gather would silently CLAMP to the last input)
+    if not _is_tracer(idx._value):
+        iv = np.asarray(idx._value).reshape(-1)
+        enforce(iv.size == 0 or (0 <= iv.min() and iv.max() < len(inputs)),
+                f"multiplex: index out of range [0, {len(inputs)})")
+
+    def f(ix, *xs):
+        stacked = jnp.stack(xs, axis=0)            # [m, d0, ...]
+        ix = ix.reshape(-1).astype(jnp.int32)      # [d0] (accepts [d0,1])
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[ix, rows]
+
+    return apply_op(f, idx, *ts)

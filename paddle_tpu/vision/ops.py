@@ -23,7 +23,7 @@ from ..core.tensor import Tensor, apply_op
 
 __all__ = [
     "yolo_box", "prior_box", "box_coder", "multiclass_nms", "roi_align",
-    "iou_similarity", "box_iou", "psroi_pool", "deform_conv2d",
+    "iou_similarity", "box_iou", "psroi_pool", "deform_conv2d", "spp",
 ]
 
 
@@ -571,3 +571,26 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     if mask is not None:
         args.append(_t(mask))
     return apply_op(f, *args)
+
+
+def spp(x, pyramid_height=3, pooling_type="max", name=None):
+    """Spatial pyramid pooling — parity with the reference spp op
+    (/root/reference/paddle/fluid/operators/spp_op.cc, spp_op.h): level p
+    adaptively pools x:[N, C, H, W] to a [2^p, 2^p] grid, levels are
+    flattened and concatenated to [N, C * (4^height - 1) / 3]. Every level
+    is a static-shape adaptive pool, so the whole pyramid compiles to one
+    fused XLA program."""
+    from ..core.enforce import InvalidArgumentError, enforce
+    from ..nn import functional as F
+    from ..tensor.manipulation import concat, flatten
+
+    enforce(pooling_type in ("max", "avg"),
+            f"spp: unknown pooling_type {pooling_type!r}")
+    pool = (F.adaptive_max_pool2d if pooling_type == "max"
+            else F.adaptive_avg_pool2d)
+    x = _t(x)
+    outs = []
+    for p in range(int(pyramid_height)):
+        bins = 2 ** p
+        outs.append(flatten(pool(x, bins), start_axis=1))
+    return concat(outs, axis=1)

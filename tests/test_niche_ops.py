@@ -1,0 +1,175 @@
+"""The residual fluid op tail (VERDICT r3 Missing #6) + model encryption:
+multiplex, bilinear_tensor_product, conv_shift, spp — numpy goldens — and
+AES-GCM .pdexport protection (reference framework/io/crypto/aes_cipher.cc).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestMultiplex:
+    def test_golden(self):
+        """Reference example (fluid/layers/nn.py:5722 docstring)."""
+        i0 = np.array([[0, 0, 3, 4], [0, 1, 3, 4], [0, 2, 4, 4],
+                       [0, 3, 3, 4]], np.float32)
+        i1 = np.array([[1, 0, 3, 4], [1, 1, 7, 8], [1, 2, 4, 2],
+                       [1, 3, 3, 4]], np.float32)
+        idx = np.array([[1], [0], [1], [0]], np.int32)
+        out = paddle.multiplex([paddle.to_tensor(i0), paddle.to_tensor(i1)],
+                               paddle.to_tensor(idx))
+        want = np.stack([i1[0], i0[1], i1[2], i0[3]])
+        np.testing.assert_allclose(out.numpy(), want)
+
+    def test_grad_routes_to_selected_rows(self):
+        a = paddle.to_tensor(np.ones((3, 2), np.float32),
+                             stop_gradient=False)
+        b = paddle.to_tensor(np.ones((3, 2), np.float32) * 2,
+                             stop_gradient=False)
+        idx = paddle.to_tensor(np.array([0, 1, 0], np.int32))
+        out = paddle.multiplex([a, b], idx)
+        out.backward()
+        np.testing.assert_allclose(a.grad.numpy(),
+                                   [[1, 1], [0, 0], [1, 1]])
+        np.testing.assert_allclose(b.grad.numpy(),
+                                   [[0, 0], [1, 1], [0, 0]])
+
+    def test_rejects_single_input(self):
+        with pytest.raises(Exception):
+            paddle.multiplex([paddle.to_tensor(np.ones((2, 2)))],
+                             paddle.to_tensor(np.zeros(2, np.int32)))
+
+
+class TestBilinearTensorProduct:
+    def test_matches_manual_einsum(self):
+        paddle.seed(0)
+        main = paddle.static.Program()
+        start = paddle.static.Program()
+        rng = np.random.RandomState(0)
+        xv = rng.randn(3, 5).astype(np.float32)
+        yv = rng.randn(3, 4).astype(np.float32)
+        with paddle.static.program_guard(main, start):
+            x = paddle.static.data("x", [None, 5], "float32")
+            y = paddle.static.data("y", [None, 4], "float32")
+            out = paddle.static.nn.bilinear_tensor_product(x, y, size=7)
+        exe = paddle.static.Executor()
+        exe.run(start)
+        res = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[out])[0]
+        assert res.shape == (3, 7)
+        # recompute with the created parameters
+        params = list(main.parameters.values())
+        w = next(p for p in params if p.ndim == 3).numpy()
+        b = next(p for p in params if p.ndim == 2).numpy()
+        want = np.einsum("bm,imn,bn->bi", xv, w, yv) + b
+        np.testing.assert_allclose(res, want, rtol=1e-5, atol=1e-5)
+
+
+class TestConvShift:
+    def test_golden_circular(self):
+        """out[b,i] = sum_j x[b,(i+j-half) mod M] * y[b,j]
+        (conv_shift_op.cc:153-158)."""
+        rng = np.random.RandomState(0)
+        B, M, N = 2, 6, 3
+        xv = rng.randn(B, M).astype(np.float32)
+        yv = rng.randn(B, N).astype(np.float32)
+        out = paddle.static.nn.conv_shift(paddle.to_tensor(xv),
+                                          paddle.to_tensor(yv))
+        half = (N - 1) // 2
+        want = np.zeros((B, M), np.float32)
+        for b in range(B):
+            for i in range(M):
+                for j in range(N):
+                    want[b, i] += xv[b, (i + j - half + M) % M] * yv[b, j]
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-5, atol=1e-6)
+
+    def test_even_width_rejected(self):
+        with pytest.raises(Exception):
+            paddle.static.nn.conv_shift(
+                paddle.to_tensor(np.ones((1, 6), np.float32)),
+                paddle.to_tensor(np.ones((1, 4), np.float32)))
+
+
+class TestSpp:
+    def test_shapes_and_max_golden(self):
+        """[N,C,H,W] -> [N, C*(4^h-1)/3]; level 0 equals the global max
+        (spp_op.h pyramid loop)."""
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        out = paddle.vision.ops.spp(paddle.to_tensor(x), pyramid_height=3)
+        assert tuple(out.shape) == (2, 3 * (1 + 4 + 16))
+        np.testing.assert_allclose(out.numpy()[:, :3],
+                                   x.max(axis=(2, 3)), rtol=1e-6)
+
+    def test_avg_level1_golden(self):
+        x = np.arange(2 * 1 * 4 * 4, dtype=np.float32).reshape(2, 1, 4, 4)
+        out = paddle.vision.ops.spp(paddle.to_tensor(x), pyramid_height=2,
+                                    pooling_type="avg")
+        # level 1: 2x2 grid of 2x2 averages
+        want_l1 = x.reshape(2, 1, 2, 2, 2, 2).mean(axis=(3, 5)).reshape(2, 4)
+        np.testing.assert_allclose(out.numpy()[:, 1:], want_l1, rtol=1e-6)
+
+    def test_bad_pool_type(self):
+        with pytest.raises(Exception):
+            paddle.vision.ops.spp(
+                paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32)),
+                pooling_type="median")
+
+
+class TestModelEncryption:
+    def test_cipher_roundtrip_and_tamper(self):
+        from paddle_tpu.framework.io_crypto import AESCipher, CipherUtils
+
+        key = CipherUtils.gen_key()
+        c = AESCipher(key)
+        blob = c.encrypt(b"secret weights")
+        assert c.decrypt(blob) == b"secret weights"
+        bad = blob[:-1] + bytes([blob[-1] ^ 1])
+        with pytest.raises(Exception):
+            c.decrypt(bad)
+        with pytest.raises(Exception):
+            AESCipher(CipherUtils.gen_key()).decrypt(blob)  # wrong key
+
+    def test_key_file_roundtrip(self, tmp_path):
+        from paddle_tpu.framework.io_crypto import CipherUtils
+
+        p = str(tmp_path / "k.bin")
+        key = CipherUtils.gen_key_to_file(p)
+        assert CipherUtils.read_key_from_file(p) == key
+
+    def test_encrypted_export_predictor_roundtrip(self, tmp_path):
+        from paddle_tpu.framework.io_crypto import CipherUtils, is_encrypted
+        from paddle_tpu.inference import Config, create_predictor
+
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 2)
+        key = CipherUtils.gen_key()
+        prefix = str(tmp_path / "enc_model")
+        paddle.jit.save(
+            paddle.jit.to_static(net), prefix,
+            input_spec=[paddle.static.InputSpec([1, 4], "float32")],
+            encrypt_key=key)
+        assert is_encrypted(prefix + ".pdexport")
+        assert is_encrypted(prefix + ".pdiparams")  # weights protected too
+        with open(prefix + ".pdmodel", "rb") as f:
+            meta_bytes = f.read()
+        assert b"stablehlo" not in meta_bytes  # program text withheld
+        # state loads back with the key, refuses without
+        state = paddle.jit.load(prefix, cipher_key=key).state_dict()
+        assert "weight" in state
+        with pytest.raises(ValueError, match="encrypted"):
+            paddle.jit.load(prefix)
+
+        cfg = Config(prefix)
+        with pytest.raises(ValueError, match="encrypted"):
+            create_predictor(cfg)
+
+        cfg2 = Config(prefix)
+        cfg2.set_cipher_key(key)
+        pred = create_predictor(cfg2)
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.reshape([1, 4])
+        h.copy_from_cpu(np.ones((1, 4), np.float32))
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        want = net(paddle.to_tensor(np.ones((1, 4), np.float32))).numpy()
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)

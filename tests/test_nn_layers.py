@@ -346,7 +346,7 @@ class TestBatchNormManualVjp:
             return xh * w_.reshape(1, 3, 1, 1) + b_.reshape(1, 3, 1, 1)
 
         def man(x_, w_, b_):
-            return _bn_manual(x_, w_, b_, 1, axes, eps)[0]
+            return _bn_manual(x_, w_, b_, 1, axes, eps)
 
         cot = jnp.asarray(rng.randn(4, 3, 5, 5), jnp.float32)
         om, vm = jax.vjp(man, x, w, b)

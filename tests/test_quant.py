@@ -235,3 +235,69 @@ class TestConvQuant:
         assert out.shape == ref.shape
         scale = np.abs(ref).max()
         assert np.abs(out - ref).max() / scale < 0.15
+
+
+class TestPTQCalibrationAlgos:
+    def _mk(self):
+        paddle.seed(0)
+        return paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                                    paddle.nn.ReLU(),
+                                    paddle.nn.Linear(16, 4))
+
+    def _data(self, n=6):
+        rng = np.random.RandomState(0)
+        return [paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+                for _ in range(n)]
+
+    @pytest.mark.parametrize("algo", ["abs_max", "hist", "KL"])
+    def test_algo_quantizes_and_runs(self, algo):
+        from paddle_tpu.quant import PostTrainingQuantization
+
+        ptq = PostTrainingQuantization(self._mk(), algo=algo)
+        ptq.calibrate(self._data(), num_batches=6)
+        q = ptq.quantize()
+        out = q(self._data(1)[0])
+        assert np.isfinite(out.numpy()).all()
+
+    def test_hist_tighter_than_abs_max_with_outlier(self):
+        """One extreme outlier batch: the histogram percentile threshold
+        must sit far below the global abs-max scale (the point of hist/KL
+        calibration)."""
+        from paddle_tpu.quant import HistogramObserver
+
+        rng = np.random.RandomState(0)
+        obs = HistogramObserver()
+        for _ in range(10):
+            obs.update(rng.randn(1024).astype(np.float32))
+        spike = np.zeros(1024, np.float32)
+        spike[0] = 1000.0
+        obs.update(spike)
+        assert obs.scale_hist(0.999) < 0.1 * obs.scale_abs_max()
+
+    def test_kl_reasonable_on_gaussian(self):
+        from paddle_tpu.quant import HistogramObserver
+
+        rng = np.random.RandomState(0)
+        obs = HistogramObserver()
+        for _ in range(10):
+            obs.update(rng.randn(4096).astype(np.float32))
+        s_kl = obs.scale_kl()
+        s_max = obs.scale_abs_max()
+        assert 0.05 * s_max < s_kl <= 1.05 * s_max
+
+    def test_histogram_rebinning_preserves_mass(self):
+        from paddle_tpu.quant import HistogramObserver
+
+        rng = np.random.RandomState(0)
+        obs = HistogramObserver(bins=64)
+        a = rng.randn(512).astype(np.float32)
+        obs.update(a)
+        b = (rng.randn(512) * 10).astype(np.float32)  # forces re-binning
+        obs.update(b)
+        np.testing.assert_allclose(obs.hist.sum(), 1024, rtol=1e-6)
+
+    def test_unknown_algo_rejected(self):
+        from paddle_tpu.quant import PostTrainingQuantization
+
+        with pytest.raises(ValueError):
+            PostTrainingQuantization(self._mk(), algo="mse2")

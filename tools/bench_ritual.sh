@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# The round's model-benchmark ritual — the counterpart of the reference's
+# tools/test_model_benchmark.sh CI loop:
+#   1. snapshot the previous round's BENCH_extra.json
+#   2. re-measure every config (bench_all.py, real backend)
+#   3. GATE: fail (exit 8) if any config regressed >5% vs the snapshot
+# Run from the repo root on the bench rig:  bash tools/bench_ritual.sh
+set -e
+cd "$(dirname "$0")/.."
+
+if [ -f BENCH_extra.json ]; then
+  cp BENCH_extra.json BENCH_extra.prev.json
+  echo "snapshotted previous results to BENCH_extra.prev.json"
+fi
+
+python bench_all.py "$@"
+
+if [ -f BENCH_extra.prev.json ]; then
+  # LeNet is EAGER per-op dispatch through the remote-TPU tunnel: measured
+  # run-to-run jitter is +-20% in one process (RPC latency, not the chip),
+  # so its gate tolerance is wider than the compiled configs'
+  python tools/check_model_benchmark_result.py BENCH_extra.prev.json \
+    BENCH_extra.json --tol 0.05 \
+    --tol-override lenet_mnist_dygraph_samples_per_sec=0.3
+  echo "model benchmark gate: PASS"
+else
+  echo "model benchmark gate: no previous snapshot, first run recorded"
+fi

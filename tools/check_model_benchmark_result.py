@@ -31,7 +31,15 @@ def main():
     ap.add_argument("candidate")
     ap.add_argument("--tol", type=float, default=0.05,
                     help="max allowed fractional throughput drop")
+    ap.add_argument("--tol-override", action="append", default=[],
+                    metavar="METRIC=TOL",
+                    help="per-metric tolerance (e.g. a dispatch-bound eager "
+                         "config whose run-to-run jitter exceeds the default)")
     args = ap.parse_args()
+    overrides = {}
+    for ov in args.tol_override:
+        k, _, v = ov.partition("=")
+        overrides[k] = float(v)
     base = _index(args.baseline)
     cand = _index(args.candidate)
     failures = []
@@ -48,8 +56,9 @@ def main():
             print(f"[check_model_benchmark] skip     {name} (backend "
                   f"{b.get('backend')} vs {c.get('backend')})")
             continue
+        tol = overrides.get(name, args.tol)
         ratio = c["value"] / max(b["value"], 1e-9)
-        tag = ("REGRESS " if ratio < 1.0 - args.tol
+        tag = ("REGRESS " if ratio < 1.0 - tol
                else ("improve " if ratio > 1.05 else "same    "))
         extra = ""
         if "mfu_pct" in c:
@@ -57,7 +66,7 @@ def main():
         print(f"[check_model_benchmark] {tag} {name:46s} "
               f"{b['value']:10.2f} -> {c['value']:10.2f} {c.get('unit', '')}"
               f"  x{ratio:.3f}{extra}")
-        if ratio < 1.0 - args.tol:
+        if ratio < 1.0 - tol:
             failures.append(name)
     if failures:
         print(f"[check_model_benchmark] FAILED: {len(failures)} "

@@ -21,6 +21,11 @@ import time
 
 
 def build_step():
+    import os
+
+    # mirror bench.py's workload knobs so the profiler measures the same
+    # program the headline bench runs
+    os.environ.setdefault("PADDLE_TPU_MANUAL_LN", "1")
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -49,37 +54,18 @@ def build_step():
 
 
 def build_resnet_step():
-    """ResNet-50 static-Executor step (BENCH config #2), one callable."""
-    import numpy as np
+    """ResNet-50 static-Executor step — IMPORTS the benchmark's own builder
+    (bench_all.build_resnet50_train) so the profiler measures exactly the
+    program BENCH config #2 runs."""
+    import os
+    import sys
 
-    import paddle_tpu as paddle
-    from paddle_tpu import static
-    from paddle_tpu.vision.models import resnet50
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench_all import build_resnet50_train
 
-    paddle.seed(0)
-    b, size = 64, 224
-    main = static.Program()
-    start = static.Program()
-    with static.program_guard(main, start):
-        x = static.data("x", [None, 3, size, size], "float32")
-        y = static.data("y", [None, 1], "int64")
-        model = resnet50(num_classes=1000)
-        with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
-            logits = model(x)
-            loss = paddle.nn.functional.cross_entropy(logits, y.reshape([-1]))
-        opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
-        opt.minimize(loss)
-    exe = static.Executor()
-    exe.run(start)
-    rng = np.random.RandomState(0)
-    xv = paddle.to_tensor(rng.randn(b, 3, size, size).astype(np.float32))
-    yv = paddle.to_tensor(rng.randint(0, 1000, (b, 1)).astype(np.int64))
-
-    def step(_i, _l):  # fetch is a Tensor (return_numpy=False): .numpy()
-        return exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss],
-                       return_numpy=False)[0]
-
-    return step, None, None
+    step, _b = build_resnet50_train(smoke=False)
+    return (lambda _i, _l: step()), None, None
 
 
 def main():
