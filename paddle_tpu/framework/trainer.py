@@ -1,0 +1,145 @@
+"""Multithread trainer / device-worker hierarchy — parity with the
+reference's trainer stack (/root/reference/paddle/fluid/framework/trainer.h:52
+MultiTrainer + device_worker.h HogwildWorker, driven by
+Executor.train_from_dataset).
+
+TPU-first split of responsibilities:
+
+- On the COMPILED path (static Program on the accelerator) the reference's
+  reason for N device threads — per-thread op interpretation — is subsumed
+  by XLA: one chip runs one fused step at a time. What still parallelizes
+  is the HOST side, which is exactly what the reference's DataFeed threads
+  buy: ``MultiTrainer`` runs N ``DatasetWorker`` threads that parse batches
+  and stage H2D transfers concurrently, while the device dispatch itself is
+  serialized through a lock (the executor's param-commit is not
+  thread-safe, and the chip is one pipeline anyway).
+- On the PARAMETER-SERVER path the reference's HogwildWorker is genuinely
+  parallel CPU training: ``HogwildWorker`` threads each own a PsClient and
+  run lock-free pull→grad→push loops against shared tables (Hogwild!
+  semantics — races on the server's dense table are the algorithm, not a
+  bug).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+__all__ = ["DeviceWorker", "DatasetWorker", "HogwildWorker", "MultiTrainer"]
+
+
+class DeviceWorker:
+    """One worker thread's loop (reference device_worker.h DeviceWorker)."""
+
+    def __init__(self):
+        self.thread_id: int = 0
+
+    def train_loop(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class DatasetWorker(DeviceWorker):
+    """Compiled-path worker: pulls parsed batches from a shared iterator
+    (round-robin — the reference shards the filelist per thread; a guarded
+    shared iterator is the same coverage without pre-splitting), builds the
+    feed (parse + H2D stage, the parallel part), then runs the step under
+    the trainer's dispatch lock."""
+
+    def __init__(self, next_batch: Callable, build_feed: Callable,
+                 run_step: Callable, dispatch_lock: threading.Lock):
+        super().__init__()
+        self._next_batch = next_batch
+        self._build_feed = build_feed
+        self._run_step = run_step
+        self._lock = dispatch_lock
+        self.steps = 0
+        self.last_fetch = None
+        self.error: Optional[BaseException] = None
+
+    def train_loop(self):
+        try:
+            while True:
+                batch = self._next_batch()
+                if batch is None:
+                    return
+                feed = self._build_feed(batch)   # parallel: parse + H2D
+                with self._lock:                  # serialized: one chip
+                    self.last_fetch = self._run_step(feed)
+                self.steps += 1
+        except BaseException as e:  # surfaced by MultiTrainer.run
+            self.error = e
+
+
+class HogwildWorker(DeviceWorker):
+    """PS-path worker (reference device_worker.h HogwildWorker): lock-free
+    pull→compute→push against shared PS tables. ``grad_fn(params, batch)``
+    returns ``{table_id: grad ndarray}``; dense tables only (sparse grads
+    go through SparseEmbedding.push_grad inside grad_fn if needed)."""
+
+    def __init__(self, client, table_sizes: dict, grad_fn: Callable,
+                 next_batch: Callable):
+        super().__init__()
+        self._client = client
+        self._table_sizes = dict(table_sizes)
+        self._grad_fn = grad_fn
+        self._next_batch = next_batch
+        self.steps = 0
+        self.error: Optional[BaseException] = None
+
+    def train_loop(self):
+        try:
+            while True:
+                batch = self._next_batch()
+                if batch is None:
+                    return
+                params = {tid: self._client.pull_dense(tid, size)
+                          for tid, size in self._table_sizes.items()}
+                grads = self._grad_fn(params, batch)
+                for tid, g in grads.items():
+                    self._client.push_dense_grad(tid, g)
+                self.steps += 1
+        except BaseException as e:
+            self.error = e
+
+
+class MultiTrainer:
+    """Owns the worker threads (reference trainer.h:52 MultiTrainer):
+    construct with a list of DeviceWorkers, ``run()`` starts them, joins,
+    and re-raises the first worker error."""
+
+    def __init__(self, workers: List[DeviceWorker]):
+        self.workers = list(workers)
+        for i, w in enumerate(self.workers):
+            w.thread_id = i
+
+    def run(self):
+        threads = [threading.Thread(target=w.train_loop, daemon=True)
+                   for w in self.workers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for w in self.workers:
+            if w.error is not None:
+                raise w.error
+        return self
+
+    @property
+    def total_steps(self) -> int:
+        return sum(w.steps for w in self.workers)
+
+
+def shared_iterator(dataset):
+    """Thread-safe round-robin pop over a dataset iterator; returns a
+    ``next_batch()`` that yields None at exhaustion (every worker sees the
+    same sentinel)."""
+    it = iter(dataset)
+    lock = threading.Lock()
+
+    def next_batch():
+        with lock:
+            try:
+                return next(it)
+            except StopIteration:
+                return None
+
+    return next_batch

@@ -172,6 +172,10 @@ func (t *Tensor) Shape() []int32 {
 	nd := C.size_t(16)
 	buf := make([]int32, 16)
 	C.PD_TensorGetShape(t.c, &nd, (*C.int32_t)(unsafe.Pointer(&buf[0])))
+	if int(nd) > len(buf) { // rank exceeded the first buffer: re-query
+		buf = make([]int32, int(nd))
+		C.PD_TensorGetShape(t.c, &nd, (*C.int32_t)(unsafe.Pointer(&buf[0])))
+	}
 	return buf[:int(nd)]
 }
 
@@ -184,14 +188,23 @@ func (t *Tensor) numel() int {
 }
 
 func (t *Tensor) CopyFromFloat32(data []float32) {
+	if len(data) == 0 {
+		return
+	}
 	C.PD_TensorCopyFromCpuFloat(t.c, (*C.float)(unsafe.Pointer(&data[0])))
 }
 
 func (t *Tensor) CopyFromInt64(data []int64) {
+	if len(data) == 0 {
+		return
+	}
 	C.PD_TensorCopyFromCpuInt64(t.c, (*C.int64_t)(unsafe.Pointer(&data[0])))
 }
 
 func (t *Tensor) CopyFromInt32(data []int32) {
+	if len(data) == 0 {
+		return
+	}
 	C.PD_TensorCopyFromCpuInt32(t.c, (*C.int32_t)(unsafe.Pointer(&data[0])))
 }
 

@@ -1,0 +1,31 @@
+# End-to-end R inference example (counterpart of the reference
+# r/example/mobilenet.r): export a model with jit.save, serve it through
+# paddle_tpu.inference via reticulate.
+#
+#   Rscript linear.r
+library(reticulate)
+
+paddle <- import("paddle_tpu")
+inf <- import("paddle_tpu.inference")
+np <- import("numpy")
+
+# --- export a tiny model (serving-side would already have the artifact) ---
+paddle$seed(0L)
+net <- paddle$nn$Linear(4L, 2L)
+spec <- paddle$static$InputSpec(list(1L, 4L), "float32")
+prefix <- file.path(tempdir(), "linear_model")
+paddle$jit$save(paddle$jit$to_static(net), prefix, input_spec = list(spec))
+
+# --- load + run -----------------------------------------------------------
+config <- inf$Config(prefix)
+predictor <- inf$create_predictor(config)
+
+input_name <- predictor$get_input_names()[[1]]
+h <- predictor$get_input_handle(input_name)
+h$reshape(c(1L, 4L))
+h$copy_from_cpu(np$ones(c(1L, 4L), dtype = "float32"))
+
+predictor$run()
+
+out <- predictor$get_output_handle(predictor$get_output_names()[[1]])
+print(out$copy_to_cpu())

@@ -161,7 +161,12 @@ class HistogramObserver:
                 if nz:
                     q[lo:hi] = np.where(hist[lo:hi] > 0, mass / nz, 0)
             pn, qn = p / p.sum(), q / q.sum() if q.sum() else q
-            mask = (pn > 0) & (qn > 0)
+            if np.any((pn > 0) & (qn == 0)):
+                # P has mass where Q has none -> KL is +inf: REJECT the
+                # candidate (masking those bins out would hide exactly the
+                # clipped-tail penalty the sweep exists to measure)
+                continue
+            mask = pn > 0
             if not mask.any():
                 continue
             kl = float(np.sum(pn[mask] * np.log(pn[mask] / qn[mask])))

@@ -145,6 +145,42 @@ class Executor:
             getattr(program, "_program", None) or default_main_program()
         )
         fetch_list = fetch_list or []
+        if thread and int(thread) > 1:
+            return self._train_multithread(program, dataset, int(thread),
+                                           fetch_list, debug, print_period)
+
+        build_feed = self._dataset_feed_builder(program)
+
+        last = None
+        step = 0
+        it = iter(dataset)
+        try:
+            pending = build_feed(next(it))
+        except StopIteration:
+            return None
+        done = False
+        while not done:
+            try:
+                nxt = build_feed(next(it))  # prefetch while step runs
+            except StopIteration:
+                nxt, done = None, True
+            # async: keep fetches as device Tensors; materialize only when
+            # printing or at the end — the loop never blocks on the device
+            last = self.run(program, feed=pending, fetch_list=fetch_list,
+                            return_numpy=False)
+            pending = nxt
+            step += 1
+            if debug or (fetch_list and step % print_period == 0):
+                vals = ", ".join(f"{float(np.asarray(v.numpy()).ravel()[0]):.6f}"
+                                 for v in last)
+                print(f"[train_from_dataset] step {step}: {vals}")
+        if last is not None:
+            last = [np.asarray(v.numpy()) for v in last]
+        return last
+
+    def _dataset_feed_builder(self, program):
+        """One shared feed builder for the single- and multi-thread dataset
+        loops (they must never drift)."""
         feed_names = list(program.feed_vars)
 
         def build_feed(batch):
@@ -175,29 +211,40 @@ class Executor:
                 feed[name] = jax.device_put(arr)
             return feed
 
-        last = None
-        step = 0
-        it = iter(dataset)
-        try:
-            pending = build_feed(next(it))
-        except StopIteration:
-            return None
-        done = False
-        while not done:
-            try:
-                nxt = build_feed(next(it))  # prefetch while step runs
-            except StopIteration:
-                nxt, done = None, True
-            # async: keep fetches as device Tensors; materialize only when
-            # printing or at the end — the loop never blocks on the device
-            last = self.run(program, feed=pending, fetch_list=fetch_list,
-                            return_numpy=False)
-            pending = nxt
-            step += 1
-            if debug or (fetch_list and step % print_period == 0):
-                vals = ", ".join(f"{float(np.asarray(v.numpy()).ravel()[0]):.6f}"
-                                 for v in last)
-                print(f"[train_from_dataset] step {step}: {vals}")
+        return build_feed
+
+    def _train_multithread(self, program, dataset, n_threads, fetch_list,
+                           debug=False, print_period=100):
+        """thread>1: the reference's MultiTrainer/DeviceWorker path
+        (framework/trainer.h:52). N DatasetWorker threads parse + stage
+        feeds concurrently; device dispatch serializes through one lock
+        (one chip, and the runner's param commit is not thread-safe)."""
+        import threading
+
+        from ..framework.trainer import (DatasetWorker, MultiTrainer,
+                                         shared_iterator)
+
+        build_feed = self._dataset_feed_builder(program)
+        step_count = [0]  # guarded by the dispatch lock
+
+        def run_step(feed):
+            out = self.run(program, feed=feed, fetch_list=fetch_list,
+                           return_numpy=False)
+            step_count[0] += 1
+            if debug or (fetch_list and step_count[0] % print_period == 0):
+                vals = ", ".join(
+                    f"{float(np.asarray(v.numpy()).ravel()[0]):.6f}"
+                    for v in out)
+                print(f"[train_from_dataset] step {step_count[0]}: {vals}")
+            return out
+
+        lock = threading.Lock()
+        nb = shared_iterator(dataset)
+        workers = [DatasetWorker(nb, build_feed, run_step, lock)
+                   for _ in range(n_threads)]
+        trainer = MultiTrainer(workers).run()
+        last = next((w.last_fetch for w in reversed(trainer.workers)
+                     if w.last_fetch is not None), None)
         if last is not None:
             last = [np.asarray(v.numpy()) for v in last]
         return last

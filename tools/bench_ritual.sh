@@ -8,11 +8,9 @@
 set -e
 cd "$(dirname "$0")/.."
 
-if [ -f BENCH_extra.json ]; then
-  cp BENCH_extra.json BENCH_extra.prev.json
-  echo "snapshotted previous results to BENCH_extra.prev.json"
-fi
-
+# BENCH_extra.prev.json is the LAST PASSING baseline: it is only advanced
+# AFTER the gate passes, so re-running a failed ritual cannot ratchet a
+# regression into the baseline.
 python bench_all.py "$@"
 
 if [ -f BENCH_extra.prev.json ]; then
@@ -24,5 +22,6 @@ if [ -f BENCH_extra.prev.json ]; then
     --tol-override lenet_mnist_dygraph_samples_per_sec=0.3
   echo "model benchmark gate: PASS"
 else
-  echo "model benchmark gate: no previous snapshot, first run recorded"
+  echo "model benchmark gate: no previous baseline, first run recorded"
 fi
+cp BENCH_extra.json BENCH_extra.prev.json  # only reached on PASS (set -e)
