@@ -222,6 +222,10 @@ class PipelineTrainStep:
         )
 
         opt = optimizer
+        from ...core.sanitizer import finite_flags, jit_check_enabled
+
+        self._check_nan = jit_check_enabled()  # snapshot at build time
+        self._nan_names: list = []
 
         def step_fn(params, opt_state, lr, x, y):
             loss, grads = jax.value_and_grad(
@@ -233,7 +237,10 @@ class PipelineTrainStep:
                                         opt_state[key], lr)
                 new_params[key] = np_
                 new_state[key] = ns_
-            return new_params, new_state, loss
+            flags = (finite_flags(self._nan_names, loss=loss, grad=grads,
+                                  param=new_params)
+                     if self._check_nan else None)
+            return new_params, new_state, loss, flags
 
         self._jitted = jax.jit(step_fn, donate_argnums=(0, 1))
         self._dp_axis = dp_axis
@@ -243,9 +250,13 @@ class PipelineTrainStep:
         lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
         x = micro_inputs._value if isinstance(micro_inputs, Tensor) else jnp.asarray(micro_inputs)
         y = micro_labels._value if isinstance(micro_labels, Tensor) else jnp.asarray(micro_labels)
-        self._params, self._opt_state, loss = self._jitted(
+        self._params, self._opt_state, loss, flags = self._jitted(
             self._params, self._opt_state, lr, x, y
         )
+        if self._check_nan:  # state committed above (old buffers donated)
+            from ...core.sanitizer import raise_if_nonfinite
+
+            raise_if_nonfinite(self._nan_names, flags)
         self._optimizer._global_step += 1
         return Tensor(loss)
 

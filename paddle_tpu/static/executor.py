@@ -170,13 +170,20 @@ class Executor:
                             return_numpy=False)
             pending = nxt
             step += 1
-            if debug or (fetch_list and step % print_period == 0):
-                vals = ", ".join(f"{float(np.asarray(v.numpy()).ravel()[0]):.6f}"
-                                 for v in last)
-                print(f"[train_from_dataset] step {step}: {vals}")
+            self._maybe_print_fetches(step, last, fetch_list, debug,
+                                      print_period)
         if last is not None:
             last = [np.asarray(v.numpy()) for v in last]
         return last
+
+    @staticmethod
+    def _maybe_print_fetches(step, fetches, fetch_list, debug, print_period):
+        """Shared step logging for the single- and multi-thread dataset
+        loops (they must never drift)."""
+        if debug or (fetch_list and step % print_period == 0):
+            vals = ", ".join(f"{float(np.asarray(v.numpy()).ravel()[0]):.6f}"
+                             for v in fetches)
+            print(f"[train_from_dataset] step {step}: {vals}")
 
     def _dataset_feed_builder(self, program):
         """One shared feed builder for the single- and multi-thread dataset
@@ -231,11 +238,8 @@ class Executor:
             out = self.run(program, feed=feed, fetch_list=fetch_list,
                            return_numpy=False)
             step_count[0] += 1
-            if debug or (fetch_list and step_count[0] % print_period == 0):
-                vals = ", ".join(
-                    f"{float(np.asarray(v.numpy()).ravel()[0]):.6f}"
-                    for v in out)
-                print(f"[train_from_dataset] step {step_count[0]}: {vals}")
+            self._maybe_print_fetches(step_count[0], out, fetch_list, debug,
+                                      print_period)
             return out
 
         lock = threading.Lock()

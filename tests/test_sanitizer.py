@@ -95,3 +95,41 @@ class TestStaticExecutorSanitizer:
             exe.run(main, feed={"x": feed_x,
                                 "y": np.zeros((2, 1), np.float32)},
                     fetch_list=[loss])
+
+
+class TestPipelineEngineSanitizer:
+    def test_inf_under_pipeline_raises(self, nan_flag):
+        """The pipeline engine is the 4th compiled path; the sanitizer must
+        cover it too (2-stage pp on the virtual mesh)."""
+        import jax
+        import pytest as _pytest
+
+        if len(jax.devices()) < 2:
+            _pytest.skip("needs >=2 devices")
+        import jax.numpy as jnp
+
+        from paddle_tpu.distributed.fleet.pipeline_engine import (
+            PipelineTrainStep)
+        from paddle_tpu.text.models.gpt import (gpt_functional_fns,
+                                                gpt_split_params)
+        from tests.test_distributed import batch, mesh_of, tiny_model
+
+        model, cfg = tiny_model(seed=21, num_layers=4)
+        embed_fn, block_fn, head_loss_fn = gpt_functional_fns(cfg)
+        embed, blocks, head = gpt_split_params(model)
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        mesh = mesh_of((2, 1), ("pp", "dp"))
+        bs, seq, num_micro = 8, 16, 4
+        step = PipelineTrainStep(
+            embed_fn, block_fn, head_loss_fn, opt, mesh, embed, blocks,
+            head, num_micro,
+            jax.ShapeDtypeStruct((bs, seq, cfg.hidden_size), jnp.float32),
+            recompute=False,
+        )
+        # poison a parameter: the post-step sweep must locate it
+        k = next(iter(step._params["blocks"]))
+        step._params["blocks"][k] = step._params["blocks"][k].at[
+            (0,) * step._params["blocks"][k].ndim].set(jnp.inf)
+        x, y = batch(bs * num_micro, seq, seed=3)
+        with _pytest.raises(FloatingPointError):
+            step(x.reshape(num_micro, bs, seq), y.reshape(num_micro, bs, seq))

@@ -1,9 +1,10 @@
 #!/usr/bin/env bash
 # The round's model-benchmark ritual — the counterpart of the reference's
 # tools/test_model_benchmark.sh CI loop:
-#   1. snapshot the previous round's BENCH_extra.json
-#   2. re-measure every config (bench_all.py, real backend)
-#   3. GATE: fail (exit 8) if any config regressed >5% vs the snapshot
+#   1. re-measure every config (bench_all.py, real backend)
+#   2. GATE: fail (exit 8) if any config regressed >5% vs the last
+#      PASSING baseline (BENCH_extra.prev.json)
+#   3. on PASS only, advance the baseline to this run
 # Run from the repo root on the bench rig:  bash tools/bench_ritual.sh
 set -e
 cd "$(dirname "$0")/.."
