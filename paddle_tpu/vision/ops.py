@@ -24,6 +24,7 @@ from ..core.tensor import Tensor, apply_op
 __all__ = [
     "yolo_box", "prior_box", "box_coder", "multiclass_nms", "roi_align",
     "iou_similarity", "box_iou", "psroi_pool", "deform_conv2d", "spp",
+    "space_to_depth_stem_conv",
 ]
 
 
@@ -594,3 +595,41 @@ def spp(x, pyramid_height=3, pooling_type="max", name=None):
         bins = 2 ** p
         outs.append(flatten(pool(x, bins), start_axis=1))
     return concat(outs, axis=1)
+
+
+def space_to_depth_stem_conv(x, weight):
+    """EXACT space-to-depth reformulation of the ResNet stem conv
+    (7x7/stride-2/pad-3) — the standard TPU trick for C_in=3 stems, whose
+    tiny contraction badly under-fills the 128-wide MXU:
+
+    pad the 7x7 kernel to 8x8 with zeros, split every spatial index into
+    (2a+p), and the stride-2 conv becomes a STRIDE-1 4x4 conv over the
+    2x2-space-to-depth input (channels C_in*4 = 12) with the kernel taps
+    regrouped — bit-for-bit the same sum, better MXU mapping. x: [N, 3,
+    H, W] (H, W even), weight: [C_out, 3, 7, 7]; returns [N, C_out, H/2,
+    W/2]. Checkpoint-compatible: the PARAMETER keeps its [C_out,3,7,7]
+    shape; the regrouping happens at trace time.
+    """
+    import jax
+
+    from ..core.tensor import apply_op
+
+    def f(a, w):
+        n, ci, H, W = a.shape
+        co = w.shape[0]
+        # pad input 3 each side (as the stride-2 conv would), then s2d
+        ap = jnp.pad(a, ((0, 0), (0, 0), (3, 3), (3, 3)))
+        Hp, Wp = H + 6, W + 6
+        z = ap.reshape(n, ci, Hp // 2, 2, Wp // 2, 2)
+        z = z.transpose(0, 1, 3, 5, 2, 4).reshape(n, ci * 4, Hp // 2, Wp // 2)
+        # kernel: zero-pad 7->8, split taps (2a+p, 2b+q) -> [co, ci*4, 4, 4]
+        wp = jnp.pad(w, ((0, 0), (0, 0), (0, 1), (0, 1)))
+        w2 = wp.reshape(co, ci, 4, 2, 4, 2)
+        w2 = w2.transpose(0, 1, 3, 5, 2, 4).reshape(co, ci * 4, 4, 4)
+        out = jax.lax.conv_general_dilated(
+            z, w2, window_strides=(1, 1), padding="VALID",
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                z.shape, w2.shape, ("NCHW", "OIHW", "NCHW")))
+        return out[:, :, :H // 2, :W // 2]
+
+    return apply_op(f, _t(x), weight)

@@ -127,3 +127,64 @@ class TestReflectionPadding:
             expect = lo_v * (1 - w1) + hi_v * w1
             assert float(out.numpy().ravel()[0]) == pytest.approx(
                 float(expect), abs=1e-5), f"gx={gx}"
+
+
+class TestSpaceToDepthStem:
+    def test_exact_vs_plain_conv(self):
+        """The s2d reformulation must be numerically EQUAL to the plain
+        7x7/2/pad3 conv (same math, regrouped taps), incl. gradients."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.vision.ops import space_to_depth_stem_conv
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(2, 3, 32, 32), jnp.float32)
+        w = jnp.asarray(rng.randn(8, 3, 7, 7), jnp.float32)
+
+        def plain(x_, w_):
+            return jax.lax.conv_general_dilated(
+                x_, w_, (2, 2), [(3, 3), (3, 3)],
+                dimension_numbers=jax.lax.conv_dimension_numbers(
+                    x_.shape, w_.shape, ("NCHW", "OIHW", "NCHW")))
+
+        import paddle_tpu as paddle
+
+        out = space_to_depth_stem_conv(paddle.to_tensor(np.asarray(x)),
+                                       paddle.to_tensor(np.asarray(w)))
+        ref = plain(x, w)
+        # exact in real arithmetic; f32 conv accumulation ORDER differs
+        # between the two groupings, so allow summation-order noise
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-3)
+
+        # gradient parity
+        gx_ref, gw_ref = jax.grad(
+            lambda a, b: (plain(a, b) ** 2).sum(), argnums=(0, 1))(x, w)
+        xt = paddle.to_tensor(np.asarray(x), stop_gradient=False)
+        wt = paddle.to_tensor(np.asarray(w), stop_gradient=False)
+        (space_to_depth_stem_conv(xt, wt) ** 2).sum().backward()
+        np.testing.assert_allclose(xt.grad.numpy(), np.asarray(gx_ref),
+                                   rtol=1e-3, atol=1e-2)
+        np.testing.assert_allclose(wt.grad.numpy(), np.asarray(gw_ref),
+                                   rtol=1e-3, atol=1e-2)
+
+    def test_resnet_stem_flag_on_equals_off(self, monkeypatch):
+        """The wired model path: flag ON (backend faked to 'tpu' — the op
+        itself is backend-agnostic) must equal flag OFF bit-for-noise."""
+        import jax
+
+        import paddle_tpu as paddle
+        import paddle_tpu.vision.models.resnet as resnet_mod
+        from paddle_tpu.vision.models import resnet18
+
+        paddle.seed(0)
+        m = resnet18()
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 3, 32, 32).astype(np.float32))
+        off = m(x).numpy()
+        monkeypatch.setenv("PADDLE_TPU_S2D_STEM", "1")
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        on = m(x).numpy()
+        np.testing.assert_allclose(on, off, rtol=1e-4, atol=1e-4)
