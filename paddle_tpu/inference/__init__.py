@@ -262,6 +262,10 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
-def create_predictor_from_path(model_prefix: str) -> Predictor:
+def create_predictor_from_path(model_prefix: str,
+                               cipher_key_file: str = "") -> Predictor:
     """Entry point used by the C API shim (inference/capi)."""
-    return Predictor(Config(model_prefix))
+    cfg = Config(model_prefix)
+    if cipher_key_file:
+        cfg.set_cipher_key_file(cipher_key_file)
+    return Predictor(cfg)

@@ -48,6 +48,7 @@ def load() -> ctypes.CDLL:
         "PD_ConfigCreate": (c.c_void_p, []),
         "PD_ConfigDestroy": (None, [c.c_void_p]),
         "PD_ConfigSetModel": (None, [c.c_void_p, c.c_char_p, c.c_char_p]),
+        "PD_ConfigSetCipherKeyFile": (None, [c.c_void_p, c.c_char_p]),
         "PD_PredictorCreate": (c.c_void_p, [c.c_void_p]),
         "PD_PredictorDestroy": (None, [c.c_void_p]),
         "PD_PredictorGetInputNum": (c.c_size_t, [c.c_void_p]),

@@ -63,6 +63,7 @@ PyObject* inference_module() {
 struct PD_Config {
   std::string model_prefix;
   std::string params_path;
+  std::string cipher_key_file;  // AES key file for encrypted artifacts
 };
 
 struct PD_Tensor {
@@ -177,6 +178,11 @@ void PD_ConfigSetModel(PD_Config* config, const char* model_prefix,
   if (params_path != nullptr) config->params_path = params_path;
 }
 
+void PD_ConfigSetCipherKeyFile(PD_Config* config, const char* key_path) {
+  if (config == nullptr || key_path == nullptr) return;
+  config->cipher_key_file = key_path;
+}
+
 /* device/opt toggles: the XLA predictor compiles for whatever backend JAX
  * selected; these exist for signature parity and are recorded no-ops, like
  * the reference's toggles that don't apply to a given build. */
@@ -195,8 +201,9 @@ PD_Predictor* PD_PredictorCreate(PD_Config* config) {
   GIL gil;
   PyObject* mod = inference_module();
   if (mod == nullptr) return nullptr;
-  PyObject* pred = PyObject_CallMethod(mod, "create_predictor_from_path", "s",
-                                       config->model_prefix.c_str());
+  PyObject* pred = PyObject_CallMethod(mod, "create_predictor_from_path",
+                                       "ss", config->model_prefix.c_str(),
+                                       config->cipher_key_file.c_str());
   Py_DECREF(mod);
   if (pred == nullptr) {
     set_error_from_python();

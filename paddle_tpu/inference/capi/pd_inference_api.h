@@ -36,6 +36,9 @@ void PD_ConfigDisableGpu(PD_Config* config);
 void PD_ConfigSetCpuMathLibraryNumThreads(PD_Config* config, int32_t n);
 void PD_ConfigSwitchIrOptim(PD_Config* config, PD_Bool on);
 void PD_ConfigEnableMemoryOptim(PD_Config* config, PD_Bool on);
+/* AES key FILE for artifacts written with jit.save(..., encrypt_key=...)
+ * (framework/io/crypto parity) */
+void PD_ConfigSetCipherKeyFile(PD_Config* config, const char* key_path);
 
 /* ---- predictor (pd_predictor.h parity) ---- */
 PD_Predictor* PD_PredictorCreate(PD_Config* config);

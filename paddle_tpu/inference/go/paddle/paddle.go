@@ -87,6 +87,14 @@ func (cfg *Config) EnableMemoryOptim(on bool) {
 	C.PD_ConfigEnableMemoryOptim(cfg.c, cbool(on))
 }
 
+// SetCipherKeyFile names the AES key file for artifacts written with
+// jit.save(..., encrypt_key=...).
+func (cfg *Config) SetCipherKeyFile(path string) {
+	p := C.CString(path)
+	defer C.free(unsafe.Pointer(p))
+	C.PD_ConfigSetCipherKeyFile(cfg.c, p)
+}
+
 // Predictor mirrors the reference Predictor (predictor.go) over the
 // pd_predictor C surface.
 type Predictor struct {

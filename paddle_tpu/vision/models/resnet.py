@@ -104,13 +104,20 @@ class ResNet(nn.Layer):
 
         import jax
 
+        w = getattr(self.conv1, "weight", None)
         if (os.environ.get("PADDLE_TPU_S2D_STEM", "0") == "1"
                 and jax.default_backend() == "tpu"
                 and x.ndim == 4 and x.shape[2] % 2 == 0
-                and x.shape[3] % 2 == 0):
+                and x.shape[3] % 2 == 0
+                # the reformulation encodes EXACTLY 7x7/stride-2/pad-3
+                # bias-free semantics: a customized stem (CIFAR 3x3 etc.)
+                # must take the generic conv
+                and w is not None and tuple(w.shape[2:]) == (7, 7)
+                and getattr(self.conv1, "_stride", None) in ((2, 2), 2)
+                and getattr(self.conv1, "bias", None) is None):
             from ..ops import space_to_depth_stem_conv
 
-            return space_to_depth_stem_conv(x, self.conv1.weight)
+            return space_to_depth_stem_conv(x, w)
         return self.conv1(x)
 
     def _make_layer(self, block, planes, blocks, stride=1):

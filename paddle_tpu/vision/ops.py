@@ -610,10 +610,6 @@ def space_to_depth_stem_conv(x, weight):
     W/2]. Checkpoint-compatible: the PARAMETER keeps its [C_out,3,7,7]
     shape; the regrouping happens at trace time.
     """
-    import jax
-
-    from ..core.tensor import apply_op
-
     def f(a, w):
         n, ci, H, W = a.shape
         co = w.shape[0]

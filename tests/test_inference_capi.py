@@ -97,3 +97,36 @@ class TestCApi:
         err = lib.PD_GetLastError()
         assert err and b"pdexport" in err
         lib.PD_ConfigDestroy(cfg)
+
+
+class TestCApiEncrypted:
+    def test_encrypted_artifact_via_key_file(self, lib, tmp_path):
+        """C clients serve encrypted exports: PD_ConfigSetCipherKeyFile
+        names the key; without it creation fails with a located error."""
+        from paddle_tpu.framework.io_crypto import CipherUtils
+
+        paddle.seed(0)
+        net = nn.Linear(4, 3)
+        net.eval()
+        key = CipherUtils.gen_key()
+        key_path = str(tmp_path / "model.key")
+        with open(key_path, "wb") as f:
+            f.write(key)
+        prefix = str(tmp_path / "enc")
+        paddle.jit.save(net, prefix,
+                        input_spec=[paddle.jit.InputSpec([2, 4], "float32")],
+                        encrypt_key=key)
+
+        cfg = lib.PD_ConfigCreate()
+        lib.PD_ConfigSetModel(cfg, prefix.encode(), None)
+        pred = lib.PD_PredictorCreate(cfg)
+        assert not pred  # no key -> refused
+        lib.PD_ConfigDestroy(cfg)
+
+        cfg2 = lib.PD_ConfigCreate()
+        lib.PD_ConfigSetModel(cfg2, prefix.encode(), None)
+        lib.PD_ConfigSetCipherKeyFile(cfg2, key_path.encode())
+        pred2 = lib.PD_PredictorCreate(cfg2)
+        assert pred2
+        lib.PD_PredictorDestroy(pred2)
+        lib.PD_ConfigDestroy(cfg2)
