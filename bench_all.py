@@ -9,10 +9,13 @@ Configs (BASELINE.md table):
   #1 MNIST LeNet, dygraph, host batches           -> samples/sec
   #2 ResNet-50, static-graph Executor, one chip   -> samples/sec
   #3 BERT-base pretrain, fleet DP engine, one chip-> samples/sec + tok/sec
+  #4 long-context GPT-small, L=8192, flash_tpu attention + remat
+     (net-new vs the reference)                    -> tokens/sec
 (#5 ERNIE pp+tp needs a pod slice; its sharding path is validated by
  dryrun_multichip on the virtual mesh.)
 
-Usage: python bench_all.py [--smoke]   (--smoke: tiny shapes, any backend)
+Usage: python bench_all.py [--smoke] [lenet|resnet50|bert|longctx]
+  (--smoke: tiny shapes, any backend; names select a subset)
 """
 from __future__ import annotations
 
@@ -188,11 +191,55 @@ def bench_bert_dp():
     return out
 
 
+def bench_gpt_long_context():
+    """Long-context end-to-end: GPT-small at L=8192 on ONE chip — the
+    sequence length where the materialized O(L²) path exhausts HBM, so the
+    auto dispatch routes attention through the flash_tpu Mosaic kernel and
+    the step runs under full rematerialization. Net-new vs the reference
+    (SURVEY §5: long-context absent there)."""
+    import paddle_tpu as paddle
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.fleet.engine import ParallelTrainStep
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    if SMOKE:
+        config = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                           num_heads=4, max_position_embeddings=512,
+                           hidden_dropout=0.0, attention_dropout=0.0)
+        b, L, iters = 1, 512, 2
+    else:
+        config = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                           max_position_embeddings=8192,
+                           hidden_dropout=0.0, attention_dropout=0.0)
+        b, L, iters = 1, 8192, 10
+    model = GPTForCausalLM(config)
+    opt = paddle.optimizer.Adam(learning_rate=1e-4,
+                                parameters=model.parameters())
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    step = ParallelTrainStep(model, loss_fn=model.loss_fn, optimizer=opt,
+                             mesh=mesh, recompute=True,
+                             compute_dtype=None if SMOKE else jnp.bfloat16)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, config.vocab_size, (b, L)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1).astype(np.int32)
+    ids = paddle.to_tensor(ids)
+    labels = paddle.to_tensor(labels)
+
+    def one(i):
+        return step((ids,), (labels,))
+
+    tps = _rate(one, 1, iters) * b * L
+    return {"metric": "gpt_small_L8192_longctx_train_tokens_per_sec",
+            "value": round(tps, 1), "unit": "tokens/sec",
+            "seq_len": L}
+
+
 def main():
     only = [a.lstrip("-") for a in sys.argv[1:] if a.lstrip("-") in
-            ("lenet", "resnet50", "bert")]
+            ("lenet", "resnet50", "bert", "longctx")]
     table = {"lenet": bench_lenet, "resnet50": bench_resnet50,
-             "bert": bench_bert_dp}
+             "bert": bench_bert_dp, "longctx": bench_gpt_long_context}
     results = []
     for name, fn in table.items():
         if only and name not in only:

@@ -4,6 +4,8 @@ batchnorm (replacing the reference's fused_bn_activation_op.cu path).
 """
 from __future__ import annotations
 
+import numpy as np
+
 from ... import nn
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152"]
@@ -113,12 +115,24 @@ class ResNet(nn.Layer):
                 # bias-free semantics: a customized stem (CIFAR 3x3 etc.)
                 # must take the generic conv
                 and w is not None and tuple(w.shape[2:]) == (7, 7)
-                and getattr(self.conv1, "_stride", None) in ((2, 2), 2)
+                and tuple(getattr(self.conv1, "_stride", ())) == (2, 2)
+                and tuple(getattr(self.conv1, "_dilation", (1, 1))) == (1, 1)
+                and getattr(self.conv1, "_groups", 1) == 1
+                and self._stem_pad3()
                 and getattr(self.conv1, "bias", None) is None):
             from ..ops import space_to_depth_stem_conv
 
             return space_to_depth_stem_conv(x, w)
         return self.conv1(x)
+
+    def _stem_pad3(self):
+        pad = getattr(self.conv1, "_padding", None)
+        if isinstance(pad, int):
+            return pad == 3
+        try:
+            return all(int(p) == 3 for p in np.ravel(np.asarray(pad)))
+        except Exception:
+            return False
 
     def _make_layer(self, block, planes, blocks, stride=1):
         norm_layer = self._norm_layer

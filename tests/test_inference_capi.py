@@ -120,7 +120,9 @@ class TestCApiEncrypted:
         cfg = lib.PD_ConfigCreate()
         lib.PD_ConfigSetModel(cfg, prefix.encode(), None)
         pred = lib.PD_PredictorCreate(cfg)
-        assert not pred  # no key -> refused
+        assert not pred  # no key -> refused...
+        err = lib.PD_GetLastError()
+        assert err and b"encrypted" in err  # ...for the RIGHT reason
         lib.PD_ConfigDestroy(cfg)
 
         cfg2 = lib.PD_ConfigCreate()

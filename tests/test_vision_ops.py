@@ -186,5 +186,14 @@ class TestSpaceToDepthStem:
         off = m(x).numpy()
         monkeypatch.setenv("PADDLE_TPU_S2D_STEM", "1")
         monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        # prove the s2d branch actually RAN (a dead guard would pass the
+        # equality check trivially)
+        import paddle_tpu.vision.ops as vops
+
+        calls = []
+        real = vops.space_to_depth_stem_conv
+        monkeypatch.setattr(vops, "space_to_depth_stem_conv",
+                            lambda *a: (calls.append(1), real(*a))[1])
         on = m(x).numpy()
+        assert calls, "PADDLE_TPU_S2D_STEM=1 did not take the s2d path"
         np.testing.assert_allclose(on, off, rtol=1e-4, atol=1e-4)
