@@ -76,6 +76,13 @@ from . import incubate  # noqa: E402
 from . import quant  # noqa: E402
 from . import distribution  # noqa: E402
 from .hapi.summary import summary  # noqa: E402,F401
+from . import callbacks  # noqa: E402
+from . import device  # noqa: E402
+from . import hub  # noqa: E402
+from . import onnx  # noqa: E402
+from . import reader  # noqa: E402
+from . import sysconfig  # noqa: E402
+from .batch import batch  # noqa: E402,F401
 
 
 # dygraph-compat helpers

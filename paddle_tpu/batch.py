@@ -1,0 +1,29 @@
+"""paddle.batch — the fluid-era reader batcher (parity:
+/root/reference/python/paddle/batch.py). Legacy training loops wrap sample
+readers with it before feeding Executor/DataFeeder."""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Transform a sample-level reader creator into a batch-level one.
+
+    ``reader``: callable returning an iterable of samples. Returns a
+    reader creator whose iterator yields lists of ``batch_size`` samples
+    (the trailing partial batch is kept unless ``drop_last``).
+    """
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

@@ -1,0 +1,12 @@
+"""paddle.callbacks — re-export of the hapi callbacks (parity:
+/root/reference/python/paddle/callbacks.py, which is the same shim)."""
+from .hapi.callbacks import Callback  # noqa: F401
+from .hapi.callbacks import EarlyStopping  # noqa: F401
+from .hapi.callbacks import LRScheduler  # noqa: F401
+from .hapi.callbacks import ModelCheckpoint  # noqa: F401
+from .hapi.callbacks import ProgBarLogger  # noqa: F401
+from .hapi.callbacks import ReduceLROnPlateau  # noqa: F401
+from .hapi.callbacks import VisualDL  # noqa: F401
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
+           "LRScheduler", "EarlyStopping", "ReduceLROnPlateau"]

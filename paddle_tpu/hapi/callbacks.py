@@ -201,6 +201,103 @@ class LRScheduler(Callback):
                 s.step()
 
 
+class ReduceLROnPlateau(Callback):
+    """Shrink the LR when a monitored metric plateaus — parity with
+    hapi/callbacks.py ReduceLROnPlateau in the reference."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, mode="min",
+                 min_delta=1e-4, min_lr=0.0, verbose=1, cooldown=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = float(factor)
+        self.patience = patience
+        self.mode = mode
+        self.min_delta = min_delta
+        self.min_lr = min_lr
+        self.verbose = verbose
+        self.cooldown = cooldown
+        self._cooldown_counter = 0
+        self._wait = 0
+        self._best = None
+
+    def _better(self, cur):
+        if self._best is None:
+            return True
+        if self.mode == "min":
+            return cur < self._best - self.min_delta
+        return cur > self._best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        self._check(logs or {})
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._check(logs or {})
+
+    def _check(self, logs):
+        # fit() reports eval metrics in the epoch logs as 'eval_<name>'
+        # (same fallback EarlyStopping uses): prefer the eval metric over
+        # the noisy last-train-batch value when both exist
+        cur = logs.get(f"eval_{self.monitor}", logs.get(self.monitor))
+        if cur is None:
+            return
+        try:
+            cur = float(np.asarray(cur).ravel()[0])
+        except Exception:
+            return
+        if self._cooldown_counter > 0:
+            self._cooldown_counter -= 1
+            self._wait = 0
+        if self._better(cur):
+            self._best = cur
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is None:
+                return
+            old = float(opt.get_lr())
+            new = max(old * self.factor, self.min_lr)
+            if new < old:
+                opt.set_lr(new)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr {old:.3e} -> {new:.3e}")
+            self._cooldown_counter = self.cooldown
+            self._wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logging callback. The reference streams to the VisualDL
+    service; that package isn't in this image, so scalars append to a
+    JSONL file any dashboard (or `jq`) can tail — same hook points."""
+
+    def __init__(self, log_dir="./vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._step = 0
+
+    def _write(self, tag, logs):
+        import json as _json
+        import os as _os
+
+        _os.makedirs(self.log_dir, exist_ok=True)
+        rec = {"step": self._step, "tag": tag}
+        for k, v in (logs or {}).items():
+            try:
+                rec[k] = float(np.asarray(v).ravel()[0])
+            except Exception:
+                continue
+        with open(_os.path.join(self.log_dir, "scalars.jsonl"), "a") as f:
+            f.write(_json.dumps(rec) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)
+
+
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
                      steps=None, log_freq=2, verbose=2, save_freq=1,
                      save_dir=None, metrics=None, mode="train"):

@@ -1,0 +1,74 @@
+"""paddle.hub — load models from a hubconf.py entry-point directory
+(parity: /root/reference/python/paddle/hapi/hub.py). The reference also
+fetches github/gitee archives; this environment is zero-egress, so
+``source='local'`` (a directory containing ``hubconf.py``) is the
+supported path and the remote sources raise with that guidance.
+
+hubconf contract (same as the reference): a ``hubconf.py`` whose public
+callables are the model entry points; ``dependencies = [...]`` is an
+optional list of importable module names checked before load.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _import_hubconf(repo_dir: str):
+    if not os.path.isdir(repo_dir):
+        raise ValueError(f"hub: {repo_dir!r} is not a directory")
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"hub: no {_HUBCONF} in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    for dep in getattr(mod, "dependencies", []):
+        if importlib.util.find_spec(dep) is None:
+            raise RuntimeError(f"hub: missing dependency {dep!r} required "
+                               f"by {path}")
+    return mod
+
+
+def _check_source(source: str):
+    if source not in ("local",):
+        raise ValueError(
+            f"hub source {source!r} is unavailable in this zero-egress "
+            "environment; clone the repo yourself and use source='local'")
+
+
+def list(repo_dir, source="local", force_reload=False):
+    """Names of the model entry points exported by the repo's hubconf."""
+    _check_source(source)
+    mod = _import_hubconf(repo_dir)
+    return [n for n, v in vars(mod).items()
+            if callable(v) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    """Docstring of one entry point."""
+    _check_source(source)
+    mod = _import_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"hub: no entry point {model!r} in {repo_dir!r}")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Call the entry point and return the constructed model."""
+    _check_source(source)
+    mod = _import_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"hub: no entry point {model!r} in {repo_dir!r}")
+    return fn(**kwargs)

@@ -1,0 +1,7 @@
+"""paddle.hub — re-export shim (parity:
+/root/reference/python/paddle/hub.py)."""
+from .hapi.hub import help  # noqa: F401
+from .hapi.hub import list  # noqa: F401
+from .hapi.hub import load  # noqa: F401
+
+__all__ = ["list", "help", "load"]

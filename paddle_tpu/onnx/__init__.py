@@ -1,0 +1,35 @@
+"""paddle.onnx — ONNX export surface (parity:
+/root/reference/python/paddle/onnx/export.py, which delegates to the
+external ``paddle2onnx`` package).
+
+Neither ``onnx`` nor a converter is present in this image, and this
+framework's native interchange format is StableHLO (``jit.save`` writes a
+self-contained AOT artifact any XLA runtime loads). ``export`` therefore
+raises with that guidance unless an ``onnx`` toolchain is importable —
+the gate mirrors the reference, which also hard-depends on an external
+package for this API.
+"""
+from __future__ import annotations
+
+import importlib.util
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export ``layer`` to ONNX at ``path``.
+
+    Requires an ONNX toolchain in the environment. Without one, use
+    ``paddle_tpu.jit.save(layer, path, input_spec=...)`` — the ``.pdexport``
+    StableHLO artifact is this framework's portable serving format (served
+    by the Python/C/Go clients).
+    """
+    if importlib.util.find_spec("onnx") is None:
+        raise ModuleNotFoundError(
+            "paddle_tpu.onnx.export requires the 'onnx' package, which is "
+            "not installed in this environment. The TPU-native portable "
+            "artifact is StableHLO: paddle_tpu.jit.save(layer, path, "
+            "input_spec=[...]) produces a .pdexport any XLA runtime serves.")
+    raise NotImplementedError(
+        "ONNX conversion from StableHLO is not implemented; serve the "
+        "jit.save .pdexport artifact instead.")

@@ -1,0 +1,245 @@
+"""paddle.reader — reader-creator decorators (parity:
+/root/reference/python/paddle/reader/decorator.py). These compose
+sample-level reader creators (zero-arg callables returning iterables) —
+the fluid-era input pipeline that predates DataLoader. The TPU-native
+pipeline is io.DataLoader + the native MultiSlot path; these decorators
+keep legacy recipes runnable unchanged.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader):
+    """Materialize the reader once; subsequent iterations replay from
+    memory (reference: decorator.py cache)."""
+    all_data = None
+
+    def cached():
+        nonlocal all_data
+        if all_data is None:
+            all_data = list(reader())
+        return iter(all_data)
+
+    return cached
+
+
+def map_readers(func, *readers):
+    """Zip ``readers`` and map ``func`` over the sample tuples."""
+
+    def mapped():
+        for samples in zip(*[r() for r in readers]):
+            yield func(*samples)
+
+    return mapped
+
+
+def shuffle(reader, buf_size):
+    """Shuffle within a sliding buffer of ``buf_size`` samples."""
+
+    def shuffled():
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    """Concatenate readers sequentially."""
+
+    def chained():
+        return itertools.chain(*[r() for r in readers])
+
+    return chained
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flat tuples: samples (a, ...) + (b, ...) ->
+    (a, ..., b, ...). ``check_alignment=True`` (default) raises when the
+    readers are of uneven length."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def composed():
+        its = [r() for r in readers]
+        for samples in itertools.zip_longest(*its, fillvalue=_END):
+            # identity checks only: `in`/`==` would broadcast over numpy
+            # array samples and raise "truth value is ambiguous"
+            if any(s is _END for s in samples):
+                if check_alignment and any(s is not _END for s in samples):
+                    raise RuntimeError("compose: readers have uneven lengths")
+                return
+            yield sum((make_tuple(s) for s in samples), ())
+
+    return composed
+
+
+_END = object()
+
+
+def firstn(reader, n):
+    """Limit to the first ``n`` samples."""
+
+    def limited():
+        return itertools.islice(reader(), n)
+
+    return limited
+
+
+class _ReaderError:
+    """Producer exception captured in a worker; re-raised in the consumer
+    so failures surface instead of silently truncating the stream."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def buffered(reader, size):
+    """Decouple producer and consumer through a bounded queue filled by a
+    background thread (reference: decorator.py buffered)."""
+
+    def buffered_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for s in reader():
+                    q.put(s)
+            except BaseException as e:  # surfaced in the consumer
+                q.put(_ReaderError(e))
+                return
+            q.put(_END)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if isinstance(s, _ReaderError):
+                raise s.exc
+            if s is _END:
+                return
+            yield s
+
+    return buffered_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Map ``mapper`` over samples with ``process_num`` worker THREADS
+    feeding a bounded queue. The reference uses threads too
+    (decorator.py xmap_readers); mappers are typically IO/numpy-bound, so
+    threads overlap fine. ``order=True`` preserves input order."""
+
+    def xmapped():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+
+        def feed():
+            try:
+                for i, s in enumerate(reader()):
+                    in_q.put((i, s))
+            except BaseException as e:
+                out_q.put(_ReaderError(e))
+            finally:
+                # every worker gets its sentinel even after a feed error,
+                # so the consumer can never block forever
+                for _ in range(process_num):
+                    in_q.put(_END)
+
+        def work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is _END:
+                        return
+                    i, s = item
+                    out_q.put((i, mapper(s)))
+            except BaseException as e:
+                out_q.put(_ReaderError(e))
+            finally:
+                out_q.put(_END)
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        def next_item():
+            item = out_q.get()
+            if isinstance(item, _ReaderError):
+                raise item.exc
+            return item
+
+        done = 0
+        if not order:
+            while done < process_num:
+                item = next_item()
+                if item is _END:
+                    done += 1
+                    continue
+                yield item[1]
+            return
+        pending = {}
+        nxt = 0
+        while done < process_num or pending:
+            if nxt in pending:
+                yield pending.pop(nxt)
+                nxt += 1
+                continue
+            if done >= process_num:
+                break  # workers done but a gap remains: feed errored
+            item = next_item()
+            if item is _END:
+                done += 1
+                continue
+            pending[item[0]] = item[1]
+
+    return xmapped
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers concurrently. The reference forks
+    processes; sample readers here are python generators that rarely
+    release work to real parallelism, so worker THREADS provide the same
+    interleaving semantics without fork-safety hazards (the heavy native
+    parse path lives in io.DataLoader/MultiSlotDataFeed instead)."""
+
+    def merged():
+        q: queue.Queue = queue.Queue(queue_size)
+
+        def run(r):
+            try:
+                for s in r():
+                    q.put(s)
+            except BaseException as e:
+                q.put(_ReaderError(e))
+                return
+            q.put(_END)
+
+        for r in readers:
+            threading.Thread(target=run, args=(r,), daemon=True).start()
+        done = 0
+        while done < len(readers):
+            s = q.get()
+            if isinstance(s, _ReaderError):
+                raise s.exc
+            if s is _END:
+                done += 1
+                continue
+            yield s
+
+    return merged

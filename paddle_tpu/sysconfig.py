@@ -1,0 +1,21 @@
+"""paddle.sysconfig — header/library discovery for native extensions
+(parity: /root/reference/python/paddle/sysconfig.py)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of the C inference API header (pd_inference_api.h)."""
+    return os.path.join(_ROOT, "inference", "capi")
+
+
+def get_lib() -> str:
+    """Directory containing the built native shared libraries."""
+    cand = os.path.join(_ROOT, "inference", "capi", "build")
+    native = os.path.join(_ROOT, "native", "build")
+    return cand if os.path.isdir(cand) else native

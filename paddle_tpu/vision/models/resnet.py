@@ -115,22 +115,27 @@ class ResNet(nn.Layer):
                 # bias-free semantics: a customized stem (CIFAR 3x3 etc.)
                 # must take the generic conv
                 and w is not None and tuple(w.shape[2:]) == (7, 7)
-                and tuple(getattr(self.conv1, "_stride", ())) == (2, 2)
-                and tuple(getattr(self.conv1, "_dilation", (1, 1))) == (1, 1)
+                and self._stem_attr_is(self.conv1, "_stride", 2)
+                and self._stem_attr_is(self.conv1, "_dilation", 1)
                 and getattr(self.conv1, "_groups", 1) == 1
-                and self._stem_pad3()
+                and self._stem_attr_is(self.conv1, "_padding", 3)
                 and getattr(self.conv1, "bias", None) is None):
             from ..ops import space_to_depth_stem_conv
 
             return space_to_depth_stem_conv(x, w)
         return self.conv1(x)
 
-    def _stem_pad3(self):
-        pad = getattr(self.conv1, "_padding", None)
-        if isinstance(pad, int):
-            return pad == 3
+    @staticmethod
+    def _stem_attr_is(conv, name, value):
+        """True iff conv's attr equals ``value`` in every spatial position
+        — accepts int, list, tuple, or nested forms; anything unparseable
+        safely fails the guard (generic conv path)."""
+        v = getattr(conv, name, None)
+        if isinstance(v, (int, np.integer)):
+            return int(v) == value
         try:
-            return all(int(p) == 3 for p in np.ravel(np.asarray(pad)))
+            arr = np.ravel(np.asarray(v))
+            return arr.size > 0 and all(int(p) == value for p in arr)
         except Exception:
             return False
 

@@ -1,0 +1,197 @@
+"""fluid-era top-level API surface: paddle.batch, paddle.reader decorators,
+paddle.callbacks, paddle.device, paddle.hub, paddle.sysconfig, paddle.onnx
+(parity with the corresponding modules under
+/root/reference/python/paddle/)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestBatchAndReader:
+    def test_batch_groups_and_tail(self):
+        r = paddle.batch(lambda: iter(range(7)), batch_size=3)
+        assert list(r()) == [[0, 1, 2], [3, 4, 5], [6]]
+        r2 = paddle.batch(lambda: iter(range(7)), 3, drop_last=True)
+        assert list(r2()) == [[0, 1, 2], [3, 4, 5]]
+        with pytest.raises(ValueError):
+            paddle.batch(lambda: iter([]), 0)
+
+    def test_reader_decorators(self):
+        from paddle_tpu import reader
+
+        base = lambda: iter(range(10))
+        assert list(reader.firstn(base, 3)()) == [0, 1, 2]
+        assert sorted(reader.shuffle(base, 4)()) == list(range(10))
+        assert list(reader.chain(base, lambda: iter([99]))()) == (
+            list(range(10)) + [99])
+        assert list(reader.map_readers(lambda a, b: a + b, base, base)()) == [
+            2 * i for i in range(10)]
+        assert list(reader.buffered(base, 2)()) == list(range(10))
+        cached = reader.cache(base)
+        assert list(cached()) == list(cached()) == list(range(10))
+        comp = reader.compose(lambda: iter([(1, 2), (3, 4)]),
+                              lambda: iter([5, 6]))
+        assert list(comp()) == [(1, 2, 5), (3, 4, 6)]
+        with pytest.raises(RuntimeError):
+            list(reader.compose(lambda: iter([1]), lambda: iter([1, 2]))())
+
+    def test_xmap_ordered_and_unordered(self):
+        from paddle_tpu import reader
+
+        sq = reader.xmap_readers(lambda x: x * x, lambda: iter(range(20)),
+                                 process_num=3, buffer_size=4, order=True)
+        assert list(sq()) == [i * i for i in range(20)]
+        un = reader.xmap_readers(lambda x: x * x, lambda: iter(range(20)),
+                                 process_num=3, buffer_size=4)
+        assert sorted(un()) == [i * i for i in range(20)]
+
+    def test_multiprocess_reader_interleaves(self):
+        from paddle_tpu import reader
+
+        merged = reader.multiprocess_reader(
+            [lambda: iter(range(5)), lambda: iter(range(5, 10))])
+        assert sorted(merged()) == list(range(10))
+
+
+class TestDeviceModule:
+    def test_queries(self):
+        from paddle_tpu import device
+
+        assert device.device_count() >= 1
+        assert not device.is_compiled_with_cuda()
+        assert device.cuda.device_count() == 0
+        device.cuda.synchronize()  # barrier, must not raise
+        assert isinstance(device.get_available_device(), list)
+        assert "cpu" in device.get_all_device_type()
+
+
+class TestSysconfig:
+    def test_paths_exist(self):
+        from paddle_tpu import sysconfig
+
+        inc = sysconfig.get_include()
+        assert os.path.exists(os.path.join(inc, "pd_inference_api.h"))
+        assert isinstance(sysconfig.get_lib(), str)
+
+
+class TestHub:
+    def test_local_hubconf_roundtrip(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "dependencies = ['numpy']\n"
+            "def tiny(scale=1):\n"
+            "    '''A tiny model.'''\n"
+            "    import paddle_tpu as paddle\n"
+            "    net = paddle.nn.Linear(2, 2)\n"
+            "    net.scale = scale\n"
+            "    return net\n")
+        from paddle_tpu import hub
+
+        assert hub.list(str(tmp_path)) == ["tiny"]
+        assert "tiny model" in hub.help(str(tmp_path), "tiny")
+        net = hub.load(str(tmp_path), "tiny", scale=3)
+        assert net.scale == 3
+
+    def test_remote_sources_rejected(self, tmp_path):
+        from paddle_tpu import hub
+
+        with pytest.raises(ValueError, match="zero-egress"):
+            hub.load(str(tmp_path), "x", source="github")
+
+
+class TestOnnxGate:
+    def test_export_raises_with_guidance(self):
+        from paddle_tpu import onnx
+
+        with pytest.raises((ModuleNotFoundError, NotImplementedError),
+                           match="pdexport|onnx"):
+            onnx.export(paddle.nn.Linear(2, 2), "/tmp/x")
+
+
+class TestCallbacksShim:
+    def test_exports(self):
+        from paddle_tpu import callbacks
+
+        for name in ["Callback", "ProgBarLogger", "ModelCheckpoint",
+                     "VisualDL", "LRScheduler", "EarlyStopping",
+                     "ReduceLROnPlateau"]:
+            assert hasattr(callbacks, name), name
+
+    def test_reduce_lr_on_plateau_shrinks(self):
+        from paddle_tpu.callbacks import ReduceLROnPlateau
+
+        paddle.seed(0)
+        net = paddle.nn.Linear(2, 2)
+        opt = paddle.optimizer.SGD(learning_rate=1.0,
+                                   parameters=net.parameters())
+
+        class FakeModel:
+            _optimizer = opt
+
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                               verbose=0)
+        cb.model = FakeModel()
+        cb.on_epoch_end(0, {"loss": 1.0})
+        for e in range(1, 4):
+            cb.on_epoch_end(e, {"loss": 1.0})  # plateau
+        assert opt.get_lr() == pytest.approx(0.5)
+
+    def test_visualdl_writes_jsonl(self, tmp_path):
+        import json
+
+        from paddle_tpu.callbacks import VisualDL
+
+        cb = VisualDL(log_dir=str(tmp_path))
+        cb.on_train_batch_end(0, {"loss": np.float32(2.5)})
+        cb.on_eval_end({"acc": 0.75})
+        lines = [json.loads(x) for x in
+                 (tmp_path / "scalars.jsonl").read_text().splitlines()]
+        assert lines[0]["tag"] == "train" and lines[0]["loss"] == 2.5
+        assert lines[1]["tag"] == "eval" and lines[1]["acc"] == 0.75
+
+
+class TestReaderErrorPropagation:
+    def test_buffered_raises_producer_error(self):
+        from paddle_tpu import reader
+
+        def bad():
+            yield 1
+            raise IOError("disk gone")
+
+        it = reader.buffered(bad, 4)()
+        assert next(it) == 1
+        with pytest.raises(IOError, match="disk gone"):
+            list(it)
+
+    def test_xmap_raises_mapper_error(self):
+        from paddle_tpu import reader
+
+        def mapper(x):
+            if x == 5:
+                raise ValueError("corrupt sample")
+            return x
+
+        r = reader.xmap_readers(mapper, lambda: iter(range(10)),
+                                process_num=2, buffer_size=4)
+        with pytest.raises(ValueError, match="corrupt sample"):
+            list(r())
+
+    def test_compose_numpy_samples(self):
+        from paddle_tpu import reader
+
+        comp = reader.compose(lambda: iter([np.ones(3)]),
+                              lambda: iter([np.zeros(2)]))
+        out = list(comp())
+        assert len(out) == 1 and out[0][0].shape == (3,)
+
+    def test_multiprocess_reader_raises(self):
+        from paddle_tpu import reader
+
+        def bad():
+            raise RuntimeError("boom")
+            yield  # pragma: no cover
+
+        with pytest.raises(RuntimeError, match="boom"):
+            list(reader.multiprocess_reader([bad])())
