@@ -370,7 +370,7 @@ class ParallelTrainStep:
             )
             for a in (labels if isinstance(labels, (tuple, list)) else (labels,))
         )
-        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        lr = self._optimizer.lr_device_scalar()
         opt_state = self._opt_state
         if self._offload:
             # stream host-resident optimizer state into HBM (async device_put)

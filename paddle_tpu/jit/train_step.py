@@ -96,7 +96,7 @@ class TrainStep:
         raw_labels = tuple(
             a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in labels
         )
-        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        lr = self._optimizer.lr_device_scalar()
         self._params, self._buffers, self._opt_state, loss, flags = self._jitted(
             self._params, self._buffers, self._opt_state, lr,
             (raw_inputs, raw_labels),

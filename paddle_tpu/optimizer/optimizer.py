@@ -59,6 +59,20 @@ class Optimizer:
         self._global_step = 0
 
     # -- lr ------------------------------------------------------------------
+    def lr_device_scalar(self):
+        """Device scalar of the current LR, cached while the value is
+        unchanged — a fresh jnp.asarray would issue one host→device
+        transfer every step (real cost through a remote-TPU tunnel;
+        constant-LR training needs exactly one). Shared by the compiled
+        train steps (jit.TrainStep, fleet ParallelTrainStep)."""
+        value = self.get_lr()
+        cached = getattr(self, "_lr_dev_cache", None)
+        if cached is not None and cached[0] == value:
+            return cached[1]
+        dev = jnp.asarray(value, jnp.float32)
+        self._lr_dev_cache = (value, dev)
+        return dev
+
     def get_lr(self) -> float:
         if isinstance(self._learning_rate, LRScheduler):
             return float(self._learning_rate())
