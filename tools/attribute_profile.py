@@ -1,0 +1,64 @@
+"""Join a jax.profiler trace's per-op device durations with the compiled
+HLO's metadata (source_file/source_line/op_name) — attributes every fusion
+to the model source line that produced it. This is how the r4 perf work
+located the LayerNorm-backward and attention-backward costs.
+
+Usage:
+  1. dump compiled HLO: jitted.lower(*args).compile().as_text() -> hlo.txt
+  2. profile N steps with jax.profiler.trace(logdir)
+  3. python tools/attribute_profile.py hlo.txt logdir N
+"""
+import collections, glob, gzip, json, re, sys
+
+if len(sys.argv) != 4:
+    raise SystemExit("usage: attribute_profile.py <hlo.txt> <trace_logdir> <n_steps>")
+hlo_path, logdir, steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+# fusion name -> (file:line, op_name) from HLO metadata
+meta = {}
+pat = re.compile(r"%(\S+?) = .*?metadata=\{([^}]*)\}")
+for line in open(hlo_path):
+    m = pat.search(line)
+    if not m:
+        continue
+    name, md = m.group(1), m.group(2)
+    f = re.search(r'source_file="([^"]+)"', md)
+    l = re.search(r"source_line=(\d+)", md)
+    op = re.search(r'op_name="([^"]+)"', md)
+    meta[name] = (
+        (f.group(1).split("/")[-1] if f else "?") + ":" + (l.group(1) if l else "?"),
+        op.group(1) if op else "?",
+    )
+
+paths = sorted(glob.glob(f"{logdir}/plugins/profile/*/*.trace.json.gz"))
+with gzip.open(paths[-1]) as f:
+    trace = json.load(f)
+events = trace["traceEvents"]
+procs, op_lanes = {}, set()
+for e in events:
+    if e.get("ph") != "M":
+        continue
+    if e.get("name") == "process_name":
+        procs[e["pid"]] = e["args"]["name"]
+    elif e.get("name") == "thread_name" and "XLA Ops" in e["args"].get("name", ""):
+        op_lanes.add((e["pid"], e.get("tid")))
+tpu_pids = {p for p, n in procs.items()
+            if "TPU" in n or "xla" in n.lower() or "/device" in n.lower()}
+by_src = collections.Counter()
+by_op = collections.Counter()
+for e in events:
+    if (e.get("ph") != "X" or e.get("pid") not in tpu_pids
+            or (e.get("pid"), e.get("tid")) not in op_lanes):
+        continue
+    name = e.get("name", "")
+    dur = e.get("dur", 0) / 1000.0
+    src, op = meta.get(name, ("<unattributed:" + re.sub(r"[.\d]+$", "", name) + ">", "?"))
+    by_src[src] += dur
+    opshort = re.sub(r"\[\d+\]", "", op)
+    by_op[(src, opshort)] += dur
+print("== by source line (ms/step) ==")
+for src, ms in by_src.most_common(30):
+    print(f"{ms/steps:9.3f}  {src}")
+print("\n== by (source, op_name) ==")
+for (src, op), ms in by_op.most_common(40):
+    print(f"{ms/steps:9.3f}  {src:34s}  {op[:90]}")
