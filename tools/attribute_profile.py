@@ -10,55 +10,89 @@ Usage:
 """
 import collections, glob, gzip, json, re, sys
 
-if len(sys.argv) != 4:
-    raise SystemExit("usage: attribute_profile.py <hlo.txt> <trace_logdir> <n_steps>")
-hlo_path, logdir, steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
 
-# fusion name -> (file:line, op_name) from HLO metadata
-meta = {}
-pat = re.compile(r"%(\S+?) = .*?metadata=\{([^}]*)\}")
-for line in open(hlo_path):
-    m = pat.search(line)
-    if not m:
-        continue
-    name, md = m.group(1), m.group(2)
-    f = re.search(r'source_file="([^"]+)"', md)
-    l = re.search(r"source_line=(\d+)", md)
-    op = re.search(r'op_name="([^"]+)"', md)
-    meta[name] = (
-        (f.group(1).split("/")[-1] if f else "?") + ":" + (l.group(1) if l else "?"),
-        op.group(1) if op else "?",
-    )
 
-paths = sorted(glob.glob(f"{logdir}/plugins/profile/*/*.trace.json.gz"))
-with gzip.open(paths[-1]) as f:
-    trace = json.load(f)
-events = trace["traceEvents"]
-procs, op_lanes = {}, set()
-for e in events:
-    if e.get("ph") != "M":
-        continue
-    if e.get("name") == "process_name":
-        procs[e["pid"]] = e["args"]["name"]
-    elif e.get("name") == "thread_name" and "XLA Ops" in e["args"].get("name", ""):
-        op_lanes.add((e["pid"], e.get("tid")))
-tpu_pids = {p for p, n in procs.items()
-            if "TPU" in n or "xla" in n.lower() or "/device" in n.lower()}
-by_src = collections.Counter()
-by_op = collections.Counter()
-for e in events:
-    if (e.get("ph") != "X" or e.get("pid") not in tpu_pids
-            or (e.get("pid"), e.get("tid")) not in op_lanes):
-        continue
-    name = e.get("name", "")
-    dur = e.get("dur", 0) / 1000.0
-    src, op = meta.get(name, ("<unattributed:" + re.sub(r"[.\d]+$", "", name) + ">", "?"))
-    by_src[src] += dur
-    opshort = re.sub(r"\[\d+\]", "", op)
-    by_op[(src, opshort)] += dur
-print("== by source line (ms/step) ==")
-for src, ms in by_src.most_common(30):
-    print(f"{ms/steps:9.3f}  {src}")
-print("\n== by (source, op_name) ==")
-for (src, op), ms in by_op.most_common(40):
-    print(f"{ms/steps:9.3f}  {src:34s}  {op[:90]}")
+def device_total_ms(logdir):
+    """Total device time (ms) across the XLA Ops lanes of the newest trace
+    under ``logdir`` — shared by the experiment benchmarks."""
+    import glob as _glob
+    import gzip as _gzip
+    import json as _json
+
+    paths = sorted(_glob.glob(f"{logdir}/plugins/profile/*/*.trace.json.gz"))
+    with _gzip.open(paths[-1]) as fh:
+        trace = _json.load(fh)
+    events = trace["traceEvents"]
+    procs, lanes = {}, set()
+    for ev in events:
+        if ev.get("ph") != "M":
+            continue
+        if ev.get("name") == "process_name":
+            procs[ev["pid"]] = ev["args"]["name"]
+        elif (ev.get("name") == "thread_name"
+              and "XLA Ops" in ev["args"].get("name", "")):
+            lanes.add((ev["pid"], ev.get("tid")))
+    tpu = {p for p, n in procs.items()
+           if "TPU" in n or "xla" in n.lower() or "/device" in n.lower()}
+    return sum(ev.get("dur", 0) / 1000.0 for ev in events
+               if ev.get("ph") == "X" and ev.get("pid") in tpu
+               and (ev.get("pid"), ev.get("tid")) in lanes)
+
+
+def main():
+    if len(sys.argv) != 4:
+        raise SystemExit("usage: attribute_profile.py <hlo.txt> <trace_logdir> <n_steps>")
+    hlo_path, logdir, steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+    # fusion name -> (file:line, op_name) from HLO metadata
+    meta = {}
+    pat = re.compile(r"%(\S+?) = .*?metadata=\{([^}]*)\}")
+    for line in open(hlo_path):
+        m = pat.search(line)
+        if not m:
+            continue
+        name, md = m.group(1), m.group(2)
+        f = re.search(r'source_file="([^"]+)"', md)
+        l = re.search(r"source_line=(\d+)", md)
+        op = re.search(r'op_name="([^"]+)"', md)
+        meta[name] = (
+            (f.group(1).split("/")[-1] if f else "?") + ":" + (l.group(1) if l else "?"),
+            op.group(1) if op else "?",
+        )
+
+    paths = sorted(glob.glob(f"{logdir}/plugins/profile/*/*.trace.json.gz"))
+    with gzip.open(paths[-1]) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    procs, op_lanes = {}, set()
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            procs[e["pid"]] = e["args"]["name"]
+        elif e.get("name") == "thread_name" and "XLA Ops" in e["args"].get("name", ""):
+            op_lanes.add((e["pid"], e.get("tid")))
+    tpu_pids = {p for p, n in procs.items()
+                if "TPU" in n or "xla" in n.lower() or "/device" in n.lower()}
+    by_src = collections.Counter()
+    by_op = collections.Counter()
+    for e in events:
+        if (e.get("ph") != "X" or e.get("pid") not in tpu_pids
+                or (e.get("pid"), e.get("tid")) not in op_lanes):
+            continue
+        name = e.get("name", "")
+        dur = e.get("dur", 0) / 1000.0
+        src, op = meta.get(name, ("<unattributed:" + re.sub(r"[.\d]+$", "", name) + ">", "?"))
+        by_src[src] += dur
+        opshort = re.sub(r"\[\d+\]", "", op)
+        by_op[(src, opshort)] += dur
+    print("== by source line (ms/step) ==")
+    for src, ms in by_src.most_common(30):
+        print(f"{ms/steps:9.3f}  {src}")
+    print("\n== by (source, op_name) ==")
+    for (src, op), ms in by_op.most_common(40):
+        print(f"{ms/steps:9.3f}  {src:34s}  {op[:90]}")
+
+
+if __name__ == "__main__":
+    main()

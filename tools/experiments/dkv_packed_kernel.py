@@ -7,7 +7,8 @@ GPT-2 345M backward. d=64 half-fills the MXU for the per-head dots, so this
 kernel (a) puts heads in the GRID (no per-head python loop — the overhead
 killer in ops/flash_tpu.py), and (b) packs dv+dk into ONE full-128-lane dot:
 
-  per grid cell (bh, jk): k/v block resident; loop q-blocks i >= jk:
+  per grid cell (bh, jk): k/v block resident; loop q-blocks i covering
+  queries >= this key block:
     s = q_i k^T;  p = exp(s - lse);  dp = do_i v^T;  ds = p (dp - delta)
     acc += [p; ds]^T @ [[do_i | 0], [0 | q_i*scale]]  -> [bk, 2d] = [dv | dk]
 
@@ -21,9 +22,20 @@ production backward.
 
 Usage: python tools/experiments/dkv_packed_kernel.py 512 512
 """
-import functools, time, glob, gzip, json, shutil
-import jax, jax.numpy as jnp, numpy as np
+import functools
+import os
+import shutil
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from attribute_profile import device_total_ms  # noqa: E402
 
 NEG = -1e30
 
@@ -59,7 +71,11 @@ def dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             L2, R, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)   # [bk, 2d]
 
-    acc = jax.lax.fori_loop(jk, nq, body, jnp.zeros((bk, 2 * d), jnp.float32))
+    # causal skip: first q-block whose queries can reach this key block
+    # ((jk*bk)//bq — NOT jk, which is only right when bq == bk)
+    start = (jk * bk) // bq
+    acc = jax.lax.fori_loop(start, nq, body,
+                            jnp.zeros((bk, 2 * d), jnp.float32))
     dkv_ref[0] = acc.astype(dkv_ref.dtype)
 
 
@@ -91,24 +107,9 @@ def dkv_call(q4, k4, v4, do4, lse, delta, bq=512, bk=512):
     return dk, dv
 
 
-def device_ms(logdir):
-    paths = sorted(glob.glob(f"{logdir}/plugins/profile/*/*.trace.json.gz"))
-    with gzip.open(paths[-1]) as fh:
-        trace = json.load(fh)
-    events = trace["traceEvents"]
-    procs, lanes = {}, set()
-    for ev in events:
-        if ev.get("ph") != "M": continue
-        if ev.get("name") == "process_name": procs[ev["pid"]] = ev["args"]["name"]
-        elif ev.get("name") == "thread_name" and "XLA Ops" in ev["args"].get("name", ""):
-            lanes.add((ev["pid"], ev.get("tid")))
-    tpu = {p for p, n in procs.items() if "TPU" in n or "/device" in n.lower()}
-    return sum(ev.get("dur", 0) / 1000.0 for ev in events
-               if ev.get("ph") == "X" and ev.get("pid") in tpu
-               and (ev.get("pid"), ev.get("tid")) in lanes)
-
-
 def main():
+    bq, bk = (int(sys.argv[1]), int(sys.argv[2])) if len(sys.argv) == 3 \
+        else (512, 512)
     b, H, L, d = 8, 16, 1024, 64
     rng = np.random.RandomState(0)
     mk = lambda: jnp.asarray(rng.randn(b, H, L, d) * 0.2, jnp.bfloat16)
@@ -123,17 +124,16 @@ def main():
     out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
     delta = jnp.einsum("bhqd,bhqd->bhq", do.astype(jnp.float32), out)
 
-    import sys
-    bq, bk = int(sys.argv[1]), int(sys.argv[2])
     jit_dkv = jax.jit(functools.partial(dkv_call, bq=bq, bk=bk))
     dk, dv = jit_dkv(q, k, v, do, lse, delta)
-    # correctness vs autodiff
+
     def att(q_, k_, v_):
         s_ = jnp.einsum("bhqd,bhkd->bhqk", q_.astype(jnp.float32),
                         k_.astype(jnp.float32)) / np.sqrt(d)
         s_ = jnp.where(mask, s_, NEG)
         p_ = jax.nn.softmax(s_, axis=-1)
         return jnp.einsum("bhqk,bhkd->bhqd", p_, v_.astype(jnp.float32))
+
     _, vjp = jax.vjp(att, q, k, v)
     _, dk_ref, dv_ref = vjp(do.astype(jnp.float32))
     err_k = float(jnp.max(jnp.abs(dk.astype(jnp.float32) - dk_ref)))
@@ -148,139 +148,8 @@ def main():
             dk, dv = jit_dkv(q, k, v, do, lse, delta)
         float(jnp.sum(dk.astype(jnp.float32)))
     time.sleep(0.5)
-    print(f"bq={bq} bk={bk}: {device_ms('/tmp/kdkv')/REPS:.3f} ms/layer")
+    print(f"bq={bq} bk={bk}: {device_total_ms('/tmp/kdkv')/REPS:.3f} ms/layer")
 
-if __name__ == "__main__":
-    main()
-
-import functools, time, glob, gzip, json, shutil
-import jax, jax.numpy as jnp, numpy as np
-from jax.experimental import pallas as pl
-
-NEG = -1e30
-
-
-def dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dkv_ref, *, bq, bk, nq, d, scale):
-    jk = pl.program_id(1)
-    kh = k_ref[0].astype(jnp.bfloat16)          # [bk, d]
-    vh = v_ref[0].astype(jnp.bfloat16)
-
-    def body(i, acc):
-        qs = (q_ref[0, pl.dslice(i * bq, bq), :].astype(jnp.float32)
-              * scale).astype(jnp.bfloat16)      # [bq, d]
-        doh = do_ref[0, pl.dslice(i * bq, bq), :].astype(jnp.bfloat16)
-        lse = lse_ref[0, 0, pl.dslice(i * bq, bq)]
-        delta = delta_ref[0, 0, pl.dslice(i * bq, bq)]
-        s = jax.lax.dot_general(qs, kh, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        k_pos = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        s = jnp.where(k_pos <= q_pos, s, NEG)
-        p = jnp.exp(s - lse[:, None])
-        dp = jax.lax.dot_general(doh, vh, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        # packed [2bq, bk] LHS and block-diag [2bq, 2d] RHS -> one dot
-        L2 = jnp.concatenate([p.astype(jnp.bfloat16),
-                              ds.astype(jnp.bfloat16)], axis=0)
-        z = jnp.zeros((bq, d), jnp.bfloat16)
-        R = jnp.concatenate([jnp.concatenate([doh, z], axis=1),
-                             jnp.concatenate([z, qs], axis=1)], axis=0)
-        return acc + jax.lax.dot_general(
-            L2, R, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)   # [bk, 2d]
-
-    acc = jax.lax.fori_loop(jk, nq, body, jnp.zeros((bk, 2 * d), jnp.float32))
-    dkv_ref[0] = acc.astype(dkv_ref.dtype)
-
-
-def dkv_call(q4, k4, v4, do4, lse, delta, bq=512, bk=512):
-    # q4...: [b, H, L, d]; lse/delta: [b, H, L]
-    b, H, L, d = q4.shape
-    bh = b * H
-    rs = lambda t: t.reshape(bh, L, d)
-    st = lambda t: t.reshape(bh, 1, L)
-    grid = (bh, L // bk)
-    kw = dict(bq=bq, bk=bk, nq=L // bq, d=d, scale=1.0 / np.sqrt(d))
-    with jax.enable_x64(False):
-        out = pl.pallas_call(
-            functools.partial(dkv_kernel, **kw),
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, L, d), lambda ib, j: (ib, 0, 0)),
-                pl.BlockSpec((1, bk, d), lambda ib, j: (ib, j, 0)),
-                pl.BlockSpec((1, bk, d), lambda ib, j: (ib, j, 0)),
-                pl.BlockSpec((1, L, d), lambda ib, j: (ib, 0, 0)),
-                pl.BlockSpec((1, 1, L), lambda ib, j: (ib, 0, 0)),
-                pl.BlockSpec((1, 1, L), lambda ib, j: (ib, 0, 0)),
-            ],
-            out_specs=pl.BlockSpec((1, bk, 2 * d), lambda ib, j: (ib, j, 0)),
-            out_shape=jax.ShapeDtypeStruct((bh, L, 2 * d), jnp.bfloat16),
-        )(rs(q4), rs(k4), rs(v4), rs(do4), st(lse), st(delta))
-    dv = out[:, :, :d].reshape(b, H, L, d)
-    dk = out[:, :, d:].reshape(b, H, L, d)
-    return dk, dv
-
-
-def device_ms(logdir):
-    paths = sorted(glob.glob(f"{logdir}/plugins/profile/*/*.trace.json.gz"))
-    with gzip.open(paths[-1]) as fh:
-        trace = json.load(fh)
-    events = trace["traceEvents"]
-    procs, lanes = {}, set()
-    for ev in events:
-        if ev.get("ph") != "M": continue
-        if ev.get("name") == "process_name": procs[ev["pid"]] = ev["args"]["name"]
-        elif ev.get("name") == "thread_name" and "XLA Ops" in ev["args"].get("name", ""):
-            lanes.add((ev["pid"], ev.get("tid")))
-    tpu = {p for p, n in procs.items() if "TPU" in n or "/device" in n.lower()}
-    return sum(ev.get("dur", 0) / 1000.0 for ev in events
-               if ev.get("ph") == "X" and ev.get("pid") in tpu
-               and (ev.get("pid"), ev.get("tid")) in lanes)
-
-
-def main():
-    b, H, L, d = 8, 16, 1024, 64
-    rng = np.random.RandomState(0)
-    mk = lambda: jnp.asarray(rng.randn(b, H, L, d) * 0.2, jnp.bfloat16)
-    q, k, v, do = mk(), mk(), mk(), mk()
-    # reference stats from a plain softmax attention
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) / np.sqrt(d)
-    mask = np.tril(np.ones((L, L), bool))
-    s = jnp.where(mask, s, NEG)
-    lse = jax.scipy.special.logsumexp(s, axis=-1)
-    p = jnp.exp(s - lse[..., None])
-    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
-    delta = jnp.einsum("bhqd,bhqd->bhq", do.astype(jnp.float32), out)
-
-    import sys
-    bq, bk = int(sys.argv[1]), int(sys.argv[2])
-    jit_dkv = jax.jit(functools.partial(dkv_call, bq=bq, bk=bk))
-    dk, dv = jit_dkv(q, k, v, do, lse, delta)
-    # correctness vs autodiff
-    def att(q_, k_, v_):
-        s_ = jnp.einsum("bhqd,bhkd->bhqk", q_.astype(jnp.float32),
-                        k_.astype(jnp.float32)) / np.sqrt(d)
-        s_ = jnp.where(mask, s_, NEG)
-        p_ = jax.nn.softmax(s_, axis=-1)
-        return jnp.einsum("bhqk,bhkd->bhqd", p_, v_.astype(jnp.float32))
-    _, vjp = jax.vjp(att, q, k, v)
-    _, dk_ref, dv_ref = vjp(do.astype(jnp.float32))
-    err_k = float(jnp.max(jnp.abs(dk.astype(jnp.float32) - dk_ref)))
-    err_v = float(jnp.max(jnp.abs(dv.astype(jnp.float32) - dv_ref)))
-    print("max err dk", err_k, "dv", err_v,
-          "(ref scale", float(jnp.max(jnp.abs(dk_ref))), ")")
-
-    REPS = 5
-    shutil.rmtree("/tmp/kdkv", ignore_errors=True)
-    with jax.profiler.trace("/tmp/kdkv"):
-        for _ in range(REPS):
-            dk, dv = jit_dkv(q, k, v, do, lse, delta)
-        float(jnp.sum(dk.astype(jnp.float32)))
-    time.sleep(0.5)
-    print(f"bq={bq} bk={bk}: {device_ms('/tmp/kdkv')/REPS:.3f} ms/layer")
 
 if __name__ == "__main__":
     main()
