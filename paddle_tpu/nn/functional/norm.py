@@ -20,15 +20,40 @@ def _t(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
+def _bn_stats(af, axes):
+    """Batch mean/var ([C]-shaped) from ONE variadic reduction pass.
+
+    sum(x) and sum(x*x) over the same operand fuse into a single
+    multi-output reduce on TPU, so stats cost one read of the activation
+    instead of jnp.mean + jnp.var's two-to-three (r4 profile: 5.4 ms/step
+    of convert_reduce fusions on ResNet-50 were exactly these passes).
+    var = E[x^2] - mu^2, ALWAYS accumulated in f32 (in bf16 the
+    uncentered form cancels catastrophically — mean 10/std 0.1 data
+    rounds var to the 0-clamp — and sum(x*x) overflows fp16);
+    clamped at 0 against residual cancellation."""
+    af = af.astype(jnp.float32)
+    n = 1.0
+    for ax in axes:
+        n *= af.shape[ax]
+    s1 = jnp.sum(af, axis=axes)
+    s2 = jnp.sum(af * af, axis=axes)
+    mu = s1 / n
+    var = jnp.maximum(s2 / n - mu * mu, 0.0)
+    return mu, var
+
+
 def _bn_fwd_impl(a, w, b, ch_axis, axes, epsilon):
     af = a.astype(jnp.float32)
-    mu = jnp.mean(af, axis=axes, keepdims=True)
-    var = jnp.var(af, axis=axes, keepdims=True)
+    mu, var = _bn_stats(af, axes)
     rstd = jax.lax.rsqrt(var + epsilon)
     shape = [1] * a.ndim
     shape[ch_axis] = a.shape[ch_axis]
-    out = (((af - mu) * rstd).astype(a.dtype) * w.reshape(shape)
-           + b.reshape(shape))
+    # fold the normalize+affine into one per-channel scale/shift so the
+    # output pass is a single fused multiply-add over a (no full-size f32
+    # (af-mu) intermediate)
+    k = w.astype(jnp.float32) * rstd
+    c = b.astype(jnp.float32) - mu * k
+    out = (af * k.reshape(shape) + c.reshape(shape)).astype(a.dtype)
     return out, (a, w, b, mu, rstd)
 
 
@@ -53,19 +78,26 @@ def _bn_manual_fwd(a, w, b, ch_axis, axes, epsilon):
 
 
 def _bn_manual_bwd(ch_axis, axes, epsilon, res, dy):
+    # Two passes over (a, dy) total: pass 1 is the db/dw variadic reduce
+    # (xhat recomputed from the saved [C] stats — no residual store); pass 2
+    # the dx elementwise. The centering constants come from db/dw instead of
+    # their own mean(g)/mean(g*xh) reductions: with per-channel w,
+    # mean(g) = w*db/n and mean(g*xh) = w*dw/n.
     a, w, b, mu, rstd = res
-    af = a.astype(jnp.float32)
-    xh = (af - mu) * rstd
     shape = [1] * a.ndim
     shape[ch_axis] = a.shape[ch_axis]
-    g = dy.astype(jnp.float32) * w.astype(jnp.float32).reshape(shape)
-    c1 = jnp.mean(g, axis=axes, keepdims=True)
-    c2 = jnp.mean(g * xh, axis=axes, keepdims=True)
-    dx = (rstd * (g - c1 - xh * c2)).astype(a.dtype)
+    n = 1.0
+    for ax in axes:
+        n *= a.shape[ax]
+    af = a.astype(jnp.float32)
+    xh = (af - mu.reshape(shape)) * rstd.reshape(shape)
     dyf = dy.astype(jnp.float32)
-    dw = jnp.sum(dyf * xh, axis=axes).astype(w.dtype)
-    db = jnp.sum(dyf, axis=axes).astype(b.dtype)
-    return dx, dw, db
+    db = jnp.sum(dyf, axis=axes)
+    dw = jnp.sum(dyf * xh, axis=axes)
+    k = (w.astype(jnp.float32) * rstd).reshape(shape)
+    dx = (k * (dyf - (db / n).reshape(shape) - xh * (dw / n).reshape(shape))
+          ).astype(a.dtype)
+    return dx, dw.astype(w.dtype), db.astype(b.dtype)
 
 
 _bn_manual.defvjp(_bn_manual_fwd, _bn_manual_bwd)
@@ -96,16 +128,21 @@ def batch_norm(
         # semantics match the reference: r = m*r + (1-m)*batch). On the
         # manual path these reductions CSE with _bn_manual's internal ones
         # under jit (identical expressions over the same operand).
-        stat_in = ((lambda a: a.astype(jnp.float32)) if manual
-                   else (lambda a: a))
-        mean = apply_op(lambda a: jnp.mean(stat_in(a), axis=reduce_axes), x)
-        var = apply_op(lambda a: jnp.var(stat_in(a), axis=reduce_axes), x)
+        mean, var = apply_op(
+            lambda a: _bn_stats(a, reduce_axes), x, multi_out=True)
         if running_mean is not None:
+            # EMA in the running-stat buffer's own dtype: the f32 batch
+            # stats would otherwise silently promote bf16/fp16 buffers
+            # (dtype drift in state_dict + a retrace on the next step)
             running_mean._value = (
-                momentum * running_mean._value + (1.0 - momentum) * mean._value
+                momentum * running_mean._value
+                + (1.0 - momentum)
+                * mean._value.astype(running_mean._value.dtype)
             )
             running_var._value = (
-                momentum * running_var._value + (1.0 - momentum) * var._value
+                momentum * running_var._value
+                + (1.0 - momentum)
+                * var._value.astype(running_var._value.dtype)
             )
         if manual:
             return apply_op(

@@ -4,6 +4,10 @@ reference's pool_op.cu / cuDNN pooling).
 """
 from __future__ import annotations
 
+import functools
+import itertools
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -70,6 +74,11 @@ def _pool(x, kernel, stride, padding, n, op, channel_last, ceil_mode=False,
                 full[ax] = (lo, hi)
             padding_cfg = full
         if op == "max":
+            if (jnp.issubdtype(a.dtype, jnp.floating)
+                    and isinstance(padding_cfg, list)
+                    and os.environ.get("PADDLE_TPU_MANUAL_MAXPOOL", "0") == "1"):
+                return _manual_maxpool(window, strides,
+                                       tuple(padding_cfg))(a)
             init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
             return jax.lax.reduce_window(a, init, jax.lax.max, window, strides, padding_cfg)
         # avg: sum then divide by count (exclusive=True divides by valid count)
@@ -81,6 +90,75 @@ def _pool(x, kernel, stride, padding, n, op, channel_last, ceil_mode=False,
         return s / float(np.prod(kernel))
 
     return apply_op(f, _t(x))
+
+
+@functools.lru_cache(maxsize=None)
+def _manual_maxpool(window, strides, pads):
+    """Floating max-pool with a value-equality backward. NEGATIVE RESULT —
+    default OFF (opt in via PADDLE_TPU_MANUAL_MAXPOOL=1).
+
+    Motivation: XLA differentiates ``reduce_window(max)`` into
+    select-and-scatter — 1.43 ms/step of the ResNet-50 profile
+    (tools/profiles/r4_resnet.txt). This rule instead routes gradients by
+    VALUE EQUALITY: eq_u = (view_u == y) over the prod(window) strided
+    views, dx accumulated either by dilated-pad scatter-back or by
+    gathering the dilated y/scale grids. Ties split the gradient evenly
+    (sum-preserving; XLA and the reference's cuDNN kernel pick one winner —
+    identical on tie-free continuous inputs).
+
+    Measured on v5e at the ResNet stem shape ([64,64,112,112] bf16, k3 s2
+    p1), fwd+bwd chained 10× in one jit: XLA select-and-scatter ≈ 9 ms/iter
+    incl. harness, pad-scatter formulation 76 ms, single-dilation gather
+    formulation 52 ms — the shifted-window equality passes do NOT fuse into
+    the two elementwise loops the arithmetic suggests on this emitter, so
+    the manual rule loses 6-8× and end-to-end ResNet-50 dropped
+    1581→1205 samples/s. Kept as an opt-in record of the experiment.
+
+    Forward is the same ``reduce_window`` either way; when no gradient is
+    taken the custom_vjp adds nothing.
+    """
+
+    @jax.custom_vjp
+    def mp(a):
+        return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, window,
+                                     strides, list(pads))
+
+    def fwd(a):
+        y = mp(a)
+        return y, (a, y)
+
+    def bwd(res, dy):
+        a, y = res
+        nd = a.ndim
+        ap = jax.lax.pad(a, jnp.asarray(-jnp.inf, a.dtype),
+                         [(lo, hi, 0) for lo, hi in pads])
+        dyf = dy.astype(jnp.float32)
+        offsets = list(itertools.product(*(range(w) for w in window)))
+
+        def view(u):
+            limit = [u[d] + strides[d] * (y.shape[d] - 1) + 1
+                     for d in range(nd)]
+            return jax.lax.slice(ap, u, limit, strides)
+
+        eqs = [view(u) == y for u in offsets]
+        cnt = functools.reduce(
+            jnp.add, (e.astype(jnp.float32) for e in eqs))
+        scale = dyf / cnt
+        dxp = None
+        for u, eq in zip(offsets, eqs):
+            part = jnp.where(eq, scale, 0.0)
+            cfg = [(u[d],
+                    ap.shape[d] - (u[d] + strides[d] * (y.shape[d] - 1) + 1),
+                    strides[d] - 1) for d in range(nd)]
+            scattered = jax.lax.pad(part, jnp.asarray(0.0, jnp.float32), cfg)
+            dxp = scattered if dxp is None else dxp + scattered
+        dx = jax.lax.slice(
+            dxp, [lo for lo, _ in pads],
+            [lo + s for (lo, _), s in zip(pads, a.shape)])
+        return (dx.astype(a.dtype),)
+
+    mp.defvjp(fwd, bwd)
+    return mp
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
