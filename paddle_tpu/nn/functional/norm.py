@@ -155,6 +155,10 @@ def batch_norm(
     shape = [1] * x.ndim
     shape[ch_axis] = x.shape[ch_axis]
 
+    cast_back = use_batch_stats  # train-mode stats are f32; keep the
+    # output in the input's dtype (eval keeps its historical promotion
+    # semantics when running stats are wider than the input)
+
     def f(a, m, v, *wb):
         m = m.reshape(shape)
         v = v.reshape(shape)
@@ -165,7 +169,7 @@ def batch_norm(
             i += 1
         if bias is not None:
             out = out + wb[i].reshape(shape)
-        return out
+        return out.astype(a.dtype) if cast_back else out
 
     args = [x, mean, var]
     if weight is not None:

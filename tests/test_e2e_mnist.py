@@ -103,6 +103,70 @@ def test_static_program_executor():
     assert losses[-1] < losses[0] * 0.1, f"{losses[0]} -> {losses[-1]}"
 
 
+def test_executor_run_steps_matches_per_step_loop():
+    """Executor.run_steps (scan-window) must replay EXACTLY the per-step
+    semantics: same losses, same final params, LR schedule advanced per
+    window step — with both constant and [n_steps]-stacked feeds."""
+
+    def build():
+        paddle.seed(0)
+        main = paddle.static.Program()
+        start = paddle.static.Program()
+        with paddle.static.program_guard(main, start):
+            x = paddle.static.data("x", [None, 4], "float32")
+            y = paddle.static.data("y", [None, 1], "float32")
+            out = paddle.static.nn.fc(x, 1)
+            loss = F.mse_loss(out, y)
+            sched = optimizer.lr.StepDecay(learning_rate=0.1, step_size=2,
+                                           gamma=0.5)
+            opt = optimizer.SGD(learning_rate=sched)
+            opt.minimize(loss)
+        exe = paddle.static.Executor()
+        exe.run(start)
+        return main, exe, loss, sched
+
+    rng = np.random.RandomState(0)
+    xb = rng.rand(16, 4).astype(np.float32)
+    yb = (xb @ np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32))
+
+    main_a, exe_a, loss_a, sched_a = build()
+    seq = []
+    for _ in range(6):
+        (lv,) = exe_a.run(main_a, feed={"x": xb, "y": yb},
+                          fetch_list=[loss_a])
+        seq.append(float(lv))
+        sched_a.step()
+    params_a = [np.asarray(p._value) for p in main_a.parameters.values()]
+
+    main_b, exe_b, loss_b, _ = build()
+    (win,) = exe_b.run_steps(main_b, feed={"x": xb, "y": yb},
+                             fetch_list=[loss_b], n_steps=6)
+    np.testing.assert_allclose(np.asarray(win).ravel(), seq, rtol=1e-5)
+    params_b = [np.asarray(p._value) for p in main_b.parameters.values()]
+    for pa, pb in zip(params_a, params_b):
+        np.testing.assert_allclose(pb, pa, rtol=1e-5)
+
+    # stacked per-step batches via the leading [n_steps] axis
+    main_c, exe_c, loss_c, _ = build()
+    xw = np.stack([xb] * 6)
+    yw = np.stack([yb] * 6)
+    (win2,) = exe_c.run_steps(main_c, feed={"x": xw, "y": yw},
+                              fetch_list=[loss_c], n_steps=6)
+    np.testing.assert_allclose(np.asarray(win2).ravel(), seq, rtol=1e-5)
+
+    # two windows of 3: the executor advances the scheduler n_steps-1
+    # times per window; the caller steps it once BETWEEN windows, which
+    # must reproduce the 6-step per-step loop exactly
+    main_d, exe_d, loss_d, sched_d = build()
+    (w1,) = exe_d.run_steps(main_d, feed={"x": xb, "y": yb},
+                            fetch_list=[loss_d], n_steps=3)
+    sched_d.step()
+    (w2,) = exe_d.run_steps(main_d, feed={"x": xb, "y": yb},
+                            fetch_list=[loss_d], n_steps=3)
+    got = np.concatenate([np.asarray(w1).ravel(), np.asarray(w2).ravel()])
+    np.testing.assert_allclose(got, seq, rtol=1e-5)
+
+
 def test_static_inference_only():
     main = paddle.static.Program()
     with paddle.static.program_guard(main):

@@ -64,7 +64,10 @@ def build_resnet_step():
         os.path.abspath(__file__))))
     from bench_all import build_resnet50_train
 
-    step, _b = build_resnet50_train(smoke=False)
+    # window=20 matches BENCH config #2 exactly (the benchmark runs the
+    # run_steps scan program, not the per-step jit — they compile
+    # differently); each profiled "step" is one 20-step window
+    step, _b = build_resnet50_train(smoke=False, window=20)
     return (lambda _i, _l: step()), None, None
 
 
@@ -77,17 +80,20 @@ def main():
     args = ap.parse_args()
 
     import jax
+    import numpy as np
 
     step, ids, labels = (build_step() if args.model == "gpt"
                          else build_resnet_step())
     loss = step((ids,), (labels,))
-    float(loss.numpy())  # block: materialize a scalar (block_until_ready lies)
+    # block: materialize a scalar (block_until_ready lies); ravel()[-1]
+    # handles the resnet window's stacked [W] loss fetch
+    float(np.ravel(loss.numpy())[-1])
 
     shutil.rmtree(args.logdir, ignore_errors=True)
     with jax.profiler.trace(args.logdir):
         for _ in range(args.steps):
             loss = step((ids,), (labels,))
-        float(loss.numpy())
+        float(np.ravel(loss.numpy())[-1])
 
     time.sleep(1)
     paths = sorted(glob.glob(f"{args.logdir}/plugins/profile/*/*.trace.json.gz"))
@@ -122,7 +128,7 @@ def main():
         tot[name] += dur
         n[name] += 1
         cat[re.sub(r"[.\d]+$", "", name)] += dur
-    steps = args.steps
+    steps = args.steps * (20 if args.model == "resnet" else 1)
     total_ms = sum(tot.values()) / steps
     print(f"== total device time: {total_ms:.1f} ms/step over {steps} steps ==")
     print("\n-- by category --")
