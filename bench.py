@@ -20,11 +20,9 @@ import json
 import os
 import time
 
-# manual LayerNorm VJP: measured +2.2% on THIS workload (GPT-2 345M,
-# 53.9k -> 55.1k tok/s/chip on v5e); it regresses BERT-base -24%, so it is
-# a per-workload knob rather than a global default (norm.py:_ln_manual)
-os.environ.setdefault("PADDLE_TPU_MANUAL_LN", "1")
-
+# the manual LayerNorm VJP (+2.2% on this workload, -24% on BERT-base) is
+# scoped to the model via GPTConfig.manual_layer_norm (default True) —
+# no process-wide env knob needed here
 import jax
 import jax.numpy as jnp
 import numpy as np

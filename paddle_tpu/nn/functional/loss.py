@@ -1,6 +1,9 @@
 """Loss functionals — parity with python/paddle/nn/functional/loss.py."""
 from __future__ import annotations
 
+import functools
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -29,6 +32,63 @@ def _reduce(out, reduction):
     if reduction == "none":
         return out
     raise InvalidArgumentError(f"unknown reduction {reduction!r}")
+
+
+def _hard_ce_fwd_impl(logits, lbl_i, ax, ignore_index):
+    m2 = jax.lax.stop_gradient(jnp.max(logits, axis=ax, keepdims=True))
+    # exp stays in the input dtype, the SUM accumulates f32 (see the
+    # rationale in cross_entropy's fast path)
+    lse = jnp.log(jnp.sum(jnp.exp(logits - m2), axis=ax,
+                          dtype=jnp.float32)) \
+        + jnp.squeeze(m2, axis=ax).astype(jnp.float32)
+    lbl_exp = jnp.expand_dims(lbl_i, ax)
+    picked = jnp.take_along_axis(logits, jnp.clip(lbl_exp, 0, None), axis=ax)
+    loss = (lse - jnp.squeeze(picked, axis=ax).astype(jnp.float32)
+            ).astype(logits.dtype)
+    mask = (lbl_i != ignore_index).astype(logits.dtype)
+    return loss * mask, mask, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _hard_ce(logits, lbl_i, ax, ignore_index):
+    """Hard-label CE (lse − picked logit) with a hand-written backward.
+
+    Autodiff of the lse form saves the full [N, V] exp(logits − m)
+    intermediate as a residual — for an LM head that is an extra
+    0.8 GB bf16 HBM write+read per step (GPT-2 345M, V=50257) on top of
+    the logits the head matmul already keeps. The manual rule saves only
+    the f32 per-row lse: backward recomputes softmax = exp(l − lse) from
+    the logits residual and emits dlogits = (softmax − onehot)·dy·mask in
+    ONE fused elementwise pass (the onehot subtract rides the same pass
+    via a broadcasted-iota compare, no scatter). Replaces the reference's
+    fused softmax_with_cross_entropy grad kernel
+    (operators/softmax_with_cross_entropy_op.cu) at the XLA level."""
+    loss, mask, _ = _hard_ce_fwd_impl(logits, lbl_i, ax, ignore_index)
+    return loss, mask
+
+
+def _hard_ce_fwd(logits, lbl_i, ax, ignore_index):
+    loss, mask, lse = _hard_ce_fwd_impl(logits, lbl_i, ax, ignore_index)
+    return (loss, mask), (logits, lbl_i, lse)
+
+
+def _hard_ce_bwd(ax, ignore_index, res, ct):
+    dloss, _dmask = ct  # mask is label-only — no logits cotangent
+    logits, lbl_i, lse = res
+    nd = logits.ndim
+    axp = ax % nd
+    maskf = (lbl_i != ignore_index).astype(jnp.float32)
+    g = jnp.expand_dims(dloss.astype(jnp.float32) * maskf, axp)
+    # softmax recomputed in f32 inside the fusion (a bf16 cast of lse
+    # would cost ~8 mantissa bits ON the exponent scale)
+    p = jnp.exp(logits.astype(jnp.float32) - jnp.expand_dims(lse, axp))
+    idx = jax.lax.broadcasted_iota(jnp.int32, logits.shape, axp)
+    onehot = (idx == jnp.clip(jnp.expand_dims(lbl_i, axp), 0, None))
+    dlogits = ((p - onehot) * g).astype(logits.dtype)
+    return dlogits, np.zeros(lbl_i.shape, dtype=jax.dtypes.float0)
+
+
+_hard_ce.defvjp(_hard_ce_fwd, _hard_ce_bwd)
 
 
 def cross_entropy(
@@ -65,20 +125,14 @@ def cross_entropy(
             # carries ~2 digits) while the exp values stay in the input
             # dtype — upcasting them would double the saved residual's HBM
             # bytes (measured -8% end-to-end on the GPT bench).
-            m2 = jax.lax.stop_gradient(
-                jnp.max(logits, axis=axis, keepdims=True))
-            lse = jnp.log(jnp.sum(jnp.exp(logits - m2), axis=axis,
-                                  dtype=jnp.float32)) \
-                + jnp.squeeze(m2, axis=axis).astype(jnp.float32)
-            lbl_exp = jnp.expand_dims(lbl_i, axis)
-            picked = jnp.take_along_axis(logits, jnp.clip(lbl_exp, 0, None),
-                                         axis=axis)
-            loss = lse - jnp.squeeze(picked, axis=axis).astype(jnp.float32)
-            # dtype contract: every cross_entropy path returns the input
-            # dtype (the f32 accumulation above is internal)
-            loss = loss.astype(logits.dtype)
-            mask = (lbl_i != ignore_index).astype(loss.dtype)
-            return loss * mask, mask
+            # _hard_ce adds the manual backward (no [N,V] exp residual);
+            # PADDLE_TPU_MANUAL_CE=0 falls back to autodiff of the same
+            # forward.
+            if os.environ.get("PADDLE_TPU_MANUAL_CE", "1") == "1":
+                return _hard_ce(logits, lbl_i, axis, ignore_index)
+            loss, mask, _ = _hard_ce_fwd_impl(logits, lbl_i, axis,
+                                              ignore_index)
+            return loss, mask
         logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
             jnp.clip(logits, 1e-30, None)
         )

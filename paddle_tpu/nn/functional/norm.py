@@ -4,6 +4,7 @@ with jnp reductions XLA fuses; running stats updated imperatively on the layer.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 
@@ -13,7 +14,32 @@ import numpy as np
 
 from ...core.tensor import Tensor, apply_op, to_tensor
 
-__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm", "local_response_norm"]
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
+           "local_response_norm", "manual_ln_scope"]
+
+# The manual-LN VJP is a PER-WORKLOAD knob (+2.2% on GPT-2 345M, -24% on
+# BERT-base under the fleet engine — the custom_vjp blocks a fusion BERT's
+# step depends on). Models that measure a win scope it over their own
+# forward with `manual_ln_scope(True)` (GPTConfig.manual_layer_norm does);
+# the env var remains as a global override for experiments.
+_MANUAL_LN_STACK: list = []
+
+
+@contextlib.contextmanager
+def manual_ln_scope(enabled: bool):
+    """Scope the manual LayerNorm VJP to the enclosed trace (a model's
+    forward), instead of flipping the process-wide env var."""
+    _MANUAL_LN_STACK.append(bool(enabled))
+    try:
+        yield
+    finally:
+        _MANUAL_LN_STACK.pop()
+
+
+def _manual_ln_enabled() -> bool:
+    if _MANUAL_LN_STACK:
+        return _MANUAL_LN_STACK[-1]
+    return os.environ.get("PADDLE_TPU_MANUAL_LN", "0") == "1"
 
 
 def _t(x):
@@ -243,12 +269,9 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
             from paddle_tpu.ops.fused import fused_layer_norm
 
             return fused_layer_norm(a, wb[0], wb[1], epsilon)
-        # opt-in per workload: measured +2.2% end-to-end on GPT-2 345M
-        # (bench.py sets it) but -24% on BERT-base under the fleet engine —
-        # the custom_vjp blocks a fusion BERT's step depends on (isolated
-        # microbenches win at BOTH shapes; the effect is context-specific)
+        # per-workload knob — see _MANUAL_LN_STACK above
         if (len(axes) == 1 and weight is not None and bias is not None
-                and os.environ.get("PADDLE_TPU_MANUAL_LN", "0") == "1"):
+                and _manual_ln_enabled()):
             return _ln_manual(a, wb[0], wb[1], epsilon)
         mean = jnp.mean(a, axis=axes, keepdims=True)
         var = jnp.var(a, axis=axes, keepdims=True)

@@ -432,49 +432,77 @@ def _inv_rows(inv, blhd):
     return inv.transpose(0, 2, 1)[..., None] if blhd else inv[..., None]
 
 
-def _causal_chunked_fwd_impl(q, k, v, blhd: bool):
-    """Forward pass; returns (out, residuals per chunk)."""
+def _chunk_e(q, k, i, c, blhd, m=None):
+    """exp weights of causal chunk i: e = exp(s − max(s)), s = scaled QKᵀ
+    under the chunk's static tril mask. Shared by forward and (remat mode)
+    backward — with the saved per-chunk max passed as ``m`` the recomputed
+    values are BITWISE the forward's (same ops, same operands). Returns
+    (e, m, used_sdt)."""
     axis_l = 1 if blhd else 2
-    Lq = q.shape[axis_l]
-    c = _causal_chunk_size(Lq)
-    n = Lq // c
     sl = functools.partial(jax.lax.slice_in_dim, axis=axis_l)
     d = q.shape[-1]
     scale = 1.0 / math.sqrt(d)
     eq = _einsum_eqs(blhd)
     bf = (jnp.issubdtype(q.dtype, jnp.floating) and q.dtype != jnp.float32)
-
     sdt = q.dtype if (_SCORE_BF16 and bf) else jnp.float32
     neg = jnp.asarray(_NEG_INF if sdt == jnp.float32 else -3e38, sdt)
-    outs, es, invs = [], [], []
-    for i in range(n):
-        qi = sl(q, i * c, (i + 1) * c) * jnp.asarray(scale, q.dtype)
-        ub = (i + 1) * c
-        ki, vi = sl(k, 0, ub), sl(v, 0, ub)
-        s = jnp.einsum(eq[0], qi, ki, preferred_element_type=sdt)
-        mask = jnp.tril(jnp.ones((c, ub), bool), k=ub - c)
-        s = jnp.where(mask, s, neg)
+    ub = (i + 1) * c
+    qi = sl(q, i * c, ub) * jnp.asarray(scale, q.dtype)
+    ki = sl(k, 0, ub)
+    s = jnp.einsum(eq[0], qi, ki, preferred_element_type=sdt)
+    mask = jnp.tril(jnp.ones((c, ub), bool), k=ub - c)
+    s = jnp.where(mask, s, neg)
+    if m is None:
         m = jax.lax.stop_gradient(jnp.max(s, axis=-1, keepdims=True))
-        # the UNNORMALIZED probabilities are MATERIALIZED in the input dtype
-        # (exp computed in f32 per-element, rounded on store): for bf16
-        # models this halves the O(L²) exp tensor's bytes in fwd AND in the
-        # saved residual the backward re-reads — values in (0, 1], safe in
-        # bf16, and the f32-accumulated row sum below normalizes the same
-        # bf16 weights the PV einsum consumes (profiled: the f32 exp store
-        # was 25 ms/step of divide_subtract fusions)
-        if sdt != jnp.float32:  # honors the PADDLE_TPU_ATTN_SCORE_BF16 opt-out
-            e = jnp.exp((s - m).astype(q.dtype).astype(jnp.float32)
-                        ).astype(q.dtype)
-        else:
-            e = jnp.exp(s - m)
+    # the UNNORMALIZED probabilities are MATERIALIZED in the input dtype
+    # (exp computed in f32 per-element, rounded on store): for bf16
+    # models this halves the O(L²) exp tensor's bytes in fwd AND in the
+    # saved residual the backward re-reads — values in (0, 1], safe in
+    # bf16, and the f32-accumulated row sum below normalizes the same
+    # bf16 weights the PV einsum consumes (profiled: the f32 exp store
+    # was 25 ms/step of divide_subtract fusions)
+    if sdt != jnp.float32:  # honors the PADDLE_TPU_ATTN_SCORE_BF16 opt-out
+        e = jnp.exp((s - m).astype(q.dtype).astype(jnp.float32)
+                    ).astype(q.dtype)
+    else:
+        e = jnp.exp(s - m)
+    return e, m
+
+
+def _remat_e() -> bool:
+    """Backward recomputes the exp weights instead of saving them (default
+    ON). The saved-e residuals are the single largest non-matmul cost of
+    the GPT-2 345M step: ~148 MB/layer of bf16 written in fwd, re-read in
+    bwd, PLUS ~5 ms/step of relayout copies XLA inserts moving them across
+    the custom_vjp boundary (profiled shapes bf16[8,16,128,ub]). Recompute
+    costs one extra QK einsum + exp per chunk (~0.2 ms/layer) — flash
+    attention's trade, expressed at the XLA level."""
+    return os.environ.get("PADDLE_TPU_ATTN_REMAT_E", "1") == "1"
+
+
+def _causal_chunked_fwd_impl(q, k, v, blhd: bool):
+    """Forward pass; returns (out, residuals per chunk). Residual slot 4
+    holds the exp weights (save-e mode) or their per-chunk row maxima
+    (remat mode, `_remat_e`)."""
+    axis_l = 1 if blhd else 2
+    Lq = q.shape[axis_l]
+    c = _causal_chunk_size(Lq)
+    n = Lq // c
+    sl = functools.partial(jax.lax.slice_in_dim, axis=axis_l)
+    eq = _einsum_eqs(blhd)
+    remat = _remat_e()
+    outs, aux, invs = [], [], []
+    for i in range(n):
+        e, m = _chunk_e(q, k, i, c, blhd)
+        vi = sl(v, 0, (i + 1) * c)
         l_sum = jnp.maximum(e.sum(axis=-1, dtype=jnp.float32), 1e-30)
         o = jnp.einsum(eq[1], e.astype(q.dtype), vi)
         inv = (1.0 / l_sum).astype(q.dtype)
         outs.append(o * _inv_rows(inv, blhd))
-        es.append(e)
+        aux.append(m if remat else e)
         invs.append(inv)
     out = jnp.concatenate(outs, axis=axis_l)
-    return out, (q, k, v, out, tuple(es), tuple(invs))
+    return out, (q, k, v, out, tuple(aux), tuple(invs))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -510,7 +538,7 @@ def _causal_chunked_fwd(q, k, v, blhd):
 
 
 def _causal_chunked_bwd(blhd, res, g):
-    q, k, v, out, es, invs = res
+    q, k, v, out, aux, invs = res
     axis_l = 1 if blhd else 2
     Lq = q.shape[axis_l]
     c = _causal_chunk_size(Lq)
@@ -519,6 +547,7 @@ def _causal_chunked_bwd(blhd, res, g):
     d = q.shape[-1]
     scale = jnp.asarray(1.0 / math.sqrt(d), q.dtype)
     dP_eq, dq_eq, dk_eq, dv_eq, delta_eq = _BWD_EQS[blhd]
+    remat = _remat_e()
 
     dqs, dks, dvs = [], [], []
     for i in range(n):
@@ -527,7 +556,11 @@ def _causal_chunked_bwd(blhd, res, g):
         ki, vi = sl(k, 0, ub), sl(v, 0, ub)
         gi = sl(g, i * c, ub)
         oi = sl(out, i * c, ub)
-        e, inv = es[i], invs[i]
+        if remat:  # aux holds the chunk maxima; e recomputed bitwise
+            e, _ = _chunk_e(q, k, i, c, blhd, m=aux[i])
+        else:
+            e = aux[i]
+        inv = invs[i]
         # softmax backward with the normalization folded into dO:
         #   P = e·inv;  dS = P ⊙ (dP − rowsum(dP ⊙ P))
         #             = e ⊙ (dP·inv − rowsum(dO ⊙ O)·inv)

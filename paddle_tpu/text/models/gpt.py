@@ -38,6 +38,10 @@ class GPTConfig:
     initializer_range: float = 0.02
     layer_norm_epsilon: float = 1e-5
     use_flash_attention: bool = True
+    # manual LayerNorm VJP scoped to THIS model's forward: +2.2% end-to-end
+    # on GPT-2 345M on v5e (it regresses BERT-base 24%, so it is a
+    # per-model config rather than a process-wide env default)
+    manual_layer_norm: bool = True
 
     def __post_init__(self):
         if self.intermediate_size == 0:
@@ -144,14 +148,16 @@ class GPT(nn.Layer):
 
     def forward(self, input_ids, attn_mask=None):
         b, l = input_ids.shape
+        from paddle_tpu.nn.functional.norm import manual_ln_scope
         from paddle_tpu.tensor import arange
 
-        pos = arange(l, dtype="int64")
-        x = self.wte(input_ids) + self.wpe(pos)
-        x = self.drop(x)
-        for block in self.h:
-            x = block(x, attn_mask)
-        return self.ln_f(x)
+        with manual_ln_scope(self.config.manual_layer_norm):
+            pos = arange(l, dtype="int64")
+            x = self.wte(input_ids) + self.wpe(pos)
+            x = self.drop(x)
+            for block in self.h:
+                x = block(x, attn_mask)
+            return self.ln_f(x)
 
 
 class GPTForCausalLM(nn.Layer):

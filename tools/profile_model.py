@@ -23,9 +23,8 @@ import time
 def build_step():
     import os
 
-    # mirror bench.py's workload knobs so the profiler measures the same
-    # program the headline bench runs
-    os.environ.setdefault("PADDLE_TPU_MANUAL_LN", "1")
+    # the manual-LN knob now rides GPTConfig.manual_layer_norm, so the
+    # profiled program matches the headline bench with no env setup
     import jax
     import jax.numpy as jnp
     import numpy as np
