@@ -9,8 +9,8 @@ Configs (BASELINE.md table):
   #1 MNIST LeNet, dygraph, host batches           -> samples/sec
   #2 ResNet-50, static-graph Executor, one chip   -> samples/sec
   #3 BERT-base pretrain, fleet DP engine, one chip-> samples/sec + tok/sec
-  #4 long-context GPT-small, L=8192, flash_tpu attention + remat
-     (net-new vs the reference)                    -> tokens/sec
+  #4 long-context GPT-small, L=8192, q-chunked causal XLA attention,
+     no recompute (net-new vs the reference)       -> tokens/sec
 (#5 ERNIE pp+tp needs a pod slice; its sharding path is validated by
  dryrun_multichip on the virtual mesh.)
 
@@ -206,11 +206,19 @@ def bench_bert_dp():
 
 
 def bench_gpt_long_context():
-    """Long-context end-to-end: GPT-small at L=8192 on ONE chip — the
-    sequence length where the materialized O(L²) path exhausts HBM, so the
-    auto dispatch routes attention through the flash_tpu Mosaic kernel and
-    the step runs under full rematerialization. Net-new vs the reference
-    (SURVEY §5: long-context absent there)."""
+    """Long-context end-to-end: GPT-small at L=8192 on ONE chip. Net-new
+    vs the reference (SURVEY §5: long-context absent there).
+
+    r5 configuration (each measured): the causal-chunked XLA attention
+    tier + NO step-level recompute — 46.5-47.0k tok/s vs r4's 27.5k
+    (flash_tpu Mosaic + full recompute); dots-policy remat measured
+    36.4k, full remat 35.8k, manual attention VJP (O(L) remat residuals)
+    46.2k. The chunked tier's autodiff residuals are the ~0.53·L² bf16
+    exp weights (~0.85 GB/layer, ~10 GB total) — they fit v5e HBM at
+    b=1; b=2 OOMs in every variant, so b=1 is the measured shape.
+    MFU/vs_baseline framing follows bench.py's A100 methodology with the
+    causal-attention term included (at L=8192 attention is ~38% of model
+    FLOPs)."""
     import paddle_tpu as paddle
     from jax.sharding import Mesh
     from paddle_tpu.distributed.fleet.engine import ParallelTrainStep
@@ -231,8 +239,14 @@ def bench_gpt_long_context():
     opt = paddle.optimizer.Adam(learning_rate=1e-4,
                                 parameters=model.parameters())
     mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    # no recompute: the chunked tier's exp-weight residuals (~10 GB, see
+    # docstring) fit HBM at this b=1 shape, and remat would trade ~25%
+    # throughput for capacity that isn't needed. Smoke keeps recompute ON
+    # deliberately — it is the only place the recompute × longctx-model
+    # compose is exercised off-TPU (the real config's recompute=False
+    # program is compiled by the full run itself).
     step = ParallelTrainStep(model, loss_fn=model.loss_fn, optimizer=opt,
-                             mesh=mesh, recompute=True,
+                             mesh=mesh, recompute=bool(SMOKE),
                              compute_dtype=None if SMOKE else jnp.bfloat16)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, config.vocab_size, (b, L)).astype(np.int32)
@@ -244,9 +258,21 @@ def bench_gpt_long_context():
         return step((ids,), (labels,))
 
     tps = _rate(one, 1, iters) * b * L
-    return {"metric": "gpt_small_L8192_longctx_train_tokens_per_sec",
-            "value": round(tps, 1), "unit": "tokens/sec",
-            "seq_len": L}
+    out = {"metric": "gpt_small_L8192_longctx_train_tokens_per_sec",
+           "value": round(tps, 1), "unit": "tokens/sec",
+           "seq_len": L}
+    if not SMOKE:
+        # 6·N_matmul + causal attention 6·L·h·n_layers per token
+        n_mat = (12 * config.num_layers * config.hidden_size ** 2
+                 + config.vocab_size * config.hidden_size)
+        flops_tok = 6 * n_mat + 6 * L * config.hidden_size * config.num_layers
+        mfu = _mfu(tps, flops_tok)
+        if mfu is not None:
+            out["mfu_pct"] = mfu
+        # bench.py's A100 north-star methodology: 90% of an A100 chip at a
+        # typical 45% training MFU (312 TF/s bf16 peak)
+        out["vs_baseline"] = round(tps / (0.9 * 0.45 * 312e12 / flops_tok), 4)
+    return out
 
 
 def main():

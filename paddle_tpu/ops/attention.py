@@ -29,22 +29,29 @@ __all__ = [
 ]
 
 # Attention implementation selector. 'auto' (default) picks per context:
-# ring for sp-sharded, the materialized XLA path on TPU for moderate
-# lengths — measured fastest end-to-end on v5e for GPT-2 345M (L=1024,
-# d=64): the big batched einsums tile onto the MXU better than per-head
-# Pallas kernel ops at these shapes — and for LONG causal sequences
-# (L > PADDLE_TPU_ATTENTION_MAX_SEQ) the repo's flash_tpu Mosaic kernel
-# (past ~4k the O(L²) materialized path exhausts HBM and blockwise is
-# 8-10x slower). 'pallas' (the jax-shipped kernel) and 'flash_tpu' can
+# ring for sp-sharded, the materialized XLA path on TPU up to a
+# per-context length threshold — measured fastest end-to-end on v5e for
+# GPT-2 345M (L=1024, d=64: the big batched einsums tile onto the MXU
+# better than per-head Pallas kernel ops) AND, q-chunked, for causal
+# unbiased sequences up to L=8192 (46.5k vs 27.5k tok/s on the longctx
+# bench, r5) — then the repo's flash_tpu Mosaic kernel for longer causal
+# sequences (the materialized scores exhaust HBM and blockwise is 8-10x
+# slower). 'pallas' (the jax-shipped kernel) and 'flash_tpu' can
 # also be forced explicitly. Rigs whose Mosaic compile service fails —
 # plain XLA needs no such service — would die at jit-compile time on
 # auto's long-sequence route: set PADDLE_TPU_ATTN_NO_MOSAIC=1 to keep
 # auto on the streaming blockwise path instead.
 _IMPL = os.environ.get("PADDLE_TPU_ATTENTION", "auto")
 _NO_MOSAIC = os.environ.get("PADDLE_TPU_ATTN_NO_MOSAIC", "") == "1"
-# beyond this length the materialized [L, L] scores dominate HBM; stream
-# instead
+# beyond these lengths the materialized scores dominate HBM; stream
+# instead. Two thresholds (r5): CAUSAL unbiased attention runs q-chunked
+# (_causal_chunked_fwd_impl — fully-masked blocks never computed, ~0.53·L²
+# footprint) and measured 46.5k tok/s at GPT-small L=8192 b=1 vs 27.5k on
+# flash_tpu + recompute, so its auto threshold is 8192; everything else
+# materializes the full [b,h,L,L] scores and keeps the stricter 4096.
 _XLA_MAX_SEQ = int(os.environ.get("PADDLE_TPU_ATTENTION_MAX_SEQ", "4096"))
+_XLA_MAX_SEQ_CAUSAL = int(os.environ.get(
+    "PADDLE_TPU_ATTENTION_MAX_SEQ_CAUSAL", "8192"))
 
 
 def set_attention_impl(impl: str):
@@ -696,14 +703,20 @@ def _resolve_impl(L, bias, use_flash, causal=True):
     auto: ``use_flash=False`` keeps the exact f32 blockwise recurrence (the
     model-level flag selects numerics, not just a kernel); on TPU short/mid
     sequences take the materialized XLA path (measured fastest at GPT-class
-    shapes — L=1024/d=64: 53k vs 40k for the kernels), while LONG causal
-    sequences take the repo's Pallas flash kernel (flash_tpu.py): past
-    ~4k the scan-based blockwise path is 8-10x slower (measured L=8192
-    f+b: 100ms vs 13ms) and the materialized path's O(L²) residuals
-    exhaust HBM. Off-TPU flash_attention safely degrades to blockwise.
-    The kernel tiers gate on SHAPE at trace time; a rig whose Mosaic
-    compile service itself fails surfaces that at jit-compile time —
-    select 'xla'/'blockwise' there."""
+    shapes — L=1024/d=64: 53k vs 40k for the kernels). CAUSAL unbiased
+    sequences stay on the q-chunked XLA tier up to _XLA_MAX_SEQ_CAUSAL
+    (r5: its fully-masked blocks are skipped and its residuals fit HBM at
+    the longctx bench shape — GPT-small L=8192 measured 46.5k tok/s vs
+    27.5k on flash_tpu + recompute); NON-causal or biased calls keep the
+    stricter _XLA_MAX_SEQ=4096 guard — their [b,h,L,L] score tensor has
+    no masked blocks to skip and exhausts HBM well before 8k at real
+    batch sizes. Past the threshold, causal goes to the repo's Pallas
+    flash kernel (flash_tpu.py) and the rest to the blockwise recurrence
+    (the scan path is 8-10x slower — measured L=8192 f+b: 100ms vs 13ms —
+    but O(L) in memory). Off-TPU flash_attention safely degrades to
+    blockwise. The kernel tiers gate on SHAPE at trace time; a rig whose
+    Mosaic compile service itself fails surfaces that at jit-compile
+    time — select 'xla'/'blockwise' there."""
     on_tpu = jax.default_backend() == "tpu"
     if _IMPL == "flash_tpu":
         return "flash_tpu" if (on_tpu and bias is None and causal) else "xla"
@@ -718,7 +731,9 @@ def _resolve_impl(L, bias, use_flash, causal=True):
     if not use_flash:
         return "blockwise"
     if on_tpu:
-        if L <= _XLA_MAX_SEQ:
+        xla_max = (_XLA_MAX_SEQ_CAUSAL if (causal and bias is None)
+                   else _XLA_MAX_SEQ)
+        if L <= xla_max:
             return "xla"
         if causal and bias is None and not _NO_MOSAIC:
             return "flash_tpu"

@@ -15,12 +15,13 @@ cd "$(dirname "$0")/.."
 python bench_all.py "$@"
 
 if [ -f BENCH_extra.prev.json ]; then
-  # LeNet is EAGER per-op dispatch through the remote-TPU tunnel: measured
-  # run-to-run jitter is +-20% in one process (RPC latency, not the chip),
-  # so its gate tolerance is wider than the compiled configs'
+  # LeNet rides per-step dispatch through the remote-TPU tunnel: the r5
+  # variance study (tools/profiles/r5_lenet_variance.txt) measured CV 7.6%
+  # within-process but ~19% worst-case deviation ACROSS processes (which
+  # is what this gate compares) -> tolerance 0.25
   python tools/check_model_benchmark_result.py BENCH_extra.prev.json \
     BENCH_extra.json --tol 0.05 \
-    --tol-override lenet_mnist_dygraph_samples_per_sec=0.3
+    --tol-override lenet_mnist_dygraph_samples_per_sec=0.25
   echo "model benchmark gate: PASS"
 else
   echo "model benchmark gate: no previous baseline, first run recorded"

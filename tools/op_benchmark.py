@@ -110,6 +110,45 @@ def _cases():
     def case_reduce_sum():
         return (lambda x: x.sum(axis=-1)), (f32(8192, 4096),)
 
+    def _longctx_grad_case(attn_fn):
+        """fwd+bwd of one causal attention layer at the longctx bench
+        shape (b=1, L=8192, h=12, d=64) — the single-chip tier comparison
+        the longctx config's 47k-tok/s number rests on."""
+        q = bf16(1, 8192, 12, 64)
+
+        def f(q, k, v):
+            y, vjp = jax.vjp(attn_fn, q, k, v)
+            return vjp(y)[0]
+
+        return f, (q, q, q)
+
+    def case_longctx_attn_chunked():
+        # through the PUBLIC xla_attention so the case measures whatever
+        # backward the model dispatch actually runs (autodiff by default;
+        # PADDLE_TPU_ATTN_MANUAL_VJP=1 flips both this case and the model)
+        from paddle_tpu.ops.attention import xla_attention
+
+        return _longctx_grad_case(
+            lambda q, k, v: xla_attention(q, k, v, causal=True,
+                                          layout="blhd"))
+
+    def case_longctx_attn_flash_tpu():
+        from paddle_tpu.ops.flash_tpu import flash_attention_blhd
+
+        return _longctx_grad_case(
+            lambda q, k, v: flash_attention_blhd(q, k, v, causal=True))
+
+    def case_longctx_attn_blockwise():
+        from paddle_tpu.ops.attention import blockwise_attention
+
+        def attn(q, k, v):
+            # blockwise layout is [b, h, L, d]
+            qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+            return blockwise_attention(qt, kt, vt, causal=True
+                                       ).transpose(0, 2, 1, 3)
+
+        return _longctx_grad_case(attn)
+
     def case_multiclass_nms():
         from paddle_tpu.vision.ops import multiclass_nms
         from paddle_tpu.core.tensor import Tensor
@@ -137,6 +176,9 @@ def _cases():
         "gelu_mlp": case_gelu,
         "reduce_sum": case_reduce_sum,
         "multiclass_nms": case_multiclass_nms,
+        "longctx_attn_L8192_chunked": case_longctx_attn_chunked,
+        "longctx_attn_L8192_flash_tpu": case_longctx_attn_flash_tpu,
+        "longctx_attn_L8192_blockwise": case_longctx_attn_blockwise,
     }
 
 
