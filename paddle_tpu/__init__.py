@@ -76,6 +76,7 @@ from . import incubate  # noqa: E402
 from . import quant  # noqa: E402
 from . import distribution  # noqa: E402
 from .hapi.summary import summary  # noqa: E402,F401
+from .hapi.dynamic_flops import flops  # noqa: E402,F401
 from . import callbacks  # noqa: E402
 from . import device  # noqa: E402
 from . import hub  # noqa: E402
@@ -105,6 +106,63 @@ def in_dynamic_mode() -> bool:
     from .static import _in_static_mode
 
     return not _in_static_mode()
+
+
+# fluid-era export-parity aliases (reference python/paddle/__init__.py):
+# dygraph mode toggles, device-place twins, RNG-state accessors, and
+# Tensor/VarBase naming — all resolved onto the TPU-native equivalents
+in_dygraph_mode = in_dynamic_mode
+enable_dygraph = disable_static          # dygraph ON == static OFF
+disable_dygraph = enable_static
+DataParallel = nn.DataParallel
+ParamAttr = nn.ParamAttr
+VarBase = Tensor                          # fluid's eager tensor name
+from .core.place import NPUPlace, XPUPlace  # noqa: E402,F401
+from .core.dtype import convert_dtype as _convert_dtype  # noqa: E402
+dtype = _convert_dtype                    # paddle.dtype('float32') coercion
+from .tensor.math import floor_mod  # noqa: E402,F401
+from .tensor.manipulation import crop as crop_tensor  # noqa: E402,F401
+
+
+def check_shape(shape):
+    """Validate a shape argument (fluid layer-helper parity): every entry
+    an int (or -1/None for inferred dims)."""
+    if shape is None:
+        raise TypeError("shape must not be None")
+    for s in (shape if isinstance(shape, (list, tuple)) else [shape]):
+        if s is not None and not isinstance(s, (int,)):
+            raise TypeError(f"shape entries must be int/None, got {type(s)}")
+    return shape
+
+
+def get_cudnn_version():
+    """None — not compiled with cuDNN (the TPU build's truthful answer,
+    same contract as the reference off-GPU)."""
+    return None
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def get_cuda_rng_state():
+    """Device RNG state (CUDA name kept for parity; returns the repo's
+    device PRNG state list)."""
+    from .core import rng as _rng
+
+    return [_rng.default_generator().get_state()]
+
+
+def set_cuda_rng_state(state_list):
+    from .core import rng as _rng
+
+    if not isinstance(state_list, (list, tuple)) or not state_list:
+        raise ValueError("expects the list get_cuda_rng_state returned")
+    _rng.default_generator().set_state(state_list[0])
 
 
 __version__ = "0.1.0"

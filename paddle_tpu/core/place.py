@@ -67,6 +67,7 @@ class TPUPlace(Place):
 # accelerator"; here the accelerator is the TPU.
 CUDAPlace = TPUPlace
 XPUPlace = TPUPlace
+NPUPlace = TPUPlace
 
 
 class TPUPinnedPlace(Place):

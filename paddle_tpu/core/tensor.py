@@ -271,7 +271,12 @@ class Tensor:
         return self.shape[0]
 
     def __repr__(self):
-        val = np.asarray(self._value) if not _is_tracer(self._value) else self._value
+        if _is_tracer(self._value):
+            val = self._value
+        else:
+            from ..tensor.to_string import array_repr
+
+            val = array_repr(self._value)  # honors set_printoptions
         return (
             f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
             f"stop_gradient={self.stop_gradient},\n       {val})"

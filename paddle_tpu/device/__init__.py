@@ -74,12 +74,16 @@ class _Cuda:
     def synchronize(device=None):
         import numpy as _np
 
+        import jax.numpy as _jnp
+
         for d in jax.devices():
             # a host MATERIALIZATION of a device computation is the proven
             # barrier on this platform (block_until_ready returns before
             # execution finishes on the remote-TPU rig — see bench_all._block);
-            # the tiny jitted add is enqueued AFTER prior work on d's stream
-            _np.asarray(jax.jit(lambda a: a + 1, device=d)(0))
+            # the tiny device_put+add is enqueued AFTER prior work on d's
+            # stream (jax.jit(device=...) is deprecated and slated for
+            # removal on jax 0.9)
+            _np.asarray(jax.device_put(_jnp.zeros(()), d) + 1)
 
     @staticmethod
     def empty_cache():

@@ -126,6 +126,27 @@ class Normal(Distribution):
                         - 0.5)
 
 
+def _categorical_validate_nonneg(orig) -> bool:
+    """True when ``orig`` (the user's ORIGINAL input, pre-conversion) is
+    checkable WITHOUT a device sync and holds a negative entry. Host
+    values (numpy/list/scalars) check for free; device-resident
+    Tensors/arrays are only checked under
+    PADDLE_TPU_VALIDATE_DISTRIBUTIONS=1 (each check is a blocking D2H
+    roundtrip — ~100 ms through this rig's tunnel — per eager
+    construction otherwise); traced values never."""
+    import os
+
+    val = orig._value if isinstance(orig, Tensor) else orig
+    if isinstance(val, jax.core.Tracer):
+        return False
+    on_host = isinstance(val, (np.ndarray, np.generic, list, tuple, float,
+                               int))
+    if not on_host and os.environ.get(
+            "PADDLE_TPU_VALIDATE_DISTRIBUTIONS", "0") != "1":
+        return False
+    return bool(np.any(np.asarray(val) < 0))
+
+
 class Categorical(Distribution):
     """Categorical over unnormalized ``logits`` (the reference accepts
     unnormalized probabilities; log-space here is the numerically stable
@@ -138,12 +159,16 @@ class Categorical(Distribution):
         # while entropy()/kl_divergence() run softmax over the same values
         # as if they were log-space logits (distribution.py:812-860) —
         # both faithfully mirrored, including the asymmetry.
-        # validate only when concrete: a traced value (inside jit/grad/vmap)
-        # cannot be bool()'d, and forcing it eagerly would device-sync every
-        # construction — skip the check there (the reference does no
-        # validation at all; entropy()/kl run softmax so log-space logits
-        # are legitimate inputs for those methods)
-        if not isinstance(raw, jax.core.Tracer) and bool(jnp.any(raw < 0)):
+        # validation policy (r5): NEVER force a device sync at construction.
+        # - traced values (jit/grad/vmap) cannot be bool()'d at all;
+        # - host values (numpy/list) are checked for free;
+        # - device arrays would pay a blocking D2H roundtrip per eager
+        #   construction (~100ms through this rig's tunnel) just to
+        #   validate — skipped unless FLAGS/env debug opt-in
+        #   (PADDLE_TPU_VALIDATE_DISTRIBUTIONS=1). The reference does no
+        #   validation at all; entropy()/kl run softmax so log-space
+        #   logits are legitimate inputs for those methods.
+        if _categorical_validate_nonneg(logits):
             raise ValueError(
                 "Categorical expects non-negative unnormalized "
                 "probabilities (negative entries would produce negative "

@@ -44,7 +44,19 @@ class CipherUtils:
     @staticmethod
     def read_key_from_file(path: str) -> bytes:
         with open(path, "rb") as f:
-            return f.read()
+            key = f.read()
+        # validate HERE, naming the file: a shell-created key file with a
+        # trailing newline would otherwise only fail deep inside AESCipher
+        # with a generic length error far from the cause
+        if len(key) not in (16, 24, 32):
+            stripped = key.rstrip(b"\r\n")
+            if len(stripped) in (16, 24, 32):
+                return stripped  # tolerate the trailing-newline foot-gun
+            raise ValueError(
+                f"key file {path!r} holds {len(key)} bytes; AES needs "
+                "16/24/32 (was the key written with a trailing newline "
+                "or hex-encoded?)")
+        return key
 
 
 class AESCipher:

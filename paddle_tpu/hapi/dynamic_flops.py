@@ -1,0 +1,180 @@
+"""paddle.flops — model complexity profiler.
+
+Parity with python/paddle/hapi/dynamic_flops.py:24: per-layer FLOPs
+(multiply-add counts, matching the reference's conventions exactly) via
+forward post-hooks on leaf layers, a custom_ops override dict keyed by
+layer class, an optional per-layer detail table, and an integer total
+return. Works on any ``nn.Layer``; static ``Program`` complexity is the
+recorded op list's job (static_flops is the reference's separate path).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn import layer_base
+
+__all__ = ["flops", "dynamic_flops"]
+
+
+def _numel(t):
+    return int(np.prod(t.shape)) if hasattr(t, "shape") else 0
+
+
+def count_convNd(m, x, y):
+    x = x[0]
+    kernel_ops = int(np.prod(m.weight.shape[2:]))
+    bias_ops = 1 if getattr(m, "bias", None) is not None else 0
+    groups = getattr(m, "_groups", 1)
+    m.total_ops += abs(int(
+        _numel(y) * (x.shape[1] / groups * kernel_ops + bias_ops)))
+
+
+def count_leaky_relu(m, x, y):
+    m.total_ops += _numel(x[0])
+
+
+def count_bn(m, x, y):
+    nelements = _numel(x[0])
+    if not getattr(m, "training", False):
+        m.total_ops += abs(int(2 * nelements))
+
+
+def count_linear(m, x, y):
+    m.total_ops += abs(int(m.weight.shape[0] * _numel(y)))
+
+
+def count_avgpool(m, x, y):
+    m.total_ops += _numel(y)
+
+
+def count_adap_avgpool(m, x, y):
+    kernel = np.array(x[0].shape[2:]) // np.array(y.shape[2:])
+    total_add = int(np.prod(kernel))
+    m.total_ops += abs(int((total_add + 1) * _numel(y)))
+
+
+def count_zero_ops(m, x, y):
+    m.total_ops += 0
+
+
+def count_parameters(m, x, y):
+    m.total_params = sum(_numel(p) for p in m.parameters(include_sublayers=False))
+
+
+def count_io_info(m, x, y):
+    m.input_shape = list(x[0].shape)
+    out = y[0] if isinstance(y, (list, tuple)) else y
+    m.output_shape = list(out.shape)
+
+
+def _register_hooks():
+    from .. import nn
+
+    table = {
+        nn.Conv1D: count_convNd, nn.Conv2D: count_convNd,
+        nn.Conv3D: count_convNd,
+        nn.ReLU: count_zero_ops, nn.ReLU6: count_zero_ops,
+        nn.LeakyReLU: count_leaky_relu,
+        nn.Linear: count_linear,
+        nn.Dropout: count_zero_ops,
+        nn.AvgPool1D: count_avgpool, nn.AvgPool2D: count_avgpool,
+        nn.AvgPool3D: count_avgpool,
+        nn.AdaptiveAvgPool1D: count_adap_avgpool,
+        nn.AdaptiveAvgPool2D: count_adap_avgpool,
+        nn.AdaptiveAvgPool3D: count_adap_avgpool,
+    }
+    for name, fn in (("Conv1DTranspose", count_convNd),
+                     ("Conv2DTranspose", count_convNd),
+                     ("Conv3DTranspose", count_convNd),
+                     ("BatchNorm", count_bn), ("BatchNorm1D", count_bn),
+                     ("BatchNorm2D", count_bn), ("BatchNorm3D", count_bn)):
+        cls = getattr(nn, name, None)
+        if cls is not None:
+            table[cls] = fn
+    return table
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Count a network's FLOPs (reference hapi/dynamic_flops.py:24).
+
+    ``net``: an ``nn.Layer`` (static Programs record their op list — use
+    the executor's compiled cost model there). ``input_size``: shape of a
+    single input batch, e.g. ``[1, 3, 224, 224]``. ``custom_ops``: dict
+    mapping layer CLASSES to ``fn(layer, inputs, output)`` that adds into
+    ``layer.total_ops``. Returns the integer total; optionally prints the
+    per-layer table."""
+    from ..core.tensor import to_tensor
+
+    if isinstance(net, layer_base.Layer):
+        inputs = to_tensor(np.random.rand(*input_size).astype("float32"))
+        return dynamic_flops(net, inputs, custom_ops=custom_ops,
+                             print_detail=print_detail)
+    raise TypeError(
+        "flops expects an nn.Layer instance (static Program complexity "
+        "rides the recorded op list; see static executor)")
+
+
+def dynamic_flops(model, inputs, custom_ops=None, print_detail=False):
+    handlers = []
+    custom_ops = custom_ops or {}
+    register_hooks = _register_hooks()
+    seen_types = set()
+
+    def add_hooks(m):
+        if len(list(m.children())) > 0:
+            return
+        m.total_ops = 0
+        m.total_params = 0
+        m_type = type(m)
+        fn = custom_ops.get(m_type, register_hooks.get(m_type))
+        if m_type not in seen_types:
+            if m_type in custom_ops:
+                print(f"Customize Function has been applied to {m_type}")
+            elif fn is None:
+                print(f"Cannot find suitable count function for {m_type}. "
+                      "Treat it as zero FLOPs.")
+            seen_types.add(m_type)
+        if fn is not None:
+            handlers.append(m.register_forward_post_hook(fn))
+        handlers.append(m.register_forward_post_hook(count_parameters))
+        handlers.append(m.register_forward_post_hook(count_io_info))
+
+    training = model.training
+    model.eval()
+    model.apply(add_hooks)
+    model(inputs)
+    if training:
+        model.train()
+    for h in handlers:
+        h.remove()
+
+    rows, total_ops, total_params = [], 0, 0
+    for name, m in model.named_sublayers():
+        if len(list(m.children())) > 0 or not hasattr(m, "input_shape"):
+            continue
+        rows.append((m.full_name(), m.input_shape, m.output_shape,
+                     int(m.total_params), int(m.total_ops)))
+        total_ops += m.total_ops
+        total_params += m.total_params
+        for attr in ("total_ops", "total_params", "input_shape",
+                     "output_shape"):
+            delattr(m, attr)
+
+    if print_detail:
+        header = ("Layer Name", "Input Shape", "Output Shape",
+                  "Params", "Flops")
+        all_rows = [tuple(str(c) for c in r) for r in rows]
+        widths = [max(len(h), *(len(r[i]) for r in all_rows)) if all_rows
+                  else len(h) for i, h in enumerate(header)]
+        line = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+        print(line)
+        print("|" + "|".join(f" {h:<{w}} " for h, w in zip(header, widths))
+              + "|")
+        print(line)
+        for r in all_rows:
+            print("|" + "|".join(f" {c:<{w}} " for c, w in zip(r, widths))
+                  + "|")
+        print(line)
+    print(f"Total Flops: {int(total_ops)}     "
+          f"Total Params: {int(total_params)}")
+    return int(total_ops)

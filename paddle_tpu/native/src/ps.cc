@@ -38,6 +38,7 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -107,33 +108,63 @@ struct Server {
   int bar_count = 0;
   uint64_t bar_gen = 0;
 
-  // push idempotence: highest applied seq per client (survives reconnects
-  // — keyed by the client's random id, not the connection)
+  // push idempotence, keyed by the client's random id (survives
+  // reconnects). Per client: applied_max (highest successfully applied
+  // seq), in_flight (claimed, not yet committed/rolled back) and
+  // rolled_back (seqs BELOW applied_max whose apply failed — a later seq
+  // from a concurrent connection committed first, so "seq <= applied_max"
+  // alone can no longer distinguish applied from failed; a single
+  // last-counter scheme mis-acked exactly that interleaving as an applied
+  // duplicate). rolled_back only collects entries on concurrent-failure
+  // interleavings and is erased again when the retry commits, so it stays
+  // tiny.
+  struct ClientDedup {
+    uint64_t applied_max = 0;
+    std::unordered_set<uint64_t> in_flight;
+    std::unordered_set<uint64_t> rolled_back;
+  };
   std::mutex dedup_mu;
-  std::unordered_map<uint64_t, uint64_t> last_push_seq;
+  std::unordered_map<uint64_t, ClientDedup> push_dedup;
 
-  // claim-then-rollback dedup: claim_push atomically records the seq (so a
-  // concurrently retried frame can never double-apply — the claim IS the
-  // at-most-once guarantee), and the error paths roll the claim back
-  // (rollback_push) so a push rejected with an error status (missing
-  // table, dim mismatch) is re-processed when retried instead of being
-  // falsely acked as an applied duplicate.
-  bool claim_push(uint64_t client_id, uint64_t seq, uint64_t* prev) {
-    *prev = 0;
-    if (client_id == 0 || seq == 0) return true;  // unsequenced: always run
+  // claim-then-commit/rollback: claim_push atomically marks the seq
+  // in-flight (at-most-once against concurrent retries of the SAME frame);
+  // commit_push records it applied; rollback_push forgets it so a push
+  // rejected with an error status (missing table, dim mismatch) is
+  // re-processed when retried instead of being falsely acked. A duplicate
+  // of a STILL-IN-FLIGHT push is a distinct verdict (kClaimDupInFlight ->
+  // wire status 3): the original may yet fail and roll back, so acking it
+  // as applied would be a false success — the client backs off and
+  // retries until the original either commits (then: applied duplicate,
+  // ack 0) or rolls back (then: the retry claims and applies).
+  enum ClaimResult { kClaimRun = 0, kClaimDupApplied = 1,
+                     kClaimDupInFlight = 2 };
+
+  ClaimResult claim_push(uint64_t client_id, uint64_t seq) {
+    if (client_id == 0 || seq == 0) return kClaimRun;  // unsequenced
     std::lock_guard<std::mutex> g(dedup_mu);
-    uint64_t& last = last_push_seq[client_id];
-    if (seq <= last) return false;  // duplicate of an applied/in-flight push
-    *prev = last;
-    last = seq;
-    return true;
+    ClientDedup& d = push_dedup[client_id];
+    if (d.in_flight.count(seq)) return kClaimDupInFlight;
+    if (seq <= d.applied_max && !d.rolled_back.count(seq))
+      return kClaimDupApplied;
+    d.in_flight.insert(seq);
+    return kClaimRun;
   }
 
-  void rollback_push(uint64_t client_id, uint64_t seq, uint64_t prev) {
+  void commit_push(uint64_t client_id, uint64_t seq) {
     if (client_id == 0 || seq == 0) return;
     std::lock_guard<std::mutex> g(dedup_mu);
-    uint64_t& last = last_push_seq[client_id];
-    if (last == seq) last = prev;  // undo only our own claim
+    ClientDedup& d = push_dedup[client_id];
+    d.in_flight.erase(seq);
+    d.rolled_back.erase(seq);
+    if (seq > d.applied_max) d.applied_max = seq;
+  }
+
+  void rollback_push(uint64_t client_id, uint64_t seq) {
+    if (client_id == 0 || seq == 0) return;
+    std::lock_guard<std::mutex> g(dedup_mu);
+    ClientDedup& d = push_dedup[client_id];
+    d.in_flight.erase(seq);
+    if (seq <= d.applied_max) d.rolled_back.insert(seq);
   }
 
   ~Server() {
@@ -256,14 +287,20 @@ void handle_conn(Server* sv, int fd) {
       case kPushDenseGrad: {
         payload.resize(a * 4);
         if (!read_full(fd, payload.data(), payload.size())) return;
-        uint64_t prev;
-        if (!sv->claim_push(client_id, seq, &prev)) {  // applied duplicate
-          send_resp(fd, 0, nullptr, 0);
-          break;
+        {
+          Server::ClaimResult cl = sv->claim_push(client_id, seq);
+          if (cl == Server::kClaimDupApplied) {
+            send_resp(fd, 0, nullptr, 0);
+            break;
+          }
+          if (cl == Server::kClaimDupInFlight) {
+            send_resp(fd, 3, nullptr, 0);  // transient: client retries
+            break;
+          }
         }
         auto it = sv->dense.find(table);
         if (it == sv->dense.end()) {
-          sv->rollback_push(client_id, seq, prev);  // retry must re-process
+          sv->rollback_push(client_id, seq);  // retry must re-process
           send_resp(fd, 1, nullptr, 0);
           break;
         }
@@ -275,6 +312,7 @@ void handle_conn(Server* sv, int fd) {
           apply_grad(t->opt, t->lr, t->w.data(), t->m0.data(), t->m1.data(),
                      t->step, reinterpret_cast<float*>(payload.data()), n);
         }
+        sv->commit_push(client_id, seq);
         send_resp(fd, 0, nullptr, 0);
         break;
       }
@@ -308,19 +346,25 @@ void handle_conn(Server* sv, int fd) {
         uint64_t dim = b;
         payload.resize(a * 8 + a * dim * 4);
         if (!read_full(fd, payload.data(), payload.size())) return;
-        uint64_t prev;
-        if (!sv->claim_push(client_id, seq, &prev)) {  // applied duplicate
-          send_resp(fd, 0, nullptr, 0);
-          break;
+        {
+          Server::ClaimResult cl = sv->claim_push(client_id, seq);
+          if (cl == Server::kClaimDupApplied) {
+            send_resp(fd, 0, nullptr, 0);
+            break;
+          }
+          if (cl == Server::kClaimDupInFlight) {
+            send_resp(fd, 3, nullptr, 0);  // transient: client retries
+            break;
+          }
         }
         if (it == sv->sparse.end()) {
-          sv->rollback_push(client_id, seq, prev);
+          sv->rollback_push(client_id, seq);
           send_resp(fd, 1, nullptr, 0);
           break;
         }
         SparseTable* t = it->second;
         if (dim != t->dim) {
-          sv->rollback_push(client_id, seq, prev);
+          sv->rollback_push(client_id, seq);
           send_resp(fd, 2, nullptr, 0);
           break;
         }
@@ -343,6 +387,7 @@ void handle_conn(Server* sv, int fd) {
           apply_grad(t->opt, t->lr, w, m0, m1, step, &grads[i * t->dim],
                      t->dim);
         }
+        sv->commit_push(client_id, seq);
         send_resp(fd, 0, nullptr, 0);
         break;
       }
@@ -571,7 +616,15 @@ bool client_req(Client* c, uint32_t op, uint32_t table, uint64_t a, uint64_t b,
       bool ok = send_once(c, op, table, a, b, seq, payload, pn, reply,
                           &status);
       if (op == kBarrier && c->fd >= 0) set_rcv_deadline(c->fd, c->deadline_ms);
-      if (ok) return status == 0;
+      if (ok) {
+        if (status == 3 && retriable) {
+          // duplicate of a still-in-flight push: the original's verdict is
+          // pending — back off and re-ask (same cadence as reconnects)
+          usleep(50000u << attempt);
+          continue;
+        }
+        return status == 0;
+      }
     }
     if (!retriable) return false;
     // reconnect with backoff: 50ms * 2^attempt

@@ -13,9 +13,12 @@ from .layer.common import *  # noqa: F401,F403
 from .layer.container import *  # noqa: F401,F403
 from .layer.conv import *  # noqa: F401,F403
 from .layer.loss import *  # noqa: F401,F403
+from .layer import loss  # noqa: F401  (paddle.nn.loss submodule parity)
 from .layer.norm import *  # noqa: F401,F403
 from .layer.pooling import *  # noqa: F401,F403
 from .layer.decode import *  # noqa: F401,F403
+from .layer.distance import *  # noqa: F401,F403
+from .layer_dp import DataParallel  # noqa: F401
 from .layer.rnn import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
 

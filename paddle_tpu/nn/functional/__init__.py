@@ -9,3 +9,5 @@ from .vision import *  # noqa: F401,F403
 from ...tensor.sequence import sequence_mask  # noqa: F401
 
 from . import activation, common, conv, loss, norm, pooling, vision  # noqa: F401
+
+from ..layer.decode import gather_tree  # noqa: F401  (F.gather_tree parity)

@@ -11,6 +11,7 @@ import numpy as np
 from ...core.tensor import Tensor, apply_op, to_tensor
 
 __all__ = [
+    "elu_",
     "relu", "relu_", "relu6", "elu", "selu", "celu", "gelu", "sigmoid",
     "hardsigmoid", "hardswish", "hardtanh", "hardshrink", "leaky_relu",
     "log_sigmoid", "log_softmax", "maxout", "mish", "prelu", "rrelu",
@@ -35,6 +36,11 @@ def relu_(x, name=None):
 
 def relu6(x, name=None):
     return apply_op(jax.nn.relu6, _t(x))
+
+
+def elu_(x, alpha=1.0, name=None):
+    x._rebind(elu(x, alpha))
+    return x
 
 
 def elu(x, alpha=1.0, name=None):

@@ -16,7 +16,8 @@ __all__ = [
     "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
     "kl_div", "smooth_l1_loss", "margin_ranking_loss", "hinge_embedding_loss",
     "cosine_embedding_loss", "square_error_cost", "log_loss", "sigmoid_focal_loss",
-    "triplet_margin_loss", "ctc_loss", "edit_distance",
+    "triplet_margin_loss", "ctc_loss", "edit_distance", "hsigmoid_loss",
+    "dice_loss", "npair_loss",
 ]
 
 
@@ -462,3 +463,117 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
     args = [inp, lab] + [t.detach() for t in (il, ll) if t is not None]
     out = apply_op(f, *args)
     return out, to_tensor(np.array([float(B)], np.float32))
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss — parity with
+    python/paddle/nn/functional/loss.py:312 (kernel
+    paddle/fluid/operators/hierarchical_sigmoid_op.h).
+
+    Default tree: complete binary tree over ``num_classes`` leaves via the
+    reference's SimpleCode (matrix_bit_code.h:106): for leaf ``l`` the code
+    is ``c = l + num_classes``; step ``j`` classifies against internal node
+    ``(c >> (j+1)) - 1`` with binary target ``(c >> j) & 1``; the path
+    length is ``floor(log2(c))``. Loss per sample is the summed
+    sigmoid-BCE over its path: Σ_j log(1+exp(p_j)) − Σ_{bit_j=1} p_j with
+    pre-activation clipped to ±40 like the kernel.
+
+    TPU-first shape: the variable-length path is computed at a STATIC
+    max length with a per-sample mask (no data-dependent loops under jit);
+    the per-step weight rows ride one gather + batched dot.
+    ``is_sparse`` selects the reference's sparse row update — under XLA
+    gathers/scatters are already sparse at the lattice level, so it is
+    accepted and ignored.
+    """
+    input = _t(input)
+    label = _t(label)
+    weight = _t(weight)
+
+    custom = path_table is not None and path_code is not None
+
+    def f(x, lbl, w, *rest):
+        rest = list(rest)
+        b = rest.pop(0) if bias is not None else None
+        if custom:
+            table, code_bits = rest[0], rest[1]
+            mask = (table >= 0)
+            idx = jnp.clip(table, 0, None).astype(jnp.int32)
+            bits = (code_bits > 0) & mask
+        else:
+            lbl_i = lbl.reshape((lbl.shape[0],)).astype(jnp.uint32)
+            c = lbl_i + jnp.uint32(num_classes)
+            max_len = int(np.floor(np.log2(2 * num_classes - 1)))
+            j = jnp.arange(max_len, dtype=jnp.uint32)[None, :]
+            length = jnp.floor(
+                jnp.log2(c.astype(jnp.float32)))[:, None]  # per-sample
+            mask = j.astype(jnp.float32) < length
+            idx = ((c[:, None] >> (j + 1)) - 1).astype(jnp.int32)
+            idx = jnp.clip(idx, 0, num_classes - 2)
+            bits = ((c[:, None] >> j) & 1).astype(bool) & (mask > 0)
+        rows = jnp.take(w, idx, axis=0)             # [N, L, D]
+        pre = jnp.einsum("nld,nd->nl", rows, x)
+        if b is not None:
+            pre = pre + jnp.take(b.reshape(-1), idx, axis=0)
+        pre = jnp.clip(pre, -40.0, 40.0)
+        maskf = mask.astype(pre.dtype)
+        loss = jnp.sum(jnp.log1p(jnp.exp(pre)) * maskf, axis=1) \
+            - jnp.sum(jnp.where(bits, pre, 0.0), axis=1)
+        return loss[:, None]
+
+    args = [input, label.detach(), weight]
+    if bias is not None:
+        args.append(_t(bias))
+    if custom:
+        args.append(_t(path_table).detach())
+        args.append(_t(path_code).detach())
+    return apply_op(f, *args)
+
+
+def dice_loss(input, label, epsilon=0.00001, name=None):
+    """Dice loss — parity with
+    python/paddle/fluid/layers/nn.py:7060 (one-hot over the trailing class
+    axis, per-sample dice score over all non-batch dims, mean-reduced)."""
+    input = _t(input)
+    label = _t(label)
+
+    def f(x, lbl):
+        lbl_i = lbl.astype(jnp.int32)
+        if lbl_i.shape[-1] == 1:
+            lbl_i = lbl_i[..., 0]
+        onehot = jax.nn.one_hot(lbl_i, x.shape[-1], dtype=x.dtype)
+        reduce_dim = tuple(range(1, x.ndim))
+        inse = jnp.sum(x * onehot, axis=reduce_dim)
+        denom = jnp.sum(x, axis=reduce_dim) + jnp.sum(onehot, axis=reduce_dim)
+        return jnp.mean(1.0 - 2.0 * inse / (denom + epsilon))
+
+    return apply_op(f, input, label.detach())
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair metric loss — parity with
+    python/paddle/fluid/layers/loss.py:1653: soft-label CE over the
+    anchor·positiveᵀ similarity matrix plus a 0.25·l2_reg embedding
+    regularizer."""
+    anchor = _t(anchor)
+    positive = _t(positive)
+    labels = _t(labels)
+
+    def f(a, p, lbl):
+        beta = 0.25
+        bsz = lbl.shape[0]
+        l2 = lbl.reshape((bsz, 1))
+        eq = (l2 == l2.T).astype(a.dtype)
+        soft = eq / jnp.sum(eq, axis=1, keepdims=True)
+        l2loss = (jnp.mean(jnp.sum(a * a, axis=1))
+                  + jnp.mean(jnp.sum(p * p, axis=1))) * beta * l2_reg
+        sim = a @ p.T
+        logp = jax.nn.log_softmax(sim, axis=-1)
+        ce_rows = -jnp.sum(soft * logp, axis=1)       # [B]
+        # reference quirk: reduce_sum(labels * ce, 0) then mean — the
+        # soft-label CE rows are re-weighted by the soft labels
+        celoss = jnp.mean(jnp.sum(soft * ce_rows[:, None], axis=0))
+        return l2loss + celoss
+
+    return apply_op(f, anchor, positive, labels.detach())

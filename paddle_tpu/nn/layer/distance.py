@@ -1,0 +1,36 @@
+"""Distance layers — parity with python/paddle/nn/layer/distance.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op, to_tensor
+from ..layer_base import Layer
+
+__all__ = ["PairwiseDistance"]
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between row vectors — parity with
+    python/paddle/nn/layer/distance.py:26 (the reference lowers to a
+    p_norm op over x−y+epsilon; one fused elementwise+reduce here)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = float(p)
+        self.epsilon = float(epsilon)
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        x = x if isinstance(x, Tensor) else to_tensor(x)
+        y = y if isinstance(y, Tensor) else to_tensor(y)
+        p, eps, keepdim = self.p, self.epsilon, self.keepdim
+
+        def f(a, b):
+            d = jnp.abs(a - b + eps)
+            if p == jnp.inf:
+                return jnp.max(d, axis=-1, keepdims=keepdim)
+            if p == -jnp.inf:
+                return jnp.min(d, axis=-1, keepdims=keepdim)
+            return jnp.sum(d ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+        return apply_op(f, x, y)

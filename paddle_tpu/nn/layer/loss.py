@@ -8,7 +8,37 @@ __all__ = [
     "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
     "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss", "MarginRankingLoss",
     "HingeEmbeddingLoss", "CosineEmbeddingLoss", "CTCLoss", "TripletMarginLoss",
+    "HSigmoidLoss",
 ]
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid layer — parity with
+    python/paddle/nn/layer/loss.py:410. Owns the [C, feature_size] internal
+    node weights (C = num_classes for a custom tree, num_classes−1 for the
+    default complete binary tree) and delegates to F.hsigmoid_loss."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if (num_classes < 2) and (not is_custom):
+            raise ValueError("num_classes must not be less than 2 "
+                             "with default tree")
+        self._feature_size = feature_size
+        self._num_classes = num_classes
+        self._is_custom = is_custom
+        self._is_sparse = is_sparse
+        c = num_classes if is_custom else num_classes - 1
+        self.weight = self.create_parameter([c, feature_size],
+                                            attr=weight_attr)
+        self.bias = self.create_parameter([c, 1], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(
+            input, label, self._num_classes, self.weight, self.bias,
+            path_table=path_table, path_code=path_code,
+            is_sparse=self._is_sparse)
 
 
 class CrossEntropyLoss(Layer):

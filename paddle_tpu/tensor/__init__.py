@@ -19,6 +19,12 @@ from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
+from .to_string import *  # noqa: F401,F403
+
+# LoDTensorArray op parity (reference paddle.tensor exports the fluid
+# array ops; the implementations live with the static control flow)
+from ..static.control_flow import (  # noqa: F401
+    array_length, array_read, array_write, create_array)
 
 from . import (attribute, creation, linalg, logic, manipulation, math, random,
                search, sequence, stat)

@@ -15,7 +15,7 @@ from ..core.enforce import InvalidArgumentError, enforce
 from ..core.tensor import Tensor, _is_tracer, apply_op, to_tensor, wrap_raw
 
 __all__ = [
-    "reshape", "reshape_", "transpose", "flatten", "squeeze", "squeeze_",
+    "reshape", "reshape_", "flatten_", "transpose", "flatten", "squeeze", "squeeze_",
     "unsqueeze", "unsqueeze_", "concat", "stack", "split", "chunk", "tile",
     "expand", "expand_as", "broadcast_to", "gather", "gather_nd", "scatter",
     "scatter_", "scatter_nd", "scatter_nd_add", "slice", "strided_slice",
@@ -49,6 +49,11 @@ def reshape(x, shape, name=None):
 
 def reshape_(x, shape, name=None):
     x._rebind(reshape(x, shape))
+    return x
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    x._rebind(flatten(x, start_axis, stop_axis))
     return x
 
 
@@ -524,3 +529,8 @@ def multiplex(inputs, index, name=None):
         return stacked[ix, rows]
 
     return apply_op(f, idx, *ts)
+
+
+# fluid-era alias (reference: `from .manipulation import flip as reverse`)
+reverse = flip
+__all__.append("reverse")

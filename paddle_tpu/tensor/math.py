@@ -16,7 +16,7 @@ from ..core.tensor import Tensor, apply_op, to_tensor, _binop, _promote_pair
 
 __all__ = [
     "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
-    "pow", "sqrt", "rsqrt", "exp", "expm1", "log", "log2", "log10", "log1p",
+    "floor_mod", "pow", "sqrt", "rsqrt", "exp", "expm1", "log", "log2", "log10", "log1p",
     "abs", "ceil", "floor", "round", "trunc", "sin", "cos", "tan", "asin",
     "acos", "atan", "atan2", "hypot", "logaddexp", "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
     "sigmoid", "square", "reciprocal", "sign", "neg", "maximum", "minimum",
@@ -455,3 +455,48 @@ def log_softmax_(x, axis=-1):
 
 def broadcast_shape(x_shape, y_shape):
     return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+# ---------------------------------------------------------------------------
+# Inplace API variants — parity with the reference's
+# @inplace_apis_in_dygraph_only family (python/paddle/tensor/math.py:85,
+# fluid/layers exp_/sqrt_/...). JAX arrays are immutable, but the Tensor
+# WRAPPER rebinds its buffer (x._rebind), which preserves the reference's
+# user-visible aliasing: every live reference to x observes the new value.
+# ---------------------------------------------------------------------------
+def _inplace(fn):
+    def g(x, *args, **kwargs):
+        x._rebind(fn(x, *args, **kwargs))
+        return x
+
+    g.__name__ = fn.__name__ + "_"
+    g.__qualname__ = fn.__name__ + "_"
+    g.__doc__ = (f"Inplace version of ``{fn.__name__}`` — the Tensor "
+                 "rebinds its buffer to the result.")
+    return g
+
+
+exp_ = _inplace(exp)
+sqrt_ = _inplace(sqrt)
+rsqrt_ = _inplace(rsqrt)
+ceil_ = _inplace(ceil)
+floor_ = _inplace(floor)
+round_ = _inplace(round)
+reciprocal_ = _inplace(reciprocal)
+tanh_ = _inplace(tanh)
+clip_ = _inplace(clip)
+scale_ = _inplace(scale)
+add_ = _inplace(add)
+subtract_ = _inplace(subtract)
+
+__all__ += ["exp_", "sqrt_", "rsqrt_", "ceil_", "floor_", "round_",
+            "reciprocal_", "tanh_", "clip_", "scale_", "add_", "subtract_",
+            "inverse"]
+
+
+def inverse(x, name=None):
+    """Top-level alias of ``linalg.inv`` — parity with
+    python/paddle/__init__.py:395 exporting tensor.math.inverse."""
+    from .linalg import inv
+
+    return inv(x, name=name)

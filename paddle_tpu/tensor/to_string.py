@@ -1,0 +1,48 @@
+"""Tensor print options — parity with python/paddle/tensor/to_string.py."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["set_printoptions"]
+
+# reference DEFAULT_PRINT_OPTIONS (to_string.py:24): precision 8,
+# threshold 1000, edgeitems 3, sci_mode False
+_PRINT_OPTS = {
+    "precision": 8,
+    "threshold": 1000,
+    "edgeitems": 3,
+    "sci_mode": False,
+    "linewidth": 80,
+}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Set Tensor printing options (reference
+    python/paddle/tensor/to_string.py:34). Only non-None fields change."""
+    for k, v in (("precision", precision), ("threshold", threshold),
+                 ("edgeitems", edgeitems), ("sci_mode", sci_mode),
+                 ("linewidth", linewidth)):
+        if v is not None:
+            _PRINT_OPTS[k] = v
+
+
+def array_repr(val) -> str:
+    """numpy rendering of a device value under the active print options
+    (used by Tensor.__repr__)."""
+    arr = np.asarray(val)
+    fmt = {}
+    if arr.dtype.kind == "f":
+        if _PRINT_OPTS["sci_mode"]:
+            fmt["float_kind"] = (
+                lambda x: np.format_float_scientific(
+                    x, precision=_PRINT_OPTS["precision"]))
+        else:
+            fmt["float_kind"] = (
+                lambda x: np.format_float_positional(
+                    x, precision=_PRINT_OPTS["precision"], trim="0"))
+    return np.array2string(
+        arr, threshold=_PRINT_OPTS["threshold"],
+        edgeitems=_PRINT_OPTS["edgeitems"],
+        max_line_width=_PRINT_OPTS["linewidth"],
+        formatter=fmt or None, separator=", ")

@@ -1,0 +1,221 @@
+"""Round-5 API-tail parity: flops, hsigmoid, inplace variants, small ops.
+
+Golden values follow the reference implementations' own math
+(hapi/dynamic_flops.py, hierarchical_sigmoid_op.h + matrix_bit_code.h
+SimpleCode, fluid/layers nn.py dice_loss / loss.py npair_loss,
+nn/functional/extension.py diag_embed, nn/layer/distance.py,
+tensor/to_string.py).
+"""
+import io
+import contextlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+
+def test_inplace_variants_rebind_and_alias():
+    for name, base, args in [
+        ("exp_", [0.0, 1.0], ()), ("sqrt_", [4.0, 9.0], ()),
+        ("rsqrt_", [4.0, 16.0], ()), ("ceil_", [1.2, -1.2], ()),
+        ("floor_", [1.8, -1.2], ()), ("round_", [1.4, 2.6], ()),
+        ("reciprocal_", [2.0, 4.0], ()), ("tanh_", [0.0, 1.0], ()),
+        ("clip_", [-2.0, 2.0], (-1.0, 1.0)),
+        ("scale_", [1.0, 2.0], (3.0,)),
+        ("add_", [1.0, 2.0], (np.asarray([10.0, 20.0], np.float32),)),
+        ("subtract_", [1.0, 2.0], (np.asarray([10.0, 20.0], np.float32),)),
+    ]:
+        x = paddle.to_tensor(np.asarray(base, np.float32))
+        alias = x  # every live reference must observe the update
+        out_fn = getattr(paddle, name)
+        ref_fn = getattr(paddle, name[:-1])
+        expect = ref_fn(paddle.to_tensor(np.asarray(base, np.float32)),
+                        *args).numpy()
+        ret = out_fn(x, *args)
+        assert ret is x, name
+        np.testing.assert_allclose(alias.numpy(), expect, rtol=1e-6,
+                                   err_msg=name)
+    # method surface
+    x = paddle.to_tensor(np.asarray([4.0], np.float32))
+    assert x.sqrt_() is x and float(x.numpy()[0]) == 2.0
+    # manipulation inplace
+    x = paddle.to_tensor(np.zeros((2, 3, 4), np.float32))
+    assert paddle.flatten_(x, 1, 2).shape == [2, 12]
+
+
+def test_diag_embed_matches_torch_semantics():
+    torch = pytest.importorskip("torch")
+    a = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    for off, d1, d2 in [(0, -2, -1), (-1, 0, 2), (1, 0, 2), (0, 1, 0),
+                        (2, -2, -1)]:
+        mine = F.diag_embed(paddle.to_tensor(a), offset=off,
+                            dim1=d1, dim2=d2).numpy()
+        ref = torch.diag_embed(torch.tensor(a), offset=off,
+                               dim1=d1, dim2=d2).numpy()
+        np.testing.assert_allclose(mine, ref, err_msg=str((off, d1, d2)))
+
+
+def test_pairwise_distance():
+    rng = np.random.RandomState(0)
+    xa = rng.rand(4, 8).astype(np.float32)
+    xb = rng.rand(4, 8).astype(np.float32)
+    for p in (1.0, 2.0, np.inf):
+        pd = nn.PairwiseDistance(p=p)
+        got = pd(paddle.to_tensor(xa), paddle.to_tensor(xb)).numpy()
+        d = np.abs(xa - xb + 1e-6)
+        ref = (np.max(d, axis=1) if p == np.inf
+               else np.sum(d ** p, axis=1) ** (1.0 / p))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+    assert nn.PairwiseDistance(keepdim=True)(
+        paddle.to_tensor(xa), paddle.to_tensor(xb)).shape == [4, 1]
+
+
+def test_dice_loss_golden():
+    # perfect one-hot prediction -> ~0; uniform prediction -> 1 - 2/(C+1)
+    pred = np.eye(4, dtype=np.float32)[None].repeat(2, 0)
+    lbl = np.arange(4)[None, :, None].repeat(2, 0).astype(np.int64)
+    d = float(F.dice_loss(paddle.to_tensor(pred),
+                          paddle.to_tensor(lbl)).numpy())
+    assert d < 1e-3
+    # uniform 0.25 prediction: inse = 1, denom = sum(pred) + sum(onehot)
+    # = 4 + 4 -> dice loss = 1 - 2/8 = 0.75
+    uni = np.full((2, 4, 4), 0.25, np.float32)
+    d2 = float(F.dice_loss(paddle.to_tensor(uni),
+                           paddle.to_tensor(lbl)).numpy())
+    np.testing.assert_allclose(d2, 0.75, rtol=1e-4)
+
+
+def test_npair_loss_golden():
+    # reference math re-implemented in numpy (fluid/layers/loss.py:1653)
+    rng = np.random.RandomState(0)
+    an = rng.rand(6, 5).astype(np.float32)
+    po = rng.rand(6, 5).astype(np.float32)
+    lb = np.array([0, 0, 1, 1, 2, 2], np.int64)
+    got = float(F.npair_loss(paddle.to_tensor(an), paddle.to_tensor(po),
+                             paddle.to_tensor(lb)).numpy())
+    eq = (lb[:, None] == lb[None, :]).astype(np.float32)
+    soft = eq / eq.sum(1, keepdims=True)
+    l2 = (np.mean((an * an).sum(1)) + np.mean((po * po).sum(1))) \
+        * 0.25 * 0.002
+    sim = an @ po.T
+    logp = sim - np.log(np.exp(sim - sim.max(1, keepdims=True)).sum(
+        1, keepdims=True)) - sim.max(1, keepdims=True)
+    ce_rows = -(soft * logp).sum(1)
+    ref = l2 + np.mean((soft * ce_rows[:, None]).sum(0))
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def _np_hsigmoid(x, w, b, lbl, nc):
+    out = np.zeros((len(lbl), 1), np.float32)
+    for i, l in enumerate(lbl):
+        c = int(l) + nc
+        s = 0.0
+        for j in range(int(np.floor(np.log2(c)))):
+            idx = (c >> (j + 1)) - 1
+            bit = (c >> j) & 1
+            pre = float(np.clip(x[i] @ w[idx] + (0.0 if b is None
+                                                 else b[idx, 0]), -40, 40))
+            s += np.log1p(np.exp(pre)) - bit * pre
+        out[i, 0] = s
+    return out
+
+
+def test_hsigmoid_loss_default_tree_golden():
+    rng = np.random.RandomState(1)
+    N, D, C = 5, 4, 7
+    x = rng.randn(N, D).astype(np.float32)
+    w = rng.randn(C - 1, D).astype(np.float32)
+    b = rng.randn(C - 1, 1).astype(np.float32)
+    lbl = rng.randint(0, C, (N,)).astype(np.int64)
+    got = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(lbl), C,
+                          paddle.to_tensor(w), paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(got, _np_hsigmoid(x, w, b, lbl, C), rtol=1e-4)
+    # no-bias path
+    got2 = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(lbl), C,
+                           paddle.to_tensor(w)).numpy()
+    np.testing.assert_allclose(got2, _np_hsigmoid(x, w, None, lbl, C),
+                               rtol=1e-4)
+
+
+def test_hsigmoid_loss_custom_tree_and_layer_grad():
+    rng = np.random.RandomState(2)
+    N, D = 4, 3
+    x = rng.randn(N, D).astype(np.float32)
+    w = rng.randn(5, D).astype(np.float32)
+    # custom paths, -1 padded
+    table = np.array([[0, 2, -1], [1, 3, -1], [0, 2, 4], [1, -1, -1]],
+                     np.int64)
+    code = np.array([[1, 0, 0], [0, 1, 0], [1, 1, 0], [0, 0, 0]], np.int64)
+    got = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(
+        np.zeros((N, 1), np.int64)), 5, paddle.to_tensor(w),
+        path_table=paddle.to_tensor(table),
+        path_code=paddle.to_tensor(code)).numpy()
+    ref = np.zeros((N, 1), np.float32)
+    for i in range(N):
+        s = 0.0
+        for j in range(3):
+            if table[i, j] < 0:
+                continue
+            pre = float(np.clip(x[i] @ w[table[i, j]], -40, 40))
+            s += np.log1p(np.exp(pre)) - code[i, j] * pre
+        ref[i, 0] = s
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    # the layer trains: loss decreases on a toy problem
+    paddle.seed(0)
+    layer = nn.HSigmoidLoss(feature_size=D, num_classes=6)
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=layer.parameters())
+    xt = paddle.to_tensor(x)
+    lt = paddle.to_tensor(rng.randint(0, 6, (N, 1)).astype(np.int64))
+    first = None
+    for _ in range(12):
+        loss = layer(xt, lt).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss.numpy())
+    assert float(loss.numpy()) < first * 0.8
+
+
+def test_flops_lenet_golden():
+    net = nn.Sequential(nn.Conv2D(1, 6, 3, padding=1), nn.ReLU(),
+                        nn.MaxPool2D(2, 2), nn.Flatten(),
+                        nn.Linear(6 * 14 * 14, 10))
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        total = paddle.flops(net, [1, 1, 28, 28], print_detail=True)
+    # conv: numel(y)*(Cin/g*K + bias) = 6*28*28*(1*9+1) = 47040
+    # linear: in_features*numel(y) = 1176*10 = 11760
+    assert total == 47040 + 11760
+    out = buf.getvalue()
+    assert "Layer Name" in out and "47040" in out
+
+    # custom_ops override wins over the builtin table
+    def count_conv_double(m, x, y):
+        m.total_ops += 2
+
+    with contextlib.redirect_stdout(io.StringIO()):
+        t2 = paddle.flops(net, [1, 1, 28, 28],
+                          custom_ops={nn.Conv2D: count_conv_double})
+    assert t2 == 2 + 11760
+
+
+def test_set_printoptions():
+    paddle.set_printoptions(precision=2)
+    try:
+        s = repr(paddle.to_tensor(np.array([1.23456789], np.float32)))
+        assert "1.23" in s and "1.2345" not in s
+    finally:
+        paddle.set_printoptions(precision=8)
+    s = repr(paddle.to_tensor(np.array([1.23456789], np.float32)))
+    assert "1.2345" in s
+
+
+def test_inverse_alias():
+    m = np.array([[2.0, 1.0], [0.0, 4.0]], np.float32)
+    np.testing.assert_allclose(paddle.inverse(paddle.to_tensor(m)).numpy(),
+                               np.linalg.inv(m), rtol=1e-5)

@@ -74,6 +74,48 @@ class TestRunSteps:
         step.run_steps((xs,), (ys,))
         assert opt._global_step == n
 
+    def test_run_steps_composes_with_offload(self):
+        """run_steps × pinned-host offload (r4 verdict Weak #5): the state
+        streams into HBM once per window and evacuates after, so a window
+        over ZeRO-offloaded state must (a) match the per-step offload
+        loop's losses and params, and (b) leave the optimizer state on the
+        HOST memory space between windows."""
+        n = 4
+        xs, ys = _batches(n)
+
+        def mk_off():
+            paddle.seed(7)
+            net = paddle.nn.Sequential(
+                paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                paddle.nn.Linear(16, 4))
+            opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                        parameters=net.parameters())
+            mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+            step = ParallelTrainStep(net, loss_fn=paddle.nn.MSELoss(),
+                                     optimizer=opt, mesh=mesh, zero_stage=1,
+                                     offload=True)
+            return net, opt, step
+
+        net_a, _, step_a = mk_off()
+        per_step = [float(step_a((xs[i],), (ys[i],)).numpy())
+                    for i in range(n)]
+        step_a.sync_to_layer()
+        ref = {k: np.asarray(v._value) for k, v in net_a.named_parameters()}
+
+        net_b, _, step_b = mk_off()
+        losses = step_b.run_steps((xs,), (ys,)).numpy()
+        np.testing.assert_allclose(np.asarray(losses), np.asarray(per_step),
+                                   rtol=1e-5, atol=1e-6)
+        step_b.sync_to_layer()
+        got = {k: np.asarray(v._value) for k, v in net_b.named_parameters()}
+        for k in ref:
+            np.testing.assert_allclose(got[k], ref[k], rtol=1e-5,
+                                       atol=1e-6, err_msg=k)
+        # state parked back on pinned host memory between windows
+        for leaf in jax.tree_util.tree_leaves(step_b._opt_state):
+            if hasattr(leaf, "sharding"):
+                assert leaf.sharding.memory_kind == "pinned_host", leaf
+
 
 class TestSelectiveRemat:
     @pytest.mark.parametrize("policy", ["dots", "dots_no_batch", "nothing"])

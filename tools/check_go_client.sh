@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# CI stub for the Go inference client (inference/go/paddle).
+#
+# The build image ships NO Go toolchain, so the cgo package has never been
+# compiled here — this script is the gate that runs the moment one exists
+# (vet + build + the smoke test), and states that status honestly otherwise.
+# Counterpart of the reference's go/paddle build in its CI
+# (/root/reference/go/paddle/predictor.go).
+set -e
+cd "$(dirname "$0")/../paddle_tpu/inference/go/paddle"
+if ! command -v go >/dev/null 2>&1; then
+  echo "check_go_client: SKIP — no Go toolchain in this image."
+  echo "  The package is source-only and compile-UNVERIFIED (PARITY.md #45)."
+  echo "  On a machine with Go >= 1.18:  bash tools/check_go_client.sh"
+  exit 0
+fi
+echo "== go vet =="
+go vet ./...
+echo "== go build =="
+go build ./...
+echo "== go test (smoke; needs libpd_inference_c.so on LD_LIBRARY_PATH) =="
+go test ./... || {
+  echo "go test failed — if the error is a missing shared library, build"
+  echo "the C ABI first: make -C paddle_tpu/inference/capi"; exit 1; }
+echo "check_go_client: PASS"
