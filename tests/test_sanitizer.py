@@ -96,6 +96,45 @@ class TestStaticExecutorSanitizer:
                                 "y": np.zeros((2, 1), np.float32)},
                     fetch_list=[loss])
 
+    def test_inf_feed_raises_through_run_steps_window(self, nan_flag):
+        """The scan-window path reduces the per-step flag vectors across
+        the window (any non-finite step must surface) — the compiled
+        multi-step program is a separate instrumentation site from the
+        per-step jit."""
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 4], "float32")
+            y = paddle.static.data("y", [None, 1], "float32")
+            pred = paddle.static.nn.fc(x, 1)
+            loss = paddle.mean((pred - y) ** 2)
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        # window of 3, only the LAST step's batch is poisoned
+        xw = np.ones((3, 2, 4), np.float32)
+        xw[2, 0, 0] = np.inf
+        yw = np.zeros((3, 2, 1), np.float32)
+        with pytest.raises(FloatingPointError):
+            exe.run_steps(main, feed={"x": xw, "y": yw},
+                          fetch_list=[loss], n_steps=3)
+        # finite window passes — on a FRESH program (the poisoned window
+        # deliberately committed its inf params before raising, same
+        # post-mortem contract as the per-step path)
+        main2 = paddle.static.Program()
+        startup2 = paddle.static.Program()
+        with paddle.static.program_guard(main2, startup2):
+            x2 = paddle.static.data("x", [None, 4], "float32")
+            y2 = paddle.static.data("y", [None, 1], "float32")
+            pred2 = paddle.static.nn.fc(x2, 1)
+            loss2 = paddle.mean((pred2 - y2) ** 2)
+            opt2 = paddle.optimizer.SGD(learning_rate=0.1)
+            opt2.minimize(loss2)
+        exe.run(startup2)
+        exe.run_steps(main2, feed={"x": np.ones((3, 2, 4), np.float32),
+                                   "y": yw}, fetch_list=[loss2], n_steps=3)
+
 
 class TestPipelineEngineSanitizer:
     def test_inf_under_pipeline_raises(self, nan_flag):
