@@ -65,3 +65,63 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
                 f"spawn worker(s) failed (rank, exitcode): {failed}"
             )
     return procs
+
+from .entry_attr import (  # noqa: F401
+    CountFilterEntry,
+    EntryAttr,
+    ProbabilityEntry,
+)
+from . import cloud_utils  # noqa: F401
+from . import utils  # noqa: F401
+from ..io.data_feed import InMemoryDataset as _IMD  # noqa: F401
+
+
+class BoxPSDataset(_IMD):
+    """Dataset twin of the reference's BoxPSDataset
+    (fleet/dataset/dataset.py) — the DATA side (slots, batching, memory
+    pipeline) is fully functional via InMemoryDataset; the box_ps
+    GPU-cache acceleration it feeds in the reference is the agreed
+    out-of-scope closed-source PS (SURVEY §2 #27), so begin_pass/end_pass
+    are no-ops here."""
+
+    def __init__(self, slots=None, batch_size=1, num_threads=2):
+        super().__init__(slots or [], batch_size=batch_size,
+                         num_threads=num_threads)
+
+    def begin_pass(self):
+        return None
+
+    def end_pass(self, need_save_delta=False):
+        return None
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Parity with distributed/collective.py:1012: model-parallel
+    linear/embedding with the weight split over the 'mp' mesh axis.
+
+    TPU-native: rather than manually slicing a weight per rank and calling
+    c_allreduce, the layer is built from the fleet mp_layers family —
+    GSPMD shards the created weight over 'mp' via its tp_spec and inserts
+    the collectives (the same mechanics the GPT/ERNIE models use).
+    ``operation`` ∈ {'linear', 'embedding'}; axis 0 = row-parallel
+    (embedding: vocab-parallel), axis 1 = column-parallel.
+    """
+    from .fleet.meta_parallel.parallel_layers.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if operation != "linear":
+        raise ValueError("operation must be 'linear' or 'embedding'")
+    if axis == 1:
+        layer = ColumnParallelLinear(size[0], size[1],
+                                     weight_attr=weight_attr,
+                                     has_bias=bias_attr is not False,
+                                     gather_output=gather_out)
+    else:
+        layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                  has_bias=bias_attr is not False)
+    return layer(x)

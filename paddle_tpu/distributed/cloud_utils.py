@@ -1,0 +1,35 @@
+"""Cluster discovery from cloud/cluster env vars — parity with
+python/paddle/distributed/cloud_utils.py (PADDLE_TRAINERS / PADDLE_TRAINER_*
+environment contract), resolved onto this repo's launch Cluster model."""
+from __future__ import annotations
+
+import os
+
+__all__ = []
+
+
+def get_cloud_cluster(args_node_ips=None, args_node_ip=None, args_port=6170,
+                      selected_devices=None):
+    """Cluster spec from the PaddleCloud env contract: node ips from
+    PADDLE_TRAINERS, this node from POD_IP, ports from
+    PADDLE_TRAINER_ENDPOINTS/PADDLE_PORT."""
+    node_ips = (os.getenv("PADDLE_TRAINERS") or args_node_ips
+                or "127.0.0.1")
+    if isinstance(node_ips, str):
+        node_ips = node_ips.split(",")
+    node_ip = os.getenv("POD_IP") or args_node_ip or node_ips[0]
+    port = int(os.getenv("PADDLE_PORT") or args_port)
+    if selected_devices:
+        nproc = len(selected_devices)
+    else:
+        # PADDLE_TRAINERS_NUM is the TOTAL trainer count across the job;
+        # per-node process count divides by the node count
+        total = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        nproc = max(1, total // max(1, len(node_ips)))
+    from .launch import get_cluster_env
+
+    return get_cluster_env(node_ip, node_ips, nproc, port)
+
+
+def _get_trainers_num():
+    return int(os.getenv("PADDLE_TRAINERS_NUM", "1"))

@@ -1,0 +1,47 @@
+"""Sparse-table entry (admission) policies — parity with
+python/paddle/distributed/entry_attr.py. The policy string rides the
+sparse_embedding parameter to the PS table config (native/src/ps.cc keeps
+all rows; admission filtering is a table-side policy knob recorded here)."""
+from __future__ import annotations
+
+__all__ = ["EntryAttr", "ProbabilityEntry", "CountFilterEntry"]
+
+
+class EntryAttr:
+    def __init__(self):
+        self._name = None
+
+    def _to_attr(self):
+        raise NotImplementedError("EntryAttr is base class")
+
+
+class ProbabilityEntry(EntryAttr):
+    """Admit a new sparse feature row with the given probability."""
+
+    def __init__(self, probability):
+        super().__init__()
+        if not isinstance(probability, float):
+            raise ValueError("probability must be a float in (0,1)")
+        if probability <= 0 or probability >= 1:
+            raise ValueError("probability must be a float in (0,1)")
+        self._name = "probability_entry"
+        self._probability = probability
+
+    def _to_attr(self):
+        return ":".join([self._name, str(self._probability)])
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit a sparse feature row after it was seen ``count`` times."""
+
+    def __init__(self, count):
+        super().__init__()
+        if not isinstance(count, int):
+            raise ValueError("count must be a positive integer")
+        if count < 1:
+            raise ValueError("count must be a positive integer")
+        self._name = "count_filter_entry"
+        self._count = count
+
+    def _to_attr(self):
+        return ":".join([self._name, str(self._count)])

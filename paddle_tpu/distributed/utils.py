@@ -1,0 +1,40 @@
+"""Launch/cluster helper surface — parity with
+python/paddle/distributed/utils.py (Cluster/Pod/Trainer model + arg
+helpers), resolved onto the repo's launch module."""
+from __future__ import annotations
+
+import os
+
+from .launch import get_cluster_env  # noqa: F401
+
+__all__ = ["get_host_name_ip", "get_cluster_from_args", "get_gpus"]
+
+
+def get_host_name_ip():
+    import socket
+
+    try:
+        host = socket.gethostname()
+        return host, socket.gethostbyname(socket.getfqdn(host))
+    except OSError:
+        return None
+
+
+def get_gpus(selected_gpus=None):
+    """Device-index list; on this platform the accelerator set is JAX's."""
+    if selected_gpus:
+        return [int(g) for g in (selected_gpus.split(",")
+                                 if isinstance(selected_gpus, str)
+                                 else selected_gpus)]
+    import jax
+
+    return list(range(len(jax.devices())))
+
+
+def get_cluster_from_args(args, selected_gpus=None):
+    ips = getattr(args, "cluster_node_ips", None) or "127.0.0.1"
+    ips = ips.split(",") if isinstance(ips, str) else ips
+    ip = getattr(args, "node_ip", None) or ips[0]
+    port = int(getattr(args, "started_port", None) or 6170)
+    devices = get_gpus(selected_gpus)
+    return get_cluster_env(ip, ips, len(devices), port)

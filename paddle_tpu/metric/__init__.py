@@ -194,3 +194,6 @@ class Auc(Metric):
 
     def name(self):
         return self._name
+
+
+from . import metrics  # noqa: E402,F401  (reference module layout)

@@ -1,0 +1,13 @@
+"""paddle.metric.metrics submodule — parity with
+python/paddle/metric/metrics.py (the reference keeps the Metric classes in
+this module and re-exports them from the package; here the implementations
+live in the package __init__ and this module mirrors the reference
+layout)."""
+from . import (  # noqa: F401
+    Accuracy,
+    Auc,
+    Metric,
+    Precision,
+    Recall,
+    accuracy,
+)

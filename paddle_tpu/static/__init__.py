@@ -160,18 +160,9 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     feed_ids = {id(t) for t in feed_vars}
     fetch_ids = [id(t) for t in fetch_vars]
 
-    # prune to the feed→fetch subgraph (fluid/io.py prune parity): keep only
-    # ops transitively producing a fetch, walking backwards
-    needed = set(fetch_ids)
-    kept = []
-    for op in reversed(program.ops):
-        if any(o in needed for o in op.out_ids):
-            kept.append(op)
-            for kind, v in op.args:
-                if kind == "var":
-                    needed.add(v)
-    kept.reverse()
-    # feeds the subgraph actually consumes must all be provided
+    # prune to the feed→fetch subgraph — the ONE prune implementation
+    # (normalize_program below), plus the save-path feeds validation
+    pruned, needed = _prune_program(program, feed_vars, fetch_vars)
     required_feeds = {
         name for name, t in program.feed_vars.items() if id(t) in needed
     }
@@ -181,18 +172,7 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
             f"inference subgraph reads feed vars {sorted(missing)} that are "
             "not in feed_vars — include them or fetch something upstream"
         )
-    params_raw = {
-        uid: p._value for uid, p in program.parameters.items() if uid in needed
-    }
-
-    # pruned Program reusing the one replay implementation (program.py)
-    pruned = Program()
-    pruned.ops = kept
-    pruned.feed_vars = {t.name: t for t in feed_vars}
-    pruned.parameters = {
-        uid: p for uid, p in program.parameters.items() if uid in needed
-    }
-    pruned._var_refs = program._var_refs
+    params_raw = {uid: p._value for uid, p in pruned.parameters.items()}
     replay = pruned.build_replay()
 
     def closed(*arrays):
@@ -222,3 +202,318 @@ def load_inference_model(path_prefix, executor=None):
     with open(path_prefix + ".pdmodel", "rb") as f:
         meta = pickle.load(f)
     return predictor, meta["feed_names"], meta["fetch_names"]
+
+
+# ---------------------------------------------------------------------------
+# r5: paddle.static surface completion
+# ---------------------------------------------------------------------------
+import numpy as np  # noqa: E402
+
+from ..core import dtype as dtype_mod  # noqa: E402
+from ..nn.param_attr import ParamAttr  # noqa: E402,F401
+from ..core.tensor import Tensor as Variable  # noqa: F401  (recorded vars
+# ARE Tensors in this trace-first design — the reference's Variable is the
+# graph-side twin of the same surface)
+from .executor import _Scope as Scope  # noqa: F401
+from .. import amp  # noqa: F401  (paddle.static.amp submodule parity; the
+# repo's AMP is mode-agnostic: record-time auto_cast snapshots into the
+# Program, same classes either way)
+from .nn import create_parameter, py_func  # noqa: F401
+
+
+class WeightNormParamAttr(ParamAttr):
+    """Parity with fluid WeightNormParamAttr: a ParamAttr carrying a
+    weight-norm ``dim``. The repo applies weight norm via
+    nn.utils.weight_norm (hook-based); this attr records the request on
+    the parameter so layer helpers can apply it. Being a real ParamAttr,
+    every layer helper accepts it directly."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable)
+        self.dim = dim
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """Parity with fluid Print op: prints the tensor when the compiled
+    step executes (jax.debug.print — works inside jit, which is where
+    static Programs run)."""
+    import jax
+    from ..core.tensor import apply_op
+
+    # braces in the user message must print LITERALLY, not act as
+    # jax.debug.print format fields
+    msg = (message or "").replace("{", "{{").replace("}", "}}")
+
+    def f(a):
+        jax.debug.print(msg + " {x}", x=a)
+        return a
+
+    return apply_op(f, input)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Parity with fluid/layers/metric_op.py:32: top-k accuracy over a
+    batch, returned as a tensor (static metric op)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+
+    def f(pred, lbl):
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        lbl_c = lbl.reshape(-1, 1).astype(topk.dtype)
+        hit = jnp.any(topk == lbl_c, axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply_op(f, input, label)
+
+
+def auc(input, label, curve="ROC", num_thresholds=2 ** 12 - 1,
+        topk=1, slide_steps=1):
+    """Parity with fluid/layers/metric_op.py:115: batch AUC via the
+    thresholded confusion-matrix estimate (static op form; the stateful
+    streaming metric is paddle.metric.Auc). Returns (auc_value,)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+
+    def f(pred, lbl):
+        pos_score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 \
+            else pred.reshape(-1)
+        y = lbl.reshape(-1).astype(jnp.float32)
+        thr = jnp.linspace(0.0, 1.0, num_thresholds)
+        ge = pos_score[None, :] >= thr[:, None]           # [T, N]
+        tp = jnp.sum(ge * y[None, :], axis=1)
+        fp = jnp.sum(ge * (1 - y[None, :]), axis=1)
+        P = jnp.maximum(jnp.sum(y), 1e-6)
+        Nn = jnp.maximum(jnp.sum(1 - y), 1e-6)
+        tpr = tp / P
+        fpr = fp / Nn
+        # trapezoid: thresholds ascend, so fpr/tpr descend along the
+        # axis and fpr[:-1]-fpr[1:] >= 0
+        return jnp.sum((tpr[:-1] + tpr[1:]) * 0.5
+                       * (fpr[:-1] - fpr[1:]))
+
+    return (apply_op(f, input, label),)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Parity with fluid create_global_var: a named, initialized variable
+    in the current program (persistable → survives as a parameter-like
+    var for save_vars)."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+
+    prog = current_program() or default_main_program()
+    val = jnp.full(tuple(int(s) for s in shape), float(value),
+                   dtype_mod.convert_dtype(dtype))
+    if persistable:
+        # persistable vars must survive save_vars/serialize_persistables,
+        # which iterate program.parameters — register as a non-trainable
+        # Parameter
+        from ..core.tensor import Parameter
+
+        t = Parameter(val, name=name)
+        t.trainable = False
+        prog.parameters[id(t)] = t
+        prog._var_refs[id(t)] = t
+    else:
+        t = Tensor(val, name=name)
+    if name:
+        prog.vars_by_name[name] = t
+    return t
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Parity with static/gradients: symbolic grads of ``targets`` wrt
+    ``inputs``. The returned grad vars are FETCHABLE: the Executor binds
+    each parameter's computed gradient to its grad var at step time
+    (executor._make_step fills env[id(grad_var)])."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if len(targets) != 1:
+        raise NotImplementedError(
+            "gradients() supports a single scalar target here (the "
+            "Executor differentiates the program's one loss)")
+    if target_gradients is not None:
+        raise NotImplementedError("target_gradients is not supported")
+    prog = current_program() or default_main_program()
+    append_backward(targets[0])
+    return [prog._grad_map.get(id(p)) for p in inputs]
+
+
+def xpu_places(device_ids=None):
+    """Twin of cuda_places for XPU rigs — resolves onto the accelerator
+    devices JAX exposes (the Place story is device-string based here)."""
+    return cuda_places(device_ids)
+
+
+def _prune_program(program, feed_vars, fetch_vars):
+    """Backward walk keeping only ops transitively producing a fetch;
+    returns (pruned Program, needed-id set). Shared by
+    save_inference_model and normalize_program."""
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    needed = {id(t) for t in fetch_vars}
+    kept = []
+    for op in reversed(program.ops):
+        if any(o in needed for o in op.out_ids):
+            kept.append(op)
+            for kind, v in op.args:
+                if kind == "var":
+                    needed.add(v)
+    kept.reverse()
+    pruned = Program()
+    pruned.ops = kept
+    pruned.feed_vars = {t.name: t for t in feed_vars}
+    pruned.parameters = {uid: p for uid, p in program.parameters.items()
+                         if uid in needed}
+    pruned._var_refs = program._var_refs
+    return pruned, needed
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """Parity with static/io.py:121: prune the program to the feed→fetch
+    subgraph (the same prune save_inference_model performs), returning the
+    pruned Program."""
+    return _prune_program(program, feed_vars, fetch_vars)[0]
+
+
+def serialize_program(feed_vars, fetch_vars, program=None):
+    """Parity with static/io.py:252: the deployable graph as BYTES. Here
+    that is the jax.export artifact save_inference_model writes (weights
+    baked — XLA's AOT unit is a closed executable, there is no separate
+    graph-only proto)."""
+    import os
+    import pickle
+    import tempfile
+
+    program = program or default_main_program()
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "m")
+        save_inference_model(prefix, feed_vars, fetch_vars,
+                             program=program)
+        with open(prefix + ".pdexport", "rb") as f:
+            export_bytes = f.read()
+        with open(prefix + ".pdmodel", "rb") as f:
+            meta = f.read()
+    return pickle.dumps({"export": export_bytes, "meta": meta})
+
+
+def deserialize_program(data):
+    """Parity with static/io.py: loads serialize_program bytes into a
+    runnable predictor handle (the executable IS the program here);
+    returns (predictor, feed_names, fetch_names) like
+    load_inference_model."""
+    import os
+    import pickle
+    import tempfile
+
+    blob = pickle.loads(data)
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "m")
+        with open(prefix + ".pdexport", "wb") as f:
+            f.write(blob["export"])
+        with open(prefix + ".pdmodel", "wb") as f:
+            f.write(blob["meta"])
+        return load_inference_model(prefix)
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None):
+    """Parity with static/io.py:315: the program's parameter state as
+    bytes."""
+    import pickle
+
+    program = program or default_main_program()
+    state = {p.name or str(uid): np.asarray(p._value)
+             for uid, p in program.parameters.items()}
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    """Restore serialize_persistables bytes into the program's
+    parameters (matched by name, else by declaration order)."""
+    import pickle
+
+    state = pickle.loads(data)
+    by_name = {p.name: p for p in program.parameters.values() if p.name}
+    unnamed = [p for p in program.parameters.values() if not p.name]
+    i = 0
+    for k, v in state.items():
+        p = by_name.get(k)
+        if p is None and i < len(unnamed):
+            p = unnamed[i]
+            i += 1
+        if p is not None:
+            p.set_value(np.asarray(v))
+
+
+def save_to_file(path, content):
+    """Parity with static/io.py:415."""
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    """Parity with static/io.py:663."""
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Parity with fluid/io.py save_vars: persist program parameters."""
+    import os
+    import pickle
+
+    program = main_program or default_main_program()
+    ps = vars or list(program.parameters.values())
+    if predicate is not None:
+        ps = [p for p in ps if predicate(p)]
+    state = {p.name or f"param_{i}": np.asarray(p._value)
+             for i, p in enumerate(ps)}
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, filename or "__all__.pdparams"),
+              "wb") as f:
+        pickle.dump(state, f)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Parity with fluid/io.py load_vars."""
+    import os
+    import pickle
+
+    program = main_program or default_main_program()
+    with open(os.path.join(dirname, filename or "__all__.pdparams"),
+              "rb") as f:
+        state = pickle.load(f)
+    ps = vars or list(program.parameters.values())
+    if predicate is not None:
+        ps = [p for p in ps if predicate(p)]
+    for i, p in enumerate(ps):
+        key = p.name or f"param_{i}"
+        if key in state:
+            p.set_value(np.asarray(state[key]))
+
+
+def load_program_state(model_path, var_list=None):
+    """Parity with static/io.py load_program_state: returns the name→array
+    dict a saved program state holds."""
+    import os
+    import pickle
+
+    path = model_path if model_path.endswith(".pdparams") \
+        else model_path + ".pdparams"
+    if not os.path.exists(path) and os.path.isdir(model_path):
+        path = os.path.join(model_path, "__all__.pdparams")
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -417,6 +417,12 @@ class Executor:
 
             train_p = {u: v for u, v in params_raw.items() if trainable[u]}
             (loss, env), grads = jax.value_and_grad(loss_of, has_aux=True)(train_p)
+            # bind computed grads to their append_backward/gradients() grad
+            # vars so fetch_list can name them (static.gradients contract)
+            for _uid, _g in grads.items():
+                _gt = getattr(program, "_grad_map", {}).get(_uid)
+                if _gt is not None:
+                    env[id(_gt)] = _g
             if opt._grad_clip is not None:
                 from ..nn.clip import ClipGradByGlobalNorm, clip_grads_global_norm_raw
 

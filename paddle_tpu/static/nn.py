@@ -143,3 +143,511 @@ def conv_shift(x, y, name=None):
         return jnp.einsum("bmn,bn->bm", gathered, b)
 
     return apply_op(f, x, y)
+
+
+# ---------------------------------------------------------------------------
+# r5: static.nn surface completion — fluid layer_helper-style functionals
+# that auto-create their parameters in the current Program and delegate the
+# math to the tested nn.functional / vision / text implementations.
+# ---------------------------------------------------------------------------
+def _norm_tuple(v, n):
+    return (int(v),) * n if isinstance(v, (int, np.integer)) else tuple(
+        int(i) for i in v)
+
+
+def _act(out, act):
+    return getattr(F, act)(out) if act else out
+
+
+def _make_scale_param(shape, attr, default_value):
+    """Scale/alpha parameters default to the reference's CONSTANT init
+    (1.0 for norm scales, 0.25 for prelu alpha) when the ParamAttr carries
+    no initializer — a bare ParamAttr(name=...) must not fall through to
+    Xavier."""
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    if attr.initializer is None:
+        attr.initializer = I.Constant(default_value)
+    return _make_param(shape, attr, False)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Parity with python/paddle/static/nn/common.py create_parameter."""
+    attr = ParamAttr._to_attr(attr)
+    if default_initializer is not None and attr is not False:
+        attr.initializer = default_initializer
+    p = _make_param(list(shape), attr, is_bias, dtype)
+    if name and p is not None and not p.name:
+        p.name = name
+    return p
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    """Parity with fluid/layers/nn.py conv2d_transpose (weight
+    [C_in, num_filters/groups, kh, kw])."""
+    c_in = input.shape[1 if data_format.startswith("NC") else -1]
+    if filter_size is None:
+        raise ValueError("filter_size is required (output_size-only shape "
+                         "inference: pass filter_size explicitly)")
+    kh, kw = _norm_tuple(filter_size, 2)
+    w = _make_param([c_in, num_filters // groups, kh, kw], param_attr, False)
+    b = _make_param([num_filters], bias_attr, True)
+    out = F.conv2d_transpose(input, w, b, stride=stride, padding=padding,
+                             groups=groups, dilation=dilation,
+                             output_size=output_size,
+                             data_format=data_format)
+    return _act(out, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    c_in = input.shape[1 if data_format.startswith("NC") else -1]
+    kd, kh, kw = _norm_tuple(filter_size, 3)
+    w = _make_param([num_filters, c_in // groups, kd, kh, kw], param_attr,
+                    False)
+    b = _make_param([num_filters], bias_attr, True)
+    out = F.conv3d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=data_format)
+    return _act(out, act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    c_in = input.shape[1 if data_format.startswith("NC") else -1]
+    if filter_size is None:
+        raise ValueError("filter_size is required")
+    kd, kh, kw = _norm_tuple(filter_size, 3)
+    w = _make_param([c_in, num_filters // groups, kd, kh, kw], param_attr,
+                    False)
+    b = _make_param([num_filters], bias_attr, True)
+    out = F.conv3d_transpose(input, w, b, stride=stride, padding=padding,
+                             groups=groups, dilation=dilation,
+                             output_size=output_size,
+                             data_format=data_format)
+    return _act(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """fluid layer_norm: normalize over dims [begin_norm_axis:], flat
+    scale/shift params."""
+    norm_shape = tuple(int(s) for s in input.shape[begin_norm_axis:])
+    w = _make_scale_param(list(norm_shape), param_attr, 1.0) if scale \
+        else None
+    b = _make_param(list(norm_shape), bias_attr, True) if shift else None
+    out = F.layer_norm(input, norm_shape, weight=w, bias=b, epsilon=epsilon)
+    return _act(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    c = input.shape[1 if data_layout.startswith("NC") else -1]
+    w = _make_scale_param([c], param_attr, 1.0)
+    b = _make_param([c], bias_attr, True)
+    out = F.group_norm(input, groups, epsilon=epsilon, weight=w, bias=b,
+                       data_format=data_layout)
+    return _act(out, act)
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    c = input.shape[1]
+    w = _make_scale_param([c], param_attr, 1.0)
+    b = _make_param([c], bias_attr, True)
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    """fluid prelu: mode in {'all','channel','element'} sizes the alpha."""
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [x.shape[1 if data_format.startswith("NC") else -1]]
+    elif mode == "element":
+        shape = list(x.shape[1:])
+    else:
+        raise ValueError(f"unknown prelu mode {mode!r}")
+    alpha = _make_scale_param(shape, param_attr, 0.25)
+    return F.prelu(x, alpha, data_format=data_format)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Parity with fluid/layers/nn.py:3631: returns the weight normalized
+    by its spectral norm, estimated with ``power_iters`` rounds of power
+    iteration (fresh u/v each call — the STATIC op form; the stateful
+    layer hook is nn.utils.spectral_norm)."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+
+    d = int(dim)
+
+    def f(w):
+        mat = jnp.moveaxis(w, d, 0).reshape(w.shape[d], -1)
+        u = jnp.ones((mat.shape[0],), w.dtype)
+        v = None
+        for _ in range(max(1, int(power_iters))):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ (mat @ v)
+        return w / jnp.maximum(sigma, eps)
+
+    return apply_op(f, weight)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Parity with fluid/layers/nn.py:3219 (CTR data normalization): keeps
+    batch_size/batch_sum/batch_square_sum summaries as parameters and
+    normalizes x -> (x - sum/size) / sqrt(square_sum/size). The summary
+    update ops ride the optimizer in the reference; here the summaries are
+    trainable-excluded parameters updated imperatively on each call."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+
+    d = int(input.shape[-1])
+    size = _make_param([d], None, True)
+    size.set_value(np.full([d], 1e4, np.float32))
+    ssum = _make_param([d], None, True)
+    ssum.set_value(np.zeros([d], np.float32))
+    sqsum = _make_param([d], None, True)
+    sqsum.set_value(np.full([d], 1e4, np.float32))
+    for p in (size, ssum, sqsum):
+        p.trainable = False
+
+    def f(x, n, s, sq):
+        mean = s / n
+        scale = jnp.sqrt(jnp.maximum(sq / n, epsilon))
+        return (x - mean) / scale
+
+    out = apply_op(f, input, size, ssum, sqsum)
+    # summary EMA update (reference: the data_norm op emits summary
+    # update outputs the optimizer applies; here the same decayed
+    # accumulate rides the imperative buffer-update pattern batch_norm's
+    # running stats use)
+    from ..core.tensor import apply_op as _ap
+
+    bn = _ap(lambda x: jnp.full((d,), float(x.shape[0]),
+                                jnp.float32), input)
+    bs = _ap(lambda x: jnp.sum(x, axis=tuple(range(x.ndim - 1))
+                               ).astype(jnp.float32), input)
+    bsq = _ap(lambda x: jnp.sum(x * x, axis=tuple(range(x.ndim - 1))
+                                ).astype(jnp.float32), input)
+    r = float(summary_decay_rate)
+    size._value = r * size._value + bn._value
+    ssum._value = r * ssum._value + bs._value
+    sqsum._value = r * sqsum._value + bsq._value
+    return _act(out, act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Parity with fluid/layers/nn.py:5675 (lookahead row convolution):
+    out[t] = sum_{i=0..k} w[i] * x[t+i], weight [k+1, D], zero padding at
+    the sequence tail. Batched [N, T, D] form (LoD -> padded)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+
+    d = int(input.shape[-1])
+    k = int(future_context_size)
+    w = _make_param([k + 1, d], param_attr, False)
+
+    def f(x, wt):
+        outs = 0.0
+        for i in range(k + 1):
+            shifted = jnp.pad(x[:, i:, :], ((0, 0), (0, i), (0, 0)))
+            outs = outs + shifted * wt[i]
+        return outs
+
+    return _act(apply_op(f, input, w), act)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Parity with fluid/layers/sequence_lod.py:44: context-window conv
+    over time. Batched [N, T, D] form; context window of ``filter_size``
+    starting at ``padding_start`` (default -(filter_size-1)//2), zero
+    padded, then one [filter_size*D, num_filters] matmul."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+
+    d = int(input.shape[-1])
+    fs = int(filter_size)
+    start = -((fs - 1) // 2) if padding_start is None else int(padding_start)
+    w = _make_param([fs * d, num_filters], param_attr, False)
+    b = _make_param([num_filters], bias_attr, True)
+
+    def f(x, wt, *bb):
+        cols = []
+        T = x.shape[1]
+        for i in range(fs):
+            off = start + i
+            if off < 0:
+                sl = jnp.pad(x[:, :T + off if T + off > 0 else 0, :],
+                             ((0, 0), (min(-off, T), 0), (0, 0)))[:, :T]
+            else:
+                sl = jnp.pad(x[:, off:, :], ((0, 0), (0, min(off, T)),
+                                             (0, 0)))[:, :T]
+            cols.append(sl)
+        ctx = jnp.concatenate(cols, axis=-1)          # [N, T, fs*D]
+        out = ctx @ wt
+        if bb:
+            out = out + bb[0]
+        return out
+
+    args = [input, w] + ([b] if b is not None else [])
+    return _act(apply_op(f, *args), act)
+
+
+def sequence_reshape(input, new_dim):
+    """Parity with sequence_lod.py:1101: [N, T, D] -> [N, T*D/new_dim,
+    new_dim] (total elements preserved per sequence)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+
+    return apply_op(
+        lambda x: x.reshape(x.shape[0], -1, int(new_dim)), input)
+
+
+def sequence_scatter(input, index, updates):
+    """Parity with sequence_lod.py:1165: adds ``updates`` into ``input`` at
+    per-row positions ``index`` (batched padded form: index/updates
+    [N, L])."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+
+    def f(x, idx, upd):
+        rows = jnp.arange(x.shape[0])[:, None]
+        return x.at[rows, idx.astype(jnp.int32)].add(upd)
+
+    return apply_op(f, input, index, updates)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, param_attr=None, dtype="float32"):
+    """Parity with fluid/contrib sparse_embedding: embedding whose gradient
+    is row-sparse (the repo's embedding grads are RowSparseGrad already —
+    see core/selected_rows.py); ``entry`` (frequency admission) is a PS
+    table policy, accepted and recorded on the parameter."""
+    out = embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                    param_attr=param_attr, dtype=dtype)
+    return out
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None):
+    """Parity with fluid crf_decoding: viterbi decode over the linear-chain
+    CRF transitions learned by linear_chain_crf (text/crf.py)."""
+    from ..text.crf import crf_decoding as _impl
+
+    transition = param_attr if not isinstance(param_attr, ParamAttr) else None
+    if transition is None:
+        raise ValueError("pass the transition parameter (the repo's "
+                         "linear_chain_crf returns it) as param_attr")
+    return _impl(input, transition, label=label, length=length)
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Parity with fluid/layers/nn.py:13496: embed a host python function
+    as an op. TPU-native realization: jax.pure_callback (host callback
+    through the runtime) with an optional custom backward callback."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor, apply_op
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [tuple(int(s) for s in o.shape) for o in outs]
+    dtypes = [o._value.dtype for o in outs]
+
+    def hostfn(*arrays):
+        res = func(*[np.asarray(a) for a in arrays])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(r, dt) for r, dt in zip(res, dtypes))
+
+    def f(*arrays):
+        # out declares trailing dims; the leading (batch) dim follows the
+        # actual inputs so record-time placeholders (batch 1) and the
+        # executor's real feeds both trace cleanly
+        bs = arrays[0].shape[0] if arrays and getattr(
+            arrays[0], "ndim", 0) else None
+        eff = [((bs,) + sh[1:] if bs is not None and len(sh) >= 1 else sh)
+               for sh in shapes]
+        result_shape = tuple(jax.ShapeDtypeStruct(sh, dt)
+                             for sh, dt in zip(eff, dtypes))
+        res = jax.pure_callback(hostfn, result_shape, *arrays)
+        return res if len(res) > 1 else res[0]
+
+    result = apply_op(f, *xs, multi_out=len(outs) > 1)
+    results = list(result) if isinstance(result, tuple) else [result]
+    from .program import current_program
+
+    prog = current_program()
+    for o, r in zip(outs, results):
+        o._value = r._value
+        o._node = getattr(r, "_node", None)
+        o._idx = getattr(r, "_idx", 0)
+        if prog is not None:
+            # alias the user's declared `out` var to the callback's result
+            # in the PROGRAM (paddle's py_func contract returns `out`, so
+            # downstream ops recorded against out's id must replay from
+            # the callback, not out's placeholder constant)
+            prog.record_op(lambda v: v, [r], [o], False, "py_func_alias")
+    return outs if isinstance(out, (list, tuple)) else outs[0]
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=[0.1, 0.1, 0.2, 0.2], flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """Parity with fluid/layers/detection.py:2106 (SSD prior-box head):
+    per feature map, a conv predicts box offsets (4/prior) and class
+    scores, and prior_box generates the anchors; outputs are concatenated
+    across maps as (mbox_locs, mbox_confs, boxes, variances)."""
+    import jax.numpy as jnp
+    from ..vision.ops import prior_box
+
+    if min_sizes is None:
+        # reference ratio schedule: evenly spaced between min/max ratio
+        n = len(inputs)
+        min_sizes, max_sizes = [], []
+        step = int(np.floor((max_ratio - min_ratio) / (n - 2))) if n > 2 \
+            else 0
+        ratio = min_ratio
+        for _ in range(n - 1):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+            ratio += step
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ms = min_sizes[i]
+        ms = ms if isinstance(ms, (list, tuple)) else [ms]
+        mx = max_sizes[i] if max_sizes else None
+        mx = (mx if isinstance(mx, (list, tuple)) else [mx]) if mx else []
+        ar = aspect_ratios[i]
+        ar = ar if isinstance(ar, (list, tuple)) else [ar]
+        box, var = prior_box(feat, image, min_sizes=list(ms),
+                             max_sizes=list(mx), aspect_ratios=list(ar),
+                             variance=variance, flip=flip, clip=clip,
+                             steps=[steps[i], steps[i]] if steps else [0.0,
+                                                                       0.0],
+                             offset=offset,
+                             min_max_aspect_ratios_order=
+                             min_max_aspect_ratios_order)
+        num_priors = int(box.shape[2]) if box.ndim == 4 else int(
+            np.prod(box.shape[:-1]) // (feat.shape[2] * feat.shape[3]))
+        loc = conv2d(feat, num_priors * 4, kernel_size, stride=stride,
+                     padding=pad)
+        conf = conv2d(feat, num_priors * num_classes, kernel_size,
+                      stride=stride, padding=pad)
+        from ..core.tensor import apply_op
+
+        def nchw_to_flat(t, last):
+            return apply_op(
+                lambda a: jnp.transpose(a, (0, 2, 3, 1)).reshape(
+                    a.shape[0], -1, last), t)
+
+        locs.append(nchw_to_flat(loc, 4))
+        confs.append(nchw_to_flat(conf, num_classes))
+        boxes_all.append(apply_op(lambda b_: b_.reshape(-1, 4), box))
+        vars_all.append(apply_op(lambda v_: v_.reshape(-1, 4), var))
+
+    from ..tensor.manipulation import concat
+
+    return (concat(locs, axis=1), concat(confs, axis=1),
+            concat(boxes_all, axis=0), concat(vars_all, axis=0))
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=10, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Parity with fluid/layers/loss.py:644 (noise-contrastive estimation):
+    weight [num_total_classes, D], bias [num_total_classes]; per sample,
+    the positive class plus ``num_neg_samples`` sampled negatives feed a
+    binary logistic loss. Negatives are drawn host-side at build time with
+    ``seed`` (static sampling — under jit the sample set is fixed per
+    compiled step, the statistical contract NCE needs across steps comes
+    from resampling per program build, matching the reference's per-op
+    seed semantics for seed != 0)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+
+    d = int(input.shape[-1])
+    w = _make_param([num_total_classes, d], param_attr, False)
+    b = _make_param([num_total_classes], bias_attr, True)
+    rng = np.random.RandomState(seed or 0)
+    if sampler == "uniform":
+        negs = rng.randint(0, num_total_classes, num_neg_samples)
+    elif sampler == "log_uniform":
+        p = 1.0 / (np.arange(num_total_classes) + 1.0)
+        negs = rng.choice(num_total_classes, num_neg_samples,
+                          p=p / p.sum())
+    elif sampler == "custom_dist":
+        negs = rng.choice(num_total_classes, num_neg_samples,
+                          p=np.asarray(custom_dist))
+    else:
+        raise ValueError(f"unknown sampler {sampler!r}")
+    negs = jnp_negs = negs.astype(np.int32)
+
+    def f(x, lbl, wt, *bb):
+        lbl_i = lbl.reshape(-1).astype(jnp.int32)
+        w_pos = jnp.take(wt, lbl_i, axis=0)             # [N, D]
+        s_pos = jnp.sum(x * w_pos, axis=-1)
+        w_neg = jnp.take(wt, jnp_negs, axis=0)          # [K, D]
+        s_neg = x @ w_neg.T                             # [N, K]
+        if bb:
+            s_pos = s_pos + jnp.take(bb[0], lbl_i)
+            s_neg = s_neg + jnp.take(bb[0], jnp_negs)[None, :]
+        loss = jnp.logaddexp(0.0, -s_pos) \
+            + jnp.sum(jnp.logaddexp(0.0, s_neg), axis=-1)
+        return loss[:, None]
+
+    args = [input, label, w] + ([b] if b is not None else [])
+    return apply_op(f, *args)
+
+
+__all__ += ["conv2d_transpose", "conv3d", "conv3d_transpose", "layer_norm",
+            "group_norm", "instance_norm", "prelu", "spectral_norm",
+            "data_norm", "row_conv", "sequence_conv", "sequence_reshape",
+            "sequence_scatter", "sparse_embedding", "crf_decoding",
+            "py_func", "multi_box_head", "nce", "create_parameter"]
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    """Parity with static/nn deform_conv2d (modulated DCNv2 when mask is
+    given): creates the [num_filters, C/groups, kh, kw] weight and
+    delegates to vision.ops.deform_conv2d."""
+    from ..vision.ops import deform_conv2d as _impl
+
+    c_in = x.shape[1]
+    kh, kw = _norm_tuple(filter_size, 2)
+    w = _make_param([num_filters, c_in // groups, kh, kw], param_attr, False)
+    b = _make_param([num_filters], bias_attr, True)
+    return _impl(x, offset, w, bias=b, stride=stride, padding=padding,
+                 dilation=dilation, deformable_groups=deformable_groups,
+                 groups=groups, mask=mask)
+
+
+__all__.append("deform_conv2d")

@@ -1,5 +1,6 @@
 """paddle_tpu.vision — models/transforms/datasets (parity python/paddle/vision)."""
 from . import datasets, transforms  # noqa: F401
+from . import image  # noqa: F401
 from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from .models import *  # noqa: F401,F403

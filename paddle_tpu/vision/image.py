@@ -1,0 +1,45 @@
+"""Image IO backend — parity with python/paddle/vision/image.py
+(set_image_backend / get_image_backend / image_load). 'pil' and 'cv2'
+mirror the reference backends; 'tensor' decodes to a paddle Tensor via
+numpy (no torch/cv2 dependency needed for the common path)."""
+from __future__ import annotations
+
+__all__ = ["set_image_backend", "get_image_backend", "image_load"]
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'tensor'], "
+            f"but got {backend}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image; returns a PIL Image ('pil'), ndarray ('cv2') or
+    Tensor ('tensor')."""
+    backend = backend or _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'tensor'], "
+            f"but got {backend}")
+    if backend == "pil":
+        from PIL import Image
+
+        return Image.open(path)
+    import numpy as np
+    from PIL import Image
+
+    arr = np.asarray(Image.open(path))
+    if backend == "cv2":
+        return arr[..., ::-1] if arr.ndim == 3 else arr  # RGB->BGR like cv2
+    from ..core.tensor import to_tensor
+
+    return to_tensor(arr)

@@ -52,6 +52,17 @@ NAMESPACES = [
     ("nn/functional/__init__.py", "nn.functional"),
     ("tensor/__init__.py", "tensor"),
     ("linalg/__init__.py", "linalg"),
+    ("optimizer/__init__.py", "optimizer"),
+    ("metric/__init__.py", "metric"),
+    ("io/__init__.py", "io"),
+    ("static/__init__.py", "static"),
+    ("static/nn/__init__.py", "static.nn"),
+    ("vision/__init__.py", "vision"),
+    ("distributed/__init__.py", "distributed"),
+    # NOTE: implementation modules (vision/ops.py, distribution.py) are
+    # NOT diffable this way — their `from x import y` lines are internal
+    # dependencies, not exports; their public classes are covered by the
+    # test suite instead.
 ]
 
 
