@@ -58,8 +58,12 @@ def main():
     opt = paddle.optimizer.Adam(learning_rate=1e-4,
                                 parameters=model.parameters())
     mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    # labels ride as a forward input so GPTForCausalLM computes the loss
+    # inside forward and honors GPTConfig.fused_head_ce (default False —
+    # the split path measured faster on this rig; see r5_gpt.txt). The
+    # forward returns the scalar loss directly, so loss_fn is identity.
     step = ParallelTrainStep(
-        model, loss_fn=model.loss_fn, optimizer=opt, mesh=mesh,
+        model, loss_fn=lambda out, lbl: out, optimizer=opt, mesh=mesh,
         recompute=not on_tpu, compute_dtype=jnp.bfloat16,
     )
 
@@ -72,7 +76,7 @@ def main():
     ids = paddle.to_tensor(ids)
     labels = paddle.to_tensor(labels)
 
-    loss = step((ids,), (labels,))  # compile + warmup
+    loss = step((ids, labels), (labels,))  # compile + warmup
     float(loss.numpy())
     # median of `reps` timed windows of `iters` steps each (clock jitter at
     # ~100-200 ms/step makes a single short window unreliable)
@@ -80,7 +84,7 @@ def main():
     for _ in range(reps):
         t0 = time.perf_counter()
         for _ in range(iters):
-            loss = step((ids,), (labels,))
+            loss = step((ids, labels), (labels,))
         float(loss.numpy())
         dt = time.perf_counter() - t0
         rates.append(batch * seq * iters / dt)

@@ -23,9 +23,17 @@ def get_cloud_cluster(args_node_ips=None, args_node_ip=None, args_port=6170,
         nproc = len(selected_devices)
     else:
         # PADDLE_TRAINERS_NUM is the TOTAL trainer count across the job;
-        # per-node process count divides by the node count
+        # per-node process count divides by the node count. The reference
+        # asserts divisibility — a silent floor-divide would launch a
+        # smaller world than the job contract says
         total = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
-        nproc = max(1, total // max(1, len(node_ips)))
+        n_nodes = max(1, len(node_ips))
+        if total % n_nodes != 0:
+            raise ValueError(
+                f"PADDLE_TRAINERS_NUM={total} is not divisible by the "
+                f"{n_nodes} nodes in PADDLE_TRAINERS — refusing to launch "
+                "a smaller world than configured")
+        nproc = max(1, total // n_nodes)
     from .launch import get_cluster_env
 
     return get_cluster_env(node_ip, node_ips, nproc, port)

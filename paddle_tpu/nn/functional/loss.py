@@ -92,6 +92,54 @@ def _hard_ce_bwd(ax, ignore_index, res, ct):
 _hard_ce.defvjp(_hard_ce_fwd, _hard_ce_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_linear_hard_ce(h2, wT, lbl_i, ignore_index=-100):
+    """LM-head matmul + hard-label CE with a hand-written joint backward.
+
+    Splitting linear (autodiff) from _hard_ce (custom_vjp) leaves XLA a
+    [N, V] ``dlogits`` with TWO dot consumers (dW and dh) — it materializes
+    dlogits once (~0.8 GB bf16 at GPT-2 345M) and re-reads it for each dot.
+    The joint rule instead hands each dot its own algebraically distinct
+    dlogits expression ((p − y)·g vs p·g − y·g — different HLO, so CSE
+    cannot re-merge them), letting each fuse into its consumer dot's
+    operand: the softmax recompute reads the saved logits residual
+    directly and dlogits never exists in HBM. Replaces the reference's
+    fused softmax_with_cross_entropy grad + matmul grad pair
+    (operators/softmax_with_cross_entropy_op.cu, matmul_v2_op) at the XLA
+    level. Returns (per-row loss·mask, mask)."""
+    logits = jnp.matmul(h2, wT)
+    loss, mask, _ = _hard_ce_fwd_impl(logits, lbl_i, -1, ignore_index)
+    return loss, mask
+
+
+def _flce_fwd(h2, wT, lbl_i, ignore_index):
+    logits = jnp.matmul(h2, wT)
+    loss, mask, lse = _hard_ce_fwd_impl(logits, lbl_i, -1, ignore_index)
+    return (loss, mask), (h2, wT, lbl_i, logits, lse)
+
+
+def _flce_bwd(ignore_index, res, ct):
+    dloss, _dmask = ct
+    h2, wT, lbl_i, logits, lse = res
+    maskf = (lbl_i != ignore_index).astype(jnp.float32)
+    g = jnp.expand_dims(dloss.astype(jnp.float32) * maskf, -1)
+    shifted = logits.astype(jnp.float32) - jnp.expand_dims(lse, -1)
+    idx = jax.lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1)
+    onehot = (idx == jnp.clip(jnp.expand_dims(lbl_i, -1), 0, None))
+    # two NON-CSE-able forms of the same dlogits, one per consumer dot
+    d_for_w = ((jnp.exp(shifted) - onehot) * g).astype(logits.dtype)
+    d_for_h = (jnp.exp(shifted) * g
+               - jnp.where(onehot, g, jnp.zeros((), jnp.float32))
+               ).astype(logits.dtype)
+    dw = jnp.einsum("nh,nv->hv", h2, d_for_w)
+    dh = jnp.matmul(d_for_h, wT.T)
+    return dh, dw.astype(wT.dtype), np.zeros(lbl_i.shape,
+                                             dtype=jax.dtypes.float0)
+
+
+fused_linear_hard_ce.defvjp(_flce_fwd, _flce_bwd)
+
+
 def cross_entropy(
     input,
     label,

@@ -207,8 +207,15 @@ def batch_norm(
 
 def _ln_fwd_impl(a, w, b, epsilon):
     af = a.astype(jnp.float32)
-    mu = jnp.mean(af, axis=-1, keepdims=True)
-    var = jnp.var(af, axis=-1, keepdims=True)
+    n = af.shape[-1]
+    # one-pass row stats: sum(x) and sum(x·x) fuse into a single
+    # multi-output reduce (one read of the activation); jnp.mean + jnp.var
+    # is two sequential passes (var needs the mean first). Uncentered var
+    # in f32 — same rationale and clamp as _bn_stats.
+    s1 = jnp.sum(af, axis=-1, keepdims=True)
+    s2 = jnp.sum(af * af, axis=-1, keepdims=True)
+    mu = s1 / n
+    var = jnp.maximum(s2 / n - mu * mu, 0.0)
     rstd = jax.lax.rsqrt(var + epsilon)
     out = ((af - mu) * rstd).astype(a.dtype) * w + b
     return out, (a, w, jnp.zeros((), b.dtype), mu, rstd)

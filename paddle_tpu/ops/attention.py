@@ -582,6 +582,10 @@ def _causal_chunked_bwd(blhd, res, g):
               ).astype(q.dtype)
         # masked positions need no re-masking: e is exactly 0 there
         dqs.append(jnp.einsum(dq_eq, dS, ki) * scale)
+        # pad-to-L and tree-sum: measured BEST of three accumulation
+        # shapes for the ragged dk/dv chunk contributions on v5e (ragged
+        # per-block slice+sum+concat re-lowered to 2.8× the
+        # dynamic-update-slice traffic; see r5_gpt.txt)
         pad = [(0, 0)] * q.ndim
         pad[axis_l] = (0, Lq - ub)
         dks.append(jnp.pad(jnp.einsum(dk_eq, dS, qi) * scale, pad))

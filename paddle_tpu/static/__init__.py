@@ -260,24 +260,53 @@ def Print(input, first_n=-1, message=None, summarize=20,
 
 def accuracy(input, label, k=1, correct=None, total=None):
     """Parity with fluid/layers/metric_op.py:32: top-k accuracy over a
-    batch, returned as a tensor (static metric op)."""
+    batch. When the reference's ``correct``/``total`` output vars are
+    passed they are bound to this batch's counts (the reference op writes
+    them for the streaming Accuracy metric to accumulate)."""
+    import jax
     import jax.numpy as jnp
     from ..core.tensor import apply_op
 
     def f(pred, lbl):
-        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        # lax.top_k: O(V·k) vs a full O(V log V) argsort of the class axis
+        _, topk = jax.lax.top_k(pred, k)
         lbl_c = lbl.reshape(-1, 1).astype(topk.dtype)
         hit = jnp.any(topk == lbl_c, axis=-1)
-        return jnp.mean(hit.astype(jnp.float32))
+        n_correct = jnp.sum(hit.astype(jnp.int64))
+        n_total = jnp.asarray(hit.shape[0] if hit.ndim else 1, jnp.int64)
+        return (n_correct.astype(jnp.float32)
+                / jnp.maximum(n_total, 1).astype(jnp.float32),
+                n_correct, n_total)
 
-    return apply_op(f, input, label)
+    acc, n_correct, n_total = apply_op(f, input, label, multi_out=True)
+
+    def _bind(user_var, computed):
+        # eager: copy the value; recording: alias the user's var id to the
+        # computed op output in the Program (same contract as
+        # py_func_alias) so fetch_list=[user_var] and downstream ops
+        # replay the per-step count, not a record-time constant
+        user_var._value = computed._value
+        from ..core import tensor as tensor_mod
+
+        if tensor_mod._op_recorder is not None:
+            tensor_mod._op_recorder(lambda v: v, [computed], (user_var,),
+                                    False, "accuracy_out_alias")
+
+    if correct is not None:
+        _bind(correct, n_correct)
+    if total is not None:
+        _bind(total, n_total)
+    return acc
 
 
 def auc(input, label, curve="ROC", num_thresholds=2 ** 12 - 1,
         topk=1, slide_steps=1):
     """Parity with fluid/layers/metric_op.py:115: batch AUC via the
     thresholded confusion-matrix estimate (static op form; the stateful
-    streaming metric is paddle.metric.Auc). Returns (auc_value,)."""
+    streaming metric is paddle.metric.Auc). Returns the reference's
+    3-tuple (auc_out, batch_auc_out, state_list) — in this stateless op
+    form batch_auc equals auc and the state vars are the batch's
+    confusion-matrix rows."""
     import jax.numpy as jnp
     from ..core.tensor import apply_op
 
@@ -295,10 +324,13 @@ def auc(input, label, curve="ROC", num_thresholds=2 ** 12 - 1,
         fpr = fp / Nn
         # trapezoid: thresholds ascend, so fpr/tpr descend along the
         # axis and fpr[:-1]-fpr[1:] >= 0
-        return jnp.sum((tpr[:-1] + tpr[1:]) * 0.5
-                       * (fpr[:-1] - fpr[1:]))
+        a = jnp.sum((tpr[:-1] + tpr[1:]) * 0.5 * (fpr[:-1] - fpr[1:]))
+        fn = P - tp
+        tn = Nn - fp
+        return a, tp, fn, tn, fp
 
-    return (apply_op(f, input, label),)
+    a, tp, fn, tn, fp = apply_op(f, input, label, multi_out=True)
+    return a, a, [tp, fn, tn, fp]
 
 
 def create_global_var(shape, value, dtype, persistable=False,
@@ -344,7 +376,23 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
         raise NotImplementedError("target_gradients is not supported")
     prog = current_program() or default_main_program()
     append_backward(targets[0])
-    return [prog._grad_map.get(id(p)) for p in inputs]
+    outs = []
+    for p in inputs:
+        g = prog._grad_map.get(id(p))
+        if g is not None and not getattr(p, "trainable", True):
+            g = None  # executor only binds grads of TRAINABLE params
+        if g is None:
+            # fail HERE with the real reason, not later with a None leaking
+            # into fetch_list/arithmetic: only parameter grads are bound by
+            # the executor (intermediate-activation grads would need the
+            # full symbolic-graph transpose the reference builds)
+            raise NotImplementedError(
+                "gradients() can only return gradients of TRAINABLE "
+                f"Parameters here (got {getattr(p, 'name', p)!r}); grads "
+                "of intermediate activations and frozen parameters are "
+                "not bound by the Executor")
+        outs.append(g)
+    return outs
 
 
 def xpu_places(device_ids=None):

@@ -444,6 +444,14 @@ class Executor:
             for uid in param_uids:
                 if uid not in new_state:
                     new_state[uid] = opt_state[uid]
+            # persistent-var updates recorded by ops like data_norm: the
+            # post-step summary values replace the (non-trainable) params
+            # so they persist across runs exactly like optimizer updates
+            for uid, src_id in getattr(program, "buffer_updates",
+                                       {}).items():
+                if uid in new_params and src_id in env:
+                    new_params[uid] = env[src_id].astype(
+                        params_raw[uid].dtype)
             if check_nan:
                 # uid keys -> variable names so the error locates the tensor
                 pname = lambda uid: getattr(named[uid], "name", None) or str(uid)

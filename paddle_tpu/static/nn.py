@@ -168,6 +168,12 @@ def _make_scale_param(shape, attr, default_value):
     if attr is False:
         return None
     if attr.initializer is None:
+        # COPY before filling the default: _to_attr returns the caller's
+        # own ParamAttr instance, and mutating it would leak Constant()
+        # into any later layer the user reuses the attr with
+        import copy
+
+        attr = copy.copy(attr)
         attr.initializer = I.Constant(default_value)
     return _make_param(shape, attr, False)
 
@@ -177,6 +183,9 @@ def create_parameter(shape, dtype="float32", name=None, attr=None,
     """Parity with python/paddle/static/nn/common.py create_parameter."""
     attr = ParamAttr._to_attr(attr)
     if default_initializer is not None and attr is not False:
+        import copy
+
+        attr = copy.copy(attr)  # never mutate the caller's ParamAttr
         attr.initializer = default_initializer
     p = _make_param(list(shape), attr, is_bias, dtype)
     if name and p is not None and not p.name:
@@ -314,7 +323,11 @@ def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
               enable_scale_and_shift=False):
     """Parity with fluid/layers/nn.py:3219 (CTR data normalization): keeps
     batch_size/batch_sum/batch_square_sum summaries as parameters and
-    normalizes x -> (x - sum/size) / sqrt(square_sum/size). The summary
+    normalizes x -> (x - sum/size) / sqrt(square_sum/size), with optional
+    learnable scale/shift (``enable_scale_and_shift``). ``sync_stats`` is
+    a multi-worker all-reduce of the summaries (single-program here: the
+    engine's dp replication covers it); ``slot_dim`` sparse-slot special
+    casing is PS-table policy and not modeled. The summary
     update ops ride the optimizer in the reference; here the summaries are
     trainable-excluded parameters updated imperatively on each call."""
     import jax.numpy as jnp
@@ -330,28 +343,57 @@ def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
     for p in (size, ssum, sqsum):
         p.trainable = False
 
-    def f(x, n, s, sq):
-        mean = s / n
-        scale = jnp.sqrt(jnp.maximum(sq / n, epsilon))
-        return (x - mean) / scale
+    if enable_scale_and_shift:
+        # reference: learnable per-feature scale_w/bias applied after the
+        # summary normalization (fluid/layers/nn.py data_norm)
+        scale_w = _make_scale_param([d], param_attr, 1.0)
+        bias_p = _make_param([d], param_attr, True)
 
-    out = apply_op(f, input, size, ssum, sqsum)
-    # summary EMA update (reference: the data_norm op emits summary
-    # update outputs the optimizer applies; here the same decayed
-    # accumulate rides the imperative buffer-update pattern batch_norm's
-    # running stats use)
+        def f(x, n, s, sq, w, b):
+            mean = s / n
+            scale = jnp.sqrt(jnp.maximum(sq / n, epsilon))
+            return ((x - mean) / scale) * w + b
+
+        out = apply_op(f, input, size, ssum, sqsum, scale_w, bias_p)
+    else:
+        def f(x, n, s, sq):
+            mean = s / n
+            scale = jnp.sqrt(jnp.maximum(sq / n, epsilon))
+            return (x - mean) / scale
+
+        out = apply_op(f, input, size, ssum, sqsum)
+    # summary EMA update (reference: the data_norm op emits summary-update
+    # outputs the optimizer applies every step, fluid/layers/nn.py:3219).
+    # Recorded as ops whose outputs are registered in
+    # Program.buffer_updates — the executor commits them after each
+    # optimized run, so the summaries track the data across steps instead
+    # of freezing at their record-time values.
     from ..core.tensor import apply_op as _ap
+    from .control_flow import _recording
 
-    bn = _ap(lambda x: jnp.full((d,), float(x.shape[0]),
-                                jnp.float32), input)
-    bs = _ap(lambda x: jnp.sum(x, axis=tuple(range(x.ndim - 1))
-                               ).astype(jnp.float32), input)
-    bsq = _ap(lambda x: jnp.sum(x * x, axis=tuple(range(x.ndim - 1))
-                                ).astype(jnp.float32), input)
     r = float(summary_decay_rate)
-    size._value = r * size._value + bn._value
-    ssum._value = r * ssum._value + bs._value
-    sqsum._value = r * sqsum._value + bsq._value
+    new_size = _ap(
+        lambda x, n: r * n + jnp.full((d,), float(x.shape[0]), jnp.float32),
+        input, size)
+    new_sum = _ap(
+        lambda x, s: r * s + jnp.sum(
+            x, axis=tuple(range(x.ndim - 1))).astype(jnp.float32),
+        input, ssum)
+    new_sqsum = _ap(
+        lambda x, sq: r * sq + jnp.sum(
+            x * x, axis=tuple(range(x.ndim - 1))).astype(jnp.float32),
+        input, sqsum)
+    if _recording():
+        from .program import default_main_program
+
+        prog = default_main_program()
+        prog.buffer_updates[id(size)] = id(new_size)
+        prog.buffer_updates[id(ssum)] = id(new_sum)
+        prog.buffer_updates[id(sqsum)] = id(new_sqsum)
+    else:  # eager: commit immediately
+        size._value = new_size._value
+        ssum._value = new_sum._value
+        sqsum._value = new_sqsum._value
     return _act(out, act)
 
 
@@ -480,10 +522,13 @@ def py_func(func, x, out, backward_func=None,
         res = res if isinstance(res, (list, tuple)) else [res]
         return tuple(np.asarray(r, dt) for r, dt in zip(res, dtypes))
 
-    def f(*arrays):
-        # out declares trailing dims; the leading (batch) dim follows the
-        # actual inputs so record-time placeholders (batch 1) and the
-        # executor's real feeds both trace cleanly
+    skip = set()
+    for v in (skip_vars_in_backward_input or []) if not isinstance(
+            skip_vars_in_backward_input, Tensor) else [
+            skip_vars_in_backward_input]:
+        skip.add(id(v))
+
+    def _callback(*arrays):
         bs = arrays[0].shape[0] if arrays and getattr(
             arrays[0], "ndim", 0) else None
         eff = [((bs,) + sh[1:] if bs is not None and len(sh) >= 1 else sh)
@@ -491,7 +536,57 @@ def py_func(func, x, out, backward_func=None,
         result_shape = tuple(jax.ShapeDtypeStruct(sh, dt)
                              for sh, dt in zip(eff, dtypes))
         res = jax.pure_callback(hostfn, result_shape, *arrays)
-        return res if len(res) > 1 else res[0]
+        return tuple(res)
+
+    if backward_func is None:
+        def f(*arrays):
+            # out declares trailing dims; the leading (batch) dim follows
+            # the actual inputs so record-time placeholders (batch 1) and
+            # the executor's real feeds both trace cleanly
+            res = _callback(*arrays)
+            return res if len(res) > 1 else res[0]
+    else:
+        # reference contract (fluid/layers/nn.py:13496): backward_func is
+        # called with (x, out, dout) — minus skip_vars_in_backward_input —
+        # and returns the grads of x (None where an input has no grad)
+        @jax.custom_vjp
+        def _pyop(*arrays):
+            res = _callback(*arrays)
+            return res if len(res) > 1 else res[0]
+
+        def _pyop_fwd(*arrays):
+            res = _callback(*arrays)
+            return (res if len(res) > 1 else res[0]), arrays
+
+        def _pyop_bwd(arrays, g):
+            gs = g if isinstance(g, tuple) else (g,)
+            fwd_outs = _callback(*arrays)
+
+            def bwd_host(*vals):
+                n = len(arrays)
+                xs_v = vals[:n]
+                outs_v = vals[n:n + len(fwd_outs)]
+                gs_v = vals[n + len(fwd_outs):]
+                binputs = [np.asarray(v) for a, v in zip(xs, xs_v)
+                           if id(a) not in skip]
+                binputs += [np.asarray(v) for o, v in zip(outs, outs_v)
+                            if id(o) not in skip]
+                binputs += [np.asarray(v) for v in gs_v]
+                res = backward_func(*binputs)
+                res = res if isinstance(res, (list, tuple)) else [res]
+                return tuple(
+                    np.zeros(xv.shape, xv.dtype) if r is None
+                    else np.asarray(r, xv.dtype)
+                    for r, xv in zip(res, xs_v))
+
+            result_shape = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                                 for a in arrays)
+            dx = jax.pure_callback(bwd_host, result_shape,
+                                   *arrays, *fwd_outs, *gs)
+            return tuple(dx)
+
+        _pyop.defvjp(_pyop_fwd, _pyop_bwd)
+        f = _pyop
 
     result = apply_op(f, *xs, multi_out=len(outs) > 1)
     results = list(result) if isinstance(result, tuple) else [result]
@@ -546,11 +641,20 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         mx = (mx if isinstance(mx, (list, tuple)) else [mx]) if mx else []
         ar = aspect_ratios[i]
         ar = ar if isinstance(ar, (list, tuple)) else [ar]
+        # per-map step priority: explicit steps list > step_w/step_h
+        # lists > auto-derive (0.0 lets prior_box use feat/image ratio)
+        if steps is not None:
+            st = [float(steps[i]), float(steps[i])]
+        elif step_w is not None or step_h is not None:
+            sw = step_w[i] if step_w is not None else 0.0
+            sh = step_h[i] if step_h is not None else 0.0
+            st = [float(sh), float(sw)]
+        else:
+            st = [0.0, 0.0]
         box, var = prior_box(feat, image, min_sizes=list(ms),
                              max_sizes=list(mx), aspect_ratios=list(ar),
                              variance=variance, flip=flip, clip=clip,
-                             steps=[steps[i], steps[i]] if steps else [0.0,
-                                                                       0.0],
+                             steps=st,
                              offset=offset,
                              min_max_aspect_ratios_order=
                              min_max_aspect_ratios_order)

@@ -56,6 +56,12 @@ class Program:
         self.random_seed = 0
         self._appended_backward = False
         self.declared_shapes: Dict[str, list] = {}  # feed name -> user shape
+        # persistent-var updates that ride the training step (reference:
+        # ops like data_norm emit summary-update outputs the optimizer
+        # applies each step): param id -> id of the recorded op output
+        # holding its post-step value. The executor commits these after
+        # every optimized run.
+        self.buffer_updates: Dict[int, int] = {}
 
     # ------------------------------------------------------------- recording
     def record_op(self, fn, args, outs, multi_out, name=""):
@@ -144,6 +150,7 @@ class Program:
         p._var_refs = dict(self._var_refs)
         p._optimize = None if for_test else self._optimize
         p.declared_shapes = dict(self.declared_shapes)
+        p.buffer_updates = {} if for_test else dict(self.buffer_updates)
         return p
 
     def all_parameters(self):

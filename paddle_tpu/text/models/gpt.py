@@ -42,6 +42,14 @@ class GPTConfig:
     # on GPT-2 345M on v5e (it regresses BERT-base 24%, so it is a
     # per-model config rather than a process-wide env default)
     manual_layer_norm: bool = True
+    # joint lm_head+CE backward (loss.fused_linear_hard_ce): hands each of
+    # the dW/dh dots its own fusable dlogits expression hoping the [N, V]
+    # dlogits never materializes. MEASURED OFF: the v5e emitter materializes
+    # both expressions instead of operand-fusing them (56.1k vs 56.4k tok/s
+    # on the 345M headline), so the default stays on the split
+    # linear+_hard_ce path; the knob is kept for rigs whose emitter does
+    # operand-fuse dot inputs
+    fused_head_ce: bool = False
 
     def __post_init__(self):
         if self.intermediate_size == 0:
@@ -170,6 +178,22 @@ class GPTForCausalLM(nn.Layer):
 
     def forward(self, input_ids, labels=None):
         h = self.gpt(input_ids)
+        if labels is not None and self.config.fused_head_ce:
+            from paddle_tpu.nn.functional.loss import fused_linear_hard_ce
+
+            def head_ce(hr, w, lbl):
+                from paddle_tpu.amp.auto_cast import maybe_cast_inputs
+
+                hr2 = hr.reshape(-1, hr.shape[-1])
+                hr2, wc = maybe_cast_inputs("linear", hr2, w)
+                loss, mask = fused_linear_hard_ce(
+                    hr2, wc.T, lbl.reshape(-1).astype(jnp.int32))
+                return (jnp.sum(loss)
+                        / jnp.maximum(jnp.sum(mask), 1.0)).astype(loss.dtype)
+
+            return apply_op(head_ce, h, self.gpt.wte.weight,
+                            labels.detach() if isinstance(labels, Tensor)
+                            else labels)
         logits = F.linear(h, _transposed(self.gpt.wte.weight))
         if labels is not None:
             loss = F.cross_entropy(

@@ -37,9 +37,14 @@ def image_load(path, backend=None):
     import numpy as np
     from PIL import Image
 
-    arr = np.asarray(Image.open(path))
+    img = Image.open(path)
     if backend == "cv2":
-        return arr[..., ::-1] if arr.ndim == 3 else arr  # RGB->BGR like cv2
+        # cv2.imread default decodes EVERY format to 3-channel BGR
+        # (palette expanded, alpha dropped) — reversing raw PIL output
+        # would produce ABGR for RGBA and index maps for 'P' images
+        arr = np.asarray(img.convert("RGB"))
+        return arr[..., ::-1]
+    arr = np.asarray(img)
     from ..core.tensor import to_tensor
 
     return to_tensor(arr)

@@ -222,6 +222,55 @@ class TestLosses:
         ref = -np.log(p[[0, 2], [0, 2]]).mean()
         np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
 
+    def test_fused_linear_hard_ce_matches_split(self):
+        """Joint lm_head+CE VJP (loss.fused_linear_hard_ce) computes the
+        same loss and gradients as the split linear→_hard_ce path,
+        ignore_index included."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.nn.functional.loss import _hard_ce, fused_linear_hard_ce
+
+        rng = np.random.RandomState(7)
+        N, H, V = 32, 16, 64
+        h2 = jnp.asarray(rng.randn(N, H), jnp.float32)
+        wT = jnp.asarray(rng.randn(H, V) * 0.05, jnp.float32)
+        lbl = jnp.asarray(rng.randint(0, V, (N,)), jnp.int32).at[5].set(-100)
+
+        def f_fused(h2, wT):
+            loss, mask = fused_linear_hard_ce(h2, wT, lbl, -100)
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)
+
+        def f_split(h2, wT):
+            loss, mask = _hard_ce(h2 @ wT, lbl, -1, -100)
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)
+
+        l1, g1 = jax.value_and_grad(f_fused, argnums=(0, 1))(h2, wT)
+        l2, g2 = jax.value_and_grad(f_split, argnums=(0, 1))(h2, wT)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(g1[0]), np.asarray(g2[0]),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(g1[1]), np.asarray(g2[1]),
+                                   atol=1e-6)
+
+    def test_gpt_fused_head_ce_config_path(self):
+        """GPTForCausalLM(fused_head_ce=True) forward(ids, labels) returns
+        the same loss as the default split path."""
+        from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+        kw = dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                  max_position_embeddings=32, hidden_dropout=0.0,
+                  attention_dropout=0.0, use_flash_attention=False)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, (2, 16)).astype(np.int32)
+        labels = np.roll(ids, -1, axis=1).astype(np.int32)
+        losses = []
+        for fused in (False, True):
+            paddle.seed(11)
+            m = GPTForCausalLM(GPTConfig(fused_head_ce=fused, **kw))
+            losses.append(float(m(paddle.to_tensor(ids),
+                                  paddle.to_tensor(labels)).numpy()))
+        np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+
     def test_mse_l1(self):
         x, y = _rand(3, 4), _rand(3, 4)
         np.testing.assert_allclose(

@@ -145,6 +145,49 @@ class TestStaticNNLayers:
         (v,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
         np.testing.assert_allclose(v, xv * 2 + 1)
 
+    def test_py_func_backward_func(self):
+        """backward_func contract (fluid/layers/nn.py:13496): called with
+        (x, out, dout), returns dx — grads must flow through the host op."""
+        main, start = _in_prog()
+        with static.program_guard(main, start):
+            x = static.data("x", [None, 3], "float32")
+            w = static.nn.create_parameter([3], "float32")
+            out = paddle.zeros([2, 3], "float32")
+
+            def host(a):
+                return a * 3.0
+
+            def host_bwd(a, o, do):
+                return do * 3.0
+
+            res = static.nn.py_func(host, x * w, out,
+                                    backward_func=host_bwd)
+            loss = paddle.mean(res)
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(start)
+        (w_p,) = [p for p in main.all_parameters()]
+        w0 = np.asarray(w_p.numpy()).copy()
+        xv = np.ones((2, 3), np.float32)
+        (lv,) = exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        w1 = np.asarray(w_p.numpy())
+        # dloss/dw_j = (1/6)*3*sum_batch x_bj = 1.0; SGD step 0.1*1.0
+        np.testing.assert_allclose(w0 - w1, 0.1, rtol=1e-5)
+
+    def test_data_norm_scale_and_shift_params(self):
+        main, start = _in_prog()
+        with static.program_guard(main, start):
+            x = static.data("x", [None, 5], "float32")
+            out = static.nn.data_norm(x, enable_scale_and_shift=True)
+        trainable = [p for p in main.all_parameters() if p.trainable]
+        assert len(trainable) == 2  # scale_w + bias
+        exe = static.Executor()
+        exe.run(start)
+        (v,) = exe.run(main, feed={"x": np.random.rand(4, 5).astype(
+            np.float32)}, fetch_list=[out])
+        assert v.shape == (4, 5) and np.isfinite(v).all()
+
     def test_data_norm_runs(self):
         rng = np.random.RandomState(0)
         main, start = _in_prog()
@@ -157,6 +200,42 @@ class TestStaticNNLayers:
                        fetch_list=[out])
         assert v.shape == (4, 5) and np.isfinite(v).all()
 
+    def test_data_norm_summaries_track_data_across_steps(self):
+        """The summary EMA updates ride the optimized step (reference:
+        data_norm emits summary-update outputs the optimizer applies) —
+        batch_size/sum/square_sum must move from their init values after
+        training steps, and the normalization must follow the data."""
+        rng = np.random.RandomState(1)
+        main, start = _in_prog()
+        with static.program_guard(main, start):
+            x = static.data("x", [None, 5], "float32")
+            out = static.nn.data_norm(x)
+            loss = paddle.mean(out)
+            opt = paddle.optimizer.SGD(learning_rate=0.0)
+            opt.minimize(loss)
+        summaries = [p for p in main.all_parameters() if not p.trainable]
+        assert len(summaries) == 3
+        before = [np.asarray(p.numpy()).copy() for p in summaries]
+        exe = static.Executor()
+        exe.run(start)
+        data = (rng.rand(8, 5) * 3 + 7).astype(np.float32)  # mean ~8.5
+        for _ in range(3):
+            exe.run(main, feed={"x": data}, fetch_list=[loss])
+        after = [np.asarray(p.numpy()) for p in summaries]
+        moved = [float(np.max(np.abs(a - b))) for a, b in zip(after, before)]
+        assert all(m > 1.0 for m in moved), moved  # EMA accumulated 3 batches
+
+    def test_accuracy_correct_total_outputs(self):
+        pred = paddle.to_tensor(np.array(
+            [[0.1, 0.9], [0.8, 0.2], [0.3, 0.7], [0.6, 0.4]], np.float32))
+        lbl = paddle.to_tensor(np.array([[1], [0], [1], [1]], np.int64))
+        correct = paddle.to_tensor(np.zeros((), np.int64))
+        total = paddle.to_tensor(np.zeros((), np.int64))
+        acc = float(static.accuracy(pred, lbl, correct=correct,
+                                    total=total).numpy())
+        assert abs(acc - 0.75) < 1e-6
+        assert int(correct.numpy()) == 3 and int(total.numpy()) == 4
+
 
 class TestStaticTopLevel:
     def test_accuracy_and_auc(self):
@@ -165,7 +244,10 @@ class TestStaticTopLevel:
         lbl = paddle.to_tensor(np.array([[1], [0], [1], [1]], np.int64))
         acc = float(static.accuracy(pred, lbl).numpy())
         assert abs(acc - 0.75) < 1e-6
-        (auc_v,) = static.auc(pred, lbl)
+        auc_v, batch_auc_v, states = static.auc(pred, lbl)
+        assert len(states) == 4  # [tp, fn, tn, fp] per reference contract
+        np.testing.assert_allclose(float(auc_v.numpy()),
+                                   float(batch_auc_v.numpy()))
         # perfect-ish separation for the 2-class toy: positives 0.9/0.7/0.4
         # vs negative 0.2 -> AUC 2/3 pairs above = (3-0... compute numpy:
         pos = np.array([0.9, 0.7, 0.4])

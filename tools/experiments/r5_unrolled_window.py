@@ -1,0 +1,93 @@
+"""r5 experiment: UNROLLED multi-step window vs flat per-step dispatch.
+
+run_steps (lax.scan) measured ~5% SLOWER than per-step for the headline
+config — the scan body compiles worse than the flat step. This tries the
+third shape: W step_fn applications UNROLLED in one jit (flat HLO, no
+scan), one dispatch per W steps. If XLA compiles each unrolled step as
+well as the flat step, the ~3 ms/step dispatch gap (wall 146.5 vs device
+143.4 ms) shrinks by (W-1)/W.
+
+Usage: python tools/experiments/r5_unrolled_window.py [W ...]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    import paddle_tpu as paddle
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.fleet.engine import ParallelTrainStep
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    ws = [int(a) for a in sys.argv[1:]] or [3, 5]
+
+    config = GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                       max_position_embeddings=1024, hidden_dropout=0.0,
+                       attention_dropout=0.0)
+    batch, seq = 8, 1024
+    paddle.seed(0)
+    model = GPTForCausalLM(config)
+    opt = paddle.optimizer.Adam(learning_rate=1e-4,
+                                parameters=model.parameters())
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    step = ParallelTrainStep(model, loss_fn=model.loss_fn, optimizer=opt,
+                             mesh=mesh, recompute=False,
+                             compute_dtype=jnp.bfloat16)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, config.vocab_size, (batch, seq)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1).astype(np.int32)
+    ids = paddle.to_tensor(ids)
+    labels = paddle.to_tensor(labels)
+
+    # flat baseline
+    loss = step((ids,), (labels,))
+    float(loss.numpy())
+    iters = 45
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step((ids,), (labels,))
+    float(loss.numpy())
+    dt = time.perf_counter() - t0
+    print(f"flat per-step:   {batch * seq * iters / dt:10.1f} tok/s "
+          f"({dt / iters * 1e3:.2f} ms/step)")
+
+    step_fn = step._step_fn
+
+    for W in ws:
+        def multi(params, buffers, opt_state, lr, batch_):
+            loss = None
+            for _ in range(W):
+                params, buffers, opt_state, loss, _ = step_fn(
+                    params, buffers, opt_state, lr, batch_)
+            return params, buffers, opt_state, loss, None
+
+        jitted = jax.jit(multi, donate_argnums=(0, 2),
+                         out_shardings=step._out_shardings)
+        raw = ((ids._value,), (labels._value,))
+        lr = step._optimizer.lr_device_scalar()
+        t0 = time.perf_counter()
+        p, b, o, loss, _ = jitted(step._params, step._buffers,
+                                  step._opt_state, lr, raw)
+        float(np.asarray(loss))
+        print(f"  W={W} compile+first: {time.perf_counter() - t0:.1f} s")
+        step._params, step._buffers, step._opt_state = p, b, o
+        nwin = max(45 // W, 6)
+        t0 = time.perf_counter()
+        for _ in range(nwin):
+            p, b, o, loss, _ = jitted(step._params, step._buffers,
+                                      step._opt_state, lr, raw)
+            step._params, step._buffers, step._opt_state = p, b, o
+        float(np.asarray(loss))
+        dt = time.perf_counter() - t0
+        n = nwin * W
+        print(f"unrolled W={W}:   {batch * seq * n / dt:10.1f} tok/s "
+              f"({dt / n * 1e3:.2f} ms/step)")
+
+
+if __name__ == "__main__":
+    main()
