@@ -216,6 +216,15 @@ def bench_gpt_long_context():
     46.2k. The chunked tier's autodiff residuals are the ~0.53·L² bf16
     exp weights (~0.85 GB/layer, ~10 GB total) — they fit v5e HBM at
     b=1; b=2 OOMs in every variant, so b=1 is the measured shape.
+
+    r5 second pass: chunk size c=256 (32 chunks, now the tier default at
+    this L) measured 58.5-60.0k tok/s (+24-27%; c=512/128/64 all worse —
+    the attention here is HBM-bound on ~4 mandatory passes over the
+    score-space tiles, and c=256 balances tile-size against causal-stair
+    waste). The official pallas flash kernel measured 58.7 ms/layer
+    fwd+bwd vs this tier's 8.3 at the same shape (Mosaic via this rig's
+    remote compile service is ~7x off the pace — same wall as r4's own
+    kernels), so the XLA-level tier stands.
     MFU/vs_baseline framing follows bench.py's A100 methodology with the
     causal-attention term included (at L=8192 attention is ~38% of model
     FLOPs)."""

@@ -556,11 +556,14 @@ def py_func(func, x, out, backward_func=None,
 
         def _pyop_fwd(*arrays):
             res = _callback(*arrays)
-            return (res if len(res) > 1 else res[0]), arrays
+            # save the outputs as residuals: re-running _callback in the
+            # backward would invoke the user's host func twice per step
+            # (and re-trigger any side effects it has)
+            return (res if len(res) > 1 else res[0]), (arrays, res)
 
-        def _pyop_bwd(arrays, g):
+        def _pyop_bwd(res_pack, g):
+            arrays, fwd_outs = res_pack
             gs = g if isinstance(g, tuple) else (g,)
-            fwd_outs = _callback(*arrays)
 
             def bwd_host(*vals):
                 n = len(arrays)
@@ -648,7 +651,7 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         elif step_w is not None or step_h is not None:
             sw = step_w[i] if step_w is not None else 0.0
             sh = step_h[i] if step_h is not None else 0.0
-            st = [float(sh), float(sw)]
+            st = [float(sw), float(sh)]  # prior_box reads [step_w, step_h]
         else:
             st = [0.0, 0.0]
         box, var = prior_box(feat, image, min_sizes=list(ms),
