@@ -55,8 +55,12 @@ def main():
 
     paddle.seed(0)
     model = GPTForCausalLM(config)
+    # multi_precision (reference AMP-O2 semantics): bf16 resident params
+    # + f32 master in optimizer state — kills the per-step f32->bf16 cast
+    # pass and halves grad/param traffic outside the Adam update
     opt = paddle.optimizer.Adam(learning_rate=1e-4,
-                                parameters=model.parameters())
+                                parameters=model.parameters(),
+                                multi_precision=True)
     mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
     # labels ride as a forward input so GPTForCausalLM computes the loss
     # inside forward and honors GPTConfig.fused_head_ce (default False —

@@ -85,7 +85,7 @@ class DPStrategyTrainStep:
         self._buffers = {n: jax.device_put(v, repl) for n, v in buffers.items()}
         self._opt_state = {
             n: {k: jax.device_put(s, repl)
-                for k, s in optimizer._init_state(v).items()}
+                for k, s in optimizer._init_state_for(v).items()}
             for n, v in params.items()
         }
         zeros_like_f32 = lambda v: jnp.zeros(v.shape, jnp.float32)
@@ -289,7 +289,7 @@ class LocalSGDTrainStep:
         self._opt_state = {
             n: {k: stack(s) if hasattr(s, "shape") and s.shape == v.shape
                 else jax.device_put(s, repl)
-                for k, s in optimizer._init_state(v).items()}
+                for k, s in optimizer._init_state_for(v).items()}
             for n, v in params.items()
         }
         self._count = jax.device_put(jnp.zeros((), jnp.int32), repl)

@@ -165,7 +165,7 @@ class PipelineTrainStep:
             """ZeRO: shard param-shaped optimizer-state tensors over the
             'sharding' axis on the first still-free divisible dim (the
             reference sharding_optimizer's stage-1 placement)."""
-            st = optimizer._init_state(p)
+            st = optimizer._init_state_for(p)
             out = {}
             zeroable = (zero_stage >= 1 and sharding_axis in mesh.axis_names
                         and mesh.shape[sharding_axis] > 1)
@@ -187,7 +187,7 @@ class PipelineTrainStep:
             opt_leaf_sharding, self._params, all_specs)
         self._opt_state = jax.tree_util.tree_map(
             lambda p, sh: {k: jax.device_put(s, sh[k])
-                           for k, s in optimizer._init_state(p).items()},
+                           for k, s in optimizer._init_state_for(p).items()},
             self._params, opt_shardings)
         self._out_shardings = (
             jax.tree_util.tree_map(
@@ -267,12 +267,14 @@ class PipelineTrainStep:
 
 def _tree_update(opt, params, grads, state, lr):
     """Apply opt._update over a pytree whose state mirrors its structure."""
+    from .engine import master_aware_update
+
     flat_p, treedef = jax.tree_util.tree_flatten(params)
     flat_g = treedef.flatten_up_to(grads)
     flat_s = treedef.flatten_up_to(state)
     new_p, new_s = [], []
     for p, g, s in zip(flat_p, flat_g, flat_s):
-        np_, ns_ = opt._update(p, g.astype(p.dtype), s, lr)
+        np_, ns_ = master_aware_update(opt, p, g, s, lr)
         new_p.append(np_)
         new_s.append(ns_)
     return treedef.unflatten(new_p), treedef.unflatten(new_s)

@@ -39,7 +39,7 @@ class TrainStep:
         self._buffers = get_buffers(layer)
         self._named_params = dict(layer.named_parameters())
         self._opt_state = {
-            name: optimizer._init_state(p)
+            name: optimizer._init_state_for(p)
             for name, p in self._params.items()
         }
         self._dirty = True
@@ -69,17 +69,29 @@ class TrainStep:
             new_params = {}
             new_opt_state = {}
             for name, p in params.items():
-                g = grads[name].astype(p.dtype)
+                st = opt_state[name]
+                # multi_precision: all pre-update math (L2 fold, AdamW
+                # decay) runs on the f32 master, like apply_optimizer_update
+                master = (st.get("master")
+                          if isinstance(st, dict) else None)
+                p_eff = master if master is not None else p
+                g = grads[name].astype(p_eff.dtype)
                 wd = opt._decay_coeff(self._named_params[name])
                 if wd and type(opt).__name__ != "AdamW":
-                    g = g + wd * p
+                    g = g + wd * p_eff
                 if type(opt).__name__ == "AdamW" and getattr(opt, "_coeff", 0.0):
                     decay = True
                     if opt._apply_decay_param_fun is not None:
                         decay = opt._apply_decay_param_fun(name)
                     if decay:
-                        p = p * (1.0 - lr * opt._coeff)
-                np_, ns = opt._update(p, g, opt_state[name], lr)
+                        p_eff = p_eff * (1.0 - lr * opt._coeff)
+                if master is not None:
+                    sub = {k: v for k, v in st.items() if k != "master"}
+                    new_master, ns = opt._update(p_eff, g, sub, lr)
+                    ns["master"] = new_master
+                    np_ = new_master.astype(p.dtype)
+                else:
+                    np_, ns = opt._update(p_eff, g, st, lr)
                 new_params[name] = np_
                 new_opt_state[name] = ns
             flags = (finite_flags(self._nan_names, loss=loss, grad=grads,

@@ -30,6 +30,20 @@ __all__ = [
 ]
 
 
+class _MasterView:
+    """A Parameter stand-in whose ``_value`` is the f32 master — lets the
+    decay fold and sparse-update paths run their p-based math on the
+    master without changing their signatures. Forwards everything else
+    (regularizer, optimize_attr, name) to the real parameter."""
+
+    def __init__(self, p, master):
+        self._p = p
+        self._value = master
+
+    def __getattr__(self, name):
+        return getattr(self._p, name)
+
+
 class Optimizer:
     _state_names: List[str] = []
 
@@ -92,11 +106,26 @@ class Optimizer:
     def _get_state(self, p: Parameter) -> dict:
         key = id(p)
         if key not in self._accumulators:
-            self._accumulators[key] = self._init_state(p._value)
+            self._accumulators[key] = self._init_state_for(p._value)
         return self._accumulators[key]
 
     def _init_state(self, value) -> dict:
         return {}
+
+    def _init_state_for(self, value) -> dict:
+        """State init honoring ``multi_precision``: for a low-precision
+        float param, accumulators are built from (and the 'master' key
+        holds) the f32 master — the reference multi_precision contract
+        (moments and the master are f32 regardless of param dtype). All
+        engines and the dygraph path share this entry point."""
+        if (self._multi_precision and hasattr(value, "dtype")
+                and jnp.issubdtype(value.dtype, jnp.floating)
+                and value.dtype != jnp.float32):
+            master = jnp.asarray(value, jnp.float32)
+            st = self._init_state(master)
+            st["master"] = master
+            return st
+        return self._init_state(value)
 
     # -- main entry points ---------------------------------------------------
     def step(self):
@@ -115,17 +144,47 @@ class Optimizer:
                     continue
                 state = self._get_state(p)
                 if isinstance(g, RowSparseGrad):
-                    new_value, new_state = self._update_sparse(
-                        p, g, state, self._lr_for(p))
-                    p._value = new_value
+                    if "master" in state:
+                        # sparse multi_precision: the row update runs on
+                        # the f32 master (a _Shim param view), the resident
+                        # re-casts from it; a raw _update_sparse would drop
+                        # the master key (Adam) or stale it (SGD)
+                        master = state["master"]
+                        sub = {k: v for k, v in state.items()
+                               if k != "master"}
+                        shim = _MasterView(p, master)
+                        new_master, new_state = self._update_sparse(
+                            shim, g, sub, self._lr_for(p))
+                        new_state["master"] = new_master
+                        p._value = new_master.astype(p._value.dtype)
+                    else:
+                        new_value, new_state = self._update_sparse(
+                            p, g, state, self._lr_for(p))
+                        p._value = new_value
                     self._accumulators[id(p)] = new_state
                     continue
-                graw = g._value.astype(p._value.dtype) if g.dtype != p.dtype else g._value
-                graw = self._apply_decay_to_grad(p, graw)
-                new_value, new_state = self._update(
-                    p._value, graw, state, self._lr_for(p)
-                )
-                p._value = new_value
+                if "master" in state:
+                    # multi_precision: update the f32 master, re-cast the
+                    # low-precision param from it. L2 decay folds on the
+                    # MASTER (same as apply_optimizer_update in the
+                    # compiled engines — decay on the bf16 resident would
+                    # make dygraph and compiled runs drift)
+                    master = state["master"]
+                    graw = g._value.astype(jnp.float32)
+                    graw = self._apply_decay_to_grad(_MasterView(p, master),
+                                                     graw)
+                    sub = {k: v for k, v in state.items() if k != "master"}
+                    new_master, new_state = self._update(
+                        master, graw, sub, self._lr_for(p))
+                    new_state["master"] = new_master
+                    p._value = new_master.astype(p._value.dtype)
+                else:
+                    graw = g._value.astype(p._value.dtype) if g.dtype != p.dtype else g._value
+                    graw = self._apply_decay_to_grad(p, graw)
+                    new_value, new_state = self._update(
+                        p._value, graw, state, self._lr_for(p)
+                    )
+                    p._value = new_value
                 self._accumulators[id(p)] = new_state
 
     def _update_sparse(self, p, g, state, lr):
@@ -203,7 +262,7 @@ class Optimizer:
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
         for p in self._parameter_list:
             st = {}
-            for k in self._state_names:
+            for k in self._state_names + ["master"]:
                 key = f"{p.name}__{k}"
                 if key in state_dict:
                     v = state_dict[key]
@@ -211,7 +270,7 @@ class Optimizer:
                         jnp.asarray(v) if isinstance(v, np.ndarray) else v
                     )
             if st:
-                base = self._init_state(p._value)
+                base = self._init_state_for(p._value)
                 base.update(st)
                 self._accumulators[id(p)] = base
 
@@ -420,6 +479,24 @@ class AdamW(Adam):
                 lr = self._lr_for(p)
                 if self._lr_ratio is not None:
                     lr = lr * self._lr_ratio(p)
+                if "master" in state:
+                    # multi_precision: decoupled decay + update on the f32
+                    # master, resident re-cast from it (base step's master
+                    # branch, with AdamW's pre-scale)
+                    master = state["master"]
+                    if decay and self._coeff:
+                        master = master * (1.0 - lr * self._coeff)
+                    sub = {k: v for k, v in state.items() if k != "master"}
+                    if isinstance(g, RowSparseGrad):
+                        new_master, new_state = self._update_sparse(
+                            _MasterView(p, master), g, sub, lr)
+                    else:
+                        new_master, new_state = self._update(
+                            master, g._value.astype(jnp.float32), sub, lr)
+                    new_state["master"] = new_master
+                    p._value = new_master.astype(p._value.dtype)
+                    self._accumulators[id(p)] = new_state
+                    continue
                 if decay and self._coeff:
                     p._value = p._value * (1.0 - lr * self._coeff)
                 if isinstance(g, RowSparseGrad):

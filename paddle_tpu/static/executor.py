@@ -403,7 +403,7 @@ class Executor:
         nan_names: list = []
         if id(program) not in self._opt_states:
             self._opt_states[id(program)] = {
-                uid: opt._init_state(p._value) for uid, p in param_items
+                uid: opt._init_state_for(p._value) for uid, p in param_items
             }
         trainable = {uid: p.trainable for uid, p in param_items}
         named = dict(param_items)
@@ -432,13 +432,25 @@ class Executor:
             new_state = {}
             for uid, g in grads.items():
                 p = params_raw[uid]
-                g = g.astype(p.dtype)
+                st = opt_state[uid]
+                # multi_precision: all update math runs on the f32 master
+                # (same shape as apply_optimizer_update / jit.TrainStep)
+                master = st.get("master") if isinstance(st, dict) else None
+                if master is not None:
+                    p_eff, st = master, {k: v for k, v in st.items()
+                                         if k != "master"}
+                else:
+                    p_eff = p
+                g = g.astype(p_eff.dtype)
                 wd = opt._decay_coeff(named[uid])
                 if wd and type(opt).__name__ != "AdamW":
-                    g = g + wd * p
+                    g = g + wd * p_eff
                 if type(opt).__name__ == "AdamW" and getattr(opt, "_coeff", 0.0):
-                    p = p * (1.0 - lr * opt._coeff)
-                np_, ns = opt._update(p, g, opt_state[uid], lr)
+                    p_eff = p_eff * (1.0 - lr * opt._coeff)
+                np_, ns = opt._update(p_eff, g, st, lr)
+                if master is not None:
+                    ns["master"] = np_
+                    np_ = np_.astype(p.dtype)
                 new_params[uid] = np_
                 new_state[uid] = ns
             for uid in param_uids:

@@ -113,6 +113,99 @@ def test_optimizer_state_dict_roundtrip():
     np.testing.assert_allclose(np.asarray(s1["moment1"]), np.asarray(s2["moment1"]))
 
 
+class TestMultiPrecision:
+    """Reference multi_precision contract (fluid optimizers' master-weight
+    mode): with low-precision params, accumulators + master live in f32,
+    the dygraph step updates the master and re-casts the param, and
+    state_dict round-trips the master."""
+
+    def _bf16_layer(self):
+        import jax.numpy as jnp
+
+        net = nn.Linear(4, 4)
+        for _, p in net.named_parameters():
+            p._value = p._value.astype(jnp.bfloat16)
+        return net
+
+    def test_adamw_dygraph_keeps_master(self):
+        """AdamW.step's override must run decay+update on the f32 master —
+        a raw _update would silently drop the 'master' key after step 1."""
+        import jax.numpy as jnp
+
+        net = self._bf16_layer()
+        opt = optimizer.AdamW(learning_rate=0.05, weight_decay=0.01,
+                              parameters=net.parameters(),
+                              multi_precision=True)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(3):
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        for st in opt._accumulators.values():
+            assert st["master"].dtype == jnp.float32
+        for _, p in net.named_parameters():
+            assert p._value.dtype == jnp.bfloat16
+        assert any(k.endswith("__master") for k in opt.state_dict())
+
+    def test_static_executor_master_mode(self):
+        """multi_precision through the static Executor: bf16 params get an
+        f32 master in the executor's opt state and keep training."""
+        import jax.numpy as jnp
+        from paddle_tpu import static
+
+        main, start = static.Program(), static.Program()
+        with static.program_guard(main, start):
+            x = static.data("x", [None, 4], "float32")
+            h = static.nn.fc(x, 4)
+            loss = paddle.mean(h ** 2)
+            opt = optimizer.Adam(learning_rate=0.1, multi_precision=True)
+            opt.minimize(loss)
+        # flip the created params to bf16 (the multi_precision case)
+        for p in main.all_parameters():
+            p._value = p._value.astype(jnp.bfloat16)
+        exe = static.Executor()
+        exe.run(start)
+        xv = np.ones((4, 4), np.float32)
+        l0 = float(exe.run(main, feed={"x": xv}, fetch_list=[loss])[0])
+        for _ in range(10):
+            l1 = float(exe.run(main, feed={"x": xv}, fetch_list=[loss])[0])
+        assert l1 < l0, (l0, l1)
+        sts = next(iter(exe._opt_states.values()))
+        st = next(iter(sts.values()))
+        assert st["master"].dtype == jnp.float32
+        for p in main.all_parameters():
+            assert p._value.dtype == jnp.bfloat16
+
+    def test_dygraph_step_and_state_roundtrip(self):
+        import jax.numpy as jnp
+
+        net = self._bf16_layer()
+        opt = optimizer.Adam(learning_rate=0.1,
+                             parameters=net.parameters(),
+                             multi_precision=True)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(2):
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        st = next(iter(opt._accumulators.values()))
+        assert st["master"].dtype == jnp.float32
+        assert st["moment1"].dtype == jnp.float32  # moments f32, not bf16
+        for _, p in net.named_parameters():
+            assert p._value.dtype == jnp.bfloat16  # residents stay bf16
+        sd = opt.state_dict()
+        assert any(k.endswith("__master") for k in sd)
+        opt2 = optimizer.Adam(learning_rate=0.1,
+                              parameters=net.parameters(),
+                              multi_precision=True)
+        opt2.set_state_dict(sd)
+        st2 = next(iter(opt2._accumulators.values()))
+        np.testing.assert_array_equal(np.asarray(st2["master"], np.float32),
+                                      np.asarray(st["master"], np.float32))
+
+
 class TestLRSchedulers:
     def test_step_decay(self):
         s = optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
