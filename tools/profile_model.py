@@ -20,9 +20,20 @@ import shutil
 import time
 
 
-def build_step():
+def _repo_on_path():
+    """Running as `python tools/profile_model.py` puts tools/ (not the repo
+    root) on sys.path — add the root so paddle_tpu imports without a
+    manual PYTHONPATH."""
     import os
+    import sys
 
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+
+
+def build_step():
+    _repo_on_path()
     # the manual-LN knob now rides GPTConfig.manual_layer_norm, so the
     # profiled program matches the headline bench with no env setup
     import jax
@@ -37,11 +48,14 @@ def build_step():
     config = GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
                        max_position_embeddings=1024, hidden_dropout=0.0,
                        attention_dropout=0.0)
+    # multi_precision matches bench.py's headline Adam (bf16 residents +
+    # f32 masters) so the profiled program IS the benched program
     batch, seq = 8, 1024
     paddle.seed(0)
     model = GPTForCausalLM(config)
     opt = paddle.optimizer.Adam(learning_rate=1e-4,
-                                parameters=model.parameters())
+                                parameters=model.parameters(),
+                                multi_precision=True)
     mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
     step = ParallelTrainStep(model, loss_fn=model.loss_fn, optimizer=opt,
                              mesh=mesh, recompute=False,
@@ -56,11 +70,7 @@ def build_resnet_step():
     """ResNet-50 static-Executor step — IMPORTS the benchmark's own builder
     (bench_all.build_resnet50_train) so the profiler measures exactly the
     program BENCH config #2 runs."""
-    import os
-    import sys
-
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
+    _repo_on_path()
     from bench_all import build_resnet50_train
 
     # window=20 matches BENCH config #2 exactly (the benchmark runs the
