@@ -298,6 +298,17 @@ def main():
         r["smoke"] = SMOKE
         print(json.dumps(r), flush=True)
         results.append(r)
+    # machine-readable telemetry for this bench run: one record per config
+    # plus the final counter/histogram state, validated by
+    # tools/check_telemetry_schema.py in the bench ritual
+    from paddle_tpu.profiler import get_telemetry
+
+    tel = get_telemetry()
+    for i, r in enumerate(results):
+        extra = {k: v for k, v in r.items()
+                 if isinstance(v, (int, float)) and not isinstance(v, bool)}
+        tel.to_jsonl("TELEMETRY.jsonl", step=i, tag=f"bench/{r['metric']}",
+                     extra=extra, append=i > 0)
     if not SMOKE:
         # merge with any previously recorded configs (per-config runs)
         try:

@@ -6,7 +6,9 @@ from .hapi.callbacks import LRScheduler  # noqa: F401
 from .hapi.callbacks import ModelCheckpoint  # noqa: F401
 from .hapi.callbacks import ProgBarLogger  # noqa: F401
 from .hapi.callbacks import ReduceLROnPlateau  # noqa: F401
+from .hapi.callbacks import TelemetryLogger  # noqa: F401
 from .hapi.callbacks import VisualDL  # noqa: F401
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
-           "LRScheduler", "EarlyStopping", "ReduceLROnPlateau"]
+           "LRScheduler", "EarlyStopping", "ReduceLROnPlateau",
+           "TelemetryLogger"]

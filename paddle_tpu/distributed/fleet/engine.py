@@ -30,6 +30,7 @@ exactly the collectives each strategy needs:
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable, Dict, Optional
 
 import jax
@@ -38,6 +39,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.profiler.retrace import tracked_jit
+from paddle_tpu.profiler.telemetry import get_telemetry
+from paddle_tpu.utils import profiler as _host_profiler
 from paddle_tpu.jit.functionalize import (
     functionalize,
     get_buffers,
@@ -425,17 +429,65 @@ class ParallelTrainStep:
             repl,
             repl if self._check_nan else None,  # None output = empty subtree
         )
-        self._jitted = jax.jit(
+        self._jitted = tracked_jit(
             step_fn,
+            name="fleet.train_step",
+            sig_argnums=(3, 4),  # lr + batch drift; params/opt state are fixed
             donate_argnums=(0, 2) if donate else (),
             out_shardings=out_shardings,
         )
         self._out_shardings = out_shardings
         self._donate = donate
         self._jitted_multi = None
+        self._last_step_t = None  # inter-call interval ⇒ steady-state step time
 
     # ----------------------------------------------------------------------
+    def _record_step_metrics(self, t_enter, n_steps, n_tokens, loss,
+                             compiled=False):
+        """Per-step telemetry shared by ``__call__`` and ``run_steps``.
+
+        Dispatch is async, so the wall time spent *inside* the call is
+        only the host dispatch cost (``engine/dispatch_ms``). True step
+        latency is taken from the interval BETWEEN calls — in steady
+        state the device-bound pipeline makes inter-arrival time equal
+        the device step time without ever forcing a blocking sync.
+        ``loss`` is stored as a deferred device scalar; it is only
+        materialized when a snapshot/JSONL export reads the gauge."""
+        tel = get_telemetry()
+        if not tel.enabled or not n_steps:  # empty window: nothing to time
+            return
+        now = time.perf_counter()
+        tel.counter("engine/steps", n_steps)
+        if not compiled:
+            # a compiling call's host time is trace+XLA compile, not
+            # dispatch — it lands in compile_ms/<name> via tracked_jit;
+            # recording it here would permanently skew dispatch_ms
+            # mean/max (full-stream aggregates never window out)
+            tel.observe("engine/dispatch_ms", (now - t_enter) * 1e3)
+        if n_tokens:
+            tel.counter("engine/tokens", n_tokens)
+        last = self._last_step_t
+        if last is not None and now > last and not compiled:
+            # ``compiled`` also drops the step interval containing the
+            # (re)trace — during exactly the shape-drift pathology the
+            # retrace tracker warns about, compile time must not be
+            # reported as step latency. The pause filter lives in
+            # observe_interval (shared with executor/step_ms; a data
+            # stall between steps would otherwise land here even though
+            # sync_to_layer resets the anchor around checkpoint/eval).
+            dt = now - last
+            if tel.observe_interval("engine/step_ms", dt * 1e3 / n_steps):
+                if n_tokens:
+                    tel.gauge("engine/tokens_per_s", n_tokens / dt)
+        self._last_step_t = now
+        if loss is not None:
+            tel.gauge("engine/loss", loss)
+        # inside a profiling window, counters ride the chrome timeline
+        _host_profiler.add_counter_snapshot("fleet.step")
+
     def __call__(self, inputs, labels):
+        t_enter = time.perf_counter()
+        compiles_before = self._jitted.tracker.compiles
         raw_in = tuple(
             jax.device_put(
                 a._value if isinstance(a, Tensor) else jnp.asarray(a),
@@ -476,6 +528,9 @@ class ParallelTrainStep:
 
             raise_if_nonfinite(self._nan_names, flags)
         self._optimizer._global_step += 1
+        self._record_step_metrics(
+            t_enter, 1, int(np.prod(raw_in[0].shape)) if raw_in else 0, loss,
+            compiled=self._jitted.tracker.compiles > compiles_before)
         return Tensor(loss)
 
     def run_steps(self, inputs, labels, step_scheduler=True):
@@ -510,6 +565,8 @@ class ParallelTrainStep:
         (sharding_optimizer.py:168-183 gradient-merge modes).
         """
 
+        t_enter = time.perf_counter()
+
         def stack_put(a):
             arr = a._value if isinstance(a, Tensor) else jnp.asarray(a)
             spec = self._batch_sharding.spec
@@ -541,8 +598,10 @@ class ParallelTrainStep:
                     (lrs, batches[0], batches[1]))
                 return params, buffers, opt_state, losses, flags
 
-            self._jitted_multi = jax.jit(
+            self._jitted_multi = tracked_jit(
                 multi_fn,
+                name="fleet.train_step_multi",
+                sig_argnums=(3, 4),  # lrs + stacked batches
                 donate_argnums=(0, 2) if self._donate else (),
                 out_shardings=self._out_shardings,
             )
@@ -561,6 +620,7 @@ class ParallelTrainStep:
         else:
             lr_list = [float(self._optimizer.get_lr())] * int(n_steps)
         lrs = jnp.asarray(lr_list, jnp.float32)
+        compiles_before = self._jitted_multi.tracker.compiles
         opt_state = self._opt_state
         if self._offload:
             # stream host-resident optimizer state into HBM once per window
@@ -586,9 +646,17 @@ class ParallelTrainStep:
             raise_if_nonfinite(self._nan_names, flags.all(axis=0))
         self._optimizer._global_step += int(n_steps)
         self._dirty = True
+        self._record_step_metrics(
+            t_enter, int(n_steps),
+            int(np.prod(raw_in[0].shape)) if raw_in else 0,
+            losses[-1] if int(n_steps) else None,
+            compiled=self._jitted_multi.tracker.compiles > compiles_before)
         return Tensor(losses)
 
     def sync_to_layer(self):
+        # checkpoint/eval work follows: the next inter-call interval
+        # would measure that pause, not a device step — drop the anchor
+        self._last_step_t = None
         if self._dirty:
             host_params = self._params
             if self._master:

@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.jit.functionalize import functionalize, get_buffers, get_params, set_buffers, set_params
+from paddle_tpu.profiler.retrace import tracked_jit
 from .engine import apply_optimizer_update
 
 __all__ = ["DPStrategyTrainStep", "LocalSGDTrainStep", "create_strategy_train_step"]
@@ -216,7 +217,9 @@ class DPStrategyTrainStep:
                        spec_uv, n_p, n_p),
             check_vma=False,
         )
-        self._jitted = jax.jit(shard_step, donate_argnums=(0, 2, 3, 4, 5))
+        self._jitted = tracked_jit(shard_step, name="fleet.dp_strategy_step",
+                                   sig_argnums=(6, 7, 8),  # count, lr, batch
+                                   donate_argnums=(0, 2, 3, 4, 5))
 
     def __call__(self, inputs, labels):
         put = lambda a: jax.device_put(
@@ -367,7 +370,9 @@ class LocalSGDTrainStep:
             out_specs=(spec_params, spec_buf, spec_opt, n_p, n_p),
             check_vma=False,
         )
-        self._jitted = jax.jit(shard_step, donate_argnums=(0, 2))
+        self._jitted = tracked_jit(shard_step, name="fleet.localsgd_step",
+                                   sig_argnums=(3, 4, 5, 6),  # count, lr, k, batch
+                                   donate_argnums=(0, 2))
 
     def __call__(self, inputs, labels):
         put = lambda a: jax.device_put(

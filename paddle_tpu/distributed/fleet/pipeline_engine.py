@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.profiler.retrace import tracked_jit
 
 __all__ = ["PipelineTrainStep", "pipeline_forward_loss"]
 
@@ -242,7 +243,9 @@ class PipelineTrainStep:
                      if self._check_nan else None)
             return new_params, new_state, loss, flags
 
-        self._jitted = jax.jit(step_fn, donate_argnums=(0, 1))
+        self._jitted = tracked_jit(step_fn, name="fleet.pipeline_step",
+                                   sig_argnums=(2, 3, 4),  # lr, x, y
+                                   donate_argnums=(0, 1))
         self._dp_axis = dp_axis
 
     def __call__(self, micro_inputs, micro_labels):

@@ -66,35 +66,54 @@ def save(obj, path, protocol=_PROTOCOL, **configs):
     """``configs['cipher_key']``: AES key (bytes) — the file is written
     AES-GCM encrypted (framework.io_crypto; reference
     framework/io/crypto/aes_cipher.cc)."""
+    from ..profiler.telemetry import get_telemetry
+
+    tel = get_telemetry()
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    payload = _to_saveable(obj)
-    key = configs.get("cipher_key")
-    if key is not None:
-        from .io_crypto import AESCipher
+    with tel.timer("checkpoint/write_ms"):
+        payload = _to_saveable(obj)
+        key = configs.get("cipher_key")
+        if key is not None:
+            from .io_crypto import AESCipher
 
-        AESCipher(key).encrypt_to_file(
-            pickle.dumps(payload, protocol=protocol), path)
-        return
-    with open(path, "wb") as f:
-        pickle.dump(payload, f, protocol=protocol)
+            AESCipher(key).encrypt_to_file(
+                pickle.dumps(payload, protocol=protocol), path)
+        else:
+            with open(path, "wb") as f:
+                pickle.dump(payload, f, protocol=protocol)
+    tel.counter("checkpoint/writes")
+    try:
+        tel.counter("checkpoint/write_bytes", os.path.getsize(path))
+    except OSError:
+        pass
 
 
 def load(path, **configs):
     """``configs['cipher_key']``: AES key for a file written with
     ``save(..., cipher_key=...)``; encrypted files are auto-detected and
     loading one without the key raises a clear error."""
+    from ..profiler.telemetry import get_telemetry
+
+    tel = get_telemetry()
     return_numpy = configs.get("return_numpy", False)
     from .io_crypto import AESCipher, is_encrypted
 
-    if is_encrypted(path):
-        key = configs.get("cipher_key")
-        if key is None:
-            raise ValueError(
-                f"{path} is encrypted; pass cipher_key=<bytes> to load it")
-        payload = pickle.loads(AESCipher(key).decrypt_from_file(path))
-    else:
-        with open(path, "rb") as f:
-            payload = pickle.load(f)
-    return _from_saveable(payload, return_numpy)
+    with tel.timer("checkpoint/read_ms"):
+        if is_encrypted(path):
+            key = configs.get("cipher_key")
+            if key is None:
+                raise ValueError(
+                    f"{path} is encrypted; pass cipher_key=<bytes> to load it")
+            payload = pickle.loads(AESCipher(key).decrypt_from_file(path))
+        else:
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
+        out = _from_saveable(payload, return_numpy)
+    tel.counter("checkpoint/reads")
+    try:
+        tel.counter("checkpoint/read_bytes", os.path.getsize(path))
+    except OSError:
+        pass
+    return out

@@ -9,7 +9,7 @@ import numpy as np
 
 __all__ = [
     "Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
-    "EarlyStopping", "LRScheduler", "config_callbacks",
+    "EarlyStopping", "LRScheduler", "TelemetryLogger", "config_callbacks",
 ]
 
 
@@ -296,6 +296,73 @@ class VisualDL(Callback):
 
     def on_eval_end(self, logs=None):
         self._write("eval", logs)
+
+
+class TelemetryLogger(Callback):
+    """Stream the runtime telemetry during ``Model.fit`` (the VisualDL-
+    parity scalar surface over ``paddle_tpu.profiler``): every
+    ``log_freq`` train batches, one JSONL record — the batch's logs
+    (loss, metrics), per-batch latency/throughput, and the global
+    ``Telemetry`` snapshot (counters, gauges, histogram percentiles) —
+    lands in ``<log_dir>/<filename>`` in the schema
+    ``tools/check_telemetry_schema.py`` validates. A record is also
+    written at every eval end and at train end, so short runs always
+    produce at least one row."""
+
+    def __init__(self, log_dir="./telemetry", filename="scalars.jsonl",
+                 log_freq=1, sample_memory=False):
+        super().__init__()
+        import os
+
+        self.path = os.path.join(log_dir, filename)
+        self.log_freq = max(int(log_freq), 1)
+        self.sample_memory = sample_memory
+        self._step = 0
+        self._t0 = None
+
+    def _telemetry(self):
+        from ..profiler.telemetry import get_telemetry
+
+        return get_telemetry()
+
+    def _write(self, tag, logs=None):
+        tel = self._telemetry()
+        if self.sample_memory:
+            from ..profiler.telemetry import sample_device_memory
+
+            sample_device_memory(tel)
+        extra = {}
+        for k, v in (logs or {}).items():
+            if k != "step":
+                extra[str(k)] = v  # to_jsonl drops non-coercible values
+        tel.to_jsonl(self.path, step=self._step, tag=tag, extra=extra)
+
+    def on_train_begin(self, logs=None):
+        self._write("train_begin", logs)
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        tel = self._telemetry()
+        if self._t0 is not None:
+            dt = time.perf_counter() - self._t0
+            tel.observe("hapi/step_ms", dt * 1e3)
+            if dt > 0:
+                # steps/s, not samples/s: fit's nominal batch_size param
+                # is a lie when train_data arrives pre-batched (list or
+                # DataLoader) — scaling by it would misreport throughput
+                # by the real batch-size factor
+                tel.gauge("hapi/steps_per_s", 1.0 / dt)
+        if self._step % self.log_freq == 0:
+            self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)
+
+    def on_train_end(self, logs=None):
+        self._write("train_end", logs)
 
 
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,

@@ -107,8 +107,9 @@ class Model:
                 eval_data, batch_size=batch_size, num_workers=num_workers,
             )
         cbks = callbacks_mod.config_callbacks(
-            callbacks, model=self, epochs=epochs, verbose=verbose,
-            log_freq=log_freq, save_dir=save_dir, save_freq=save_freq,
+            callbacks, model=self, batch_size=batch_size, epochs=epochs,
+            verbose=verbose, log_freq=log_freq, save_dir=save_dir,
+            save_freq=save_freq,
             metrics=["loss"] + [n for m in self._metrics for n in _as_list(m.name())],
         )
         cbks.on_begin("train")

@@ -317,8 +317,25 @@ class DataLoader:
 
     def __iter__(self):
         if self.num_workers > 0:
-            return _MultiProcessIter(self)
-        return self._single_process_iter()
+            return self._counted(_MultiProcessIter(self))
+        return self._counted(self._single_process_iter())
+
+    @staticmethod
+    def _counted(it):
+        """Stream batches through the telemetry reader counters
+        (reader/batches, reader/bytes) — the data-ingest half of the
+        step-latency picture, shared by the single- and multi-process
+        paths."""
+        from ..profiler.telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if not tel.enabled:
+            yield from it
+            return
+        for batch in it:
+            tel.counter("reader/batches")
+            tel.counter("reader/bytes", _batch_nbytes(batch))
+            yield batch
 
     def _single_process_iter(self):
         if self._is_iterable_ds:
@@ -334,6 +351,17 @@ class DataLoader:
         for indices in self.batch_sampler:
             samples = [self.dataset[i] for i in indices]
             yield _to_tensors(self.collate_fn(samples), self.return_list)
+
+
+def _batch_nbytes(batch) -> int:
+    """Total array bytes in a collated batch (metadata walk only)."""
+    if isinstance(batch, (list, tuple)):
+        return sum(_batch_nbytes(b) for b in batch)
+    if isinstance(batch, dict):
+        return sum(_batch_nbytes(b) for b in batch.values())
+    if isinstance(batch, Tensor):
+        batch = batch._value
+    return int(getattr(batch, "nbytes", 0))
 
 
 class _IterableBatchCfg:

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 from ..optimizer.optimizer import Optimizer
+from ..profiler.retrace import tracked_jit
 from .functionalize import functionalize, get_buffers, get_params, set_buffers, set_params
 
 __all__ = ["TrainStep", "EvalStep"]
@@ -99,7 +100,9 @@ class TrainStep:
                      if self._check_nan else None)
             return new_params, new_buffers, new_opt_state, loss, flags
 
-        self._jitted = jax.jit(step_fn, donate_argnums=(0, 2) if donate else ())
+        self._jitted = tracked_jit(step_fn, name="jit.train_step",
+                                   sig_argnums=(3, 4),
+                                   donate_argnums=(0, 2) if donate else ())
 
     def __call__(self, inputs, labels):
         raw_inputs = tuple(
@@ -146,7 +149,8 @@ class EvalStep:
             out, _ = self._apply(params, buffers, *inputs)
             return out
 
-        self._jitted = jax.jit(eval_fn)
+        self._jitted = tracked_jit(eval_fn, name="jit.eval_step",
+                                   sig_argnums=slice(2, None))
 
     def __call__(self, *inputs):
         raw = tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in inputs)

@@ -212,6 +212,13 @@ def _ln_fwd_impl(a, w, b, epsilon):
     # multi-output reduce (one read of the activation); jnp.mean + jnp.var
     # is two sequential passes (var needs the mean first). Uncentered var
     # in f32 — same rationale and clamp as _bn_stats.
+    # ASSUMPTION (documented in README "Observability"): E[x²]−E[x]²
+    # cancels catastrophically when |mean| ≫ std (var ≈ difference of two
+    # large near-equal f32 numbers). Safe here because LN inputs are
+    # residual-stream activations with |mean|/std of order 1; feeding
+    # un-normalized data with a huge DC offset through LayerNorm would
+    # lose var precision (the clamp floors it at 0 rather than going
+    # negative).
     s1 = jnp.sum(af, axis=-1, keepdims=True)
     s2 = jnp.sum(af * af, axis=-1, keepdims=True)
     mu = s1 / n

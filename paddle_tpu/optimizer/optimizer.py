@@ -460,6 +460,25 @@ class AdamW(Adam):
     def _apply_decay_to_grad(self, p, graw):
         return graw  # decoupled: applied in _update via param scale
 
+    def _decayed(self, value, g, lr):
+        """Decoupled decay honoring gradient sparsity: a RowSparseGrad
+        decays ONLY the rows it touches (the reference sparse adamw
+        kernel applies decay inside the per-row update, so untouched
+        embedding rows keep their values — a dense decay would shrink
+        the whole [vocab, dim] table every step). Master and resident
+        paths share this so multi_precision cannot drift. NOTE: this is
+        an intentional divergence from a dense AdamW run of the same
+        data (which decays every row every step) in BOTH lazy modes —
+        Adam._update_sparse's dense bit-match contract covers the
+        moment/update math, not the decoupled decay, which the
+        reference ties to the row kernel."""
+        from ..core.selected_rows import RowSparseGrad
+
+        scale = 1.0 - lr * self._coeff
+        if isinstance(g, RowSparseGrad):
+            return value.at[g.merged().rows].multiply(scale, mode="drop")
+        return value * scale
+
     def step(self):
         from ..core.selected_rows import RowSparseGrad
 
@@ -485,7 +504,7 @@ class AdamW(Adam):
                     # branch, with AdamW's pre-scale)
                     master = state["master"]
                     if decay and self._coeff:
-                        master = master * (1.0 - lr * self._coeff)
+                        master = self._decayed(master, g, lr)
                     sub = {k: v for k, v in state.items() if k != "master"}
                     if isinstance(g, RowSparseGrad):
                         new_master, new_state = self._update_sparse(
@@ -498,7 +517,7 @@ class AdamW(Adam):
                     self._accumulators[id(p)] = new_state
                     continue
                 if decay and self._coeff:
-                    p._value = p._value * (1.0 - lr * self._coeff)
+                    p._value = self._decayed(p._value, g, lr)
                 if isinstance(g, RowSparseGrad):
                     new_value, new_state = self._update_sparse(p, g, state, lr)
                 else:

@@ -1,0 +1,44 @@
+"""paddle_tpu.profiler — the runtime telemetry subsystem.
+
+Parity target: the reference's observability stack
+(platform/profiler.h RecordEvent/DeviceTracer + platform/monitor.h
+StatRegistry) as ONE surface with three sinks:
+
+- ``Telemetry`` (telemetry.py): counters (on StatRegistry), gauges,
+  streaming histograms/timers; ``to_jsonl`` appends flat scalar records
+  in the schema ``tools/check_telemetry_schema.py`` validates.
+- chrome tracing: re-exported from ``utils.profiler`` — host spans plus
+  telemetry counter snapshots as instant events in one catapult JSON.
+- ``hapi.callbacks.TelemetryLogger``: streams the same scalars during
+  ``Model.fit`` (VisualDL-parity surface).
+
+``tracked_jit`` (retrace.py) wraps the engines' ``jax.jit`` entry points
+to count/time XLA compilations per function and warn (rate-limited) when
+a function retraces more than ``PADDLE_TPU_RETRACE_WARN`` times.
+
+The legacy span API (``RecordEvent``, ``Profiler``, ``start_profiler``…)
+stays in ``paddle_tpu.utils.profiler`` and is re-exported here so
+``paddle.profiler.Profiler``-style code ports unchanged.
+"""
+from ..utils.profiler import (  # noqa: F401
+    Profiler,
+    RecordEvent,
+    export_chrome_tracing,
+    record_event,
+    start_profiler,
+    stop_profiler,
+)
+from .retrace import RetraceTracker, tracked_jit  # noqa: F401
+from .telemetry import (  # noqa: F401
+    Histogram,
+    Telemetry,
+    get_telemetry,
+    sample_device_memory,
+)
+
+__all__ = [
+    "Telemetry", "Histogram", "get_telemetry", "sample_device_memory",
+    "tracked_jit", "RetraceTracker",
+    "Profiler", "RecordEvent", "record_event", "start_profiler",
+    "stop_profiler", "export_chrome_tracing",
+]

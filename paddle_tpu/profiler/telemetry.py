@@ -1,0 +1,293 @@
+"""Telemetry core — counters, gauges, and streaming histograms/timers.
+
+The runtime-observability layer the reference builds from
+platform/monitor.h (StatRegistry int64 stats) + platform/profiler.h
+(RecordEvent spans feeding a tuning loop). Here one ``Telemetry`` object
+unifies three primitives:
+
+- **counters** — monotonically accumulated int64s, layered directly on the
+  existing ``core.monitor.StatRegistry`` so ``stat_add``/``all_stats`` and
+  telemetry snapshots always agree;
+- **gauges** — last-value scalars (loss, tokens/s, live device bytes).
+  A gauge accepts anything float-convertible and coerces at *snapshot*
+  time, so hot paths may store a not-yet-ready ``jax.Array`` without
+  forcing a device sync;
+- **histograms** — streaming distributions (step latency, compile time):
+  running count/sum/min/max, an EMA, and p50/p95/p99 over a bounded
+  sliding window (exact percentiles over unbounded streams would hold
+  every sample; a window is what production step-latency dashboards use).
+
+One JSONL sink (``to_jsonl``) emits flat scalar records — the schema
+``tools/check_telemetry_schema.py`` validates:
+
+    {"ts": <float unix seconds>, "step": <int|null>, "tag": <str>,
+     "scalars": {<str>: <finite number>}}
+
+Scalar names are namespaced: ``counter/<name>``, ``gauge/<name>``, and
+``hist/<name>/{count,sum,min,max,mean,ema,p50,p95,p99}``.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..core import monitor
+
+__all__ = ["Histogram", "Telemetry", "get_telemetry", "sample_device_memory"]
+
+_HIST_WINDOW = 1024  # sliding-window size backing the percentile estimates
+
+
+class Histogram:
+    """Streaming scalar distribution: running aggregates + EMA + windowed
+    percentiles. Thread-safe; ``observe`` is O(1)."""
+
+    def __init__(self, window: int = _HIST_WINDOW, ema_alpha: float = 0.1):
+        self._lock = threading.Lock()
+        self._window: deque = deque(maxlen=window)
+        self._alpha = float(ema_alpha)
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.ema = None
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if v < self.min else self.min
+            self.max = v if v > self.max else self.max
+            self.ema = v if self.ema is None else (
+                self._alpha * v + (1.0 - self._alpha) * self.ema)
+            self._window.append(v)
+
+    def percentile(self, q) -> float:
+        """Linear-interpolated percentile(s) over the sliding window."""
+        with self._lock:
+            if not self._window:
+                return float("nan")
+            return float(np.percentile(np.asarray(self._window), q))
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            if self.count == 0:
+                return {"count": 0}
+            # copy aggregates under the same lock as the window: an
+            # in-flight observe() on another thread must not tear
+            # count/sum apart (mean would be wrong in the export)
+            count, total, lo, hi, ema = (self.count, self.sum, self.min,
+                                         self.max, self.ema)
+            win = np.asarray(self._window)
+        p50, p95, p99 = np.percentile(win, [50, 95, 99])
+        return {
+            "count": count, "sum": total, "min": lo, "max": hi,
+            "mean": total / count, "ema": ema,
+            "p50": float(p50), "p95": float(p95), "p99": float(p99),
+        }
+
+
+class _Timer:
+    """Context manager feeding a histogram in milliseconds."""
+
+    def __init__(self, telemetry: "Telemetry", name: str):
+        self._tel = telemetry
+        self._name = name
+        self.elapsed_ms = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        self.elapsed_ms = (time.perf_counter() - self._t0) * 1e3
+        # a failed operation's partial time is not a sample of the
+        # operation's duration — recording it would desync paired
+        # metrics (e.g. checkpoint/write_ms count vs writes counter)
+        if exc_type is None:
+            self._tel.observe(self._name, self.elapsed_ms)
+        return False
+
+
+def _coerce_scalar(v) -> Optional[float]:
+    """Best-effort float of a gauge value (may be a deferred jax.Array)."""
+    try:
+        f = float(np.asarray(v).ravel()[0])
+    except Exception:
+        return None
+    return f if math.isfinite(f) else None
+
+
+class Telemetry:
+    """Process-wide metric hub. All mutators are cheap and thread-safe;
+    disabling via ``PADDLE_TPU_TELEMETRY=0`` turns them into no-ops."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._gauges: Dict[str, object] = {}
+        self._hists: Dict[str, Histogram] = {}
+        self._counter_names: set = set()
+        self.enabled = os.environ.get("PADDLE_TPU_TELEMETRY", "1") not in (
+            "0", "false", "off")
+
+    # -- primitives ------------------------------------------------------
+    def counter(self, name: str, value: int = 1) -> None:
+        if not self.enabled:
+            return
+        self._counter_names.add(name)
+        monitor.stat_add(name, int(value))
+
+    def counter_value(self, name: str) -> int:
+        return monitor.stat_get(name)
+
+    def gauge(self, name: str, value) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value) -> None:
+        if not self.enabled:
+            return
+        self.histogram(name).observe(value)
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+            return h
+
+    def timer(self, name: str) -> _Timer:
+        return _Timer(self, name)
+
+    def observe_interval(self, name: str, dt_ms: float) -> bool:
+        """Record an inter-call interval as a steady-state step time,
+        REJECTING pauses: an interval wildly above the running EMA is
+        host work between steps (eval, checkpoint, data stall), not a
+        step — recording it would make p99/max measure checkpoint
+        cadence. One shared filter so the engine and executor step_ms
+        metrics cannot drift apart. Returns True when recorded."""
+        ema = self.histogram(name).ema
+        if ema is not None and dt_ms >= 50 * ema + 1e3:
+            return False
+        self.observe(name, dt_ms)
+        return True
+
+    # -- snapshots -------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Structured view: {'counters': .., 'gauges': .., 'histograms': ..}.
+        Counters come from the shared StatRegistry, so stats bumped via
+        ``monitor.stat_add`` directly appear too."""
+        counters = {k: v for k, v in monitor.all_stats().items()}
+        with self._lock:
+            gauges = dict(self._gauges)
+            hists = dict(self._hists)
+        return {
+            "counters": counters,
+            "gauges": {k: g for k, g in (
+                (k, _coerce_scalar(v)) for k, v in gauges.items())
+                if g is not None},
+            "histograms": {k: h.summary() for k, h in hists.items()},
+        }
+
+    def counter_scalars(self) -> Dict[str, int]:
+        """Flat counters-only view (``counter/<name>``). This is the
+        cheap snapshot the per-step chrome instant events use: it never
+        coerces gauges (which may hold not-yet-ready device arrays — a
+        ``float()`` there would block the async pipeline mid-profile)
+        and never computes histogram percentiles."""
+        return {f"counter/{k}": int(v)
+                for k, v in monitor.all_stats().items()}
+
+    def scalars(self) -> Dict[str, float]:
+        """Flat ``{namespaced_name: number}`` view — the JSONL payload."""
+        snap = self.snapshot()
+        out: Dict[str, float] = {}
+        for k, v in snap["counters"].items():
+            out[f"counter/{k}"] = int(v)
+        for k, v in snap["gauges"].items():
+            out[f"gauge/{k}"] = v
+        for k, s in snap["histograms"].items():
+            for field, v in s.items():
+                if v is not None and math.isfinite(float(v)):
+                    out[f"hist/{k}/{field}"] = float(v)
+        return out
+
+    def to_jsonl(self, path: str, step: Optional[int] = None,
+                 tag: str = "telemetry", extra: Optional[dict] = None,
+                 append: bool = True) -> str:
+        """Append one flat scalar record (the documented schema) to
+        ``path``. ``extra`` scalars merge on top of the snapshot."""
+        scalars = self.scalars()
+        for k, v in (extra or {}).items():
+            f = _coerce_scalar(v)
+            if f is not None:
+                scalars[str(k)] = f
+        rec = {"ts": time.time(),
+               "step": int(step) if step is not None else None,
+               "tag": str(tag), "scalars": scalars}
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "a" if append else "w") as f:
+            f.write(json.dumps(rec) + "\n")
+        return path
+
+    def reset(self) -> None:
+        """Drop gauges/histograms and zero the counters this object
+        created (other StatRegistry stats are left alone)."""
+        with self._lock:
+            self._gauges.clear()
+            self._hists.clear()
+            names = list(self._counter_names)
+        for n in names:
+            monitor.stat_reset(n)
+
+
+_telemetry: Optional[Telemetry] = None
+_telemetry_lock = threading.Lock()
+
+
+def get_telemetry() -> Telemetry:
+    global _telemetry
+    if _telemetry is None:
+        with _telemetry_lock:
+            if _telemetry is None:
+                _telemetry = Telemetry()
+    return _telemetry
+
+
+def sample_device_memory(telemetry: Optional[Telemetry] = None) -> dict:
+    """Device-memory gauges (the reference's STAT_gpu0_mem_size twin):
+    ``device/live_bytes`` sums ``jax.live_arrays()``; when the backend
+    reports allocator stats (TPU does), ``device/bytes_in_use`` and
+    ``device/peak_bytes_in_use`` mirror them."""
+    import jax
+
+    tel = telemetry or get_telemetry()
+    out = {}
+    try:
+        out["device/live_bytes"] = float(
+            sum(getattr(a, "nbytes", 0) for a in jax.live_arrays()))
+    except Exception:
+        pass
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        for src, dst in (("bytes_in_use", "device/bytes_in_use"),
+                         ("peak_bytes_in_use", "device/peak_bytes_in_use")):
+            if src in stats:
+                out[dst] = float(stats[src])
+    except Exception:
+        pass  # CPU backends may not implement memory_stats
+    for k, v in out.items():
+        tel.gauge(k, v)
+    return out

@@ -8,6 +8,7 @@ on-device between runs.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -16,6 +17,9 @@ import numpy as np
 
 from ..core.enforce import InvalidArgumentError, enforce
 from ..core.tensor import Parameter, Tensor
+from ..profiler.retrace import tracked_jit
+from ..profiler.telemetry import get_telemetry
+from ..utils import profiler as _host_profiler
 from .program import Program, default_main_program
 
 __all__ = ["Executor", "global_scope", "scope_guard"]
@@ -85,6 +89,7 @@ class Executor:
         self.place = place
         self._cache: Dict[tuple, Any] = {}
         self._opt_states: Dict[int, dict] = {}
+        self._last_run_t = None  # inter-run interval ⇒ async step time
 
     def close(self):
         self._cache.clear()
@@ -92,6 +97,8 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
             fetch_var_name="fetch", scope=None, return_numpy=True,
             use_program_cache=True):
+        t_enter = time.perf_counter()
+        tel = get_telemetry()
         program = program if isinstance(program, Program) else (
             getattr(program, "_program", None) or default_main_program()
         )
@@ -115,18 +122,49 @@ class Executor:
                 fetch_ids.append(id(program.vars_by_name[f]))
             else:
                 raise InvalidArgumentError(f"cannot fetch {f!r}")
+        t_fed = time.perf_counter()
 
         key = (
             id(program), tuple(sorted((n, tuple(v.shape), str(v.dtype))
                                       for n, v in feed_raw.items())),
             tuple(fetch_ids), len(program.ops),
         )
-        if key not in self._cache:
+        fresh_compile = key not in self._cache
+        if fresh_compile:
+            tel.counter("executor/compiles")
             self._cache[key] = self._compile(program, fetch_ids)
+            # the interval spanning this build (+ the XLA compile inside
+            # the first runner call) is not a step — drop the anchor
+            self._last_run_t = None
         runner = self._cache[key]
         outs = runner(feed_raw)
+        t_run = time.perf_counter()
+        if tel.enabled:
+            tel.counter("executor/runs")
+            tel.observe("executor/feed_ms", (t_fed - t_enter) * 1e3)
+            if not fresh_compile:
+                # run_ms is HOST time in the runner (dispatch + param
+                # commit; near-zero on the async path) — a compiling
+                # call's runner time is XLA compile, tracked separately
+                # in compile_ms/executor.*. True steady-state step time
+                # on the async train loop is the inter-run interval
+                # (executor/step_ms), same rationale as engine/step_ms;
+                # the shared pause filter lives in observe_interval.
+                tel.observe("executor/run_ms", (t_run - t_fed) * 1e3)
+                last = self._last_run_t
+                if last is not None and t_run > last:
+                    tel.observe_interval("executor/step_ms",
+                                         (t_run - last) * 1e3)
+            self._last_run_t = t_run
+            _host_profiler.add_counter_snapshot("executor.run")
         if return_numpy:
-            return [np.asarray(o) for o in outs]
+            res = [np.asarray(o) for o in outs]
+            if tel.enabled:
+                # fetch = materializing device results on the host; this
+                # blocks on the program, so it also covers device time
+                tel.observe("executor/fetch_ms",
+                            (time.perf_counter() - t_run) * 1e3)
+            return res
         return [Tensor(o) for o in outs]
 
     # ------------------------------------------------------------------
@@ -190,8 +228,11 @@ class Executor:
         loops (they must never drift)."""
         feed_names = list(program.feed_vars)
 
+        tel = get_telemetry()
+
         def build_feed(batch):
             feed = {}
+            n_bytes = 0
             for name in feed_names:
                 if name in batch:
                     # a genuine dataset slot always wins — including one
@@ -215,7 +256,11 @@ class Executor:
                 # (the trainer-thread parse/H2D/compute overlap of the
                 # reference's multithreaded DeviceWorker, trainer.h:97,
                 # expressed as double buffering on the dispatch queue)
+                n_bytes += getattr(arr, "nbytes", 0)
                 feed[name] = jax.device_put(arr)
+            if tel.enabled:
+                tel.counter("reader/batches")
+                tel.counter("reader/bytes", n_bytes)
             return feed
 
         return build_feed
@@ -349,7 +394,7 @@ class Executor:
         param_items = list(program.parameters.items())
 
         if program._optimize is None:
-            @jax.jit
+            @tracked_jit(name="executor.forward", sig_argnums=(0,))
             def fwd(feed_raw, params_raw):
                 env = replay(feed_raw, params_raw)
                 return [env[i] for i in fetch_ids]
@@ -363,7 +408,8 @@ class Executor:
 
         step, opt, check_nan, nan_names = self._make_step(
             program, fetch_ids, replay, param_items)
-        jitted = jax.jit(step, donate_argnums=(1, 2))
+        jitted = tracked_jit(step, name="executor.train_step",
+                             sig_argnums=(0, 3), donate_argnums=(1, 2))
 
         def runner(feed_raw):
             params_raw = {uid: p._value for uid, p in param_items}
@@ -568,7 +614,8 @@ class Executor:
                 flags = jnp.all(flags, axis=0)  # any step non-finite
             return outs, params_raw, opt_state, flags
 
-        jitted = jax.jit(multi, donate_argnums=(2, 3))
+        jitted = tracked_jit(multi, name="executor.run_steps",
+                             sig_argnums=(0, 1, 4), donate_argnums=(2, 3))
 
         def runner(feed_raw, step_scheduler=True):
             from ..optimizer.lr import LRScheduler

@@ -576,7 +576,14 @@ def py_func(func, x, out, backward_func=None,
                             if id(o) not in skip]
                 binputs += [np.asarray(v) for v in gs_v]
                 res = backward_func(*binputs)
-                res = res if isinstance(res, (list, tuple)) else [res]
+                res = list(res) if isinstance(res, (list, tuple)) else [res]
+                # a short (or all-None) grad list means "no grad" for the
+                # trailing inputs — pad with None so the zero-fill below
+                # covers every input; an unpadded short tuple would reach
+                # pure_callback with fewer arrays than result_shape and
+                # die in an opaque shape-mismatch error
+                if len(res) < len(xs_v):
+                    res += [None] * (len(xs_v) - len(res))
                 return tuple(
                     np.zeros(xv.shape, xv.dtype) if r is None
                     else np.asarray(r, xv.dtype)

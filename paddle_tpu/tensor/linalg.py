@@ -61,44 +61,31 @@ def qr(x, mode="reduced", name=None):
     return apply_op(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), _t(x), multi_out=True)
 
 
-def _eager_on_tpu(xt) -> bool:
-    """True for a concrete (non-traced) call while the default backend is
-    TPU. SVD-family lowerings are LAPACK-style iterations XLA:TPU handles
-    poorly (and some TPU compile services reject the custom-call
-    outright); eager calls route to the host CPU backend like ``eig``
-    below, while traced/jit calls keep the native lowering."""
-    from ..core.tensor import _is_tracer
-
-    if _is_tracer(xt._value):
-        return False
-    return jax.default_backend() == "tpu"
-
-
-def _host_linalg(fn, *tensors):
-    """Run ``fn`` on host-CPU jax arrays; return device-default results."""
-    from ..core.tensor import wrap_raw
-
-    cpu = jax.devices("cpu")[0]
-    args = [jax.device_put(t._value, cpu) for t in tensors]
-    with jax.default_device(cpu):
-        res = fn(*args)
-    if not isinstance(res, tuple):
-        res = (res,)
-    out = tuple(wrap_raw(jax.device_put(np.asarray(r))) for r in res)
-    return out if len(out) > 1 else out[0]
-
-
 def svd(x, full_matrices=False, name=None):
-    xt = _t(x)
-    if _eager_on_tpu(xt):
-        return _host_linalg(
-            lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
-            xt)
-    return apply_op(
-        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
-        xt,
-        multi_out=True,
-    )
+    # SVD-family lowerings are LAPACK-style iterations XLA:TPU handles
+    # poorly (and some TPU compile services reject the custom-call
+    # outright) — concrete eager calls on TPU route to the host CPU
+    # backend like ``eig`` below.
+    # The eager-TPU host fallback routes THROUGH apply_op (not around it,
+    # which returned grad-less, unrecorded results): the op function
+    # itself picks host CPU only for concrete non-grad values, so
+    # static-program recording captures the op and replay/jit traces keep
+    # the native lowering. When gradients are required, apply_op's vjp
+    # trace sees tracers and also takes the native branch — grads flow
+    # (the host fallback is unreachable there: a pure_callback SVD would
+    # silently detach the graph instead).
+    def f(a):
+        from ..core.tensor import _is_tracer
+
+        if not _is_tracer(a) and jax.default_backend() == "tpu":
+            cpu = jax.devices("cpu")[0]
+            with jax.default_device(cpu):
+                res = jnp.linalg.svd(jax.device_put(a, cpu),
+                                     full_matrices=full_matrices)
+            return tuple(jax.device_put(np.asarray(r)) for r in res)
+        return tuple(jnp.linalg.svd(a, full_matrices=full_matrices))
+
+    return apply_op(f, _t(x), multi_out=True, op_name="svd")
 
 
 def inv(x, name=None):

@@ -20,12 +20,40 @@ import jax
 __all__ = [
     "RecordEvent", "record_event", "start_profiler", "stop_profiler",
     "profiler", "Profiler", "export_chrome_tracing",
+    "add_counter_snapshot", "spans_active",
 ]
 
 _host_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
 _host_spans = []  # (name, start_us, dur_us, tid) for the chrome timeline
+_counter_events = []  # (name, ts_us, scalars) — telemetry snapshots
 _spans_active = False  # spans record only inside a profiling window
+_device_tracing = False  # whether jax.profiler.start_trace is live
 _trace_dir = None
+
+
+def spans_active() -> bool:
+    """True inside a profiling window — instrumented hot paths use this to
+    gate per-step counter snapshots (free outside a window)."""
+    return _spans_active
+
+
+def add_counter_snapshot(name="telemetry", scalars=None):
+    """Record a telemetry counter snapshot as a chrome instant event.
+
+    Inside a profiling window the engines call this once per step, so the
+    exported timeline interleaves counter values with the host spans (the
+    role of the reference timeline's device_tracer counters). ``scalars``
+    defaults to the COUNTERS-ONLY flat view: the full scalar view would
+    coerce gauges (possibly blocking on a not-yet-ready device array —
+    serializing the very pipeline being profiled) and compute histogram
+    percentiles on every step."""
+    if not _spans_active:
+        return
+    if scalars is None:
+        from ..profiler.telemetry import get_telemetry
+
+        scalars = get_telemetry().counter_scalars()
+    _counter_events.append((name, time.perf_counter() * 1e6, dict(scalars)))
 
 
 class RecordEvent:
@@ -58,10 +86,28 @@ def export_chrome_tracing(path: str):
     the role of the reference's protobuf timeline (platform/profiler.proto →
     chrome timeline); the device-side kernel timeline is the jax trace in
     ``log_dir`` (TensorBoard/perfetto)."""
+    pid = os.getpid()
     events = [
         {"name": name, "ph": "X", "ts": ts, "dur": dur,
-         "pid": os.getpid(), "tid": tid, "cat": "host"}
+         "pid": pid, "tid": tid, "cat": "host"}
         for name, ts, dur, tid in _host_spans
+    ]
+    # telemetry counter snapshots ride along as instant events ("i") so
+    # counter values line up against the spans in the same timeline; a
+    # final snapshot is always appended so the export carries the
+    # end-of-window counter state even if no step sampled one
+    snaps = list(_counter_events)
+    try:
+        from ..profiler.telemetry import get_telemetry
+
+        snaps.append(("telemetry", time.perf_counter() * 1e6,
+                      get_telemetry().scalars()))
+    except Exception:
+        pass
+    events += [
+        {"name": name, "ph": "i", "ts": ts, "s": "p", "pid": pid, "tid": 0,
+         "cat": "telemetry", "args": scalars}
+        for name, ts, scalars in snaps
     ]
     d = os.path.dirname(path)
     if d:
@@ -77,19 +123,36 @@ def record_event(name):
         yield
 
 
-def start_profiler(state="All", tracer_option="Default", log_dir="./profiler_log"):
-    global _trace_dir, _spans_active
+def start_profiler(state="All", tracer_option="Default",
+                   log_dir="./profiler_log", device_trace=True):
+    """``device_trace=False`` opens a host-only window: spans + counter
+    snapshots record for chrome export without paying for (or requiring)
+    a full XLA device trace — the cheap mode tests and always-on step
+    sampling use."""
+    global _trace_dir, _spans_active, _device_tracing
     _trace_dir = log_dir
-    _host_spans.clear()  # export covers THIS window, not process lifetime
+    if not _spans_active:
+        # export covers THIS window, not process lifetime — but re-entering
+        # while a window is live (e.g. a host-only window opened inside a
+        # device-trace window) must NOT wipe the outer window's spans
+        _host_spans.clear()
+        _counter_events.clear()
     _spans_active = True
-    os.makedirs(log_dir, exist_ok=True)
-    jax.profiler.start_trace(log_dir)
+    if device_trace:
+        os.makedirs(log_dir, exist_ok=True)
+        jax.profiler.start_trace(log_dir)
+        _device_tracing = True
+    # device_trace=False must NOT clear the flag: a host-only window
+    # opened while a device trace is live would otherwise orphan it
+    # (stop_profiler would never call jax.profiler.stop_trace)
 
 
 def stop_profiler(sorted_key="total", profile_path="/tmp/profile"):
-    global _spans_active
+    global _spans_active, _device_tracing
     _spans_active = False
-    jax.profiler.stop_trace()
+    if _device_tracing:
+        jax.profiler.stop_trace()
+        _device_tracing = False
     if profile_path:
         # reference semantics: the timeline lands at profile_path
         export_chrome_tracing(profile_path)
@@ -131,10 +194,12 @@ class Profiler:
         self._running = True
 
     def stop(self):
-        global _spans_active
+        global _spans_active, _device_tracing
         if self._running:
             _spans_active = False
-            jax.profiler.stop_trace()
+            if _device_tracing:
+                jax.profiler.stop_trace()
+                _device_tracing = False
             self._running = False
 
     def step(self, num_samples=None):

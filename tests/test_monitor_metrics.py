@@ -1,6 +1,9 @@
-"""StatRegistry counters (platform/monitor.h parity) and fleet metrics
-(fleet/metrics/metric.py parity) — numpy-golden checks; the distributed
+"""StatRegistry counters (platform/monitor.h parity), fleet metrics
+(fleet/metrics/metric.py parity), and the paddle_tpu.profiler telemetry
+subsystem (histograms/percentiles, retrace tracking, step metrics, JSONL
+schema, chrome counter events) — numpy-golden checks; the distributed
 reduction path collapses to identity in a single-process world."""
+import json
 import threading
 
 import numpy as np
@@ -8,6 +11,7 @@ import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu.core import monitor
 from paddle_tpu.distributed.fleet import metrics
+from paddle_tpu.profiler import (Histogram, get_telemetry, tracked_jit)
 
 
 class TestStatRegistry:
@@ -84,3 +88,242 @@ class TestFleetMetrics:
     def test_tensor_inputs(self):
         t = paddle.to_tensor([2.0, 4.0])
         assert metrics.sum(t) == 6.0
+
+
+class TestHistogram:
+    def test_percentiles_match_numpy_golden(self):
+        rng = np.random.RandomState(0)
+        vals = rng.rand(500) * 100
+        h = Histogram()
+        for v in vals:
+            h.observe(v)
+        s = h.summary()
+        assert s["count"] == 500
+        assert abs(s["sum"] - vals.sum()) < 1e-6
+        assert s["min"] == vals.min() and s["max"] == vals.max()
+        assert abs(s["mean"] - vals.mean()) < 1e-9
+        for q, key in [(50, "p50"), (95, "p95"), (99, "p99")]:
+            assert abs(s[key] - np.percentile(vals, q)) < 1e-9
+            assert abs(h.percentile(q) - np.percentile(vals, q)) < 1e-9
+
+    def test_ema_golden(self):
+        h = Histogram(ema_alpha=0.5)
+        for v in [10.0, 20.0, 30.0]:
+            h.observe(v)
+        # 10 -> 0.5*20+0.5*10=15 -> 0.5*30+0.5*15=22.5
+        assert abs(h.summary()["ema"] - 22.5) < 1e-12
+
+    def test_window_bounds_percentiles(self):
+        h = Histogram(window=4)
+        # running aggregates keep the full stream; percentiles window it
+        for v in [1000.0, 1.0, 2.0, 3.0, 4.0]:  # 1000 rolls out of the window
+            h.observe(v)
+        s = h.summary()
+        assert s["count"] == 5 and s["max"] == 1000.0
+        assert abs(s["p50"] - 2.5) < 1e-9
+
+    def test_telemetry_counters_layer_on_stat_registry(self):
+        tel = get_telemetry()
+        monitor.stat_reset("t_tel_counter")
+        tel.counter("t_tel_counter", 7)
+        # same registry: both views agree
+        assert monitor.stat_get("t_tel_counter") == 7
+        assert tel.counter_value("t_tel_counter") == 7
+        assert tel.snapshot()["counters"]["t_tel_counter"] == 7
+
+    def test_gauge_defers_device_scalar(self):
+        import jax.numpy as jnp
+
+        tel = get_telemetry()
+        tel.gauge("t_tel_gauge", jnp.asarray(2.5))
+        assert tel.snapshot()["gauges"]["t_tel_gauge"] == 2.5
+
+
+class TestRetraceTracker:
+    def test_two_shapes_two_compiles(self):
+        import jax.numpy as jnp
+
+        monitor.stat_reset("compile/t_retrace")
+        f = tracked_jit(lambda x: x * 2, name="t_retrace")
+        a = f(jnp.ones((2,)))
+        b = f(jnp.ones((3,)))  # new shape -> retrace
+        c = f(jnp.ones((2,)))  # cached signature -> no compile
+        np.testing.assert_allclose(np.asarray(a), 2.0)
+        np.testing.assert_allclose(np.asarray(c), 2.0)
+        assert b.shape == (3,)
+        assert f.tracker.compiles == 2
+        assert get_telemetry().counter_value("compile/t_retrace") == 2
+
+    def test_dtype_change_is_a_compile(self):
+        import jax.numpy as jnp
+
+        f = tracked_jit(lambda x: x + 1, name="t_retrace_dtype")
+        f(jnp.ones((2,), jnp.float32))
+        f(jnp.ones((2,), jnp.int32))
+        assert f.tracker.compiles == 2
+
+    def test_warning_rate_limited_over_threshold(self, caplog, monkeypatch):
+        import logging
+
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("PADDLE_TPU_RETRACE_WARN", "2")
+        f = tracked_jit(lambda x: x * 1, name="t_retrace_warn")
+        with caplog.at_level(logging.WARNING, logger="paddle_tpu.profiler"):
+            for n in range(1, 6):
+                f(jnp.ones((n,)))
+        warns = [r for r in caplog.records if "t_retrace_warn" in r.getMessage()]
+        assert len(warns) == 1  # threshold crossed 3x, but rate-limited
+
+
+def _tiny_fleet_step():
+    import jax
+    from jax.sharding import Mesh
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet.engine import ParallelTrainStep
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    paddle.seed(0)
+    m = M()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=m.parameters())
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    return ParallelTrainStep(
+        m, loss_fn=lambda out, y: ((out - y) ** 2).mean(),
+        optimizer=opt, mesh=mesh)
+
+
+class TestStepTelemetryEndToEnd:
+    """Acceptance: a short training run produces step-latency/throughput
+    scalars via JSONL, the retrace counter reports exactly the expected
+    compilations, and the chrome export carries host spans AND telemetry
+    counter instant events."""
+
+    def test_fleet_step_metrics_jsonl_and_chrome(self, tmp_path):
+        from paddle_tpu.utils import profiler as host_prof
+
+        tel = get_telemetry()
+        steps_before = tel.counter_value("engine/steps")
+        step = _tiny_fleet_step()
+        x = np.random.RandomState(0).rand(8, 4).astype("float32")
+        y = np.random.RandomState(1).rand(8, 2).astype("float32")
+
+        host_prof.start_profiler(device_trace=False)  # host-only window
+        try:
+            with host_prof.RecordEvent("t_train_span"):
+                for _ in range(3):
+                    step((x,), (y,))
+        finally:
+            host_prof.stop_profiler(profile_path=None)
+
+        # -- step scalars ------------------------------------------------
+        assert tel.counter_value("engine/steps") - steps_before == 3
+        assert tel.histogram("engine/step_ms").summary()["count"] >= 2
+        scalars = tel.scalars()
+        assert "hist/engine/step_ms/p50" in scalars
+        assert scalars["gauge/engine/tokens_per_s"] > 0
+        assert "gauge/engine/loss" in scalars
+
+        # -- retrace counter: one signature -> exactly one compile; a new
+        # batch shape -> exactly one more
+        assert step._jitted.tracker.compiles == 1
+        step((x[:4],), (y[:4],))
+        assert step._jitted.tracker.compiles == 2
+
+        # -- JSONL sink matches the documented schema --------------------
+        import tools.check_telemetry_schema as cts
+
+        path = tel.to_jsonl(str(tmp_path / "t.jsonl"), step=3, tag="test")
+        n, err = cts.validate_file(
+            path, require=["counter/engine/steps", "hist/engine/step_ms/p50"])
+        assert err is None and n == 1
+        rec = json.loads(open(path).read())
+        assert rec["tag"] == "test" and rec["step"] == 3
+
+        # -- chrome export: host spans + counter instant events ----------
+        trace_path = host_prof.export_chrome_tracing(
+            str(tmp_path / "trace.json"))
+        events = json.load(open(trace_path))["traceEvents"]
+        spans = [e for e in events if e["ph"] == "X"]
+        counters = [e for e in events if e["ph"] == "i"
+                    and e.get("cat") == "telemetry"]
+        assert any(e["name"] == "t_train_span" for e in spans)
+        assert counters, "no telemetry counter instant events in export"
+        assert any("counter/engine/steps" in e.get("args", {})
+                   for e in counters)
+
+    def test_schema_checker_rejects_bad_records(self, tmp_path):
+        import tools.check_telemetry_schema as cts
+
+        good = tmp_path / "good.jsonl"
+        good.write_text(json.dumps(
+            {"ts": 1.0, "step": None, "tag": "t", "scalars": {"a": 1}}) + "\n")
+        assert cts.validate_file(str(good))[1] is None
+        for bad in (
+            {"ts": 1.0, "tag": "t", "scalars": {}},           # missing step
+            {"ts": 1.0, "step": 1.5, "tag": "t", "scalars": {}},  # float step
+            {"ts": 1.0, "step": 1, "tag": "t", "scalars": {"a": "x"}},
+            {"ts": 1.0, "step": 1, "tag": "", "scalars": {}},  # empty tag
+        ):
+            p = tmp_path / "bad.jsonl"
+            p.write_text(json.dumps(bad) + "\n")
+            assert cts.validate_file(str(p))[1] is not None
+
+    def test_checkpoint_io_counters(self, tmp_path):
+        tel = get_telemetry()
+        w0 = tel.counter_value("checkpoint/writes")
+        b0 = tel.counter_value("checkpoint/write_bytes")
+        r0 = tel.counter_value("checkpoint/reads")
+        state = {"w": paddle.to_tensor(np.ones((4, 4), "float32"))}
+        p = str(tmp_path / "m.pdparams")
+        paddle.save(state, p)
+        loaded = paddle.load(p)
+        np.testing.assert_allclose(loaded["w"].numpy(), 1.0)
+        assert tel.counter_value("checkpoint/writes") == w0 + 1
+        assert tel.counter_value("checkpoint/reads") == r0 + 1
+        import os
+
+        assert (tel.counter_value("checkpoint/write_bytes") - b0
+                == os.path.getsize(p))
+        assert tel.histogram("checkpoint/write_ms").summary()["count"] >= 1
+
+    def test_hapi_telemetry_logger_streams_fit(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.hapi.callbacks import TelemetryLogger
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        paddle.seed(0)
+        model = paddle.Model(Net())
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(
+                learning_rate=0.1, parameters=model.parameters()),
+            loss=nn.MSELoss())
+        rng = np.random.RandomState(0)
+        data = [(rng.rand(4, 4).astype("float32"),
+                 rng.rand(4, 2).astype("float32")) for _ in range(3)]
+        model.fit(data, epochs=1, verbose=0,
+                  callbacks=[TelemetryLogger(log_dir=str(tmp_path))])
+        path = tmp_path / "scalars.jsonl"
+        assert path.exists()
+        import tools.check_telemetry_schema as cts
+
+        n, err = cts.validate_file(str(path), require=["loss"])
+        assert err is None and n >= 3  # begin + >=1 train batch + end
+        recs = [json.loads(l) for l in path.read_text().splitlines()]
+        tags = {r["tag"] for r in recs}
+        assert {"train_begin", "train", "train_end"} <= tags

@@ -14,6 +14,12 @@ cd "$(dirname "$0")/.."
 # regression into the baseline.
 python bench_all.py "$@"
 
+# bench runs must always emit machine-readable telemetry: validate the
+# scalar log bench_all.py wrote against the documented schema (README
+# "Observability") before the perf gate even runs
+python tools/check_telemetry_schema.py TELEMETRY.jsonl
+echo "telemetry schema gate: PASS"
+
 if [ -f BENCH_extra.prev.json ]; then
   # LeNet rides per-step dispatch through the remote-TPU tunnel: the r5
   # variance study (tools/profiles/r5_lenet_variance.txt) measured CV 7.6%

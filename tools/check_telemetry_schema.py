@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Validate a telemetry JSONL scalar log against the documented schema.
+
+Every line must be a JSON object of the shape
+
+    {"ts": <float unix seconds>, "step": <int|null>, "tag": <str>,
+     "scalars": {<str>: <finite number>}}
+
+(the format ``Telemetry.to_jsonl`` and the hapi ``TelemetryLogger``
+emit — see README.md "Observability"). The bench ritual
+(tools/bench_ritual.sh) runs this over the TELEMETRY.jsonl each bench
+run writes, so benchmark telemetry stays machine-readable by
+construction.
+
+Usage:
+    python tools/check_telemetry_schema.py LOG.jsonl \
+        [--require counter/engine/steps] [--min-records 1]
+
+``--require NAME`` (repeatable) additionally demands that at least one
+record carries that scalar. Exit 0 on pass; exit 1 with the first
+violation's line number and reason on fail.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+
+def validate_record(rec, lineno):
+    if not isinstance(rec, dict):
+        return f"line {lineno}: record is {type(rec).__name__}, not an object"
+    for key in ("ts", "step", "tag", "scalars"):
+        if key not in rec:
+            return f"line {lineno}: missing required key {key!r}"
+    if not isinstance(rec["ts"], (int, float)) or isinstance(rec["ts"], bool):
+        return f"line {lineno}: 'ts' must be a number, got {rec['ts']!r}"
+    if rec["step"] is not None and (
+            not isinstance(rec["step"], int) or isinstance(rec["step"], bool)):
+        return f"line {lineno}: 'step' must be int or null, got {rec['step']!r}"
+    if not isinstance(rec["tag"], str) or not rec["tag"]:
+        return f"line {lineno}: 'tag' must be a non-empty string"
+    scalars = rec["scalars"]
+    if not isinstance(scalars, dict):
+        return f"line {lineno}: 'scalars' must be an object"
+    for name, value in scalars.items():
+        if not isinstance(name, str) or not name:
+            return f"line {lineno}: scalar name {name!r} is not a string"
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            return (f"line {lineno}: scalar {name!r} value {value!r} "
+                    f"is not a number")
+        if not math.isfinite(float(value)):
+            return f"line {lineno}: scalar {name!r} is not finite: {value!r}"
+    return None
+
+
+def validate_file(path, require=(), min_records=1):
+    """Returns (n_records, error_message_or_None)."""
+    missing = set(require)
+    n = 0
+    try:
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError as e:
+                    return n, f"line {lineno}: invalid JSON: {e}"
+                err = validate_record(rec, lineno)
+                if err:
+                    return n, err
+                n += 1
+                missing -= set(rec["scalars"])
+    except OSError as e:
+        return 0, f"cannot read {path}: {e}"
+    if n < min_records:
+        return n, f"{path}: {n} record(s), expected at least {min_records}"
+    if missing:
+        return n, f"{path}: required scalar(s) never appeared: {sorted(missing)}"
+    return n, None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Validate a telemetry JSONL scalar log")
+    ap.add_argument("path")
+    ap.add_argument("--require", action="append", default=[],
+                    help="scalar name that must appear in >=1 record")
+    ap.add_argument("--min-records", type=int, default=1)
+    args = ap.parse_args(argv)
+    n, err = validate_file(args.path, args.require, args.min_records)
+    if err:
+        print(f"telemetry schema: FAIL — {err}", file=sys.stderr)
+        return 1
+    print(f"telemetry schema: PASS ({n} records, {args.path})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,6 +86,8 @@ def main():
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--top", type=int, default=40)
     ap.add_argument("--logdir", default="/tmp/xplane_bench")
+    ap.add_argument("--jsonl", default=None,
+                    help="telemetry JSONL output (default <logdir>/telemetry.jsonl)")
     args = ap.parse_args()
 
     import jax
@@ -98,11 +100,36 @@ def main():
     # handles the resnet window's stacked [W] loss fetch
     float(np.ravel(loss.numpy())[-1])
 
+    # the profiled loop runs through the same telemetry the production
+    # engines feed (paddle_tpu.profiler) so this offline view and the
+    # online JSONL/chrome views agree on step latency and compile counts
+    from paddle_tpu.profiler import export_chrome_tracing, get_telemetry, \
+        sample_device_memory, start_profiler, stop_profiler
+
+    tel = get_telemetry()
     shutil.rmtree(args.logdir, ignore_errors=True)
-    with jax.profiler.trace(args.logdir):
+    start_profiler(log_dir=args.logdir)
+    try:
         for _ in range(args.steps):
-            loss = step((ids,), (labels,))
+            with tel.timer("profile/step_wall_ms"):
+                loss = step((ids,), (labels,))
         float(np.ravel(loss.numpy())[-1])
+    finally:
+        stop_profiler(profile_path=None)
+    sample_device_memory(tel)
+    jsonl = args.jsonl or f"{args.logdir}/telemetry.jsonl"
+    tel.to_jsonl(jsonl, step=args.steps, tag=f"profile/{args.model}")
+    export_chrome_tracing(f"{args.logdir}/host_trace.json")
+    snap = tel.snapshot()
+    print(f"== telemetry: {jsonl} (+ host_trace.json) ==")
+    for name, h in sorted(snap["histograms"].items()):
+        if h.get("count"):
+            print(f"  {name}: n={h['count']} p50={h['p50']:.3f} "
+                  f"p95={h['p95']:.3f} p99={h['p99']:.3f} ms")
+    compiles = {k: v for k, v in snap["counters"].items()
+                if k.startswith("compile/")}
+    if compiles:
+        print(f"  compiles: {compiles}")
 
     time.sleep(1)
     paths = sorted(glob.glob(f"{args.logdir}/plugins/profile/*/*.trace.json.gz"))
