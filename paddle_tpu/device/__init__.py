@@ -4,6 +4,8 @@ attached TPU; CUDA-named entry points report no CUDA devices, matching the
 reference's behavior on a CPU-only build."""
 from __future__ import annotations
 
+import os
+
 import jax
 
 from ..core.place import (CPUPlace, CUDAPlace, Place, TPUPlace, get_device,
@@ -13,7 +15,39 @@ __all__ = ["get_device", "set_device", "get_all_device_type",
            "get_all_custom_device_type", "get_available_device",
            "get_available_custom_device", "is_compiled_with_cuda",
            "is_compiled_with_rocm", "is_compiled_with_xpu",
-           "is_compiled_with_npu", "device_count", "cuda", "XPUPlace"]
+           "is_compiled_with_npu", "device_count", "cuda", "XPUPlace",
+           "configure_compilation_cache"]
+
+
+def configure_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at a directory so a warm
+    process restart skips XLA compilation entirely (the reference has no
+    equivalent — its per-op executor recompiles nothing, but every XLA
+    program here costs seconds to minutes to build).
+
+    ``cache_dir`` defaults to ``PADDLE_TPU_COMPILE_CACHE_DIR``; unset/empty
+    means disabled (returns None). The thresholds are dropped to zero so
+    every program is cached — on the remote-TPU rig even small programs pay
+    the compile-service round trip. Returns the directory in effect.
+    """
+    cache_dir = cache_dir or os.environ.get("PADDLE_TPU_COMPILE_CACHE_DIR")
+    if not cache_dir:
+        return None
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    # cache everything: by default jax skips entries that are small or
+    # compiled quickly, which is exactly the long tail a restart replays
+    for key, val in (("jax_persistent_cache_min_entry_size_bytes", -1),
+                     ("jax_persistent_cache_min_compile_time_secs", 0.0)):
+        try:
+            jax.config.update(key, val)
+        except Exception:
+            pass  # older jax: threshold flag absent — dir alone still works
+    return str(cache_dir)
+
+
+# env-gated at import so EVERY entry point (bench, tests, user scripts)
+# inherits the cache without code changes
+_compile_cache_dir = configure_compilation_cache()
 
 
 def get_all_device_type():

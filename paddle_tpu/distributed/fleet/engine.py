@@ -98,6 +98,13 @@ def _grouped_adam_update(opt, group, params, grads, opt_state, lr):
 _GROUP_NUMEL = 65536
 
 
+def _raw_tuple(x):
+    """Batch-side Tensor unwrapping shared by __call__/run_steps: a lone
+    array or a tuple/list of them → tuple of raw jax values."""
+    return tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                 for a in (x if isinstance(x, (tuple, list)) else (x,)))
+
+
 def master_aware_update(opt, p, g, state, lr, **kw):
     """opt._update honoring a ``master`` key in ``state`` (multi_precision):
     the update runs on the f32 master, the low-precision param is re-cast
@@ -485,23 +492,27 @@ class ParallelTrainStep:
         # inside a profiling window, counters ride the chrome timeline
         _host_profiler.add_counter_snapshot("fleet.step")
 
+    def prefetch(self, batches, depth=2, buckets=None):
+        """Wrap a ``(inputs, labels)`` batch iterator in a
+        ``DevicePrefetcher`` staged onto THIS engine's batch sharding: the
+        background pipeline pads/buckets each batch and issues one async
+        pytree ``jax.device_put`` with the step's ``NamedSharding``, so
+        every leaf lands already laid out over the mesh while the previous
+        step is still running. Batches coming back are device-resident —
+        ``__call__``'s device_put on them is then a no-op."""
+        from paddle_tpu.io.prefetch import DevicePrefetcher
+
+        return DevicePrefetcher(batches, depth=depth, buckets=buckets,
+                                sharding=self._batch_sharding)
+
     def __call__(self, inputs, labels):
         t_enter = time.perf_counter()
         compiles_before = self._jitted.tracker.compiles
-        raw_in = tuple(
-            jax.device_put(
-                a._value if isinstance(a, Tensor) else jnp.asarray(a),
-                self._batch_sharding,
-            )
-            for a in (inputs if isinstance(inputs, (tuple, list)) else (inputs,))
-        )
-        raw_lab = tuple(
-            jax.device_put(
-                a._value if isinstance(a, Tensor) else jnp.asarray(a),
-                self._batch_sharding,
-            )
-            for a in (labels if isinstance(labels, (tuple, list)) else (labels,))
-        )
+        # ONE pytree transfer for the whole batch (single dispatch; an
+        # already-sharded array — e.g. from ``prefetch`` — passes through
+        # without a copy)
+        raw_in, raw_lab = jax.device_put(
+            (_raw_tuple(inputs), _raw_tuple(labels)), self._batch_sharding)
         lr = self._optimizer.lr_device_scalar()
         opt_state = self._opt_state
         if self._offload:
@@ -567,18 +578,12 @@ class ParallelTrainStep:
 
         t_enter = time.perf_counter()
 
-        def stack_put(a):
-            arr = a._value if isinstance(a, Tensor) else jnp.asarray(a)
-            spec = self._batch_sharding.spec
-            sh = NamedSharding(self._mesh, P(*((None,) + tuple(spec))))
-            return jax.device_put(arr, sh)
-
-        raw_in = tuple(stack_put(a) for a in
-                       (inputs if isinstance(inputs, (tuple, list))
-                        else (inputs,)))
-        raw_lab = tuple(stack_put(a) for a in
-                        (labels if isinstance(labels, (tuple, list))
-                         else (labels,)))
+        # leading [n_steps] axis is unsharded; ONE pytree transfer for the
+        # whole stacked window (single dispatch instead of one per array)
+        spec = self._batch_sharding.spec
+        win_sharding = NamedSharding(self._mesh, P(*((None,) + tuple(spec))))
+        raw_in, raw_lab = jax.device_put(
+            (_raw_tuple(inputs), _raw_tuple(labels)), win_sharding)
         n_steps = raw_in[0].shape[0]
 
         if self._jitted_multi is None:

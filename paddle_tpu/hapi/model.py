@@ -7,6 +7,7 @@ dispatch; metrics run host-side on fetched outputs like the reference.
 """
 from __future__ import annotations
 
+import jax
 import numpy as np
 
 from ..core.tensor import Tensor, no_grad, to_tensor
@@ -94,7 +95,13 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, prefetch_depth=0,
+            prefetch_buckets=None):
+        """``prefetch_depth`` > 0 stages batches through an
+        ``io.DevicePrefetcher``: a background pipeline that many batches
+        ahead pads into ``prefetch_buckets`` (fixed compile shapes for
+        ragged data) and issues one async pytree device transfer per
+        batch, overlapping H2D with the in-flight train step."""
         from ..io import DataLoader, Dataset
 
         loader = train_data if not isinstance(train_data, Dataset) else DataLoader(
@@ -121,19 +128,37 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs = {}
-            for step_i, batch in enumerate(loader):
-                inputs, labels = _split_batch(batch)
-                cbks.on_batch_begin("train", step_i, logs)
-                out = self.train_batch(inputs, labels)
-                loss_v, metr = out if isinstance(out, tuple) else (out, [])
-                logs = {"loss": loss_v, "step": step_i}
-                for m in self._metrics:
-                    for n, v in zip(_as_list(m.name()), _as_list(m.accumulate())):
-                        logs[n] = v
-                cbks.on_batch_end("train", step_i, logs)
-                it_count += 1
-                if num_iters is not None and it_count >= num_iters:
-                    break
+            data_iter = loader
+            if prefetch_depth:
+                from ..io.prefetch import DevicePrefetcher
+
+                # one prefetcher per epoch: it is a one-shot pipeline and
+                # close() below guarantees no worker outlives the epoch
+                data_iter = DevicePrefetcher(loader, depth=prefetch_depth,
+                                             buckets=prefetch_buckets)
+            try:
+                for step_i, batch in enumerate(data_iter):
+                    if prefetch_depth:
+                        # leaves come back as device jax.Arrays; re-wrap so
+                        # metrics/eager paths see Tensors like loader output
+                        batch = jax.tree_util.tree_map(
+                            lambda a: Tensor(a) if isinstance(a, jax.Array)
+                            else a, batch)
+                    inputs, labels = _split_batch(batch)
+                    cbks.on_batch_begin("train", step_i, logs)
+                    out = self.train_batch(inputs, labels)
+                    loss_v, metr = out if isinstance(out, tuple) else (out, [])
+                    logs = {"loss": loss_v, "step": step_i}
+                    for m in self._metrics:
+                        for n, v in zip(_as_list(m.name()), _as_list(m.accumulate())):
+                            logs[n] = v
+                    cbks.on_batch_end("train", step_i, logs)
+                    it_count += 1
+                    if num_iters is not None and it_count >= num_iters:
+                        break
+            finally:
+                if prefetch_depth:
+                    data_iter.close()
             if self._train_step is not None:
                 self._train_step.sync_to_layer()
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:

@@ -7,6 +7,7 @@ from .data_feed import (  # noqa: F401
     SlotDesc,
 )
 from .dataloader import DataLoader, get_worker_info  # noqa: F401
+from .prefetch import DevicePrefetcher, ShapeBuckets  # noqa: F401
 from .dataset import (  # noqa: F401
     ChainDataset,
     ComposeDataset,

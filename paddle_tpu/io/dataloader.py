@@ -86,6 +86,11 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_id,
                 msg = index_queue.get()
                 if msg is None:
                     break
+                if msg == "__reset__":
+                    # persistent_workers epoch boundary: restart the
+                    # dataset iterator without respawning the process
+                    it = iter(dataset)
+                    continue
                 batch_id, batch_size = msg
                 samples = list(itertools.islice(it, batch_size))
                 if not samples:
@@ -111,8 +116,9 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_id,
 
 
 class _MultiProcessIter:
-    def __init__(self, loader):
+    def __init__(self, loader, persistent=False):
         self._loader = loader
+        self._persistent = persistent
         self._num_workers = loader.num_workers
         self._iterable = isinstance(loader.dataset, IterableDataset)
         # spawn, not fork: the parent holds live XLA threads/locks and a
@@ -209,12 +215,52 @@ class _MultiProcessIter:
         self._reorder[batch_id] = (err, data)
         return True
 
+    def _drain_outstanding(self):
+        """Receive (and discard) every dispatched-but-unread record so the
+        transport is empty before an epoch reset. Stops early if workers
+        died — the caller respawns in that case."""
+        deadline = 0.0
+        while self._rcvd_idx < self._send_idx:
+            if self._rcvd_idx in self._reorder:
+                self._reorder.pop(self._rcvd_idx)
+                self._rcvd_idx += 1
+                continue
+            if not self._recv_one(timeout_s=2.0):
+                deadline += 2.0
+                if (any(not w.is_alive() for w in self._workers)
+                        or deadline >= (self._loader.timeout or 120.0)):
+                    self._shutdown()
+                    return
+        self._reorder.clear()
+
+    def _reset(self):
+        """persistent_workers epoch boundary: reuse the live worker pool
+        and index queues — only the sampler order and the in-flight
+        bookkeeping restart (the reference keeps _workers alive across
+        __iter__ the same way)."""
+        self._drain_outstanding()
+        if self._done:
+            raise RuntimeError("cannot reset a shut-down DataLoader iter")
+        if self._iterable:
+            # workers hold an exhausted dataset iterator — restart it
+            for iq in self._index_queues:
+                iq.put("__reset__")
+        else:
+            self._batches = list(iter(self._loader.batch_sampler))
+        self._send_idx = 0
+        self._rcvd_idx = 0
+        self._reorder = {}
+        for _ in range(self._num_workers
+                       * max(self._loader.prefetch_factor, 2)):
+            self._dispatch()
+
     def __iter__(self):
         return self
 
     def __next__(self):
         if not self._iterable and self._rcvd_idx >= len(self._batches):
-            self._shutdown()
+            if not self._persistent:
+                self._shutdown()
             raise StopIteration
         waited = 0.0
         while self._rcvd_idx not in self._reorder:
@@ -234,7 +280,8 @@ class _MultiProcessIter:
         err, data = self._reorder.pop(self._rcvd_idx)
         self._rcvd_idx += 1
         if isinstance(err, StopIteration):
-            self._shutdown()
+            if not self._persistent:
+                self._shutdown()
             raise StopIteration
         if err is not None:
             self._shutdown()
@@ -299,6 +346,8 @@ class DataLoader:
         self.timeout = timeout
         self.use_shared_memory = use_shared_memory
         self.shm_capacity = shm_capacity
+        self.persistent_workers = bool(persistent_workers)
+        self._persistent_iter: Optional[_MultiProcessIter] = None
         self._is_iterable_ds = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -317,8 +366,33 @@ class DataLoader:
 
     def __iter__(self):
         if self.num_workers > 0:
+            if self.persistent_workers:
+                return self._counted(self._persistent_mp_iter())
             return self._counted(_MultiProcessIter(self))
         return self._counted(self._single_process_iter())
+
+    def _persistent_mp_iter(self):
+        """Keep ONE worker pool (and its index queues) alive across
+        ``__iter__`` calls — spawn respawn cost (interpreter + imports per
+        worker, dominant for short epochs) is paid once; each new epoch
+        just drains leftovers, reshuffles the sampler, and re-primes.
+
+        Contract: ONE live iterator at a time (same as the reference's
+        persistent_workers) — a second concurrent ``iter(loader)`` resets
+        the shared pool out from under the first. Sequential epochs,
+        including epochs abandoned mid-way, are fully supported."""
+        it = self._persistent_iter
+        if it is None or it._done:
+            it = self._persistent_iter = _MultiProcessIter(self,
+                                                           persistent=True)
+        else:
+            try:
+                it._reset()
+            except RuntimeError:
+                # pool died mid-drain (worker crash): fall back to respawn
+                it = self._persistent_iter = _MultiProcessIter(
+                    self, persistent=True)
+        return it
 
     @staticmethod
     def _counted(it):

@@ -1,0 +1,299 @@
+"""Device-resident input pipeline: sharded double-buffered prefetch.
+
+The reference hides feed latency behind its multithreaded DeviceWorker
+parse/H2D/compute overlap (framework/trainer.h:97): worker threads parse
+batches and stage host→device copies while the device runs the previous
+step. ``DevicePrefetcher`` is that overlap expressed in JAX idioms:
+
+- a bounded background thread runs the source iterator ``depth`` batches
+  ahead (parse/pad off the hot loop);
+- each staged batch is padded into a small configurable set of shape
+  buckets (``ShapeBuckets``) so jitted train steps compile once per
+  bucket instead of once per ragged shape;
+- the whole batch pytree goes to the device as ONE ``jax.device_put``
+  (optionally with a ``NamedSharding`` so every leaf lands already laid
+  out over the mesh) — the transfer is async and overlaps the in-flight
+  step, and one dispatch replaces one-per-array.
+
+Telemetry (``paddle_tpu.profiler``): ``prefetch/batches``,
+``prefetch/bucket_hits``/``prefetch/bucket_misses`` counters, a
+``prefetch/queue_depth`` gauge, and ``prefetch/h2d_bytes`` /
+``prefetch/h2d_ms`` histograms (dispatch wall time of the staged put —
+the transfer itself is async by design).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import traceback
+from typing import Iterable, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..profiler.telemetry import get_telemetry
+
+__all__ = ["DevicePrefetcher", "ShapeBuckets"]
+
+
+class ShapeBuckets:
+    """Pad one ragged axis of every array leaf into a fixed set of sizes.
+
+    A batch whose ``shape[axis]`` already equals a bucket size, or pads up
+    to the next one, is a *hit* — its jitted consumer compiles at most once
+    per bucket. A dim larger than every bucket is a *miss*: the array is
+    left unpadded (the retrace tracker will flag the drift) so data is
+    never truncated silently.
+
+    Leaves with ``ndim <= axis`` (e.g. ``[batch]`` labels under the default
+    ``axis=1``) pass through untouched and are not counted.
+    """
+
+    def __init__(self, sizes: Sequence[int], axis: int = 1, pad_value=0):
+        if not sizes:
+            raise ValueError("ShapeBuckets needs at least one size")
+        self.sizes = tuple(sorted(int(s) for s in sizes))
+        if self.sizes[0] <= 0:
+            raise ValueError(f"bucket sizes must be positive: {sizes}")
+        self.axis = int(axis)
+        self.pad_value = pad_value
+
+    def target(self, dim: int) -> Optional[int]:
+        """Smallest bucket >= dim, or None when dim exceeds them all."""
+        for s in self.sizes:
+            if s >= dim:
+                return s
+        return None
+
+    def _pad_leaf(self, arr):
+        """Returns (padded_array, hit_delta, miss_delta)."""
+        if not hasattr(arr, "ndim") or arr.ndim <= self.axis:
+            return arr, 0, 0
+        dim = arr.shape[self.axis]
+        t = self.target(dim)
+        if t is None:
+            return arr, 0, 1
+        if t == dim:
+            return arr, 1, 0
+        if isinstance(arr, jax.Array):
+            # already device-resident: pad on-device — np.asarray here
+            # would force a blocking D2H copy just to re-upload it
+            import jax.numpy as jnp
+
+            widths = [(0, 0)] * arr.ndim
+            widths[self.axis] = (0, t - dim)
+            return jnp.pad(arr, widths,
+                           constant_values=self.pad_value), 1, 0
+        a = np.asarray(arr)
+        shape = list(a.shape)
+        shape[self.axis] = t
+        out = np.full(shape, self.pad_value, dtype=a.dtype)
+        sl = tuple(slice(0, d) for d in a.shape)
+        out[sl] = a
+        return out, 1, 0
+
+    def pad_tree(self, tree):
+        """Pad every array leaf; returns ``(tree, hits, misses)``."""
+        hits = misses = 0
+
+        def pad(leaf):
+            nonlocal hits, misses
+            out, h, m = self._pad_leaf(leaf)
+            hits += h
+            misses += m
+            return out
+
+        return jax.tree_util.tree_map(pad, tree), hits, misses
+
+
+# queue sentinels (identity-compared; never visible to consumers)
+_STOP = object()
+
+
+class _WorkerError:
+    def __init__(self, exc: BaseException, tb: str):
+        self.exc = exc
+        self.tb = tb
+
+
+def _host_leaf(leaf):
+    """Tensor/list → transferable array; device arrays pass untouched."""
+    if isinstance(leaf, Tensor):
+        return leaf._value
+    if isinstance(leaf, jax.Array) or hasattr(leaf, "dtype"):
+        return leaf
+    return np.asarray(leaf)
+
+
+class DevicePrefetcher:
+    """Wrap a batch iterator with a bounded device-resident prefetch queue.
+
+    One-shot iterator (like a file handle): construct per epoch, iterate,
+    and it shuts its worker down when the source drains. ``close()`` (or
+    the context-manager form) tears the pipeline down mid-epoch without
+    leaking the thread. An exception raised by the source (or during
+    staging) is re-raised in the consumer at the position it occurred.
+
+    Args:
+        source: any iterator/iterable of batch pytrees (dicts, tuples,
+            numpy arrays, Tensors).
+        depth: how many staged batches may be in flight ahead of the
+            consumer (the double-buffer depth; >= 1).
+        buckets: ``ShapeBuckets`` or a sequence of ints (axis=1) padding
+            ragged batches into fixed shapes; ``None`` disables.
+        sharding: a ``jax.sharding.Sharding`` broadcast over every leaf
+            (or a matching pytree of shardings) for the single
+            ``jax.device_put``; ``None`` targets the default device.
+        to_device: set False to run the pad/bucket stage only (the
+            consumer owns the transfer) — used by tests and CPU-only
+            staging paths.
+    """
+
+    def __init__(self, source: Iterable, depth: int = 2,
+                 buckets: Union[ShapeBuckets, Sequence[int], None] = None,
+                 sharding=None, to_device: bool = True):
+        self.depth = max(1, int(depth))
+        if buckets is not None and not isinstance(buckets, ShapeBuckets):
+            buckets = ShapeBuckets(buckets)
+        self._buckets = buckets
+        self._sharding = sharding
+        self._to_device = to_device
+        self._source = source
+        self._src = iter(source)
+        self._q: queue.Queue = queue.Queue(maxsize=self.depth)
+        self._closed = threading.Event()
+        self._exhausted = False
+        self._thread = threading.Thread(
+            target=self._worker, name="DevicePrefetcher", daemon=True)
+        self._started = False
+
+    # -- producer ----------------------------------------------------------
+    def _stage(self, batch):
+        """Host-convert + bucket-pad + ONE pytree device_put."""
+        tel = get_telemetry()
+        batch = jax.tree_util.tree_map(_host_leaf, batch)
+        if self._buckets is not None:
+            batch, hits, misses = self._buckets.pad_tree(batch)
+            if tel.enabled:
+                if hits:
+                    tel.counter("prefetch/bucket_hits", hits)
+                if misses:
+                    tel.counter("prefetch/bucket_misses", misses)
+        n_bytes = sum(int(getattr(l, "nbytes", 0))
+                      for l in jax.tree_util.tree_leaves(batch))
+        if self._to_device:
+            t0 = time.perf_counter()
+            if self._sharding is not None:
+                batch = jax.device_put(batch, self._sharding)
+            else:
+                batch = jax.device_put(batch)
+            if tel.enabled:
+                tel.observe("prefetch/h2d_ms",
+                            (time.perf_counter() - t0) * 1e3)
+        if tel.enabled:
+            tel.counter("prefetch/batches")
+            tel.observe("prefetch/h2d_bytes", n_bytes)
+        return batch
+
+    def _put(self, item) -> bool:
+        """Bounded put that stays responsive to close(). False if closed."""
+        while not self._closed.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self):
+        tel = get_telemetry()
+        try:
+            for batch in self._src:
+                if self._closed.is_set():
+                    return
+                staged = self._stage(batch)
+                if not self._put(staged):
+                    return
+                if tel.enabled:
+                    tel.gauge("prefetch/queue_depth", self._q.qsize())
+        except BaseException as e:  # propagate to the consumer, in order
+            self._put(_WorkerError(e, traceback.format_exc()))
+            return
+        self._put(_STOP)
+
+    # -- consumer ----------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted:
+            raise StopIteration
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        while True:
+            try:
+                item = self._q.get(timeout=0.2)
+                break
+            except queue.Empty:
+                if self._closed.is_set():
+                    raise StopIteration from None
+                if not self._thread.is_alive():
+                    # the worker may have staged its final items BETWEEN our
+                    # timed-out get and this liveness check — its puts all
+                    # happened-before thread exit, so one non-blocking get
+                    # now is race-free; only a truly empty queue means the
+                    # worker died without a sentinel (interpreter teardown)
+                    try:
+                        item = self._q.get_nowait()
+                        break
+                    except queue.Empty:
+                        self._exhausted = True
+                        raise StopIteration from None
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.gauge("prefetch/queue_depth", self._q.qsize())
+        if item is _STOP:
+            self._exhausted = True
+            self._thread.join(timeout=2.0)
+            raise StopIteration
+        if isinstance(item, _WorkerError):
+            self._exhausted = True
+            self._thread.join(timeout=2.0)
+            raise item.exc from RuntimeError(
+                f"DevicePrefetcher worker raised:\n{item.tb}")
+        return item
+
+    def __len__(self):
+        return len(self._source)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self):
+        """Tear down mid-epoch: stop the worker, drop staged batches."""
+        if self._exhausted and not self._started:
+            return
+        self._closed.set()
+        self._exhausted = True
+        # drain so a producer blocked on a full queue reaches the event
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        if self._started:
+            self._thread.join(timeout=2.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

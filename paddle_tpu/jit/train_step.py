@@ -104,13 +104,24 @@ class TrainStep:
                                    sig_argnums=(3, 4),
                                    donate_argnums=(0, 2) if donate else ())
 
+    def prefetch(self, batches, depth=2, buckets=None):
+        """Wrap a ``(inputs, labels)`` batch iterator in a background
+        ``DevicePrefetcher`` (pad/bucket + one async pytree device_put per
+        batch, ``depth`` batches ahead) so H2D overlaps the in-flight
+        step. See ``paddle_tpu.io.DevicePrefetcher``."""
+        from ..io.prefetch import DevicePrefetcher
+
+        return DevicePrefetcher(batches, depth=depth, buckets=buckets)
+
     def __call__(self, inputs, labels):
-        raw_inputs = tuple(
-            a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in inputs
-        )
-        raw_labels = tuple(
-            a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in labels
-        )
+        # ONE pytree transfer for the whole batch (single dispatch; a
+        # device-resident batch — e.g. from ``prefetch`` — passes through)
+        raw_inputs, raw_labels = jax.device_put((
+            tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                  for a in inputs),
+            tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                  for a in labels),
+        ))
         lr = self._optimizer.lr_device_scalar()
         self._params, self._buffers, self._opt_state, loss, flags = self._jitted(
             self._params, self._buffers, self._opt_state, lr,
@@ -152,8 +163,18 @@ class EvalStep:
         self._jitted = tracked_jit(eval_fn, name="jit.eval_step",
                                    sig_argnums=slice(2, None))
 
+    def prefetch(self, batches, depth=2, buckets=None):
+        """Background device prefetch for eval input batches (see
+        ``TrainStep.prefetch``)."""
+        from ..io.prefetch import DevicePrefetcher
+
+        return DevicePrefetcher(batches, depth=depth, buckets=buckets)
+
     def __call__(self, *inputs):
-        raw = tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in inputs)
+        # one pytree transfer instead of one implicit put per array
+        raw = jax.device_put(tuple(
+            a._value if isinstance(a, Tensor) else jnp.asarray(a)
+            for a in inputs))
         out = self._jitted(get_params(self._layer), get_buffers(self._layer), *raw)
         from .functionalize import _wrap_tree
 

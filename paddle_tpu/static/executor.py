@@ -170,13 +170,22 @@ class Executor:
     # ------------------------------------------------------------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           prefetch_depth=2, prefetch_buckets=None):
         """Dataset-driven training (reference call stack §3.4:
         Executor.train_from_dataset → trainer/DeviceWorker loop,
         fluid/executor.py:1433). Iterates the dataset's parsed batches,
         builds a feed per batch from the program's feed vars ↔ slot names,
         and replays the compiled step for each. Returns the last fetch
-        values (if any)."""
+        values (if any).
+
+        ``prefetch_depth`` > 0 runs the parse/pad/H2D stage in a
+        ``DevicePrefetcher`` background pipeline that many batches ahead
+        (the reference DeviceWorker overlap, trainer.h:97), issuing ONE
+        async pytree ``jax.device_put`` per feed so the transfer overlaps
+        the in-flight step; ``prefetch_buckets`` (``io.ShapeBuckets`` or a
+        sequence of ints) additionally pads ragged feeds into fixed shape
+        buckets so the jitted step compiles once per bucket."""
         if dataset is None:
             raise InvalidArgumentError("dataset is required")
         program = program if isinstance(program, Program) else (
@@ -184,32 +193,38 @@ class Executor:
         )
         fetch_list = fetch_list or []
         if thread and int(thread) > 1:
+            # N parse threads already stage feeds ahead, so prefetch_depth
+            # has no meaning there — but bucketing still must apply or
+            # ragged feeds retrace per shape
             return self._train_multithread(program, dataset, int(thread),
-                                           fetch_list, debug, print_period)
+                                           fetch_list, debug, print_period,
+                                           prefetch_buckets=prefetch_buckets)
 
-        build_feed = self._dataset_feed_builder(program)
+        from ..io.prefetch import DevicePrefetcher
 
+        use_prefetch = bool(prefetch_depth) and int(prefetch_depth) > 0
+        # with the prefetcher on, it owns the (single-pytree) device_put;
+        # off, build_feed still folds the feed into ONE pytree transfer
+        build_feed = self._dataset_feed_builder(program,
+                                                to_device=not use_prefetch)
+        src = map(build_feed, iter(dataset))
+        if use_prefetch:
+            src = DevicePrefetcher(src, depth=int(prefetch_depth),
+                                   buckets=prefetch_buckets)
         last = None
         step = 0
-        it = iter(dataset)
         try:
-            pending = build_feed(next(it))
-        except StopIteration:
-            return None
-        done = False
-        while not done:
-            try:
-                nxt = build_feed(next(it))  # prefetch while step runs
-            except StopIteration:
-                nxt, done = None, True
             # async: keep fetches as device Tensors; materialize only when
             # printing or at the end — the loop never blocks on the device
-            last = self.run(program, feed=pending, fetch_list=fetch_list,
-                            return_numpy=False)
-            pending = nxt
-            step += 1
-            self._maybe_print_fetches(step, last, fetch_list, debug,
-                                      print_period)
+            for feed in src:
+                last = self.run(program, feed=feed, fetch_list=fetch_list,
+                                return_numpy=False)
+                step += 1
+                self._maybe_print_fetches(step, last, fetch_list, debug,
+                                          print_period)
+        finally:
+            if use_prefetch:
+                src.close()
         if last is not None:
             last = [np.asarray(v.numpy()) for v in last]
         return last
@@ -223,9 +238,14 @@ class Executor:
                              for v in fetches)
             print(f"[train_from_dataset] step {step}: {vals}")
 
-    def _dataset_feed_builder(self, program):
+    def _dataset_feed_builder(self, program, to_device=True):
         """One shared feed builder for the single- and multi-thread dataset
-        loops (they must never drift)."""
+        loops (they must never drift). ``to_device=True`` ends with ONE
+        async pytree ``jax.device_put`` over the whole feed — a single
+        dispatch instead of one per feed var, and the transfer overlaps
+        the in-flight step (the reference DeviceWorker parse/H2D/compute
+        overlap, trainer.h:97). ``to_device=False`` returns host numpy —
+        the DevicePrefetcher pipeline owns the transfer there."""
         feed_names = list(program.feed_vars)
 
         tel = get_telemetry()
@@ -252,12 +272,10 @@ class Executor:
                     raise InvalidArgumentError(
                         f"dataset batch has no slot '{name}' for feed var "
                         f"(slots: {sorted(batch)})")
-                # async H2D now — the transfer overlaps the in-flight step
-                # (the trainer-thread parse/H2D/compute overlap of the
-                # reference's multithreaded DeviceWorker, trainer.h:97,
-                # expressed as double buffering on the dispatch queue)
                 n_bytes += getattr(arr, "nbytes", 0)
-                feed[name] = jax.device_put(arr)
+                feed[name] = arr
+            if to_device:
+                feed = jax.device_put(feed)  # one pytree dispatch, async
             if tel.enabled:
                 tel.counter("reader/batches")
                 tel.counter("reader/bytes", n_bytes)
@@ -266,7 +284,8 @@ class Executor:
         return build_feed
 
     def _train_multithread(self, program, dataset, n_threads, fetch_list,
-                           debug=False, print_period=100):
+                           debug=False, print_period=100,
+                           prefetch_buckets=None):
         """thread>1: the reference's MultiTrainer/DeviceWorker path
         (framework/trainer.h:52). N DatasetWorker threads parse + stage
         feeds concurrently; device dispatch serializes through one lock
@@ -276,7 +295,25 @@ class Executor:
         from ..framework.trainer import (DatasetWorker, MultiTrainer,
                                          shared_iterator)
 
-        build_feed = self._dataset_feed_builder(program)
+        if prefetch_buckets is None:
+            build_feed = self._dataset_feed_builder(program)
+        else:
+            from ..io.prefetch import ShapeBuckets
+
+            buckets = (prefetch_buckets
+                       if isinstance(prefetch_buckets, ShapeBuckets)
+                       else ShapeBuckets(prefetch_buckets))
+            host_feed = self._dataset_feed_builder(program, to_device=False)
+            tel = get_telemetry()
+
+            def build_feed(batch):
+                feed, hits, misses = buckets.pad_tree(host_feed(batch))
+                if tel.enabled:
+                    if hits:
+                        tel.counter("prefetch/bucket_hits", hits)
+                    if misses:
+                        tel.counter("prefetch/bucket_misses", misses)
+                return jax.device_put(feed)  # one pytree dispatch
         step_count = [0]  # guarded by the dispatch lock
 
         def run_step(feed):
@@ -300,7 +337,8 @@ class Executor:
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           prefetch_depth=2, prefetch_buckets=None):
         """Inference twin of train_from_dataset (fluid/executor.py:1385):
         runs a for_test clone so no optimizer update is applied. The clone
         is cached per source program — cloning per call would recompile and
@@ -320,7 +358,8 @@ class Executor:
             self._infer_clones[id(program)] = entry
         return self.train_from_dataset(entry[2], dataset,
                                        scope, thread, debug, fetch_list,
-                                       fetch_info, print_period)
+                                       fetch_info, print_period,
+                                       prefetch_depth, prefetch_buckets)
 
     @staticmethod
     def _row_lengths(slot, program, base_name):

@@ -20,6 +20,12 @@ python bench_all.py "$@"
 python tools/check_telemetry_schema.py TELEMETRY.jsonl
 echo "telemetry schema gate: PASS"
 
+# retrace-budget gate: a bench run whose feed shapes drift recompiles a
+# jitted entry per step (the silent JAX throughput cliff). Each entry's
+# compile counter must stay within budget — shape bucketing
+# (io.ShapeBuckets / DevicePrefetcher) is the fix when this fires.
+python tools/check_retrace_budget.py TELEMETRY.jsonl --budget 6
+
 if [ -f BENCH_extra.prev.json ]; then
   # LeNet rides per-step dispatch through the remote-TPU tunnel: the r5
   # variance study (tools/profiles/r5_lenet_variance.txt) measured CV 7.6%

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Gate a telemetry JSONL log on a retrace budget.
+
+Every jitted engine entry point counts its XLA compilations in a
+``counter/compile/<name>`` scalar (profiler.tracked_jit). A healthy bench
+run compiles each entry a handful of times (one per feed signature /
+shape bucket); a run whose input shapes drift recompiles per step and the
+counter explodes — throughput quietly falls off a cliff. This gate makes
+that failure loud in CI: scan every record in the log, take the MAX value
+each ``counter/compile/*`` scalar ever reached (counters are monotonic,
+so that is the final total), and fail when any entry exceeds the budget.
+
+Usage:
+    python tools/check_retrace_budget.py TELEMETRY.jsonl [--budget 6] \
+        [--ignore compile/executor.forward]
+
+``--budget`` is the per-entry ceiling (default 6: bench_all's configs
+compile each entry 1-2x per feed signature — with shape bucketing, post-
+warmup compiles per entry stay in single digits by construction).
+``--ignore NAME`` (repeatable) exempts an entry. Exit 0 on pass, 2 on
+budget violation, 1 on a malformed/unreadable log.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+PREFIX = "counter/compile/"
+
+
+def collect_compile_counters(path):
+    """{entry_name: max_observed_count} over every record in the log."""
+    peaks = {}
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"line {lineno}: invalid JSON: {e}")
+            scalars = rec.get("scalars")
+            if not isinstance(scalars, dict):
+                continue
+            for name, value in scalars.items():
+                if name.startswith(PREFIX):
+                    entry = name[len("counter/"):]
+                    try:
+                        v = int(value)
+                    except (TypeError, ValueError):
+                        continue
+                    peaks[entry] = max(peaks.get(entry, 0), v)
+    return peaks
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Fail when any jitted entry's compile counter exceeds "
+                    "the retrace budget")
+    ap.add_argument("path")
+    ap.add_argument("--budget", type=int, default=6,
+                    help="max compiles allowed per jitted entry (default 6)")
+    ap.add_argument("--ignore", action="append", default=[],
+                    help="entry name (compile/<fn>) exempt from the budget")
+    args = ap.parse_args(argv)
+    try:
+        peaks = collect_compile_counters(args.path)
+    except (OSError, ValueError) as e:
+        print(f"retrace budget: ERROR — {e}", file=sys.stderr)
+        return 1
+    over = {k: v for k, v in sorted(peaks.items())
+            if v > args.budget and k not in args.ignore}
+    if over:
+        for entry, count in over.items():
+            print(f"retrace budget: FAIL — {entry} compiled {count}x "
+                  f"(budget {args.budget}); an input shape/dtype is "
+                  f"drifting — pad or bucket it (io.ShapeBuckets)",
+                  file=sys.stderr)
+        return 2
+    detail = ", ".join(f"{k}={v}" for k, v in sorted(peaks.items())) or "none"
+    print(f"retrace budget: PASS (budget {args.budget}; {detail})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
