@@ -1,0 +1,19 @@
+"""tpu-lint — AST-based tracer-safety & retrace-hazard analysis.
+
+Static companion to the *runtime* retrace tracker (profiler.tracked_jit):
+eight rules (R1–R8) catch tracer concretization, data-dependent Python
+control flow, jit-signature retrace hazards, per-leaf H2D dispatch
+loops, host syncs in hot paths, trace-time state mutation, float64 on
+TPU, and telemetry calls under trace — all before a single step runs.
+CLI front end: ``tools/tpu_lint.py`` (with a ratcheting baseline gate).
+"""
+from .analyzer import Analyzer, Finding, analyze_source, parse_suppressions
+from .baseline import compare, load_baseline, make_baseline, save_baseline
+from .report import render_json, render_text, summary_line
+from .rules import RULES
+
+__all__ = [
+    "Analyzer", "Finding", "analyze_source", "parse_suppressions",
+    "compare", "load_baseline", "make_baseline", "save_baseline",
+    "render_json", "render_text", "summary_line", "RULES",
+]

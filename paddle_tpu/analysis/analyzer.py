@@ -1,0 +1,649 @@
+"""AST visitor core of tpu-lint: trace-context and taint tracking.
+
+JAX's trace-then-compile model turns a family of runtime disasters
+(tracer concretization, silent per-step retraces, host syncs in the hot
+loop) into *source-level patterns*. This module provides the machinery
+the rules in ``rules.py`` run on:
+
+- **trace-context detection** — which function bodies execute under
+  ``jax.jit`` tracing. Understands this framework's own idioms, not just
+  decorators: ``tracked_jit(step_fn, ...)`` / ``jax.jit(fn)`` wrap calls
+  that reference a locally-defined function (the dominant pattern in
+  ``jit.TrainStep`` / ``static.Executor`` / ``fleet.ParallelTrainStep``),
+  ``@jax.jit`` / ``@tracked_jit(...)`` / ``@partial(jax.jit, ...)``
+  decorators, callables handed to ``lax.scan/cond/while_loop``,
+  ``jax.grad/value_and_grad/vmap/checkpoint``, and op fns registered
+  through ``core.tensor.apply_op``. Functions *defined inside* a traced
+  function are traced too (grad closures, scan bodies).
+- **taint tracking** — which names inside a traced body hold traced
+  values: parameters (minus ``static_argnums``/``static_argnames``),
+  anything assigned from an expression over tainted names, loop targets
+  of tainted iterables (with ``.items()``/``.keys()`` key-vs-value
+  refinement: dict keys are static Python values). Shape/dtype
+  attributes (``x.shape`` etc.) are static under jit and break the
+  taint chain, as do ``isinstance``/``type``/``is None`` tests.
+
+Deliberate limits (documented, not bugs): the analysis is
+intra-procedural — a helper *called from* a traced body is only analyzed
+if it is itself wrapped/marked (e.g. ``fleet.apply_optimizer_update`` is
+not descended into), and ``Layer.forward`` bodies are NOT treated as
+traced because every layer here is dual-mode (define-by-run eager AND
+staged) — data-dependent Python control flow is legal in eager mode.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["Finding", "Analyzer", "analyze_source", "parse_suppressions"]
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str  # "error" | "warning" | "info"
+    path: str
+    line: int
+    col: int
+    message: str
+    hint: str
+    context: str  # enclosing function qualname ("<module>" at top level)
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers drift on unrelated edits, so
+        the ratchet store keys on (file, rule, enclosing function)."""
+        return (self.path, self.rule, self.context)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule, "severity": self.severity, "path": self.path,
+            "line": self.line, "col": self.col, "message": self.message,
+            "hint": self.hint, "context": self.context,
+        }
+
+
+# jit-entry wrappers: a call/decorator whose terminal name is one of
+# these traces its first callable argument
+JIT_WRAPPERS = {"jit", "tracked_jit", "pjit"}
+
+# transform/control callees that trace callable args at these positions
+TRACING_CALLEES = {
+    "scan": (0,), "cond": (1, 2), "switch": (1,), "while_loop": (0, 1),
+    "fori_loop": (2,), "grad": (0,), "value_and_grad": (0,), "vmap": (0,),
+    "pmap": (0,), "checkpoint": (0,), "remat": (0,), "apply_op": (0,),
+    "custom_vjp": (0,), "custom_jvp": (0,),
+}
+
+# attributes that are STATIC under jit tracing (reading them off a tracer
+# yields a concrete Python value) — they break the taint chain
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "weak_type", "sharding",
+                "aval", "name"}
+
+# calls whose result is always a concrete Python value. The trace-probe
+# helpers (core.tensor._is_tracer and friends) are how this framework
+# legitimately branches on "am I being traced" — their result is a
+# concrete bool by construction
+STATIC_CALLS = {"isinstance", "type", "hasattr", "callable", "len", "id",
+                "repr", "str", "issubclass", "_is_tracer", "_is_concrete",
+                "_recording"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpu-lint:\s*(disable|disable-next)\s*=\s*"
+    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+
+def parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    """``{line: {rule, ...}}`` from inline ``# tpu-lint: disable=R1,R5``
+    (same line) and ``# tpu-lint: disable-next=R1`` (following line)
+    comments. The rule name ``all`` suppresses every rule."""
+    out: Dict[int, Set[str]] = {}
+    for lineno, line in enumerate(source.splitlines(), 1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+        target = lineno + 1 if m.group(1) == "disable-next" else lineno
+        out.setdefault(target, set()).update(rules)
+    return out
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Terminal name of a call: ``jax.lax.scan(...)`` → ``scan``."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def dotted(node) -> Optional[str]:
+    """Dotted name of an expression, e.g. ``jax.device_put`` — None when
+    any segment is not a plain Name/Attribute."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _const_str(node) -> Optional[str]:
+    return node.value if (isinstance(node, ast.Constant)
+                          and isinstance(node.value, str)) else None
+
+
+def _static_spec(call: Optional[ast.Call]):
+    """(static_argnums, static_argnames) sets from a wrap call's kwargs."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    if call is None:
+        return nums, names
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int) \
+                        and not isinstance(n.value, bool):
+                    nums.add(n.value)
+        elif kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                s = _const_str(n)
+                if s:
+                    names.add(s)
+    return nums, names
+
+
+class Scope:
+    def __init__(self, node, qualname: str, traced: bool,
+                 parent: Optional["Scope"]):
+        self.node = node
+        self.qualname = qualname
+        self.traced = traced
+        self.parent = parent
+        self.locals: Set[str] = set()
+        self.tainted: Set[str] = set()
+        self.step_results: Set[str] = set()  # names holding jitted-step outputs
+        self.py_tuples: Set[str] = set()  # vararg tuples: emptiness is static
+        if parent is not None and traced:
+            # closure visibility: names traced in the enclosing traced
+            # scope stay traced inside nested defs (grad/scan bodies)
+            self.tainted |= parent.tainted
+            self.py_tuples |= parent.py_tuples
+
+
+def _function_locals(fn) -> Set[str]:
+    """Names bound in a function body (params, assignment/loop/with
+    targets, nested def names). ``global``/``nonlocal`` declarations are
+    removed — mutating those under trace is exactly rule R6's business."""
+    names: Set[str] = set()
+    a = fn.args
+    for p in a.posonlyargs + a.args + a.kwonlyargs:
+        names.add(p.arg)
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    nonlocals: Set[str] = set()
+    body = fn.body if isinstance(fn.body, list) else [fn.body]  # Lambda
+    stack = list(body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(n.name)
+            continue  # nested defs own their locals
+        if isinstance(n, ast.Lambda):
+            continue
+        if isinstance(n, ast.ClassDef):
+            names.add(n.name)
+            continue
+        if isinstance(n, (ast.Global, ast.Nonlocal)):
+            nonlocals.update(n.names)
+        elif isinstance(n, ast.Assign):
+            for t in n.targets:
+                names.update(_target_names(t))
+        elif isinstance(n, (ast.AnnAssign, ast.AugAssign)):
+            names.update(_target_names(n.target))
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            names.update(_target_names(n.target))
+        elif isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                if item.optional_vars is not None:
+                    names.update(_target_names(item.optional_vars))
+        elif isinstance(n, ast.NamedExpr):
+            names.update(_target_names(n.target))
+        elif isinstance(n, ast.ExceptHandler) and n.name:
+            names.add(n.name)
+        elif isinstance(n, ast.comprehension):
+            names.update(_target_names(n.target))
+        stack.extend(ast.iter_child_nodes(n))
+    return names - nonlocals
+
+
+def _target_names(t) -> Set[str]:
+    """Plain names bound by an assignment target (subscript/attribute
+    targets mutate an existing object — they bind nothing)."""
+    out: Set[str] = set()
+    if isinstance(t, ast.Name):
+        out.add(t.id)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            out.update(_target_names(e))
+    elif isinstance(t, ast.Starred):
+        out.update(_target_names(t.value))
+    return out
+
+
+class Analyzer(ast.NodeVisitor):
+    """One pass over one module. ``run()`` returns raw findings —
+    suppression filtering and baseline comparison happen in the CLI."""
+
+    def __init__(self, path: str, source: str, select: Optional[Set[str]] = None):
+        from . import rules  # late import: rules imports Finding from here
+
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.select = select
+        self.findings: List[Finding] = []
+        self.scope: Optional[Scope] = None
+        self.loop_stack: List[dict] = []
+        self._qual: List[str] = []
+        self._rules = rules
+        # nodes marked jit-traced by the pre-pass, with wrap metadata
+        self._marks: Dict[ast.AST, dict] = {}
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        self._wrap_sites: List[dict] = []  # for R3
+
+    # -- public ------------------------------------------------------------
+    def run(self) -> List[Finding]:
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+        self._prepass()
+        for site in self._wrap_sites:
+            self._rules.check_wrap_site(self, site)
+        self.visit(self.tree)
+        return self.findings
+
+    def emit(self, rule: str, node, message: str, hint: Optional[str] = None):
+        if self.select is not None and rule not in self.select:
+            return
+        meta = self._rules.RULES[rule]
+        self.findings.append(Finding(
+            rule=rule, severity=meta.severity, path=self.path,
+            line=getattr(node, "lineno", 0), col=getattr(node, "col_offset", 0),
+            message=message, hint=hint if hint is not None else meta.hint,
+            context=self.qualname()))
+
+    def qualname(self) -> str:
+        return ".".join(self._qual) if self._qual else "<module>"
+
+    def in_traced(self) -> bool:
+        return self.scope is not None and self.scope.traced
+
+    def in_loop(self) -> bool:
+        return bool(self.loop_stack)
+
+    def in_feedish_loop(self) -> bool:
+        return any(l["feedish"] for l in self.loop_stack)
+
+    # -- trace-context pre-pass --------------------------------------------
+    def _prepass(self):
+        """Mark every function node whose body executes under tracing."""
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in JIT_WRAPPERS and node.args:
+                    target = self._resolve_callable(node.args[0], node)
+                    if target is not None:
+                        nums, names = _static_spec(node)
+                        self._mark(target, nums, names)
+                        self._wrap_sites.append(
+                            {"call": node, "fn": target,
+                             "static_argnums": nums,
+                             "static_argnames": names})
+                elif name in TRACING_CALLEES:
+                    for pos in TRACING_CALLEES[name]:
+                        if pos < len(node.args):
+                            t = self._resolve_callable(node.args[pos], node)
+                            if t is not None:
+                                self._mark(t, set(), set())
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    spec = self._decorator_wrap(dec)
+                    if spec is not None:
+                        nums, names = spec
+                        self._mark(node, nums, names)
+                        self._wrap_sites.append(
+                            {"call": dec if isinstance(dec, ast.Call) else node,
+                             "fn": node, "static_argnums": nums,
+                             "static_argnames": names})
+
+    def _decorator_wrap(self, dec):
+        """(static_argnums, static_argnames) when the decorator is a jit
+        wrapper (bare, factory-called, or via functools.partial)."""
+        if isinstance(dec, (ast.Name, ast.Attribute)):
+            name = dec.id if isinstance(dec, ast.Name) else dec.attr
+            return (set(), set()) if name in JIT_WRAPPERS else None
+        if isinstance(dec, ast.Call):
+            name = call_name(dec)
+            if name in JIT_WRAPPERS:
+                return _static_spec(dec)
+            if name == "partial" and dec.args:
+                inner = dotted(dec.args[0]) or ""
+                if inner.split(".")[-1] in JIT_WRAPPERS:
+                    return _static_spec(dec)
+        return None
+
+    def _mark(self, node, nums, names):
+        info = self._marks.setdefault(
+            node, {"static_argnums": set(), "static_argnames": set()})
+        info["static_argnums"] |= nums
+        info["static_argnames"] |= names
+
+    def _resolve_callable(self, arg, at_node):
+        """A wrap call's callable argument → its def node. Lambdas mark
+        themselves; a Name resolves to a FunctionDef in the enclosing
+        scope chain (innermost first, shallow per scope)."""
+        if isinstance(arg, ast.Lambda):
+            return arg
+        if not isinstance(arg, ast.Name):
+            return None
+        scope_node = self._parents.get(at_node)
+        while scope_node is not None:
+            if isinstance(scope_node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                       ast.Module)):
+                for d in self._shallow_defs(scope_node):
+                    if d.name == arg.id:
+                        return d
+            scope_node = self._parents.get(scope_node)
+        return None
+
+    @staticmethod
+    def _shallow_defs(scope_node):
+        body = scope_node.body
+        out = []
+        stack = list(body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(n)
+                continue  # don't descend into nested scopes
+            if isinstance(n, (ast.Lambda, ast.ClassDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+        return out
+
+    # -- taint -------------------------------------------------------------
+    def tainted(self, node) -> bool:
+        """Does evaluating this expression touch a traced value?"""
+        if self.scope is None or not self.scope.traced:
+            return False
+        return self._taint(node)
+
+    def _taint(self, node) -> bool:
+        s = self.scope
+        if isinstance(node, ast.Name):
+            return node.id in s.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False  # x.shape/.dtype are static under jit
+            return self._taint(node.value)
+        if isinstance(node, ast.Subscript):
+            return self._taint(node.value) or self._taint(node.slice)
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in STATIC_CALLS:
+                return False
+            if name in ("float", "int", "bool", "complex"):
+                return False  # concretizers: result is host-side (R1 flags them)
+            return (any(self._taint(a) for a in node.args)
+                    or any(self._taint(k.value) for k in node.keywords)
+                    or self._taint(node.func))
+        if isinstance(node, ast.BinOp):
+            return self._taint(node.left) or self._taint(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._taint(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self._taint(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False  # identity tests are Python-level (x is None)
+            if all(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+                # `k in d` tests keys — static for dicts of traced values;
+                # only a traced LEFT operand is data-dependent
+                return self._taint(node.left)
+            return (self._taint(node.left)
+                    or any(self._taint(c) for c in node.comparators))
+        if isinstance(node, ast.IfExp):
+            return (self._taint(node.test) or self._taint(node.body)
+                    or self._taint(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._taint(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return (any(self._taint(v) for v in node.values)
+                    or any(k is not None and self._taint(k)
+                           for k in node.keys))
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return (any(self._taint(g.iter) for g in node.generators)
+                    or self._taint(node.elt))
+        if isinstance(node, ast.DictComp):
+            return (any(self._taint(g.iter) for g in node.generators)
+                    or self._taint(node.key) or self._taint(node.value))
+        if isinstance(node, ast.Starred):
+            return self._taint(node.value)
+        if isinstance(node, ast.NamedExpr):
+            return self._taint(node.value)
+        if isinstance(node, ast.JoinedStr):
+            return any(self._taint(v) for v in node.values)
+        if isinstance(node, ast.FormattedValue):
+            return self._taint(node.value)
+        return False
+
+    def _bind(self, target, is_tainted: bool):
+        for name in _target_names(target):
+            if is_tainted:
+                self.scope.tainted.add(name)
+            else:
+                self.scope.tainted.discard(name)
+
+    def _bind_for_target(self, target, it):
+        """Loop-target taint with the dict-iteration refinement: keys of
+        a dict of traced values are static Python objects."""
+        t = self._taint(it)
+        if (isinstance(it, ast.Call) and isinstance(it.func, ast.Attribute)
+                and not it.args):
+            base_tainted = self._taint(it.func.value)
+            meth = it.func.attr
+            if meth == "keys":
+                self._bind(target, False)
+                return
+            if meth == "items" and base_tainted and \
+                    isinstance(target, (ast.Tuple, ast.List)) \
+                    and len(target.elts) == 2:
+                self._bind(target.elts[0], False)
+                self._bind(target.elts[1], True)
+                return
+        if isinstance(it, ast.Call) and call_name(it) == "enumerate" \
+                and isinstance(target, (ast.Tuple, ast.List)) \
+                and len(target.elts) == 2:
+            self._bind(target.elts[0], False)
+            self._bind(target.elts[1], t)
+            return
+        if isinstance(it, ast.Call) and call_name(it) == "range":
+            self._bind(target, False)
+            return
+        self._bind(target, t)
+
+    # -- scope/visit machinery ---------------------------------------------
+    def _enter_function(self, node, name: str):
+        mark = self._marks.get(node)
+        traced = (mark is not None
+                  or (self.scope is not None and self.scope.traced))
+        scope = Scope(node, name, traced, self.scope)
+        scope.locals = _function_locals(node)
+        a = node.args
+        if a.vararg:
+            # a *args tuple is a Python tuple even under trace — its
+            # emptiness/length is static (rules exempt `if rest:` tests)
+            scope.py_tuples.add(a.vararg.arg)
+        # Only functions EXPLICITLY handed to a tracing entry (jit wrap,
+        # grad/scan/cond/apply_op, decorator) get tainted params. A plain
+        # helper defined inside a traced body inherits the traced CONTEXT
+        # (closure taint, trace-time print/telemetry checks) but its own
+        # params are frequently called with static values (shape ints) —
+        # auto-tainting them is the analyzer's main false-positive source.
+        if mark is not None:
+            nums = mark["static_argnums"]
+            names = mark["static_argnames"]
+            params = [p.arg for p in a.posonlyargs + a.args]
+            # static_argnums indices follow JAX's convention: they count
+            # the wrapped function's own positions, INCLUDING a leading
+            # self/cls (jit sees the unbound function)
+            for idx, pname in enumerate(params):
+                if pname in ("self", "cls"):
+                    continue
+                if idx in nums or pname in names:
+                    continue
+                scope.tainted.add(pname)
+            for p in a.kwonlyargs:
+                if p.arg not in names:
+                    scope.tainted.add(p.arg)
+            if a.vararg:
+                scope.tainted.add(a.vararg.arg)
+            if a.kwarg:
+                scope.tainted.add(a.kwarg.arg)
+        return scope
+
+    def visit_FunctionDef(self, node):
+        self._qual.append(node.name)
+        outer, self.scope = self.scope, self._enter_function(node, node.name)
+        outer_loops, self.loop_stack = self.loop_stack, []
+        for d in node.decorator_list:
+            self.visit(d)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.scope = outer
+        self.loop_stack = outer_loops
+        self._qual.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        outer = self.scope
+        self.scope = self._enter_function(node, "<lambda>")
+        self.visit(node.body)
+        self.scope = outer
+
+    def visit_ClassDef(self, node):
+        self._qual.append(node.name)
+        self.generic_visit(node)
+        self._qual.pop()
+
+    def visit_Assign(self, node):
+        self.visit(node.value)
+        t = self.tainted(node.value)
+        self._rules.check_assign(self, node)
+        for target in node.targets:
+            if self.scope is not None:
+                self._bind(target, t)
+                # a slice of a *args tuple is still a Python tuple —
+                # its emptiness stays static (`inits = flat[k:]`)
+                if (isinstance(node.value, ast.Subscript)
+                        and isinstance(node.value.slice, ast.Slice)
+                        and isinstance(node.value.value, ast.Name)
+                        and node.value.value.id in self.scope.py_tuples):
+                    for n in _target_names(target):
+                        self.scope.py_tuples.add(n)
+            if not isinstance(target, ast.Name):
+                self.visit(target)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self.visit(node.value)
+            if self.scope is not None:
+                self._bind(node.target, self.tainted(node.value))
+
+    def visit_AugAssign(self, node):
+        self.visit(node.value)
+        self._rules.check_augassign(self, node)
+        if self.scope is not None and isinstance(node.target, ast.Name):
+            if self.tainted(node.value):
+                self.scope.tainted.add(node.target.id)
+
+    def visit_NamedExpr(self, node):
+        self.visit(node.value)
+        if self.scope is not None:
+            self._bind(node.target, self.tainted(node.value))
+
+    def visit_For(self, node):
+        self.visit(node.iter)
+        if self.scope is not None:
+            self._bind_for_target(node.target, node.iter)
+        self.loop_stack.append({"node": node, "feedish": self._feedish(node)})
+        for stmt in node.body:
+            self.visit(stmt)
+        self.loop_stack.pop()
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    visit_AsyncFor = visit_For
+
+    def visit_While(self, node):
+        self._rules.check_branch(self, node, kind="while")
+        self.visit(node.test)
+        self.loop_stack.append({"node": node, "feedish": False})
+        for stmt in node.body:
+            self.visit(stmt)
+        self.loop_stack.pop()
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def visit_If(self, node):
+        self._rules.check_branch(self, node, kind="if")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        self._rules.check_branch(self, node, kind="assert")
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        self._rules.check_call(self, node)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        self._rules.check_attribute(self, node)
+        self.generic_visit(node)
+
+    @staticmethod
+    def _feedish(node: ast.For) -> bool:
+        """Does this loop iterate a feed/batch-like mapping? (the shape
+        of the per-leaf H2D dispatch regression PR 2 eliminated)"""
+        it = node.iter
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Attribute) \
+                and it.func.attr in ("items", "values"):
+            return True
+        names = []
+        for n in list(ast.walk(it)) + list(ast.walk(node.target)):
+            if isinstance(n, ast.Name):
+                names.append(n.id.lower())
+            elif isinstance(n, ast.Attribute):
+                names.append(n.attr.lower())
+        return any(k in name for name in names
+                   for k in ("feed", "batch", "slot"))
+
+
+def analyze_source(path: str, source: str,
+                   select: Optional[Set[str]] = None) -> List[Finding]:
+    """Analyze one module's source; returns findings with inline
+    ``# tpu-lint: disable=`` suppressions already applied."""
+    analyzer = Analyzer(path, source, select=select)
+    findings = analyzer.run()
+    supp = parse_suppressions(source)
+    return [f for f in findings
+            if not (supp.get(f.line) and
+                    ({f.rule, "all"} & supp[f.line]))]

@@ -1,0 +1,75 @@
+"""Baseline / ratchet store for tpu-lint (the Infer/RacerD landing
+strategy): pre-existing findings are recorded in a committed JSON file
+and tolerated; anything NEW fails CI; a FIXED finding makes its baseline
+entry stale, prompting a regenerate — so the debt can only shrink.
+
+Entries key on ``(file, rule, enclosing-function)`` with a count, never
+on line numbers — unrelated edits must not invalidate the baseline.
+"""
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Dict, List, Tuple
+
+from .analyzer import Finding
+
+__all__ = ["load_baseline", "make_baseline", "save_baseline", "compare"]
+
+_VERSION = 1
+
+
+def make_baseline(findings: List[Finding]) -> dict:
+    counts = Counter(f.key() for f in findings)
+    entries = [
+        {"file": path, "rule": rule, "context": ctx, "count": n}
+        for (path, rule, ctx), n in sorted(counts.items())
+    ]
+    return {"version": _VERSION, "entries": entries}
+
+
+def save_baseline(path: str, baseline: dict) -> None:
+    with open(path, "w") as f:
+        json.dump(baseline, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def load_baseline(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "entries" not in data:
+        raise ValueError(f"{path}: not a tpu-lint baseline file")
+    return data
+
+
+def compare(findings: List[Finding], baseline: dict
+            ) -> Tuple[List[Finding], List[dict], int]:
+    """(new_findings, stale_entries, n_baselined).
+
+    - *new*: findings over their key's baselined count (all of a key's
+      findings are reported when it exceeds budget — line numbers inside
+      one function aren't stable enough to pick "the new one");
+    - *stale*: baseline entries whose key now has FEWER findings than
+      recorded (burned down — regenerate to ratchet the budget down);
+    - *n_baselined*: findings absorbed by the baseline.
+    """
+    allowed: Dict[Tuple[str, str, str], int] = {
+        (e["file"], e["rule"], e["context"]): int(e.get("count", 0))
+        for e in baseline.get("entries", [])
+    }
+    observed = Counter(f.key() for f in findings)
+    new: List[Finding] = []
+    n_baselined = 0
+    for key, n in observed.items():
+        budget = allowed.get(key, 0)
+        if n > budget:
+            new.extend(f for f in findings if f.key() == key)
+        else:
+            n_baselined += n
+    stale = [
+        {"file": k[0], "rule": k[1], "context": k[2], "count": budget,
+         "observed": observed.get(k, 0)}
+        for k, budget in sorted(allowed.items())
+        if observed.get(k, 0) < budget
+    ]
+    return new, stale, n_baselined

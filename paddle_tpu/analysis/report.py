@@ -1,0 +1,48 @@
+"""Finding emitters: compiler-style text and machine-readable JSON."""
+from __future__ import annotations
+
+from collections import Counter
+from typing import List, Optional, TextIO
+
+from .analyzer import Finding
+
+__all__ = ["render_text", "render_json", "summary_line"]
+
+_SEV_ORDER = {"error": 0, "warning": 1, "info": 2}
+
+
+def sort_findings(findings: List[Finding]) -> List[Finding]:
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def render_text(findings: List[Finding], stream: TextIO,
+                show_hints: bool = True) -> None:
+    """``file:line:col: RULE severity: message`` (+ indented fix hint),
+    the clickable compiler convention."""
+    for f in sort_findings(findings):
+        stream.write(f"{f.path}:{f.line}:{f.col}: {f.rule} "
+                     f"{f.severity}: {f.message} [{f.context}]\n")
+        if show_hints and f.hint:
+            stream.write(f"    hint: {f.hint}\n")
+
+
+def render_json(findings: List[Finding], stale: Optional[List[dict]] = None,
+                n_baselined: int = 0) -> dict:
+    by_rule = Counter(f.rule for f in findings)
+    return {
+        "findings": [f.to_dict() for f in sort_findings(findings)],
+        "by_rule": dict(sorted(by_rule.items())),
+        "baselined": n_baselined,
+        "stale_baseline_entries": stale or [],
+    }
+
+
+def summary_line(n_new: int, n_baselined: int, n_stale: int,
+                 n_files: int) -> str:
+    parts = [f"{n_files} files", f"{n_new} new finding(s)"]
+    if n_baselined:
+        parts.append(f"{n_baselined} baselined")
+    if n_stale:
+        parts.append(f"{n_stale} stale baseline entr"
+                     + ("y" if n_stale == 1 else "ies"))
+    return ", ".join(parts)

@@ -1,0 +1,368 @@
+"""tpu-lint rules R1–R8: TPU/JAX hazard patterns keyed to failures this
+framework has actually hit (PR 1 built the *runtime* retrace tracker;
+PR 2 hand-hunted per-leaf H2D dispatch loops — both classes are caught
+here statically, before a step executes).
+
+Each rule is metadata (id, severity, title, fix hint) plus a check
+hooked into the analyzer's visit events. Adding a rule = adding a Rule
+entry and extending one of the ``check_*`` dispatchers below.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict
+
+from .analyzer import call_name, dotted
+
+__all__ = ["RULES", "Rule"]
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str
+    title: str
+    hint: str
+
+
+RULES: Dict[str, Rule] = {r.id: r for r in [
+    Rule("R1", "error", "tracer concretization",
+         "float()/int()/bool()/np.asarray()/.numpy() force a traced value "
+         "to a host constant: under jax.jit this raises "
+         "ConcretizationTypeError (or silently bakes in a trace-time "
+         "constant). Keep the math in jnp, or mark the argument static."),
+    Rule("R2", "error", "data-dependent Python control flow",
+         "a Python if/while on a traced value branches at TRACE time, not "
+         "run time — route it through static.control_flow (cond/while_loop) "
+         "or jax.lax.cond/while_loop; shape/dtype tests are static and fine."),
+    Rule("R3", "warning", "retrace hazard in jit signature",
+         "string-valued parameters retrace (or fail) per value — list them "
+         "in static_argnames/static_argnums; static args must have hashable "
+         "defaults (no list/dict/set)."),
+    Rule("R4", "warning", "per-item H2D transfer in feed loop",
+         "one device_put/jnp.asarray per dict entry dispatches one transfer "
+         "per leaf (the regression class PR 2 eliminated) — build the host "
+         "pytree first and issue ONE jax.device_put over it."),
+    Rule("R5", "warning", "host sync in hot path",
+         "block_until_ready()/.numpy()/np-reductions on step outputs force "
+         "a device sync every iteration and stall the async dispatch "
+         "pipeline — defer materialization (deferred gauges, periodic "
+         "fetch) or move the reduction into the jitted program."),
+    Rule("R6", "warning", "Python state mutation under trace",
+         "mutating closed-over state (self.x = .., list.append, dict[k] = "
+         "..) inside a jitted function runs ONCE at trace time and may "
+         "leak tracers — return new values instead, or compute outside."),
+    Rule("R7", "warning", "float64 on TPU",
+         "TPU hardware has no f64 units: float64 arrays are silently "
+         "computed as float32 there, so x64-on CPU runs diverge from TPU "
+         "— use jnp.float32 (or int dtypes for index math / host-side np "
+         "for true f64) so both backends agree."),
+    Rule("R8", "error", "telemetry call under trace",
+         "Telemetry counters/gauges inside a jitted body execute only at "
+         "trace time (silent no-op per step) — record metrics outside the "
+         "jitted function, on its inputs/outputs."),
+]}
+
+# R1: direct concretizers --------------------------------------------------
+_CONCRETIZE_BUILTINS = {"float", "int", "bool", "complex"}
+_CONCRETIZE_METHODS = {"numpy", "item", "tolist", "__array__"}
+_NP_HOST_CALLS = {"asarray", "array", "sum", "mean", "prod", "max", "min",
+                  "any", "all", "median", "percentile"}
+
+# R5: step-result detection
+_STEP_ATTRS = {"train_batch", "eval_batch", "run_steps"}
+_TELEMETRY_METHODS = {"counter", "gauge", "observe", "observe_interval",
+                      "timer", "to_jsonl"}
+_TELEMETRY_BASES = {"tel", "telemetry", "_telemetry"}
+
+
+def _np_call(node: ast.Call):
+    """('np'|'jnp', method) for numpy/jax.numpy module calls, else None."""
+    d = dotted(node.func)
+    if not d:
+        return None
+    parts = d.split(".")
+    if parts[0] in ("np", "numpy") and len(parts) == 2:
+        return "np", parts[1]
+    if parts[0] in ("jnp",) and len(parts) == 2:
+        return "jnp", parts[1]
+    if d.startswith("jax.numpy.") and len(parts) == 3:
+        return "jnp", parts[2]
+    return None
+
+
+def _is_steplike_call(node: ast.Call) -> bool:
+    """A call that runs one jitted training/eval step."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id == "step" or f.id.endswith("_step")
+    if isinstance(f, ast.Attribute):
+        return (f.attr in _STEP_ATTRS or f.attr.endswith("_step")
+                or f.attr in ("_jitted", "_jitted_multi"))
+    return False
+
+
+# -- event dispatchers ------------------------------------------------------
+
+def check_call(a, node: ast.Call) -> None:
+    name = call_name(node)
+    npc = _np_call(node)
+
+    if a.in_traced():
+        check_mutating_call(a, node)  # R6 via .append()/.update()/...
+        # R1 — concretizing a traced value (bare-builtin calls only:
+        # jax.lax.complex's terminal name is also "complex")
+        if isinstance(node.func, ast.Name) and name in _CONCRETIZE_BUILTINS \
+                and node.args \
+                and any(a.tainted(arg) for arg in node.args):
+            a.emit("R1", node,
+                   f"{name}() concretizes a traced value inside a "
+                   f"jit-traced function")
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _CONCRETIZE_METHODS \
+                and a.tainted(node.func.value):
+            a.emit("R1", node,
+                   f".{node.func.attr}() concretizes a traced value inside "
+                   f"a jit-traced function")
+        elif npc and npc[0] == "np" and npc[1] in ("asarray", "array") \
+                and any(a.tainted(arg) for arg in node.args):
+            a.emit("R1", node,
+                   f"np.{npc[1]}() materializes a traced value on the host "
+                   f"inside a jit-traced function")
+        # R5(a) — host-side work baked into the trace
+        elif npc and npc[0] == "np" and npc[1] in _NP_HOST_CALLS \
+                and any(a.tainted(arg) for arg in node.args):
+            a.emit("R5", node,
+                   f"np.{npc[1]}() on a traced value runs on the host at "
+                   f"trace time — use jnp.{npc[1]} so it stays in the "
+                   f"compiled program")
+        elif name == "print" and (any(a.tainted(arg) for arg in node.args)
+                                  or not node.args):
+            a.emit("R5", node,
+                   "print() inside a jit-traced function executes at trace "
+                   "time only (once), not per step — use jax.debug.print "
+                   "or log outside the step")
+        # R8 — telemetry no-ops under trace
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _TELEMETRY_METHODS:
+            base = node.func.value
+            base_name = base.id if isinstance(base, ast.Name) else (
+                base.attr if isinstance(base, ast.Attribute) else None)
+            if base_name in _TELEMETRY_BASES or (
+                    isinstance(base, ast.Call)
+                    and call_name(base) == "get_telemetry"):
+                a.emit("R8", node,
+                       f"Telemetry.{node.func.attr}() inside a jit-traced "
+                       f"function records only at trace time")
+        elif name == "get_telemetry":
+            a.emit("R8", node,
+                   "get_telemetry() inside a jit-traced function — any "
+                   "metric recorded here is a silent per-step no-op")
+        return
+
+    # outside traced code ---------------------------------------------------
+    # R4 — per-item H2D transfers in a feed/batch loop
+    d = dotted(node.func)
+    if a.in_feedish_loop():
+        if d in ("jax.device_put", "device_put") or name == "to_tensor" \
+                or (npc and npc[0] == "jnp" and npc[1] in ("asarray", "array")):
+            a.emit("R4", node,
+                   f"{d or name}() issues one H2D transfer per loop "
+                   f"iteration over a feed/batch dict")
+    # R5(b) — explicit device syncs in hot loops / on step results
+    if isinstance(node.func, ast.Attribute):
+        if node.func.attr == "block_until_ready" and a.in_loop():
+            a.emit("R5", node,
+                   ".block_until_ready() inside a loop forces a device "
+                   "sync per iteration")
+        elif node.func.attr == "numpy" and a.scope is not None \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in a.scope.step_results:
+            a.emit("R5", node,
+                   f".numpy() on '{node.func.value.id}' (a jitted step "
+                   f"result) blocks on the device every step")
+    elif name in ("float", "int") and node.args \
+            and a.scope is not None:
+        arg = node.args[0]
+        if isinstance(arg, ast.Name) and arg.id in a.scope.step_results:
+            a.emit("R5", node,
+                   f"{name}() on '{arg.id}' (a jitted step result) blocks "
+                   f"on the device every step")
+
+    _check_float64_call(a, node)
+
+
+def _check_float64_call(a, node: ast.Call) -> None:
+    """R7 via dtype= kwargs/astype with a 'float64'/'double' string."""
+    for kw in node.keywords:
+        if kw.arg == "dtype" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value in ("float64", "double"):
+            a.emit("R7", node,
+                   f"dtype={kw.value.value!r} creates a float64 array")
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in ("astype", "cast") and node.args:
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and arg.value in ("float64",
+                                                           "double"):
+            a.emit("R7", node,
+                   f".{node.func.attr}({arg.value!r}) casts to float64")
+
+
+def check_attribute(a, node: ast.Attribute) -> None:
+    # R7 — jnp.float64 anywhere (silently f32 with x64 off); np.float64
+    # only under trace (host-side numpy float64 is legitimate)
+    if node.attr != "float64":
+        return
+    d = dotted(node)
+    if d in ("jnp.float64", "jax.numpy.float64"):
+        a.emit("R7", node, "jnp.float64 is silently computed as float32 "
+                           "on TPU hardware")
+    elif d in ("np.float64", "numpy.float64") and a.in_traced():
+        # only as a dtype ARGUMENT — `x.dtype == np.float64` comparisons
+        # are legitimate host-side dtype probing
+        parent = a._parents.get(node)
+        is_dtype_arg = (isinstance(parent, ast.Call) and node in parent.args) \
+            or (isinstance(parent, ast.keyword) and parent.arg == "dtype")
+        if is_dtype_arg:
+            a.emit("R7", node, "np.float64 inside a jit-traced function "
+                               "requests an x64 dtype TPU will not honor")
+
+
+def _static_truthiness(a, test) -> bool:
+    """`if rest:` on a *args tuple (or a slice of one) tests Python tuple
+    emptiness — static under trace, not data-dependent."""
+    while isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        test = test.operand
+    return (isinstance(test, ast.Name) and a.scope is not None
+            and test.id in a.scope.py_tuples)
+
+
+def check_branch(a, node, kind: str) -> None:
+    """R2 — Python branching on traced values inside a traced body."""
+    if not a.in_traced():
+        return
+    if _static_truthiness(a, node.test):
+        return
+    if a.tainted(node.test):
+        stmt = {"if": "if", "while": "while", "assert": "assert"}[kind]
+        a.emit("R2", node,
+               f"`{stmt}` on a traced value inside a jit-traced function "
+               f"branches at trace time")
+
+
+def check_assign(a, node: ast.Assign) -> None:
+    # R5 bookkeeping: remember names holding jitted-step outputs
+    if a.scope is not None and isinstance(node.value, ast.Call) \
+            and _is_steplike_call(node.value):
+        for t in node.targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    a.scope.step_results.add(n.id)
+    # R6 — assignment mutating non-local state under trace
+    if not a.in_traced():
+        return
+    for t in node.targets:
+        _check_mutation_target(a, t)
+
+
+def check_augassign(a, node: ast.AugAssign) -> None:
+    if not a.in_traced():
+        return
+    _check_mutation_target(a, node.target, aug=True)
+
+
+def _check_mutation_target(a, target, aug=False) -> None:
+    """R6: writing through an attribute/subscript whose base is not a
+    local of the traced function mutates Python state at trace time."""
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            _check_mutation_target(a, e, aug)
+        return
+    base = None
+    if isinstance(target, ast.Attribute):
+        base = target.value
+    elif isinstance(target, ast.Subscript):
+        base = target.value
+    elif aug and isinstance(target, ast.Name) \
+            and target.id not in a.scope.locals:
+        a.emit("R6", target,
+               f"augmented assignment to closed-over '{target.id}' inside "
+               f"a jit-traced function mutates state at trace time")
+        return
+    if base is None:
+        return
+    root = base
+    while isinstance(root, (ast.Attribute, ast.Subscript)):
+        root = root.value
+    if isinstance(root, ast.Name):
+        if root.id == "self" or root.id not in a.scope.locals:
+            a.emit("R6", target,
+                   f"writing to '{root.id}.{getattr(target, 'attr', '[..]')}'"
+                   f" inside a jit-traced function mutates closed-over "
+                   f"Python state at trace time"
+                   if isinstance(target, ast.Attribute) else
+                   f"subscript write into closed-over '{root.id}' inside a "
+                   f"jit-traced function mutates state at trace time")
+
+
+_MUTATING_METHODS = {"append", "extend", "insert", "add", "update",
+                     "setdefault", "pop", "clear", "remove"}
+
+
+def check_mutating_call(a, node: ast.Call) -> None:
+    """R6 via mutating method calls on closed-over containers."""
+    if not a.in_traced():
+        return
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _MUTATING_METHODS \
+            and isinstance(f.value, ast.Name) \
+            and f.value.id not in a.scope.locals:
+        a.emit("R6", node,
+               f"'{f.value.id}.{f.attr}()' inside a jit-traced function "
+               f"mutates closed-over Python state at trace time")
+
+
+def check_wrap_site(a, site: dict) -> None:
+    """R3 — signature hazards at a jit wrap site (call or decorator)."""
+    fn = site["fn"]
+    if isinstance(fn, ast.Lambda):
+        return
+    call, nums, names = site["call"], site["static_argnums"], \
+        site["static_argnames"]
+    args = fn.args
+    params = args.posonlyargs + args.args
+    defaults = args.defaults
+    # map trailing defaults onto params
+    pad = [None] * (len(params) - len(defaults))
+    p_defaults = pad + list(defaults)
+    for idx, (p, default) in enumerate(zip(params, p_defaults)):
+        if p.arg in ("self", "cls"):
+            continue
+        # static_argnums count the unbound function's positions (a
+        # leading self/cls occupies index 0 — JAX's convention)
+        is_static = idx in nums or p.arg in names
+        if default is not None and isinstance(default, ast.Constant) \
+                and isinstance(default.value, str) and not is_static:
+            a.emit("R3", call,
+                   f"jit-wrapped '{fn.name}' takes string parameter "
+                   f"'{p.arg}' without marking it static — every distinct "
+                   f"value fails (or retraces) at trace time")
+        if is_static and isinstance(default, (ast.List, ast.Dict, ast.Set)):
+            a.emit("R3", call,
+                   f"static parameter '{p.arg}' of jit-wrapped '{fn.name}' "
+                   f"has a non-hashable default — jit's cache key will "
+                   f"raise TypeError")
+    for p, default in zip(args.kwonlyargs, args.kw_defaults):
+        is_static = p.arg in names
+        if default is not None and isinstance(default, ast.Constant) \
+                and isinstance(default.value, str) and not is_static:
+            a.emit("R3", call,
+                   f"jit-wrapped '{fn.name}' takes string parameter "
+                   f"'{p.arg}' without marking it static — every distinct "
+                   f"value fails (or retraces) at trace time")
+        if is_static and isinstance(default, (ast.List, ast.Dict, ast.Set)):
+            a.emit("R3", call,
+                   f"static parameter '{p.arg}' of jit-wrapped '{fn.name}' "
+                   f"has a non-hashable default — jit's cache key will "
+                   f"raise TypeError")

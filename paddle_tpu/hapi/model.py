@@ -68,6 +68,10 @@ class Model:
                 res = m.update(m.compute(outs, *labels)) if labels else None
                 metrics_out.append(res)
             step.refresh_from_layer()
+        # train_batch's contract (reference hapi Model.train_batch) returns a
+        # host float per call — the sync is the API, not an accident; fit()
+        # users who need async steps go through prefetch_depth + callbacks
+        # tpu-lint: disable-next=R5
         return (float(loss.numpy()), metrics_out) if metrics_out else float(loss.numpy())
 
     def eval_batch(self, inputs, labels=None):

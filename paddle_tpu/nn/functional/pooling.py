@@ -207,7 +207,10 @@ def _max_pool_indices(x, kernel, stride, padding, n, data_format):
 
     def f(a):
         spatial = a.shape[2:]
-        flat_idx = jnp.arange(int(np.prod(spatial)), dtype=jnp.float64).reshape(spatial)
+        # int32 indices: exact to 2^31 elements and TPU-native — float
+        # carriers are either inexact past 2^24 (f32) or silently
+        # degraded to f32 on TPU hardware (f64; tpu-lint R7)
+        flat_idx = jnp.arange(int(np.prod(spatial)), dtype=jnp.int32).reshape(spatial)
         flat_idx = jnp.broadcast_to(flat_idx, a.shape)
         window = (1, 1) + kernel_t
         strides = (1, 1) + stride_t
@@ -220,7 +223,7 @@ def _max_pool_indices(x, kernel, stride, padding, n, data_format):
             return jnp.where(take_cur, cv, av), jnp.where(take_cur, ci, ai)
 
         init_v = jnp.asarray(-jnp.inf, a.dtype)
-        init_i = jnp.asarray(-1.0, jnp.float64)
+        init_i = jnp.asarray(-1, jnp.int32)
         vals, idxs = jax.lax.reduce_window(
             (a, flat_idx), (init_v, init_i),
             lambda xa, xb: reducer((xa[0], xa[1]), (xb[0], xb[1])),

@@ -100,8 +100,9 @@ class RetraceTracker:
                     "jitted function %r compiled %d times (threshold %d) — "
                     "an input shape/dtype is drifting call-to-call and every "
                     "drift pays a full XLA retrace+compile; pad or bucket "
-                    "the offending input (warning rate-limited to one per "
-                    "%.0f s)", self.name, self.compiles, threshold,
+                    "the offending input [tpu-lint R3: tools/tpu_lint.py "
+                    "flags this hazard statically] (warning rate-limited "
+                    "to one per %.0f s)", self.name, self.compiles, threshold,
                     _WARN_EVERY_S)
 
 

@@ -60,8 +60,11 @@ def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
     qq = q._value if isinstance(q, Tensor) else jnp.asarray(q)
 
     def f(a):
+        # keep an f64 input's own precision (x64-on CPU runs), promote
+        # everything else to f32 — without ever CREATING f64, which TPU
+        # hardware silently computes as f32 (tpu-lint R7)
         return jnp.quantile(
-            a.astype(jnp.float64 if a.dtype == np.float64 else jnp.float32),
+            a.astype(a.dtype if a.dtype == np.float64 else jnp.float32),
             qq, axis=_ax(axis), keepdims=keepdim, method=interpolation,
         )
 

@@ -1,0 +1,281 @@
+"""tpu-lint static analyzer tests: golden per-rule fixtures, suppression
+syntax, the baseline ratchet, the shared tools/_gate.py conventions, and
+the end-to-end self-run gate over paddle_tpu/."""
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(__file__), "tpu_lint_fixtures")
+LINT = os.path.join(REPO, "tools", "tpu_lint.py")
+BASELINE = os.path.join(REPO, "tools", "tpu_lint_baseline.json")
+
+from paddle_tpu.analysis import (  # noqa: E402
+    RULES,
+    analyze_source,
+    compare,
+    make_baseline,
+    parse_suppressions,
+    render_json,
+    save_baseline,
+)
+
+_FIXTURE_FILES = sorted(
+    f for f in os.listdir(FIXTURES) if f.endswith(".py"))
+
+
+def _read(name):
+    with open(os.path.join(FIXTURES, name)) as f:
+        return f.read()
+
+
+def _expected(src):
+    """{(line, rule)} from '# EXPECT: R1[, R2]' fixture annotations."""
+    out = set()
+    for lineno, line in enumerate(src.splitlines(), 1):
+        m = re.search(r"#\s*EXPECT:\s*([A-Z0-9, ]+)", line)
+        if m:
+            out.update((lineno, r.strip()) for r in m.group(1).split(","))
+    return out
+
+
+class TestRuleFixtures:
+    """Golden check per rule: every EXPECT-annotated line must flag with
+    exactly that rule, and every unannotated line must stay clean — the
+    negative cases ride in the same file."""
+
+    @pytest.mark.parametrize("name", _FIXTURE_FILES)
+    def test_fixture_golden(self, name):
+        src = _read(name)
+        expected = _expected(src)
+        assert expected, f"fixture {name} has no EXPECT annotations"
+        got = {(f.line, f.rule) for f in analyze_source(name, src)}
+        assert got == expected, (
+            f"{name}: missing={sorted(expected - got)} "
+            f"unexpected={sorted(got - expected)}")
+
+    def test_all_eight_rules_covered(self):
+        covered = set()
+        for name in _FIXTURE_FILES:
+            covered.update(r for _, r in _expected(_read(name)))
+        assert covered == set(RULES) == {f"R{i}" for i in range(1, 9)}
+
+    def test_findings_carry_location_and_hint(self):
+        findings = analyze_source("r1.py", _read("r1_concretize.py"))
+        assert findings
+        for f in findings:
+            assert f.path == "r1.py" and f.line > 0
+            assert f.rule in RULES and f.severity == RULES[f.rule].severity
+            assert f.hint and f.context  # fix hint + enclosing function
+        assert any(f.context == "bad" for f in findings)
+
+
+class TestSuppression:
+    SRC = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    a = float(x)  # tpu-lint: disable=R1 -- host constant wanted\n"
+        "    # tpu-lint: disable-next=R1\n"
+        "    b = int(x)\n"
+        "    c = bool(x)\n"
+        "    return a, b, c\n"
+    )
+
+    def test_inline_and_next_line_disable(self):
+        findings = analyze_source("s.py", self.SRC)
+        assert [(f.line, f.rule) for f in findings] == [(7, "R1")]
+
+    def test_parse_suppressions(self):
+        supp = parse_suppressions("x = 1  # tpu-lint: disable=R1,R5\n"
+                                  "# tpu-lint: disable-next=all\n"
+                                  "y = 2\n")
+        assert supp == {1: {"R1", "R5"}, 3: {"all"}}
+
+
+class TestBaselineRatchet:
+    def _findings(self):
+        return analyze_source("r1_concretize.py", _read("r1_concretize.py"))
+
+    def test_baselined_findings_pass(self):
+        findings = self._findings()
+        base = make_baseline(findings)
+        new, stale, n_base = compare(findings, base)
+        assert new == [] and stale == [] and n_base == len(findings)
+
+    def test_new_finding_fails(self):
+        findings = self._findings()
+        base = make_baseline(findings)
+        extra = analyze_source("r2_control_flow.py",
+                               _read("r2_control_flow.py"))
+        new, _, _ = compare(findings + extra, base)
+        assert {f.rule for f in new} == {"R2"}
+        # and a count regression within a baselined context also fails:
+        # the whole group resurfaces when it exceeds its budget
+        grown = findings + [findings[0]]
+        new2, _, _ = compare(grown, base)
+        assert findings[0].key() in {f.key() for f in new2}
+
+    def test_fixed_finding_flags_stale_entry(self):
+        findings = self._findings()
+        base = make_baseline(findings)
+        fixed_key = findings[0].key()
+        remaining = [f for f in findings if f.key() != fixed_key]
+        new, stale, _ = compare(remaining, base)
+        assert new == []
+        assert [(s["file"], s["rule"], s["context"]) for s in stale] == [
+            fixed_key]
+
+    def test_roundtrip_via_disk(self, tmp_path):
+        findings = self._findings()
+        p = tmp_path / "base.json"
+        save_baseline(str(p), make_baseline(findings))
+        from paddle_tpu.analysis import load_baseline
+
+        new, stale, n = compare(findings, load_baseline(str(p)))
+        assert new == [] and stale == [] and n == len(findings)
+
+
+def _run_lint(*argv):
+    proc = subprocess.run(
+        [sys.executable, LINT, *argv], cwd=REPO, capture_output=True,
+        text=True, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    return proc
+
+
+class TestCLI:
+    def test_hazard_file_fails_clean_file_passes(self, tmp_path):
+        clean = tmp_path / "clean.py"
+        clean.write_text("import jax.numpy as jnp\n\n"
+                         "def f(x):\n    return jnp.sum(x)\n")
+        assert _run_lint(str(clean)).returncode == 0
+        proc = _run_lint(os.path.join(FIXTURES, "r1_concretize.py"))
+        assert proc.returncode == 1
+        assert "R1" in proc.stderr and "FAIL" in proc.stdout + proc.stderr
+
+    def test_rule_selection_and_json(self):
+        fixture = os.path.join(FIXTURES, "r2_control_flow.py")
+        proc = _run_lint(fixture, "--rules", "R1", "--json")
+        out = json.loads(proc.stdout)
+        assert proc.returncode == 0 and out["status"] == "OK"
+        proc = _run_lint(fixture, "--rules", "R2", "--json")
+        out = json.loads(proc.stdout)
+        assert proc.returncode == 1 and out["status"] == "FAIL"
+        assert out["by_rule"] == {"R2": 4}
+        assert all(f["rule"] == "R2" for f in out["findings"])
+
+    def test_update_baseline_then_gate_passes(self, tmp_path):
+        fixture = os.path.join(FIXTURES, "r4_transfer_loop.py")
+        base = tmp_path / "b.json"
+        assert _run_lint(fixture, "--update-baseline",
+                         str(base)).returncode == 0
+        assert _run_lint(fixture, "--baseline",
+                         str(base)).returncode == 0
+        # a clean tree against that baseline reports the entries stale
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        proc = _run_lint(str(clean), "--baseline", str(base))
+        assert proc.returncode == 0 and "stale" in proc.stderr
+
+    def test_list_rules(self):
+        proc = _run_lint("--list-rules")
+        assert proc.returncode == 0
+        for rid in RULES:
+            assert rid in proc.stdout
+
+
+class TestSelfRun:
+    """The acceptance gate: the framework lints clean vs the committed
+    baseline, and the baseline holds no stale (already-fixed) debt."""
+
+    def test_paddle_tpu_clean_against_committed_baseline(self):
+        proc = _run_lint("paddle_tpu", "--baseline", BASELINE, "--json")
+        out = json.loads(proc.stdout)
+        assert proc.returncode == 0, proc.stderr
+        assert out["status"] == "OK"
+        assert out["findings"] == []  # zero un-baselined findings
+        assert out["stale_baseline_entries"] == []
+
+    def test_render_json_shape(self):
+        findings = analyze_source("r5.py", _read("r5_host_sync.py"))
+        payload = render_json(findings, stale=[], n_baselined=2)
+        assert payload["baselined"] == 2
+        assert sum(payload["by_rule"].values()) == len(findings)
+        for f in payload["findings"]:
+            assert {"rule", "severity", "path", "line", "message",
+                    "hint", "context"} <= set(f)
+
+
+class TestSharedGate:
+    def test_finish_conventions(self, capsys):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            from _gate import finish
+        finally:
+            sys.path.pop(0)
+        assert finish("g", True, "fine") == 0
+        assert finish("g", False, "broken") == 1
+        out = capsys.readouterr()
+        assert "g: OK — fine" in out.out
+        assert "g: FAIL — broken" in out.err
+
+    def test_finish_json_payload(self, capsys):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            from _gate import finish
+        finally:
+            sys.path.pop(0)
+        assert finish("g", False, "d", payload={"k": 1}, json_mode=True) == 1
+        obj = json.loads(capsys.readouterr().out)
+        assert obj == {"gate": "g", "status": "FAIL", "detail": "d", "k": 1}
+
+    def test_retrace_budget_gate_ported(self, tmp_path, capsys):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import check_retrace_budget as gate
+        finally:
+            sys.path.pop(0)
+        p = tmp_path / "t.jsonl"
+        p.write_text(json.dumps(
+            {"ts": 1.0, "step": 0, "tag": "b",
+             "scalars": {"counter/compile/jit.train_step": 9}}) + "\n")
+        assert gate.main([str(p), "--budget", "9"]) == 0
+        assert gate.main([str(p), "--budget", "3"]) == 1  # uniform 0/1 now
+        capsys.readouterr()
+        assert gate.main([str(p), "--budget", "3", "--json"]) == 1
+        obj = json.loads(capsys.readouterr().out)
+        assert obj["gate"] == "retrace budget" and obj["status"] == "FAIL"
+        assert obj["over"] == {"compile/jit.train_step": 9}
+        # the runtime warning cross-references the static rule id
+        assert "tpu-lint R3" in obj["detail"]
+
+    def test_telemetry_schema_gate_ported(self, tmp_path, capsys):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import importlib
+            import check_telemetry_schema as schema
+            importlib.reload(schema)
+        finally:
+            sys.path.pop(0)
+        good = tmp_path / "g.jsonl"
+        good.write_text(json.dumps(
+            {"ts": 1.0, "step": None, "tag": "t",
+             "scalars": {"a": 1}}) + "\n")
+        assert schema.main([str(good)]) == 0
+        capsys.readouterr()
+        assert schema.main([str(good), "--json"]) == 0
+        obj = json.loads(capsys.readouterr().out)
+        assert obj["gate"] == "telemetry schema" and obj["records"] == 1
+
+    def test_retrace_warning_names_lint_rule(self):
+        # satellite: tracked_jit's runtime retrace warning points at the
+        # static finding (R3) so the two surfaces cross-reference
+        import inspect
+
+        from paddle_tpu.profiler import retrace
+
+        assert "tpu-lint R3" in inspect.getsource(retrace.RetraceTracker)

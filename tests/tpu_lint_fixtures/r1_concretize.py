@@ -1,0 +1,51 @@
+"""R1 fixture: tracer concretization. Lines marked EXPECT must flag;
+every other line must stay clean (negative cases)."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad(x, y):
+    a = float(x)                       # EXPECT: R1
+    b = int(x + y)                     # EXPECT: R1
+    c = bool(x > 0)                    # EXPECT: R1
+    d = np.asarray(x)                  # EXPECT: R1
+    e = x.numpy()                      # EXPECT: R1
+    f = y.item()                       # EXPECT: R1
+    g = y.tolist()                     # EXPECT: R1
+    return a, b, c, d, e, f, g
+
+
+@partial(jax.jit, static_argnums=(1,))
+def good_static(x, n):
+    k = float(n)            # static arg: concrete at trace time
+    m = int(x.shape[0])     # shapes are static under jit
+    return x * k + m
+
+
+@jax.jit
+def good_lax(x):
+    z = jax.lax.complex(x, x)   # jax.lax.complex is not builtins.complex
+    cfg = float(jnp.pi)         # module constant, not a traced value
+    return z, cfg
+
+
+def eager(x):
+    # not jit-traced: concretization is fine in eager mode
+    return float(np.asarray(x).sum())
+
+
+class Stepper:
+    # static_argnums count the UNBOUND function's positions: self is
+    # index 0 (JAX's convention), so (1,) marks `mode` static
+    @partial(jax.jit, static_argnums=(1,))
+    def good_method(self, mode, x):
+        k = float(mode)        # mode is static: concrete at trace time
+        return x * k
+
+    @partial(jax.jit, static_argnums=(1,))
+    def bad_method(self, mode, x):
+        return int(x)                  # EXPECT: R1

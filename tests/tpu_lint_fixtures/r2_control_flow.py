@@ -1,0 +1,40 @@
+"""R2 fixture: data-dependent Python control flow under trace."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad(x, y):
+    if x > 0:                          # EXPECT: R2
+        y = y + 1
+    while y.sum() > 0:                 # EXPECT: R2
+        y = y - 1
+    assert x.mean() < 1e6              # EXPECT: R2
+    z = x + y
+    if (z * 2).max() > 0:              # EXPECT: R2
+        z = -z
+    return z
+
+
+@jax.jit
+def good(x, *rest):
+    if x.shape[0] > 2:        # shape test: static under jit
+        x = x * 2
+    if x.dtype == jnp.float32:
+        x = x.astype(jnp.bfloat16)
+    if isinstance(x, tuple):  # type probing is static
+        x = x[0]
+    if x is None:             # identity test is Python-level
+        return 0
+    if rest:                  # *args emptiness is a static tuple test
+        x = x + rest[0]
+    tail = rest[1:]
+    if tail:                  # slices of *args stay Python tuples
+        x = x + tail[0]
+    return x
+
+
+def eager(x):
+    if x > 0:  # eager define-by-run branching is legal
+        return -x
+    return x

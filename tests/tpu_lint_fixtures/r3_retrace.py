@@ -1,0 +1,43 @@
+"""R3 fixture: retrace hazards in jit signatures (string params without
+static markers, non-hashable defaults in static positions)."""
+from functools import partial
+
+import jax
+
+from paddle_tpu.profiler.retrace import tracked_jit
+
+
+@jax.jit
+def bad_string_arg(x, mode="train"):   # EXPECT: R3
+    return x if mode == "train" else -x
+
+
+@partial(jax.jit, static_argnums=(1,))  # EXPECT: R3
+def bad_static_default(x, opts=[]):
+    return x
+
+
+def step_fn(params, batch, reduction="mean"):
+    return params, batch
+
+
+jitted = tracked_jit(step_fn, name="step")   # EXPECT: R3
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def good_static_string(x, mode="train"):
+    return x if mode == "train" else -x
+
+
+def other_step(params, batch, reduction="mean"):
+    return params, batch
+
+
+good_wrap = tracked_jit(other_step, static_argnames=("reduction",))
+
+
+@jax.jit
+def good_scalars(x, lr=0.1, steps=4):
+    # Python int/float args trace as dynamic weak scalars: new VALUES do
+    # not retrace, so they need no static marker
+    return x * lr + steps

@@ -1,0 +1,31 @@
+"""R4 fixture: per-item H2D transfers inside feed/batch loops."""
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import to_tensor
+
+
+def bad(feed):
+    out = {}
+    for name, v in feed.items():
+        out[name] = jax.device_put(v)            # EXPECT: R4
+    for name in feed:
+        out[name] = jnp.asarray(feed[name])      # EXPECT: R4
+    tensors = []
+    for batch in feed.values():
+        tensors.append(to_tensor(batch))         # EXPECT: R4
+    return out, tensors
+
+
+def good(feed):
+    host = {k: v for k, v in feed.items()}
+    return jax.device_put(host)   # ONE pytree transfer
+
+
+def good_not_feed(configs):
+    # loop is not over a feed/batch dict: construction-time transfers
+    # (e.g. staging parameters once at init) are not the hot-loop hazard
+    out = []
+    for c in configs:
+        out.append(jnp.asarray(c))
+    return out

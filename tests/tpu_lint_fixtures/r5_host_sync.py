@@ -1,0 +1,31 @@
+"""R5 fixture: host syncs in hot paths (step-result materialization in
+loops, block_until_ready per iteration, host work baked into a trace)."""
+import jax
+import numpy as np
+
+
+def bad_loop(step, batches):
+    for b in batches:
+        loss = step(b)
+        v = float(loss)                    # EXPECT: R5
+        w = loss.numpy()                   # EXPECT: R5
+        loss.block_until_ready()           # EXPECT: R5
+        yield v, w
+
+
+@jax.jit
+def bad_traced(x):
+    print("tracing", x)                    # EXPECT: R5
+    s = np.sum(x)                          # EXPECT: R5
+    return s
+
+
+def good(step, batches):
+    # deferred materialization: keep device arrays, sync once at the end
+    losses = [step(b) for b in batches]
+    return [float(v) for v in losses]
+
+
+def good_warmup(x):
+    # a single sync outside any loop is a legitimate warmup/timing fence
+    return (x @ x).block_until_ready()

@@ -1,0 +1,42 @@
+"""R6 fixture: mutation of closed-over Python state under trace."""
+import jax
+
+LOG = []
+COUNTER = 0
+
+
+class Engine:
+    def build(self, stats):
+        @jax.jit
+        def step(x):
+            self.cache = x                 # EXPECT: R6
+            LOG.append(x)                  # EXPECT: R6
+            stats["last"] = x              # EXPECT: R6
+            return x * 2
+
+        return step
+
+
+@jax.jit
+def bad_global(x):
+    global COUNTER
+    COUNTER += 1                           # EXPECT: R6
+    return x
+
+
+@jax.jit
+def good(x):
+    acc = []
+    acc.append(x)      # local container: rebuilt every trace, harmless
+    d = {}
+    d["k"] = x
+    y = x * 2
+    y += 1             # local augmented assign
+    return acc, d, y
+
+
+def eager_mutation(model, x):
+    # outside jit: imperative mutation is the normal eager idiom
+    model.cache = x
+    LOG.append(x)
+    return x

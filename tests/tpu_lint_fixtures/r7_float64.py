@@ -1,0 +1,25 @@
+"""R7 fixture: float64 creation (TPU hardware computes f64 as f32, so
+x64-on CPU runs silently diverge from TPU results)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bad():
+    a = jnp.float64(3.0)                   # EXPECT: R7
+    b = jnp.zeros(3, dtype="float64")      # EXPECT: R7
+    c = np.ones(4).astype("double")        # EXPECT: R7
+    return a, b, c
+
+
+@jax.jit
+def bad_traced(x):
+    return x.astype(np.float64)            # EXPECT: R7
+
+
+def good(vals):
+    h = np.asarray(vals, np.float64)   # host-side numpy f64 is fine
+    f32 = jnp.zeros(3, jnp.float32)
+    if h.dtype == np.float64:          # dtype probing is not creation
+        h = h.astype(np.float32)
+    return h, f32

@@ -1,0 +1,22 @@
+"""R8 fixture: Telemetry calls under trace (silent per-step no-ops)."""
+import jax
+
+from paddle_tpu.profiler.telemetry import get_telemetry
+
+tel = get_telemetry()
+
+
+@jax.jit
+def bad(x):
+    tel.counter("engine/steps")            # EXPECT: R8
+    tel.observe("step_ms", 1.0)            # EXPECT: R8
+    get_telemetry().gauge("loss", x)       # EXPECT: R8
+    return x * 2
+
+
+def good(step, x):
+    # record metrics OUTSIDE the jitted function, on its inputs/outputs
+    out = step(x)
+    tel.counter("engine/steps")
+    tel.gauge("loss", out)   # deferred-coercion gauge: no sync either
+    return out

@@ -1,0 +1,53 @@
+"""Shared conventions for the repo's CI gate scripts.
+
+Every checker under tools/ that gates CI (telemetry schema, retrace
+budget, tpu-lint) historically invented its own summary line and exit
+codes. This helper pins ONE convention so bench_ritual.sh and humans can
+treat them interchangeably:
+
+- summary line: ``<gate>: OK — <detail>`` or ``<gate>: FAIL — <detail>``
+  (OK to stdout, FAIL to stderr);
+- exit code: 0 on pass, 1 on any failure (including unreadable input);
+- ``--json``: machine-readable result object on stdout instead of the
+  summary line: ``{"gate": .., "status": "OK"|"FAIL", "detail": ..}``
+  plus gate-specific payload keys.
+
+Usage::
+
+    ap = argparse.ArgumentParser(...)
+    add_gate_args(ap)                       # installs --json
+    ...
+    return finish("retrace budget", ok, detail,
+                  payload={"peaks": peaks}, json_mode=args.json)
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def add_gate_args(parser):
+    """Install the shared gate flags (currently ``--json``)."""
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit a machine-readable JSON result object instead of the "
+             "one-line summary")
+    return parser
+
+
+def finish(gate, ok, detail, payload=None, json_mode=False,
+           out=None, err=None):
+    """Emit the uniform gate summary and return the exit code (0/1)."""
+    out = out or sys.stdout
+    err = err or sys.stderr
+    status = "OK" if ok else "FAIL"
+    if json_mode:
+        obj = {"gate": gate, "status": status, "detail": detail}
+        if payload:
+            obj.update(payload)
+        json.dump(obj, out, indent=2, sort_keys=True, default=str)
+        out.write("\n")
+    else:
+        stream = out if ok else err
+        print(f"{gate}: {status} — {detail}", file=stream)
+    return 0 if ok else 1

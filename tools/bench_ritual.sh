@@ -18,13 +18,20 @@ python bench_all.py "$@"
 # scalar log bench_all.py wrote against the documented schema (README
 # "Observability") before the perf gate even runs
 python tools/check_telemetry_schema.py TELEMETRY.jsonl
-echo "telemetry schema gate: PASS"
 
 # retrace-budget gate: a bench run whose feed shapes drift recompiles a
 # jitted entry per step (the silent JAX throughput cliff). Each entry's
 # compile counter must stay within budget — shape bucketing
 # (io.ShapeBuckets / DevicePrefetcher) is the fix when this fires.
 python tools/check_retrace_budget.py TELEMETRY.jsonl --budget 6
+
+# tpu-lint gate: the STATIC twin of the retrace-budget gate — AST
+# analysis over the framework for tracer-safety hazards (R1-R8: tracer
+# concretization, data-dependent control flow, retrace signatures,
+# per-leaf H2D loops, host syncs, trace-time mutation, float64,
+# telemetry-under-trace). Ratcheting: pre-existing findings live in the
+# committed baseline and burn down; anything NEW fails the ritual.
+python tools/tpu_lint.py paddle_tpu --baseline tools/tpu_lint_baseline.json
 
 if [ -f BENCH_extra.prev.json ]; then
   # LeNet rides per-step dispatch through the remote-TPU tunnel: the r5

@@ -10,23 +10,34 @@ that failure loud in CI: scan every record in the log, take the MAX value
 each ``counter/compile/*`` scalar ever reached (counters are monotonic,
 so that is the final total), and fail when any entry exceeds the budget.
 
+The same hazard class is caught statically before a step ever runs by
+``tools/tpu_lint.py`` rule R3 (retrace hazards in jit signatures) — this
+gate is the runtime backstop.
+
 Usage:
     python tools/check_retrace_budget.py TELEMETRY.jsonl [--budget 6] \
-        [--ignore compile/executor.forward]
+        [--ignore compile/executor.forward] [--json]
 
 ``--budget`` is the per-entry ceiling (default 6: bench_all's configs
 compile each entry 1-2x per feed signature — with shape bucketing, post-
 warmup compiles per entry stay in single digits by construction).
-``--ignore NAME`` (repeatable) exempts an entry. Exit 0 on pass, 2 on
-budget violation, 1 on a malformed/unreadable log.
+``--ignore NAME`` (repeatable) exempts an entry. Summary line, exit
+codes, and ``--json`` follow the shared gate conventions (tools/_gate.py):
+exit 0 on pass, 1 on budget violation or a malformed/unreadable log.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _gate import add_gate_args, finish  # noqa: E402
+
 PREFIX = "counter/compile/"
+
+GATE = "retrace budget"
 
 
 def collect_compile_counters(path):
@@ -64,24 +75,26 @@ def main(argv=None):
                     help="max compiles allowed per jitted entry (default 6)")
     ap.add_argument("--ignore", action="append", default=[],
                     help="entry name (compile/<fn>) exempt from the budget")
+    add_gate_args(ap)
     args = ap.parse_args(argv)
     try:
         peaks = collect_compile_counters(args.path)
     except (OSError, ValueError) as e:
-        print(f"retrace budget: ERROR — {e}", file=sys.stderr)
-        return 1
+        return finish(GATE, False, str(e), json_mode=args.json)
     over = {k: v for k, v in sorted(peaks.items())
             if v > args.budget and k not in args.ignore}
+    payload = {"budget": args.budget, "peaks": peaks, "over": over}
     if over:
-        for entry, count in over.items():
-            print(f"retrace budget: FAIL — {entry} compiled {count}x "
-                  f"(budget {args.budget}); an input shape/dtype is "
-                  f"drifting — pad or bucket it (io.ShapeBuckets)",
-                  file=sys.stderr)
-        return 2
-    detail = ", ".join(f"{k}={v}" for k, v in sorted(peaks.items())) or "none"
-    print(f"retrace budget: PASS (budget {args.budget}; {detail})")
-    return 0
+        detail = "; ".join(
+            f"{entry} compiled {count}x (budget {args.budget}) — an input "
+            f"shape/dtype is drifting (tpu-lint R3): pad or bucket it "
+            f"(io.ShapeBuckets)" for entry, count in over.items())
+        return finish(GATE, False, detail, payload=payload,
+                      json_mode=args.json)
+    detail = ("budget {}; ".format(args.budget)
+              + (", ".join(f"{k}={v}" for k, v in sorted(peaks.items()))
+                 or "no compile counters"))
+    return finish(GATE, True, detail, payload=payload, json_mode=args.json)
 
 
 if __name__ == "__main__":

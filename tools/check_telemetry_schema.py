@@ -25,7 +25,11 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _gate import add_gate_args, finish  # noqa: E402
 
 
 def validate_record(rec, lineno):
@@ -90,13 +94,16 @@ def main(argv=None):
     ap.add_argument("--require", action="append", default=[],
                     help="scalar name that must appear in >=1 record")
     ap.add_argument("--min-records", type=int, default=1)
+    add_gate_args(ap)
     args = ap.parse_args(argv)
     n, err = validate_file(args.path, args.require, args.min_records)
+    payload = {"records": n, "path": args.path}
     if err:
-        print(f"telemetry schema: FAIL — {err}", file=sys.stderr)
-        return 1
-    print(f"telemetry schema: PASS ({n} records, {args.path})")
-    return 0
+        return finish("telemetry schema", False, err, payload=payload,
+                      json_mode=args.json)
+    return finish("telemetry schema", True,
+                  f"{n} records, {args.path}", payload=payload,
+                  json_mode=args.json)
 
 
 if __name__ == "__main__":

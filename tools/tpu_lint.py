@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""tpu-lint — static tracer-safety & retrace-hazard gate.
+
+Runs the AST analyzer in ``paddle_tpu/analysis`` over the given paths
+and gates on the committed baseline (the Infer-style ratchet: baselined
+findings are tracked debt, NEW findings fail, fixed findings flag the
+baseline stale so the budget only shrinks).
+
+Usage:
+    python tools/tpu_lint.py paddle_tpu --baseline tools/tpu_lint_baseline.json
+    python tools/tpu_lint.py paddle_tpu --update-baseline tools/tpu_lint_baseline.json
+    python tools/tpu_lint.py some/file.py --rules R1,R4 --json
+    python tools/tpu_lint.py --list-rules
+
+Suppression: ``# tpu-lint: disable=R1`` on the offending line (or
+``# tpu-lint: disable-next=R1`` on the line before) with a short
+justification in the same comment.
+
+Exit codes follow tools/_gate.py: 0 clean-vs-baseline, 1 otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+
+sys.path.insert(0, _HERE)
+from _gate import add_gate_args, finish  # noqa: E402
+
+
+def _load_analysis():
+    """Import paddle_tpu/analysis as a standalone package so a lint run
+    never pays (or requires) the full framework/jax import."""
+    pkg_dir = os.path.join(_REPO, "paddle_tpu", "analysis")
+    name = "_tpu_lint_analysis"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def collect_files(paths):
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith((".", "__pycache__")))
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+def relpath(p):
+    rp = os.path.relpath(os.path.abspath(p), _REPO)
+    return rp.replace(os.sep, "/")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="AST tracer-safety / retrace-hazard linter (R1-R8)")
+    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument("--baseline", help="ratchet baseline JSON to gate against")
+    ap.add_argument("--update-baseline", metavar="PATH",
+                    help="write the current findings as the new baseline "
+                         "and exit 0")
+    ap.add_argument("--rules", help="comma-separated rule subset (e.g. R1,R4)")
+    ap.add_argument("--no-hints", action="store_true",
+                    help="omit fix hints from text output")
+    ap.add_argument("--list-rules", action="store_true")
+    add_gate_args(ap)
+    args = ap.parse_args(argv)
+
+    analysis = _load_analysis()
+
+    if args.list_rules:
+        for r in analysis.RULES.values():
+            print(f"{r.id}  {r.severity:<7}  {r.title}")
+        return 0
+    if not args.paths:
+        ap.error("no paths given")
+
+    select = None
+    if args.rules:
+        select = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = select - set(analysis.RULES)
+        if unknown:
+            ap.error(f"unknown rule(s): {sorted(unknown)}")
+
+    try:
+        files = collect_files(args.paths)
+    except FileNotFoundError as e:
+        return finish("tpu-lint", False, f"no such path: {e}",
+                      json_mode=args.json)
+
+    findings = []
+    for path in files:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            findings.extend(analysis.analyze_source(
+                relpath(path), source, select=select))
+        except SyntaxError as e:
+            return finish("tpu-lint", False,
+                          f"cannot parse {relpath(path)}: {e}",
+                          json_mode=args.json)
+
+    if args.update_baseline:
+        analysis.save_baseline(args.update_baseline,
+                               analysis.make_baseline(findings))
+        return finish(
+            "tpu-lint", True,
+            f"baseline written to {args.update_baseline} "
+            f"({len(findings)} finding(s) over {len(files)} files)",
+            json_mode=args.json)
+
+    stale, n_baselined = [], 0
+    if args.baseline:
+        try:
+            base = analysis.load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            return finish("tpu-lint", False, f"bad baseline: {e}",
+                          json_mode=args.json)
+        new, stale, n_baselined = analysis.compare(findings, base)
+    else:
+        new = findings
+
+    detail = analysis.summary_line(len(new), n_baselined, len(stale),
+                                   len(files))
+    if args.json:
+        payload = analysis.render_json(new, stale, n_baselined)
+        return finish("tpu-lint", not new, detail, payload=payload,
+                      json_mode=True)
+    if new:
+        analysis.render_text(new, sys.stderr,
+                             show_hints=not args.no_hints)
+    for e in stale:
+        print(f"tpu-lint: stale baseline entry ({e['file']} {e['rule']} "
+              f"{e['context']}: {e['observed']}/{e['count']} remain) — "
+              f"burned down! regenerate with --update-baseline",
+              file=sys.stderr)
+    return finish("tpu-lint", not new, detail)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
