@@ -70,6 +70,7 @@ from . import text  # noqa: E402
 from . import dataset  # noqa: E402
 from . import utils  # noqa: E402
 from . import profiler  # noqa: E402
+from . import resilience  # noqa: E402
 from . import hapi  # noqa: E402
 from .hapi import Model  # noqa: E402,F401
 from . import inference  # noqa: E402

@@ -10,7 +10,18 @@ import numpy as np
 
 from ..core.tensor import Tensor, no_grad, wrap_raw
 
-__all__ = ["AmpScaler", "GradScaler"]
+__all__ = ["AmpScaler", "GradScaler", "current_loss_scale"]
+
+# last scale any live scaler holds — read by core.sanitizer so a
+# non-finite abort can report the scale in effect without plumbing the
+# scaler through every engine
+_last_scale = None
+
+
+def current_loss_scale():
+    """The most recently set loss scale of any enabled AmpScaler in this
+    process, or None when AMP scaling is not in play."""
+    return _last_scale
 
 
 class AmpScaler:
@@ -19,6 +30,8 @@ class AmpScaler:
                  decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
         self._enable = enable
         self._scale = float(init_loss_scaling)
+        if enable:
+            self._publish_scale()
         self._incr_ratio = incr_ratio
         self._decr_ratio = decr_ratio
         self._incr_every_n_steps = incr_every_n_steps
@@ -68,6 +81,10 @@ class AmpScaler:
         if self._enable:
             self._update()
 
+    def _publish_scale(self):
+        global _last_scale
+        _last_scale = self._scale
+
     def _update(self):
         if not self._dynamic:
             return
@@ -83,6 +100,23 @@ class AmpScaler:
             if self._good_steps >= self._incr_every_n_steps:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
+        self._publish_scale()
+
+    def backoff(self, factor=None, min_scale=1.0):
+        """Out-of-band scale decrease (resilience StepGuard contract):
+        a non-finite COMPILED step was detected outside this scaler's
+        own unscale_ sweep — treat it like a found_inf event: shrink the
+        scale (``factor`` defaults to ``decr_ratio``) and restart the
+        good-step growth clock. A no-op for static scales
+        (``use_dynamic_loss_scaling=False``), same as ``_update``."""
+        if not self._enable or not self._dynamic:
+            return self._scale
+        f = self._decr_ratio if factor is None else float(factor)
+        self._scale = max(self._scale * f, float(min_scale))
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._publish_scale()
+        return self._scale
 
     def is_enable(self):
         return self._enable
@@ -95,6 +129,8 @@ class AmpScaler:
 
     def set_init_loss_scaling(self, v):
         self._scale = float(v)
+        if self._enable:
+            self._publish_scale()
 
     def state_dict(self):
         return {
@@ -108,9 +144,20 @@ class AmpScaler:
         }
 
     def load_state_dict(self, d):
-        self._scale = d.get("scale", self._scale)
-        self._good_steps = d.get("good_steps", 0)
-        self._bad_steps = d.get("bad_steps", 0)
+        # restore EVERY key state_dict() emits — dropping the
+        # incr/decr schedule knobs silently reset a resumed job's
+        # scaling cadence to constructor defaults
+        self._scale = float(d.get("scale", self._scale))
+        self._incr_ratio = float(d.get("incr_ratio", self._incr_ratio))
+        self._decr_ratio = float(d.get("decr_ratio", self._decr_ratio))
+        self._incr_every_n_steps = int(
+            d.get("incr_every_n_steps", self._incr_every_n_steps))
+        self._decr_every_n = int(
+            d.get("decr_every_n_nan_or_inf", self._decr_every_n))
+        self._good_steps = int(d.get("good_steps", 0))
+        self._bad_steps = int(d.get("bad_steps", 0))
+        if self._enable:
+            self._publish_scale()
 
 
 class GradScaler(AmpScaler):

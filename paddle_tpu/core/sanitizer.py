@@ -21,7 +21,8 @@ import numpy as np
 
 from .flags import flag_value
 
-__all__ = ["jit_check_enabled", "finite_flags", "raise_if_nonfinite"]
+__all__ = ["jit_check_enabled", "finite_flags", "finite_report",
+           "raise_if_nonfinite", "select_if_finite"]
 
 
 def jit_check_enabled() -> bool:
@@ -52,16 +53,54 @@ def finite_flags(names_out: list, **groups):
     return jnp.stack(flags) if flags else None
 
 
-def raise_if_nonfinite(names, flags):
-    """Host side: fetch the flag vector (one tiny transfer) and raise a
-    located error listing every non-finite tensor."""
+def select_if_finite(flags, new_tree, old_tree):
+    """Trace-time guard half (resilience ``guard_updates`` contract):
+    when ANY flag in the sweep is False, every leaf of ``new_tree`` is
+    replaced by its ``old_tree`` twin — the compiled step returns the
+    incoming state unchanged, i.e. a non-finite step never applies its
+    update. Composes with buffer donation (XLA aliases whichever side
+    the select keeps)."""
+    ok = jnp.all(flags)
+    return jax.tree_util.tree_map(lambda a, b: jnp.where(ok, a, b),
+                                  new_tree, old_tree)
+
+
+def finite_report(names, flags):
+    """Host side of the sweep: fetch the tiny flag vector and name the
+    non-finite leaves. Returns ``(ok, bad_names)``; ``flags is None``
+    (nothing to check) is ok. Shared by ``raise_if_nonfinite`` and the
+    resilience StepGuard so the two readings can never drift."""
     if flags is None:
-        return
+        return True, []
     ok = np.asarray(flags)
     if ok.all():
+        return True, []
+    return False, [n for n, f in zip(names, ok) if not f]
+
+
+def raise_if_nonfinite(names, flags, loss_scale=None):
+    """Host side: fetch the flag vector (one tiny transfer) and raise a
+    located error listing every non-finite tensor, the loss scale in
+    effect (when an AMP scaler exists — scale 65536 with fp16 says
+    "overflow", scale 1.0 says "model/data"), and the recovery hint.
+    Leaves a ``resilience/nonfinite_steps`` telemetry trace even on
+    un-guarded paths that die right after."""
+    all_ok, bad = finite_report(names, flags)
+    if all_ok:
         return
-    bad = [n for n, f in zip(names, ok) if not f]
+    from ..profiler.telemetry import get_telemetry
+
+    get_telemetry().counter("resilience/nonfinite_steps")
     shown = ", ".join(bad[:8]) + (f" (+{len(bad) - 8} more)" if len(bad) > 8
                                   else "")
+    if loss_scale is None:
+        from ..amp.grad_scaler import current_loss_scale
+
+        loss_scale = current_loss_scale()
+    scale_note = (f" (loss_scale={float(loss_scale):g})"
+                  if loss_scale is not None else "")
     raise FloatingPointError(
-        f"FLAGS_check_nan_inf: NaN or Inf detected in compiled step: {shown}")
+        f"FLAGS_check_nan_inf: NaN or Inf detected in compiled step: "
+        f"{shown}{scale_note}. For skip/rollback recovery instead of "
+        f"aborting, wrap the step in paddle_tpu.resilience.StepGuard "
+        f"(engine arg guard_updates=True).")

@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.profiler.retrace import tracked_jit
 from paddle_tpu.profiler.telemetry import get_telemetry
+from paddle_tpu.resilience.watchdog import heartbeat as _watchdog_heartbeat
 from paddle_tpu.utils import profiler as _host_profiler
 from paddle_tpu.jit.functionalize import (
     functionalize,
@@ -251,7 +252,8 @@ class ParallelTrainStep:
                  dp_axis="dp", mp_axis="mp", sharding_axis="sharding",
                  zero_stage=0, recompute=False, compute_dtype=None,
                  donate=True, extra_batch_axes=(), offload=False,
-                 master_weights=None):
+                 master_weights=None, check_finite=None,
+                 guard_updates=False):
         self._layer = layer
         self._optimizer = optimizer
         self._loss_fn = loss_fn
@@ -407,10 +409,18 @@ class ParallelTrainStep:
                        or mesh.shape[sharding_axis] == 1)
         self._group_small = group_small
 
-        from ...core.sanitizer import finite_flags, jit_check_enabled
+        from ...core.sanitizer import (finite_flags, jit_check_enabled,
+                                       select_if_finite)
 
-        self._check_nan = jit_check_enabled()  # snapshot at build time
+        # guard_updates (resilience.StepGuard contract): the compiled step
+        # selects updated-vs-incoming state on its own finite sweep, so a
+        # non-finite step never applies its update; flags are read by the
+        # guard host-side instead of raising.
+        self._guard_updates = bool(guard_updates)
+        self._check_nan = (jit_check_enabled() if check_finite is None
+                           else bool(check_finite)) or self._guard_updates
         self._nan_names: list = []
+        self._last_flags = None
 
         def step_fn(params, buffers, opt_state, lr, batch):
             inputs, labels = batch
@@ -422,6 +432,10 @@ class ParallelTrainStep:
             flags = (finite_flags(self._nan_names, loss=loss, grad=grads,
                                   param=new_params)
                      if self._check_nan else None)
+            if self._guard_updates and flags is not None:
+                new_params, new_buffers, new_opt = select_if_finite(
+                    flags, (new_params, new_buffers, new_opt),
+                    (params, buffers, opt_state))
             return new_params, new_buffers, new_opt, loss, flags
 
         self._step_fn = step_fn
@@ -506,6 +520,7 @@ class ParallelTrainStep:
                                 sharding=self._batch_sharding)
 
     def __call__(self, inputs, labels):
+        _watchdog_heartbeat()
         t_enter = time.perf_counter()
         compiles_before = self._jitted.tracker.compiles
         # ONE pytree transfer for the whole batch (single dispatch; an
@@ -535,9 +550,11 @@ class ParallelTrainStep:
         self._opt_state = new_opt
         self._dirty = True
         if self._check_nan:
-            from ...core.sanitizer import raise_if_nonfinite
+            self._last_flags = flags
+            if not self._guard_updates:
+                from ...core.sanitizer import raise_if_nonfinite
 
-            raise_if_nonfinite(self._nan_names, flags)
+                raise_if_nonfinite(self._nan_names, flags)
         self._optimizer._global_step += 1
         self._record_step_metrics(
             t_enter, 1, int(np.prod(raw_in[0].shape)) if raw_in else 0, loss,
@@ -575,7 +592,7 @@ class ParallelTrainStep:
         shape the reference's sharding optimizer runs
         (sharding_optimizer.py:168-183 gradient-merge modes).
         """
-
+        _watchdog_heartbeat()
         t_enter = time.perf_counter()
 
         # leading [n_steps] axis is unsharded; ONE pytree transfer for the
@@ -644,11 +661,14 @@ class ParallelTrainStep:
                 new_opt, self._opt_host_shardings)
         self._opt_state = new_opt
         if self._check_nan:
-            from ...core.sanitizer import raise_if_nonfinite
-
             # scan stacked the per-step flag vectors: [n_steps, k] -> all
             # steps must be finite
-            raise_if_nonfinite(self._nan_names, flags.all(axis=0))
+            window_flags = flags.all(axis=0)
+            self._last_flags = window_flags
+            if not self._guard_updates:
+                from ...core.sanitizer import raise_if_nonfinite
+
+                raise_if_nonfinite(self._nan_names, window_flags)
         self._optimizer._global_step += int(n_steps)
         self._dirty = True
         self._record_step_metrics(
@@ -657,6 +677,48 @@ class ParallelTrainStep:
             losses[-1] if int(n_steps) else None,
             compiled=self._jitted_multi.tracker.compiles > compiles_before)
         return Tensor(losses)
+
+    # -- resilience (StepGuard engine contract) ----------------------------
+    def last_step_finite(self):
+        """(ok, bad_leaf_names) of the most recent step's finite sweep."""
+        from paddle_tpu.resilience.guard import finite_report
+
+        return finite_report(self._nan_names, self._last_flags)
+
+    def snapshot_state(self):
+        """Deep sharding-preserving copy of the on-device train state —
+        ``resilience.guard.copy_tree`` (see it for the donation-safety
+        rationale)."""
+        from paddle_tpu.resilience.guard import copy_tree
+
+        return {"params": copy_tree(self._params),
+                "buffers": copy_tree(self._buffers),
+                "opt_state": copy_tree(self._opt_state)}
+
+    def restore_state(self, snap):
+        """Install a snapshot (in-memory or restored from an orbax
+        checkpoint): every leaf is re-laid-out onto this engine's
+        shardings via fresh buffers, so the snapshot itself survives
+        repeated restores across future donations."""
+        self._params = {
+            n: jax.device_put(jnp.copy(v) if isinstance(v, jax.Array) else v,
+                              self._param_shardings[n])
+            for n, v in snap["params"].items()
+        }
+        self._buffers = {
+            n: jax.device_put(jnp.copy(v) if isinstance(v, jax.Array) else v,
+                              self._repl)
+            for n, v in snap["buffers"].items()
+        }
+        opt_home = self._opt_host_shardings if self._offload \
+            else self._opt_shardings
+        self._opt_state = {
+            n: {k: jax.device_put(jnp.copy(s) if isinstance(s, jax.Array)
+                                  else s, opt_home[n][k])
+                for k, s in st.items()}
+            for n, st in snap["opt_state"].items()
+        }
+        self._dirty = True
 
     def sync_to_layer(self):
         # checkpoint/eval work follows: the next inter-call interval

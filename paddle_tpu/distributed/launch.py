@@ -7,7 +7,12 @@ PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT) plus the JAX-native
 coordinator vars consumed by init_parallel_env, streams per-rank logs to a
 log dir, and fail-fast watches the children (watch_local_trainers parity:
 any child death tears the job down; no rank replacement — recovery is
-checkpoint-based, matching the reference's elastic posture).
+checkpoint-based, matching the reference's elastic posture). One
+exception to fail-fast: a job exiting with the resilience
+``EXIT_PREEMPTED`` code (SIGTERM → emergency checkpoint, see
+``paddle_tpu.resilience.preemption``) is relaunched whole with capped
+restarts and exponential backoff (``--max_restarts`` /
+``PADDLE_TPU_MAX_RESTARTS``) — elastic parity, PARITY row 80.
 
 Multi-host: pass ``--ips host1,host2`` and run the same command on every
 host (reference contract); rank 0's host:port becomes the JAX coordinator.
@@ -125,15 +130,10 @@ def watch_local_trainers(procs: List[subprocess.Popen],
         return 130
 
 
-def launch(training_script: str, script_args: List[str],
-           nproc_per_node: int = 1, ips: str = "127.0.0.1",
-           node_ip: Optional[str] = None, base_port: Optional[int] = None,
-           log_dir: str = "log", backend: Optional[str] = None,
-           extra_env: Optional[dict] = None) -> int:
-    ip_list = [s.strip() for s in ips.split(",") if s.strip()]
-    node_ip = node_ip or ip_list[0]
-    envs, _ = get_cluster_env(node_ip, ip_list, nproc_per_node, base_port)
-    os.makedirs(log_dir, exist_ok=True)
+def _run_job_once(training_script, script_args, envs, log_dir, backend,
+                  extra_env, log_mode: str) -> int:
+    """Spawn every rank, watch fail-fast, surface the failing log tail.
+    One launch attempt — the restart policy lives in ``launch``."""
     procs = []
     logs = []
     for local_rank, env in enumerate(envs):
@@ -141,7 +141,7 @@ def launch(training_script: str, script_args: List[str],
         if backend == "cpu":  # simulation mode: each rank is a 1-device CPU
             full_env.setdefault("JAX_PLATFORMS", "cpu")
         rank = env["PADDLE_TRAINER_ID"]
-        log_f = open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
+        log_f = open(os.path.join(log_dir, f"workerlog.{rank}"), log_mode)
         logs.append(log_f)
         p = subprocess.Popen(
             [sys.executable, "-u", training_script, *script_args],
@@ -151,7 +151,7 @@ def launch(training_script: str, script_args: List[str],
     rc = watch_local_trainers(procs)
     for f in logs:
         f.close()
-    if rc != 0:
+    if rc not in (0, _preempt_exit_code()):
         # surface the failing rank's tail, like the reference's log pull
         for local_rank, env in enumerate(envs):
             rank = env["PADDLE_TRAINER_ID"]
@@ -172,6 +172,61 @@ def launch(training_script: str, script_args: List[str],
     return rc
 
 
+def _preempt_exit_code() -> int:
+    from paddle_tpu.resilience.preemption import EXIT_PREEMPTED
+
+    return EXIT_PREEMPTED
+
+
+def launch(training_script: str, script_args: List[str],
+           nproc_per_node: int = 1, ips: str = "127.0.0.1",
+           node_ip: Optional[str] = None, base_port: Optional[int] = None,
+           log_dir: str = "log", backend: Optional[str] = None,
+           extra_env: Optional[dict] = None,
+           max_restarts: Optional[int] = None,
+           restart_backoff: float = 1.0,
+           telemetry_jsonl: Optional[str] = None) -> int:
+    """Launch + watch the local ranks; with ``max_restarts`` > 0 (or
+    ``PADDLE_TPU_MAX_RESTARTS``), a job that exits with the resilience
+    ``EXIT_PREEMPTED`` code (its ranks checkpointed and asked to be
+    relaunched — see ``paddle_tpu.resilience.preemption``) is restarted
+    with capped attempts and deterministic exponential backoff. Any
+    other non-zero exit keeps the reference's fail-fast contract.
+
+    ``telemetry_jsonl`` (or ``PADDLE_TPU_TELEMETRY_JSONL``): append one
+    launcher telemetry record there when the job ends after >= 1
+    relaunch — the ``resilience/restarts`` counter lives in THIS
+    process, so without a sink it would never reach the JSONL the
+    workers write."""
+    from paddle_tpu.profiler.telemetry import get_telemetry
+    from paddle_tpu.resilience.retry import backoff_delays
+
+    ip_list = [s.strip() for s in ips.split(",") if s.strip()]
+    node_ip = node_ip or ip_list[0]
+    envs, _ = get_cluster_env(node_ip, ip_list, nproc_per_node, base_port)
+    os.makedirs(log_dir, exist_ok=True)
+    if max_restarts is None:
+        max_restarts = int(os.environ.get("PADDLE_TPU_MAX_RESTARTS", "0"))
+    if telemetry_jsonl is None:
+        telemetry_jsonl = os.environ.get("PADDLE_TPU_TELEMETRY_JSONL")
+    delays = backoff_delays(max_restarts, base=restart_backoff)
+    attempt = 0
+    while True:
+        rc = _run_job_once(training_script, script_args, envs, log_dir,
+                           backend, extra_env,
+                           log_mode="w" if attempt == 0 else "a")
+        if rc != _preempt_exit_code() or attempt >= max_restarts:
+            if telemetry_jsonl and attempt:
+                get_telemetry().to_jsonl(telemetry_jsonl, tag="launch")
+            return rc
+        get_telemetry().counter("resilience/restarts")
+        sys.stderr.write(
+            f"[launch] job preempted (exit {rc}); relaunching in "
+            f"{delays[attempt]:.2f}s (attempt {attempt + 1}/{max_restarts})\n")
+        time.sleep(delays[attempt])
+        attempt += 1
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="paddle_tpu.distributed.launch",
@@ -185,13 +240,26 @@ def main(argv=None):
     parser.add_argument("--log_dir", type=str, default="log")
     parser.add_argument("--backend", type=str, default=None,
                         choices=[None, "cpu", "tpu"])
+    parser.add_argument("--max_restarts", type=int, default=None,
+                        help="relaunch budget for EXIT_PREEMPTED jobs "
+                             "(default: PADDLE_TPU_MAX_RESTARTS or 0)")
+    parser.add_argument("--restart_backoff", type=float, default=1.0,
+                        help="base seconds of the deterministic "
+                             "exponential relaunch backoff")
+    parser.add_argument("--telemetry_jsonl", type=str, default=None,
+                        help="JSONL sink for the launcher's own telemetry "
+                             "(resilience/restarts) after a relaunched job "
+                             "ends (default: PADDLE_TPU_TELEMETRY_JSONL)")
     parser.add_argument("training_script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     rc = launch(args.training_script, args.script_args,
                 nproc_per_node=args.nproc_per_node, ips=args.ips,
                 node_ip=args.node_ip, base_port=args.started_port,
-                log_dir=args.log_dir, backend=args.backend)
+                log_dir=args.log_dir, backend=args.backend,
+                max_restarts=args.max_restarts,
+                restart_backoff=args.restart_backoff,
+                telemetry_jsonl=args.telemetry_jsonl)
     sys.exit(rc)
 
 

@@ -13,6 +13,7 @@ import numpy as np
 from ..core.tensor import Tensor, no_grad, to_tensor
 from ..metric import Metric
 from ..nn.layer_base import Layer
+from ..resilience import preemption as _preempt
 from . import callbacks as callbacks_mod
 
 __all__ = ["Model"]
@@ -117,6 +118,23 @@ class Model:
             eval_loader = eval_data if not isinstance(eval_data, Dataset) else DataLoader(
                 eval_data, batch_size=batch_size, num_workers=num_workers,
             )
+        import os
+
+        if save_dir and os.path.exists(f"{save_dir}/preempt.pdparams"):
+            # relaunched after a preemption exit: consume the emergency
+            # checkpoint the preempted attempt wrote below, so the
+            # relaunch continues from its weights/optimizer state
+            # instead of burning the restart budget on epoch-0 restarts
+            # (step-cursor resume is resilience.StepGuard's domain).
+            # Consume-ONCE: the files are removed after loading so a
+            # stale emergency state can never silently override a later,
+            # unrelated run pointed at the same save_dir
+            self.load(f"{save_dir}/preempt")
+            for suffix in (".pdparams", ".pdopt"):
+                try:
+                    os.remove(f"{save_dir}/preempt{suffix}")
+                except OSError:
+                    pass
         cbks = callbacks_mod.config_callbacks(
             callbacks, model=self, batch_size=batch_size, epochs=epochs,
             verbose=verbose, log_freq=log_freq, save_dir=save_dir,
@@ -142,6 +160,14 @@ class Model:
                                              buckets=prefetch_buckets)
             try:
                 for step_i, batch in enumerate(data_iter):
+                    # preemption boundary (resilience): with the handler
+                    # installed, SIGTERM lands here between steps — save
+                    # an emergency checkpoint and exit with the relaunch
+                    # code the distributed.launch watcher recognizes
+                    if _preempt.preemption_requested():
+                        _preempt.exit_for_relaunch(
+                            (lambda: self.save(f"{save_dir}/preempt"))
+                            if save_dir else None)
                     if prefetch_depth:
                         # leaves come back as device jax.Arrays; re-wrap so
                         # metrics/eager paths see Tensors like loader output

@@ -28,6 +28,21 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def _io_retry(fn, *args, **kwargs):
+    """Checkpoint reads/writes behind deterministic exponential backoff
+    (resilience retry layer): transient filesystem/GCS OSErrors — the
+    blips that throw away hours of state when a save dies — get
+    ``PADDLE_TPU_CKPT_RETRIES`` (default 3) extra attempts, counted in
+    ``resilience/io_retries``."""
+    from ...resilience.retry import retry_call
+
+    return retry_call(
+        fn, *args,
+        retries=int(os.environ.get("PADDLE_TPU_CKPT_RETRIES", 3)),
+        base=float(os.environ.get("PADDLE_TPU_CKPT_RETRY_BASE", 0.2)),
+        retry_on=(OSError,), **kwargs)
+
+
 def save_train_state(state: Dict[str, Any], path: str):
     """Save a pytree of (possibly mesh-sharded) arrays atomically: write to a
     temp sibling, then swap — a crash mid-save never loses the previous
@@ -44,7 +59,15 @@ def save_train_state(state: Dict[str, Any], path: str):
         shutil.rmtree(tmp)
     if os.path.exists(old) and os.path.exists(path):
         shutil.rmtree(old)
-    _checkpointer().save(tmp, state)
+
+    def _write():
+        # a retried attempt must clear its own partial tmp first (orbax
+        # refuses to write into an existing dir)
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        _checkpointer().save(tmp, state)
+
+    _io_retry(_write)
     if os.path.exists(path):
         if os.path.exists(old):
             shutil.rmtree(old)
@@ -66,7 +89,7 @@ def _resolve_ckpt_path(path: str) -> str:
 
 
 def restore_train_state(path: str):
-    return _checkpointer().restore(_resolve_ckpt_path(path))
+    return _io_retry(_checkpointer().restore, _resolve_ckpt_path(path))
 
 
 class CheckpointSaver:
@@ -113,10 +136,13 @@ class CheckpointSaver:
              meta: Optional[dict] = None):
         tmp = self._ckpt_dir(number) + ".tmp"
         final = self._ckpt_dir(number)
-        for p in (tmp, final):
-            if os.path.exists(p):
-                shutil.rmtree(p)
-        _checkpointer().save(tmp, state)
+        def _write():
+            for p in (tmp, final):
+                if os.path.exists(p):
+                    shutil.rmtree(p)
+            _checkpointer().save(tmp, state)
+
+        _io_retry(_write)
         os.rename(tmp, final)
         with open(os.path.join(self.root, "LATEST.tmp"), "w") as fh:
             json.dump({"number": number, "meta": meta or {}}, fh)
@@ -128,7 +154,7 @@ class CheckpointSaver:
         number = self.latest() if number is None else number
         if number is None:
             return None
-        return _checkpointer().restore(self._ckpt_dir(number))
+        return _io_retry(_checkpointer().restore, self._ckpt_dir(number))
 
     def _gc(self):
         nums = self.numbers()

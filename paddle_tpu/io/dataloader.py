@@ -15,7 +15,9 @@ import atexit
 import itertools
 import multiprocessing as mp
 import os
+import pickle
 import queue
+import signal
 import threading
 import traceback
 from typing import Callable, Optional
@@ -23,6 +25,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..core.tensor import Tensor, to_tensor
+from ..resilience.inject import active_injector
 from .collate import default_collate_fn, default_convert_fn
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler, SequenceSampler, RandomSampler
@@ -146,22 +149,45 @@ class _MultiProcessIter:
             except Exception:
                 self._ring = None
                 ring_name = None
+        self._ctx = ctx
+        self._ring_name = ring_name
+        self._respawned: set = set()  # worker slots already respawned once
         for w in range(self._num_workers):
-            iq = ctx.Queue()
-            self._index_queues.append(iq)
-            p = ctx.Process(
-                target=_worker_loop,
-                args=(loader.dataset, iq, self._out_queue, loader.collate_fn, w,
-                      self._num_workers, loader.worker_init_fn, self._iterable,
-                      ring_name),
-                daemon=True,
-            )
-            p.start()
-            self._workers.append(p)
+            self._index_queues.append(ctx.Queue())
+            self._workers.append(self._spawn_worker(w))
         atexit.register(self._shutdown)
         # prime the pipeline
         for _ in range(self._num_workers * max(loader.prefetch_factor, 2)):
             self._dispatch()
+
+    def _spawn_worker(self, w):
+        p = self._ctx.Process(
+            target=_worker_loop,
+            args=(self._loader.dataset, self._index_queues[w],
+                  self._out_queue, self._loader.collate_fn, w,
+                  self._num_workers, self._loader.worker_init_fn,
+                  self._iterable, self._ring_name),
+            daemon=True,
+        )
+        p.start()
+        return p
+
+    def _respawn(self, w):
+        """Replace a crashed/killed worker ONCE (resilience retry layer):
+        a fresh index queue gets every in-flight batch id the dead worker
+        owned but never answered re-enqueued, so the epoch loses and
+        duplicates nothing. Map-style datasets only — an iterable
+        dataset's position died with the worker's iterator."""
+        from ..profiler.telemetry import get_telemetry
+
+        get_telemetry().counter("resilience/worker_respawns")
+        self._respawned.add(w)
+        iq = self._ctx.Queue()
+        self._index_queues[w] = iq  # old queue (and its backlog) dropped
+        for i in range(self._rcvd_idx, self._send_idx):
+            if i % self._num_workers == w and i not in self._reorder:
+                iq.put((i, self._batches[i]))
+        self._workers[w] = self._spawn_worker(w)
 
     def _dispatch(self):
         if self._iterable:
@@ -176,7 +202,10 @@ class _MultiProcessIter:
         self._send_idx += 1
 
     def _recv_one(self, timeout_s: float) -> bool:
-        """Receive one record into the reorder buffer. False on timeout."""
+        """Receive one record into the reorder buffer. False on timeout
+        OR on a corrupted record — a worker SIGKILLed mid-write truncates
+        the mp.Queue feeder's pickle stream; treating that as no-record
+        lets the caller's liveness check own the recovery (respawn)."""
         if self._ring is not None:
             # drain any queue-overflow records first (non-blocking)
             drained = False
@@ -187,6 +216,8 @@ class _MultiProcessIter:
                     drained = True
             except queue.Empty:
                 pass
+            except (EOFError, OSError, pickle.UnpicklingError):
+                pass  # truncated record from a killed worker
             if drained:
                 return True
             try:
@@ -211,6 +242,10 @@ class _MultiProcessIter:
         try:
             batch_id, err, data = self._out_queue.get(timeout=timeout_s)
         except queue.Empty:
+            return False
+        except (EOFError, OSError, pickle.UnpicklingError):
+            # truncated record from a SIGKILLed worker; anything else
+            # (ImportError from an unpicklable payload, …) must propagate
             return False
         self._reorder[batch_id] = (err, data)
         return True
@@ -266,18 +301,36 @@ class _MultiProcessIter:
         while self._rcvd_idx not in self._reorder:
             if not self._recv_one(timeout_s=2.0):
                 waited += 2.0
-                dead = [w.pid for w in self._workers if not w.is_alive()]
-                if dead:
+                dead_slots = [w for w, p in enumerate(self._workers)
+                              if not p.is_alive()]
+                if dead_slots:
+                    # resilience retry layer: respawn each dead worker
+                    # ONCE and re-enqueue its unanswered batches; a
+                    # second death of the same slot (or any death under
+                    # an iterable dataset, whose stream position is
+                    # unrecoverable) propagates as before
+                    if (not self._iterable
+                            and not any(w in self._respawned
+                                        for w in dead_slots)):
+                        for w in dead_slots:
+                            self._respawn(w)
+                        # the respawned worker pays spawn + re-import +
+                        # recompute of re-enqueued batches — that must
+                        # not count against the receive timeout
+                        waited = 0.0
+                        continue
                     self._shutdown()
                     raise RuntimeError(
-                        f"DataLoader worker(s) {dead} exited unexpectedly. "
-                        "Note: workers start via spawn — datasets must be "
+                        f"DataLoader worker slot(s) {dead_slots} exited "
+                        "unexpectedly (respawn budget exhausted). Note: "
+                        "workers start via spawn — datasets must be "
                         "importable (defined in a module, not __main__/REPL)."
                     )
                 if waited >= (self._loader.timeout or 120.0):
                     self._shutdown()
                     raise RuntimeError("DataLoader worker timed out")
         err, data = self._reorder.pop(self._rcvd_idx)
+        batch_id = self._rcvd_idx
         self._rcvd_idx += 1
         if isinstance(err, StopIteration):
             if not self._persistent:
@@ -286,6 +339,13 @@ class _MultiProcessIter:
         if err is not None:
             self._shutdown()
             raise RuntimeError(f"DataLoader worker raised:\n{data}") from err
+        inj = active_injector()
+        if inj is not None and inj.worker_kill_due(batch_id):
+            # fault-injection harness: SIGKILL the worker that produced
+            # this batch (deterministic respawn-path exercise)
+            victim = self._workers[batch_id % self._num_workers]
+            if victim.is_alive():
+                os.kill(victim.pid, signal.SIGKILL)
         self._dispatch()
         return _to_tensors(data, self._loader.return_list)
 

@@ -141,6 +141,13 @@ class DevicePrefetcher:
             numpy arrays, Tensors).
         depth: how many staged batches may be in flight ahead of the
             consumer (the double-buffer depth; >= 1).
+        stage_retries: deterministic-backoff retries of a failed staging
+            attempt (the H2D ``device_put`` hitting a transiently full
+            staging buffer raises RuntimeError). Default from
+            ``PADDLE_TPU_H2D_RETRIES`` (2). Source-iterator errors are
+            NOT retried here — upstream owns those (the DataLoader
+            respawns a crashed worker once; only after its budget
+            exhausts does the error reach this pipeline and propagate).
         buckets: ``ShapeBuckets`` or a sequence of ints (axis=1) padding
             ragged batches into fixed shapes; ``None`` disables.
         sharding: a ``jax.sharding.Sharding`` broadcast over every leaf
@@ -153,8 +160,13 @@ class DevicePrefetcher:
 
     def __init__(self, source: Iterable, depth: int = 2,
                  buckets: Union[ShapeBuckets, Sequence[int], None] = None,
-                 sharding=None, to_device: bool = True):
+                 sharding=None, to_device: bool = True,
+                 stage_retries: Optional[int] = None):
+        import os
+
         self.depth = max(1, int(depth))
+        self._stage_retries = (int(os.environ.get("PADDLE_TPU_H2D_RETRIES", 2))
+                               if stage_retries is None else int(stage_retries))
         if buckets is not None and not isinstance(buckets, ShapeBuckets):
             buckets = ShapeBuckets(buckets)
         self._buckets = buckets
@@ -171,7 +183,12 @@ class DevicePrefetcher:
 
     # -- producer ----------------------------------------------------------
     def _stage(self, batch):
-        """Host-convert + bucket-pad + ONE pytree device_put."""
+        """Host-convert + bucket-pad + ONE pytree device_put. Only the
+        device_put gets the transient-failure retries — pad/bucket work
+        is deterministic, and retrying it would double-count the bucket
+        telemetry the retrace/bench gates read."""
+        from ..resilience.retry import retry_call
+
         tel = get_telemetry()
         batch = jax.tree_util.tree_map(_host_leaf, batch)
         if self._buckets is not None:
@@ -185,10 +202,12 @@ class DevicePrefetcher:
                       for l in jax.tree_util.tree_leaves(batch))
         if self._to_device:
             t0 = time.perf_counter()
-            if self._sharding is not None:
-                batch = jax.device_put(batch, self._sharding)
-            else:
-                batch = jax.device_put(batch)
+            put_args = ((batch,) if self._sharding is None
+                        else (batch, self._sharding))
+            batch = retry_call(jax.device_put, *put_args,
+                               retries=self._stage_retries, base=0.05,
+                               retry_on=(RuntimeError,),
+                               counter="resilience/io_retries")
             if tel.enabled:
                 tel.observe("prefetch/h2d_ms",
                             (time.perf_counter() - t0) * 1e3)
@@ -213,6 +232,9 @@ class DevicePrefetcher:
             for batch in self._src:
                 if self._closed.is_set():
                     return
+                # _stage retries its H2D dispatch internally; a source
+                # error propagates immediately (its own retry budget —
+                # e.g. loader worker respawn — is upstream)
                 staged = self._stage(batch)
                 if not self._put(staged):
                     return

@@ -17,6 +17,8 @@ from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 from ..optimizer.optimizer import Optimizer
 from ..profiler.retrace import tracked_jit
+from ..resilience.guard import copy_tree as _copy_tree
+from ..resilience.watchdog import heartbeat as _watchdog_heartbeat
 from .functionalize import functionalize, get_buffers, get_params, set_buffers, set_params
 
 __all__ = ["TrainStep", "EvalStep"]
@@ -31,7 +33,9 @@ class TrainStep:
     """
 
     def __init__(self, layer: Layer, loss_fn: Callable, optimizer: Optimizer,
-                 donate: bool = True, mesh=None, in_shardings=None):
+                 donate: bool = True, mesh=None, in_shardings=None,
+                 check_finite: Optional[bool] = None,
+                 guard_updates: bool = False):
         self._layer = layer
         self._optimizer = optimizer
         self._loss_fn = loss_fn
@@ -48,8 +52,15 @@ class TrainStep:
         opt = optimizer
         from ..core.sanitizer import finite_flags, jit_check_enabled
 
-        self._check_nan = jit_check_enabled()  # snapshot at build time
+        # ``guard_updates`` (resilience.StepGuard contract): the compiled
+        # step selects between the updated and the incoming state on its
+        # own finite sweep, so a NaN/Inf step never applies its optimizer
+        # update; the guard reads the flags host-side instead of raising.
+        self._guard_updates = bool(guard_updates)
+        self._check_nan = (jit_check_enabled() if check_finite is None
+                           else bool(check_finite)) or self._guard_updates
         self._nan_names: list = []
+        self._last_flags = None
 
         def step_fn(params, buffers, opt_state, lr, batch):
             inputs, labels = batch
@@ -98,6 +109,12 @@ class TrainStep:
             flags = (finite_flags(self._nan_names, loss=loss, grad=grads,
                                   param=new_params)
                      if self._check_nan else None)
+            if self._guard_updates and flags is not None:
+                from ..core.sanitizer import select_if_finite
+
+                new_params, new_buffers, new_opt_state = select_if_finite(
+                    flags, (new_params, new_buffers, new_opt_state),
+                    (params, buffers, opt_state))
             return new_params, new_buffers, new_opt_state, loss, flags
 
         self._jitted = tracked_jit(step_fn, name="jit.train_step",
@@ -114,6 +131,7 @@ class TrainStep:
         return DevicePrefetcher(batches, depth=depth, buckets=buckets)
 
     def __call__(self, inputs, labels):
+        _watchdog_heartbeat()
         # ONE pytree transfer for the whole batch (single dispatch; a
         # device-resident batch — e.g. from ``prefetch`` — passes through)
         raw_inputs, raw_labels = jax.device_put((
@@ -128,12 +146,38 @@ class TrainStep:
             (raw_inputs, raw_labels),
         )
         if self._check_nan:
-            from ..core.sanitizer import raise_if_nonfinite
+            self._last_flags = flags
+            if not self._guard_updates:
+                from ..core.sanitizer import raise_if_nonfinite
 
-            raise_if_nonfinite(self._nan_names, flags)
+                raise_if_nonfinite(self._nan_names, flags)
         self._optimizer._global_step += 1
         self._dirty = True
         return Tensor(loss)
+
+    # -- resilience (StepGuard engine contract) ------------------------
+    def last_step_finite(self):
+        """(ok, bad_leaf_names) of the most recent step's finite sweep."""
+        from ..resilience.guard import finite_report
+
+        return finite_report(self._nan_names, self._last_flags)
+
+    def snapshot_state(self):
+        """Deep on-device copy of params/buffers/opt-state. A copy, not a
+        reference: the jitted step donates its inputs, so snapshot
+        buffers held by reference would be deleted on the next call."""
+        return {"params": _copy_tree(self._params),
+                "buffers": _copy_tree(self._buffers),
+                "opt_state": _copy_tree(self._opt_state)}
+
+    def restore_state(self, snap):
+        """Install a snapshot (from ``snapshot_state`` or a restored
+        checkpoint). Installs COPIES so a snapshot survives being
+        restored more than once (the engine will donate what it holds)."""
+        self._params = _copy_tree(snap["params"])
+        self._buffers = _copy_tree(snap["buffers"])
+        self._opt_state = _copy_tree(snap["opt_state"])
+        self._dirty = True
 
     def sync_to_layer(self):
         """Write staged params/buffers back into the imperative Layer."""

@@ -25,6 +25,14 @@ One JSONL sink (``to_jsonl``) emits flat scalar records — the schema
 
 Scalar names are namespaced: ``counter/<name>``, ``gauge/<name>``, and
 ``hist/<name>/{count,sum,min,max,mean,ema,p50,p95,p99}``.
+
+Counter families by producer: ``engine/*`` ``executor/*`` ``reader/*``
+``prefetch/*`` ``compile/*`` ``checkpoint/*`` ``device/*`` and the
+recovery runtime's ``resilience/{nonfinite_steps,rollbacks,
+quarantined_batches,worker_respawns,restarts,watchdog_dumps,io_retries,
+spills,resumes,preempt_exits}`` (README "Fault tolerance";
+``tools/check_telemetry_schema.py --require-prefix counter/resilience/``
+asserts a run left a recovery trace).
 """
 from __future__ import annotations
 

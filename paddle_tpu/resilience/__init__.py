@@ -1,0 +1,73 @@
+"""Resilient training runtime — detect-recover-continue.
+
+The layered recovery runtime over the framework's existing detection
+paths (``core.sanitizer`` finite sweeps, ``incubate.checkpoint`` atomic
+saves, ``distributed.launch`` fail-fast watching):
+
+- :class:`StepGuard` / :class:`RecoveryPolicy` (``guard.py``) — skip
+  non-finite optimizer updates in-jit, quarantine the offending batch,
+  back off the AMP loss scale, roll back to a rolling last-good snapshot
+  after K consecutive bad steps;
+- :class:`Watchdog` (``watchdog.py``) — step-boundary heartbeat
+  deadline; on a hang, dump all thread stacks + telemetry and abort with
+  ``EXIT_WATCHDOG``;
+- preemption (``preemption.py``) — SIGTERM/SIGINT → flag → emergency
+  sharded checkpoint → ``EXIT_PREEMPTED``, which the
+  ``distributed.launch`` watcher relaunches with capped restarts;
+- :func:`retry_call` (``retry.py``) — deterministic exponential backoff
+  for checkpoint/staging I/O;
+- :class:`FaultInjector` (``inject.py``) — deterministic, env/API-driven
+  fault injection (NaN batch, SIGTERM, slow step, worker kill) so every
+  path above stays exercised by tests and the
+  ``tools/check_resilience.py`` CI gate.
+
+Telemetry: ``resilience/{nonfinite_steps,rollbacks,quarantined_batches,
+worker_respawns,restarts,watchdog_dumps,io_retries,spills,resumes,
+preempt_exits}`` counters (README "Fault tolerance").
+"""
+from __future__ import annotations
+
+from .guard import (  # noqa: F401
+    RecoveryPolicy,
+    StepGuard,
+    finite_report,
+    load_quarantine,
+    quarantine_batch,
+    replay_quarantine,
+)
+from .inject import (  # noqa: F401
+    FaultInjector,
+    active_injector,
+    clear_injector,
+    install_injector,
+)
+from .preemption import (  # noqa: F401
+    EXIT_PREEMPTED,
+    PreemptionHandler,
+    clear_preemption_request,
+    exit_for_relaunch,
+    install_preemption_handler,
+    preemption_requested,
+    uninstall_preemption_handler,
+)
+from .retry import backoff_delays, retry_call  # noqa: F401
+from .watchdog import (  # noqa: F401
+    EXIT_WATCHDOG,
+    Watchdog,
+    current_watchdog,
+    heartbeat,
+    install_watchdog,
+    uninstall_watchdog,
+)
+
+__all__ = [
+    "RecoveryPolicy", "StepGuard", "finite_report", "quarantine_batch",
+    "load_quarantine", "replay_quarantine",
+    "FaultInjector", "install_injector", "active_injector", "clear_injector",
+    "EXIT_PREEMPTED", "PreemptionHandler", "install_preemption_handler",
+    "uninstall_preemption_handler", "preemption_requested",
+    "clear_preemption_request", "exit_for_relaunch",
+    "backoff_delays", "retry_call",
+    "EXIT_WATCHDOG", "Watchdog", "install_watchdog", "uninstall_watchdog",
+    "heartbeat", "current_watchdog",
+]

@@ -1,0 +1,201 @@
+"""Deterministic fault injection — the harness that keeps every recovery
+path in ``paddle_tpu.resilience`` exercised, not just claimed.
+
+Faults are keyed on STEP (or batch) indices, never on randomness, so a
+failing recovery test replays bit-identically. Injection points are
+consulted by the runtime itself:
+
+- ``corrupt_batch(step, inputs)`` — StepGuard poisons the first float
+  leaf of the batch with NaN at the configured steps (the NaN then flows
+  through the REAL compiled step into loss/grads, exactly like a bad
+  example or an overflowed activation would);
+- ``maybe_slow(step)`` — StepGuard sleeps at a step boundary, tripping
+  the Watchdog deadline;
+- ``maybe_sigterm(step)`` — StepGuard delivers a real SIGTERM to this
+  process, driving the preemption path end-to-end;
+- ``worker_kill_due(batch_idx)`` — the DataLoader multiprocess iterator
+  SIGKILLs the worker that produced the given batch, driving the
+  respawn/re-enqueue path.
+
+Env-driven for subprocess runs (the CI smoke gate, launch children):
+
+    PADDLE_TPU_INJECT="nan@3,sigterm@7,slow@5:1.5,kill_worker@2"
+
+One-shot semantics: every injection fires at most once per injector.
+Cross-process one-shot (a relaunched job must not re-receive the same
+SIGTERM) is handled by marker files under ``PADDLE_TPU_INJECT_STATE``
+(or the ``state_dir`` argument) — present marker means already fired.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Dict, Iterable, Optional, Set
+
+import numpy as np
+
+__all__ = ["FaultInjector", "install_injector", "active_injector",
+           "clear_injector"]
+
+_ENV_SPEC = "PADDLE_TPU_INJECT"
+_ENV_STATE = "PADDLE_TPU_INJECT_STATE"
+
+
+class FaultInjector:
+    """Deterministic, step-indexed fault plan.
+
+    Args:
+        nan_steps: step indices whose batch gets a NaN poisoned into its
+            first floating leaf.
+        sigterm_steps: step indices at whose boundary a real SIGTERM is
+            delivered to this process.
+        slow_steps: ``{step: seconds}`` boundary sleeps (watchdog food).
+        kill_worker_batches: batch indices after whose delivery the
+            producing DataLoader worker is SIGKILLed.
+        state_dir: directory for cross-process one-shot markers; a fault
+            whose marker file exists never fires again (survives the
+            relaunch the fault itself provokes).
+    """
+
+    def __init__(self, nan_steps: Iterable[int] = (),
+                 sigterm_steps: Iterable[int] = (),
+                 slow_steps: Optional[Dict[int, float]] = None,
+                 kill_worker_batches: Iterable[int] = (),
+                 state_dir: Optional[str] = None):
+        self.nan_steps = {int(s) for s in nan_steps}
+        self.sigterm_steps = {int(s) for s in sigterm_steps}
+        self.slow_steps = {int(k): float(v)
+                           for k, v in (slow_steps or {}).items()}
+        self.kill_worker_batches = {int(b) for b in kill_worker_batches}
+        self.state_dir = state_dir
+        self._fired: Set[str] = set()
+
+    # -- plan parsing ------------------------------------------------------
+    @classmethod
+    def from_spec(cls, spec: str, state_dir: Optional[str] = None
+                  ) -> "FaultInjector":
+        """Parse ``"nan@3,sigterm@7,slow@5:1.5,kill_worker@2"``."""
+        nan, sig, kill = [], [], []
+        slow: Dict[int, float] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            kind, _, where = part.partition("@")
+            kind = kind.strip().lower()
+            if kind == "slow":
+                step, _, secs = where.partition(":")
+                slow[int(step)] = float(secs or 1.0)
+            elif kind == "nan":
+                nan.append(int(where))
+            elif kind == "sigterm":
+                sig.append(int(where))
+            elif kind == "kill_worker":
+                kill.append(int(where))
+            else:
+                raise ValueError(f"unknown fault kind {kind!r} in {spec!r}")
+        return cls(nan_steps=nan, sigterm_steps=sig, slow_steps=slow,
+                   kill_worker_batches=kill, state_dir=state_dir)
+
+    @classmethod
+    def from_env(cls, env=None) -> Optional["FaultInjector"]:
+        env = os.environ if env is None else env
+        spec = env.get(_ENV_SPEC)
+        if not spec:
+            return None
+        return cls.from_spec(spec, state_dir=env.get(_ENV_STATE))
+
+    # -- one-shot bookkeeping ---------------------------------------------
+    def _once(self, key: str) -> bool:
+        """True exactly once per fault key (per process, and per
+        ``state_dir`` when configured)."""
+        if key in self._fired:
+            return False
+        if self.state_dir:
+            os.makedirs(self.state_dir, exist_ok=True)
+            marker = os.path.join(self.state_dir, key + ".done")
+            if os.path.exists(marker):
+                self._fired.add(key)
+                return False
+            with open(marker, "w") as f:
+                f.write(str(time.time()))
+        self._fired.add(key)
+        return True
+
+    # -- injection points --------------------------------------------------
+    def corrupt_batch(self, step: int, batch):
+        """Poison the first floating leaf of ``batch`` with NaN when
+        ``step`` is scheduled; otherwise return the batch unchanged."""
+        if int(step) not in self.nan_steps or not self._once(f"nan@{step}"):
+            return batch
+        import jax
+
+        self._count("nan")
+        done = [False]
+
+        def poison(leaf):
+            if done[0]:
+                return leaf
+            a = np.array(leaf, copy=True) if not hasattr(leaf, "dtype") \
+                else np.asarray(leaf).copy()
+            if np.issubdtype(a.dtype, np.floating):
+                a.ravel()[0] = np.nan
+                done[0] = True
+                return a
+            return leaf
+
+        return jax.tree_util.tree_map(poison, batch)
+
+    def maybe_slow(self, step: int) -> float:
+        secs = self.slow_steps.get(int(step), 0.0)
+        if secs and self._once(f"slow@{step}"):
+            self._count("slow")
+            time.sleep(secs)
+            return secs
+        return 0.0
+
+    def maybe_sigterm(self, step: int) -> bool:
+        if int(step) in self.sigterm_steps and self._once(f"sigterm@{step}"):
+            self._count("sigterm")
+            os.kill(os.getpid(), signal.SIGTERM)
+            return True
+        return False
+
+    def worker_kill_due(self, batch_idx: int) -> bool:
+        return (int(batch_idx) in self.kill_worker_batches
+                and self._once(f"kill_worker@{batch_idx}"))
+
+    @staticmethod
+    def _count(kind: str):
+        from ..profiler.telemetry import get_telemetry
+
+        get_telemetry().counter(f"resilience/injected_{kind}")
+
+
+_active: Optional[FaultInjector] = None
+_env_checked = False
+
+
+def install_injector(injector: Optional[FaultInjector]) -> None:
+    """Set the process-wide injector consulted by StepGuard/DataLoader."""
+    global _active, _env_checked
+    _active = injector
+    _env_checked = True  # explicit install wins over the env spec
+
+
+def active_injector() -> Optional[FaultInjector]:
+    """The installed injector; lazily constructed from PADDLE_TPU_INJECT
+    the first time anything asks. Returns None in un-injected runs (the
+    overwhelmingly common case — callers must treat None as 'off')."""
+    global _active, _env_checked
+    if _active is None and not _env_checked:
+        _env_checked = True
+        _active = FaultInjector.from_env()
+    return _active
+
+
+def clear_injector() -> None:
+    global _active, _env_checked
+    _active = None
+    _env_checked = False

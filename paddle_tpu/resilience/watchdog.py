@@ -1,0 +1,191 @@
+"""Watchdog — a heartbeat deadline over train-step boundaries.
+
+A hung collective (one host dropped out of a psum), a stuck H2D
+transfer, or a deadlocked input pipeline does not crash a JAX job — it
+parks it forever, burning the reservation while monitoring shows a
+healthy process. The reference's answer is fail-fast watching of
+*processes* (launch_utils.watch_local_trainers); that cannot see a
+process that is alive but stuck. The Watchdog watches *step progress*:
+engines feed it a heartbeat at every step boundary, and when no beat
+arrives within the deadline it dumps every Python thread's stack plus a
+telemetry snapshot (the post-mortem a hang otherwise never yields) and
+aborts with ``EXIT_WATCHDOG`` — distinct from both a crash and
+``EXIT_PREEMPTED``, so the launch watcher and schedulers can tell
+"hung and self-killed" from "preempted, relaunch me".
+
+``heartbeat()`` is called from hot loops (engine/executor step
+boundaries): it is a read of one module global plus a float store when a
+watchdog is armed, and a no-op read when not.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+__all__ = ["EXIT_WATCHDOG", "Watchdog", "install_watchdog",
+           "uninstall_watchdog", "heartbeat", "current_watchdog"]
+
+# Distinct exit code for "step deadline exceeded, self-aborted with a
+# stack dump" (see module docstring; EXIT_PREEMPTED = 77 is the
+# relaunch-me code).
+EXIT_WATCHDOG = 113
+
+
+def dump_stacks(extra: str = "") -> str:
+    """All Python thread stacks + a telemetry snapshot, as one report."""
+    lines = [f"== watchdog dump pid={os.getpid()} ts={time.time():.3f} =="]
+    if extra:
+        lines.append(extra)
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for tid, frame in sys._current_frames().items():
+        lines.append(f"-- thread {names.get(tid, '?')} ({tid}) --")
+        lines.append("".join(traceback.format_stack(frame)))
+    try:
+        from ..profiler.telemetry import get_telemetry
+
+        import json
+
+        lines.append("-- telemetry --")
+        lines.append(json.dumps(get_telemetry().scalars(), sort_keys=True))
+    except Exception:
+        pass  # a dump must never fail because telemetry did
+    return "\n".join(lines)
+
+
+class Watchdog:
+    """Deadline monitor over step-boundary heartbeats.
+
+    Args:
+        deadline_s: max seconds between heartbeats before firing. Size it
+            to cover the SLOWEST legitimate gap — including the first
+            step's XLA compile (engines beat at step entry, so a long
+            compile counts against the deadline).
+        dump_dir: where to write ``watchdog-<pid>.txt``; None → stderr
+            only.
+        abort: fire → ``os._exit(exit_code)`` after the dump. ``False``
+            runs ``on_timeout(report)`` instead and disarms (for tests
+            and embedders that own process teardown). ``os._exit`` — not
+            sys.exit — because the main thread is by definition stuck;
+            SystemExit raised on this watcher thread would kill only the
+            watcher.
+        on_timeout: callback receiving the dump text when ``abort=False``.
+    """
+
+    def __init__(self, deadline_s: float, dump_dir: Optional[str] = None,
+                 abort: bool = True, exit_code: int = EXIT_WATCHDOG,
+                 on_timeout: Optional[Callable[[str], None]] = None,
+                 poll_s: Optional[float] = None):
+        self.deadline_s = float(deadline_s)
+        self.dump_dir = dump_dir
+        self.abort = abort
+        self.exit_code = int(exit_code)
+        self.on_timeout = on_timeout
+        self._poll_s = poll_s if poll_s is not None else max(
+            min(self.deadline_s / 4.0, 1.0), 0.01)
+        self._last = time.monotonic()
+        self.last_step: Optional[int] = None
+        self._stop = threading.Event()
+        self._fired = False
+        self._thread = threading.Thread(target=self._run, name="Watchdog",
+                                        daemon=True)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "Watchdog":
+        self._last = time.monotonic()
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2.0)
+
+    @property
+    def fired(self) -> bool:
+        return self._fired
+
+    # -- heartbeat ---------------------------------------------------------
+    def beat(self, step: Optional[int] = None) -> None:
+        self._last = time.monotonic()
+        if step is not None:
+            self.last_step = step
+
+    # -- watcher loop ------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            if time.monotonic() - self._last <= self.deadline_s:
+                continue
+            self._fired = True
+            from ..profiler.telemetry import get_telemetry
+
+            # counter FIRST so the dump's own telemetry snapshot (and a
+            # JSONL sink) can still observe it before an abort discards
+            # this process's in-memory state
+            get_telemetry().counter("resilience/watchdog_dumps")
+            report = dump_stacks(
+                extra=f"no heartbeat for > {self.deadline_s:.3f}s "
+                      f"(last step: {self.last_step})")
+            self._write_report(report)
+            sink = os.environ.get("PADDLE_TPU_TELEMETRY_JSONL")
+            if sink:
+                try:
+                    get_telemetry().to_jsonl(sink, tag="watchdog")
+                except Exception:
+                    pass  # the abort must not be blocked by a bad sink
+            if self.abort:
+                sys.stderr.write(report + "\n")
+                sys.stderr.flush()
+                os._exit(self.exit_code)
+            if self.on_timeout is not None:
+                try:
+                    self.on_timeout(report)
+                except Exception:
+                    pass
+            return  # non-abort mode disarms after one dump
+
+    def _write_report(self, report: str) -> None:
+        if not self.dump_dir:
+            return
+        try:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            path = os.path.join(self.dump_dir, f"watchdog-{os.getpid()}.txt")
+            with open(path, "w") as f:
+                f.write(report)
+        except OSError:
+            pass  # the dump still reaches stderr in abort mode
+
+
+_active: Optional[Watchdog] = None
+
+
+def install_watchdog(deadline_s: float, **kwargs) -> Watchdog:
+    """Create, start, and register the process-wide watchdog the engines'
+    step boundaries feed. Replaces any previous one."""
+    global _active
+    if _active is not None:
+        _active.stop()
+    _active = Watchdog(deadline_s, **kwargs).start()
+    return _active
+
+
+def uninstall_watchdog() -> None:
+    global _active
+    if _active is not None:
+        _active.stop()
+        _active = None
+
+
+def current_watchdog() -> Optional[Watchdog]:
+    return _active
+
+
+def heartbeat(step: Optional[int] = None) -> None:
+    """Step-boundary beat — the one call sites use. No-op (one global
+    read) when no watchdog is installed."""
+    w = _active
+    if w is not None:
+        w.beat(step)

@@ -19,6 +19,7 @@ from ..core.enforce import InvalidArgumentError, enforce
 from ..core.tensor import Parameter, Tensor
 from ..profiler.retrace import tracked_jit
 from ..profiler.telemetry import get_telemetry
+from ..resilience.watchdog import heartbeat as _watchdog_heartbeat
 from ..utils import profiler as _host_profiler
 from .program import Program, default_main_program
 
@@ -97,6 +98,7 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
             fetch_var_name="fetch", scope=None, return_numpy=True,
             use_program_cache=True):
+        _watchdog_heartbeat()  # run boundary feeds the hang watchdog
         t_enter = time.perf_counter()
         tel = get_telemetry()
         program = program if isinstance(program, Program) else (
@@ -600,6 +602,7 @@ class Executor:
             raise InvalidArgumentError(
                 "run_steps requires a program with an optimizer "
                 "(opt.minimize(loss) recorded)")
+        _watchdog_heartbeat()
         feed = feed or {}
         if n_steps is None:
             raise InvalidArgumentError("n_steps is required")

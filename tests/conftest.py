@@ -16,6 +16,12 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running end-to-end tests (tier-1 runs -m 'not slow')")
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(1234)

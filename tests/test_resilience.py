@@ -1,0 +1,691 @@
+"""Resilient training runtime: every recovery path exercised through the
+deterministic fault-injection harness (resilience.inject) — NaN step →
+skip + loss-scale backoff + rollback after K; watchdog stack dump on an
+injected slow step; SIGTERM → emergency checkpoint → resume at the same
+step; worker kill → respawn with no lost or duplicated batches; plus the
+retry layer, the AmpScaler state satellite, and the sanitizer message
+satellite."""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit.train_step import TrainStep
+from paddle_tpu.profiler.telemetry import get_telemetry
+from paddle_tpu.resilience import (
+    EXIT_PREEMPTED,
+    FaultInjector,
+    RecoveryPolicy,
+    StepGuard,
+    Watchdog,
+    backoff_delays,
+    clear_preemption_request,
+    install_watchdog,
+    load_quarantine,
+    replay_quarantine,
+    retry_call,
+    uninstall_preemption_handler,
+    uninstall_watchdog,
+)
+
+
+def _mse(out, y):
+    return ((out - y) ** 2).mean()
+
+
+def _build_step(guard=True, seed=0):
+    paddle.seed(seed)
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    return TrainStep(net, _mse, opt, guard_updates=guard)
+
+
+def _batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return ([rng.randn(8, 4).astype("float32") for _ in range(n)],
+            [rng.randn(8, 2).astype("float32") for _ in range(n)])
+
+
+def _host_params(step):
+    return {k: np.asarray(v) for k, v in step._params.items()}
+
+
+# ---------------------------------------------------------------------------
+class TestRetry:
+    def test_backoff_is_deterministic_and_capped(self):
+        assert backoff_delays(4, base=0.5, factor=2.0, max_delay=3.0) == \
+            [0.5, 1.0, 2.0, 3.0]
+        assert backoff_delays(0) == []
+
+    def test_retry_call_recovers_and_counts(self):
+        calls = []
+        slept = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "done"
+
+        before = get_telemetry().counter_value("resilience/io_retries")
+        out = retry_call(flaky, retries=3, base=0.01, sleep=slept.append)
+        assert out == "done" and len(calls) == 3
+        assert slept == [0.01, 0.02]
+        assert get_telemetry().counter_value("resilience/io_retries") \
+            == before + 2
+
+    def test_exhausted_reraises_last(self):
+        def always():
+            raise OSError("persistent")
+
+        with pytest.raises(OSError, match="persistent"):
+            retry_call(always, retries=2, base=0.0, sleep=lambda s: None)
+
+    def test_non_matching_exception_propagates_immediately(self):
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("logic bug")
+
+        with pytest.raises(ValueError):
+            retry_call(bad, retries=3, base=0.0, sleep=lambda s: None)
+        assert len(calls) == 1  # not retried
+
+
+class TestInjector:
+    def test_spec_parsing(self):
+        inj = FaultInjector.from_spec("nan@3, sigterm@7,slow@5:1.5,"
+                                      "kill_worker@2")
+        assert inj.nan_steps == {3}
+        assert inj.sigterm_steps == {7}
+        assert inj.slow_steps == {5: 1.5}
+        assert inj.kill_worker_batches == {2}
+
+    def test_bad_kind_raises(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultInjector.from_spec("explode@1")
+
+    def test_corrupt_batch_poisons_one_leaf_once(self):
+        inj = FaultInjector(nan_steps=[2])
+        x = np.ones((4, 3), np.float32)
+        y = np.ones((4,), np.int64)
+        out = inj.corrupt_batch(1, (x, y))
+        assert np.isfinite(np.asarray(out[0])).all()  # wrong step: untouched
+        out = inj.corrupt_batch(2, (x, y))
+        assert np.isnan(np.asarray(out[0]).ravel()[0])
+        assert np.asarray(out[1]).dtype == np.int64  # int leaf skipped
+        # one-shot: replaying step 2 in the same process is clean
+        again = inj.corrupt_batch(2, (x, y))
+        assert np.isfinite(np.asarray(again[0])).all()
+        assert np.isfinite(x).all()  # original never mutated
+
+    def test_state_dir_markers_survive_processes(self, tmp_path):
+        d = str(tmp_path / "state")
+        a = FaultInjector(sigterm_steps=[5], state_dir=d)
+        assert a._once("sigterm@5") is True
+        b = FaultInjector(sigterm_steps=[5], state_dir=d)  # "relaunched"
+        assert b._once("sigterm@5") is False
+
+
+# ---------------------------------------------------------------------------
+class TestStepGuardNaN:
+    def test_skip_quarantine_backoff_rollback(self, tmp_path):
+        from paddle_tpu.amp import AmpScaler
+
+        tel = get_telemetry()
+        before = {k: tel.counter_value(f"resilience/{k}") for k in
+                  ("nonfinite_steps", "rollbacks", "quarantined_batches")}
+        step = _build_step()
+        scaler = AmpScaler(enable=True, init_loss_scaling=1024.0)
+        qdir = str(tmp_path / "q")
+        guard = StepGuard(
+            step,
+            RecoveryPolicy(max_consecutive_bad=1, snapshot_every=1,
+                           quarantine_dir=qdir),
+            scaler=scaler,
+            injector=FaultInjector(nan_steps=[2]))
+        xs, ys = _batches(6)
+        params_before_bad = None
+        for i in range(6):
+            if i == 2:
+                params_before_bad = _host_params(step)
+            guard((xs[i],), (ys[i],))
+        assert guard.step_count == 6
+        # the bad step applied NO update (in-jit select + rollback)
+        after_bad = _host_params(step)
+        for k in params_before_bad:
+            assert np.isfinite(after_bad[k]).all()
+        assert tel.counter_value("resilience/nonfinite_steps") == \
+            before["nonfinite_steps"] + 1
+        assert tel.counter_value("resilience/rollbacks") == \
+            before["rollbacks"] + 1
+        assert tel.counter_value("resilience/quarantined_batches") == \
+            before["quarantined_batches"] + 1
+        assert scaler.get_init_loss_scaling() == 512.0  # backed off once
+        # quarantined batch replays non-finite through a fresh step
+        files = os.listdir(qdir)
+        assert files == ["step-2.npz"]
+        qpath = os.path.join(qdir, files[0])
+        _, _, meta = load_quarantine(qpath)
+        assert meta["step"] == 2 and "loss" in meta["bad"]
+        ok, bad = replay_quarantine(_build_step(), qpath)
+        assert not ok and "loss" in bad
+
+    def test_bad_step_skips_update_exactly(self):
+        """Uninjected twin skipping batch 2's update == guarded run where
+        batch 2 went NaN: the recovery semantics, stated as an equality."""
+        xs, ys = _batches(5)
+        ref = _build_step()
+        gref = StepGuard(ref, RecoveryPolicy(quarantine_dir=None))
+        for i in range(5):
+            if i == 2:
+                continue  # manual skip
+            gref((xs[i],), (ys[i],))
+        inj_step = _build_step()
+        ginj = StepGuard(inj_step,
+                         RecoveryPolicy(max_consecutive_bad=1,
+                                        snapshot_every=1,
+                                        quarantine_dir=None),
+                         injector=FaultInjector(nan_steps=[2]))
+        for i in range(5):
+            ginj((xs[i],), (ys[i],))
+        ref_p, inj_p = _host_params(ref), _host_params(inj_step)
+        for k in ref_p:
+            np.testing.assert_allclose(inj_p[k], ref_p[k], atol=1e-6)
+
+    def test_gives_up_after_max_rollbacks(self, tmp_path):
+        step = _build_step()
+        guard = StepGuard(
+            step,
+            RecoveryPolicy(max_consecutive_bad=1, max_rollbacks=2,
+                           snapshot_every=1,
+                           quarantine_dir=str(tmp_path / "q")),
+            injector=FaultInjector(nan_steps=[0, 1, 2, 3, 4]))
+        xs, ys = _batches(5)
+        with pytest.raises(FloatingPointError, match="giving up after 2"):
+            for i in range(5):
+                guard((xs[i],), (ys[i],))
+
+    def test_requires_guarded_engine(self):
+        step = _build_step(guard=False)
+        with pytest.raises(ValueError, match="guard_updates=True"):
+            StepGuard(step)
+
+
+class TestStepGuardFleet:
+    def test_sharded_engine_recovers(self, tmp_path):
+        import jax
+        from jax.sharding import Mesh
+
+        from paddle_tpu.distributed.fleet.engine import ParallelTrainStep
+
+        paddle.seed(0)
+        net = nn.Linear(8, 4)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters())
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("dp", "sharding"))
+        engine = ParallelTrainStep(net, _mse, opt, mesh, zero_stage=1,
+                                   guard_updates=True)
+        guard = StepGuard(engine,
+                          RecoveryPolicy(max_consecutive_bad=1,
+                                         snapshot_every=1,
+                                         quarantine_dir=str(tmp_path / "q")),
+                          injector=FaultInjector(nan_steps=[1]))
+        rng = np.random.RandomState(0)
+        for i in range(4):
+            guard((rng.randn(8, 8).astype("float32"),),
+                  (rng.randn(8, 4).astype("float32"),))
+        params = {k: np.asarray(v) for k, v in engine._params.items()}
+        assert all(np.isfinite(v).all() for v in params.values())
+        # snapshot/restore preserved the engine's shardings
+        snap = engine.snapshot_state()
+        engine.restore_state(snap)
+        for n, v in engine._params.items():
+            assert v.sharding == engine._param_shardings[n]
+        out = engine((rng.randn(8, 8).astype("float32"),),
+                     (rng.randn(8, 4).astype("float32"),))
+        assert np.isfinite(float(np.asarray(out._value)))
+
+
+# ---------------------------------------------------------------------------
+class TestWatchdog:
+    def test_dump_on_injected_slow_step(self, tmp_path):
+        dumps = []
+        tel = get_telemetry()
+        before = tel.counter_value("resilience/watchdog_dumps")
+        step = _build_step()
+        guard = StepGuard(step,
+                          RecoveryPolicy(quarantine_dir=None),
+                          injector=FaultInjector(slow_steps={1: 0.6}))
+        xs, ys = _batches(3)
+        guard((xs[0],), (ys[0],))  # warm up: step 0's XLA compile is a
+        # legitimate long gap — arm the deadline only once steady-state
+        wd = install_watchdog(0.15, abort=False, on_timeout=dumps.append,
+                              dump_dir=str(tmp_path), poll_s=0.02)
+        try:
+            for i in range(1, 3):
+                guard((xs[i],), (ys[i],))
+            assert wd.fired
+            assert len(dumps) == 1
+            # the dump names the stuck thread's stack (caught inside the
+            # injected sleep) and carries a telemetry snapshot
+            assert "MainThread" in dumps[0]
+            assert "-- telemetry --" in dumps[0]
+            assert "maybe_slow" in dumps[0]
+            report_file = os.path.join(str(tmp_path),
+                                       f"watchdog-{os.getpid()}.txt")
+            assert os.path.exists(report_file)
+            assert tel.counter_value("resilience/watchdog_dumps") \
+                == before + 1
+        finally:
+            uninstall_watchdog()
+
+    def test_heartbeats_keep_it_quiet(self):
+        fired = []
+        wd = install_watchdog(0.2, abort=False, on_timeout=fired.append,
+                              poll_s=0.02)
+        try:
+            import time
+
+            for i in range(5):
+                wd.beat(i)
+                time.sleep(0.05)
+            assert not wd.fired and not fired
+            assert wd.last_step == 4
+        finally:
+            uninstall_watchdog()
+
+
+# ---------------------------------------------------------------------------
+class TestPreemptionResume:
+    def test_sigterm_checkpoint_resume_matches_uninjected(self, tmp_path):
+        xs, ys = _batches(6)
+        ref = _build_step()
+        gref = StepGuard(ref, RecoveryPolicy(quarantine_dir=None))
+        for i in range(6):
+            gref((xs[i],), (ys[i],))
+        ref_params = _host_params(ref)
+
+        spill = str(tmp_path / "emergency")
+        try:
+            first = _build_step()
+            g1 = StepGuard(first,
+                           RecoveryPolicy(spill_path=spill,
+                                          quarantine_dir=None),
+                           injector=FaultInjector(sigterm_steps=[3]),
+                           ).install_preemption()
+            with pytest.raises(SystemExit) as exc:
+                for i in range(g1.resume(), 6):
+                    g1((xs[i],), (ys[i],))
+            assert exc.value.code == EXIT_PREEMPTED
+            clear_preemption_request()  # a real relaunch starts flag-clear
+
+            second = _build_step()
+            g2 = StepGuard(second, RecoveryPolicy(spill_path=spill,
+                                                  quarantine_dir=None))
+            assert g2.resume() == 3  # continues at the preempted step
+            for i in range(3, 6):
+                g2((xs[i],), (ys[i],))
+            assert g2.step_count == 6
+            got = _host_params(second)
+            for k in ref_params:
+                np.testing.assert_allclose(got[k], ref_params[k], atol=1e-6)
+        finally:
+            uninstall_preemption_handler()
+
+    def test_resume_restores_lr_schedule_position(self, tmp_path):
+        """The emergency spill carries the optimizer's scalar state: a
+        resumed job must keep its warmup/decay position, not restart the
+        schedule at step 0 while params continue from step N."""
+        from paddle_tpu.optimizer.lr import NoamDecay
+
+        spill = str(tmp_path / "em")
+
+        def build():
+            paddle.seed(0)
+            net = nn.Linear(4, 2)
+            sched = NoamDecay(d_model=64, warmup_steps=100)
+            opt = paddle.optimizer.Adam(learning_rate=sched,
+                                        parameters=net.parameters())
+            return TrainStep(net, _mse, opt, guard_updates=True), sched
+
+        xs, ys = _batches(6)
+        try:
+            step1, sched1 = build()
+            g1 = StepGuard(step1, RecoveryPolicy(spill_path=spill,
+                                                 quarantine_dir=None),
+                           injector=FaultInjector(sigterm_steps=[4]),
+                           ).install_preemption()
+            with pytest.raises(SystemExit):
+                for i in range(6):
+                    g1((xs[i],), (ys[i],))
+                    sched1.step()
+            clear_preemption_request()
+            gs_at_exit = step1._optimizer._global_step
+            epoch_at_exit = sched1.last_epoch
+
+            step2, sched2 = build()
+            assert sched2.last_epoch != epoch_at_exit  # fresh by default
+            g2 = StepGuard(step2, RecoveryPolicy(spill_path=spill,
+                                                 quarantine_dir=None))
+            assert g2.resume() == 4
+            assert step2._optimizer._global_step == gs_at_exit
+            assert sched2.last_epoch == epoch_at_exit
+        finally:
+            uninstall_preemption_handler()
+
+    def test_handler_chains_and_uninstalls(self):
+        from paddle_tpu.resilience import (install_preemption_handler,
+                                           preemption_requested)
+
+        assert not preemption_requested()  # no handler: always False
+        h = install_preemption_handler()
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert preemption_requested()
+            assert h.received_signum == signal.SIGTERM
+        finally:
+            uninstall_preemption_handler()
+        assert not preemption_requested()
+
+
+# ---------------------------------------------------------------------------
+from paddle_tpu.io.dataset import Dataset as _Dataset
+
+
+class _RowDataset(_Dataset):
+    """Module-level so spawn workers can pickle it."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.full((3,), i, np.float32)
+
+
+class TestWorkerRespawn:
+    def test_killed_worker_respawns_no_lost_or_dup_batches(self):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.resilience import clear_injector, install_injector
+
+        tel = get_telemetry()
+        before = tel.counter_value("resilience/worker_respawns")
+        install_injector(FaultInjector(kill_worker_batches=[2]))
+        try:
+            loader = DataLoader(_RowDataset(24), batch_size=2, num_workers=2,
+                                persistent_workers=True,
+                                use_shared_memory=False)
+            got = sorted(float(b.numpy().ravel()[0]) for b in loader)
+            assert got == [float(i) for i in range(0, 24, 2)]
+            assert tel.counter_value("resilience/worker_respawns") \
+                == before + 1
+            # the respawned pool serves the next epoch too
+            got2 = sorted(float(b.numpy().ravel()[0]) for b in loader)
+            assert got2 == got
+            loader._persistent_iter._shutdown()
+        finally:
+            clear_injector()
+
+    def test_second_death_of_same_slot_raises(self):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.resilience import clear_injector, install_injector
+
+        install_injector(FaultInjector(kill_worker_batches=[1, 3]))
+        try:
+            loader = DataLoader(_RowDataset(16), batch_size=2, num_workers=1,
+                                use_shared_memory=False)
+            with pytest.raises(RuntimeError, match="respawn budget"):
+                list(loader)
+        finally:
+            clear_injector()
+
+
+class _TinyXY(_Dataset):
+    def __len__(self):
+        return 4
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(i)
+        return (rng.randn(4).astype("float32"),
+                rng.randn(2).astype("float32"))
+
+
+class TestHapiPreemptResume:
+    def test_fit_consumes_preempt_checkpoint(self, tmp_path):
+        """A relaunched fit(save_dir=...) must continue from the
+        emergency checkpoint the preempted attempt wrote, not from fresh
+        init."""
+        save_dir = str(tmp_path / "ck")
+        os.makedirs(save_dir)
+
+        def build(seed):
+            paddle.seed(seed)
+            m = paddle.Model(nn.Linear(4, 2))
+            m.prepare(paddle.optimizer.SGD(learning_rate=0.0,
+                                           parameters=m.parameters()),
+                      nn.MSELoss())
+            return m
+
+        first = build(7)
+        first.save(f"{save_dir}/preempt")  # what exit_for_relaunch saved
+        want = {k: np.asarray(v.numpy())
+                for k, v in first.network.state_dict().items()}
+
+        relaunch = build(99)  # different init — must be overwritten
+        relaunch.fit(_TinyXY(), batch_size=2, epochs=1, verbose=0,
+                     save_dir=save_dir)
+        got = {k: np.asarray(v.numpy())
+               for k, v in relaunch.network.state_dict().items()}
+        for k in want:  # lr=0 ⇒ training left the restored weights alone
+            np.testing.assert_allclose(got[k], want[k], atol=1e-7)
+        # consume-once: a later unrelated run in the same save_dir must
+        # NOT silently inherit this emergency state
+        assert not os.path.exists(f"{save_dir}/preempt.pdparams")
+
+
+class TestQuarantineStructure:
+    def test_structured_batch_roundtrips(self, tmp_path):
+        """Quarantine preserves the batch's pytree SHAPE — a dict-of-
+        features input replays as a dict, not a flat leaf tuple."""
+        from paddle_tpu.resilience import load_quarantine, quarantine_batch
+
+        feats = {"ids": np.arange(6, dtype=np.float32).reshape(2, 3),
+                 "mask": np.ones((2, 3), np.int64)}
+        path = quarantine_batch(str(tmp_path), 5, (feats,),
+                                (np.zeros(2, np.float32),), ["loss"])
+        ins, labs, meta = load_quarantine(path)
+        assert isinstance(ins, tuple) and isinstance(ins[0], dict)
+        assert set(ins[0]) == {"ids", "mask"}
+        np.testing.assert_array_equal(ins[0]["ids"], feats["ids"])
+        assert ins[0]["mask"].dtype == np.int64
+        np.testing.assert_array_equal(labs[0], np.zeros(2, np.float32))
+        assert meta["step"] == 5 and meta["bad"] == ["loss"]
+
+
+# ---------------------------------------------------------------------------
+class TestCheckpointRetry:
+    def test_save_retries_transient_oserror(self, tmp_path, monkeypatch):
+        from paddle_tpu.incubate import checkpoint as ckpt
+
+        monkeypatch.setenv("PADDLE_TPU_CKPT_RETRY_BASE", "0.01")
+        real_factory = ckpt._checkpointer
+        fails = [2]
+
+        class Flaky:
+            def __init__(self):
+                self._real = real_factory()
+
+            def save(self, path, state):
+                if fails[0] > 0:
+                    fails[0] -= 1
+                    raise OSError("transient fs blip")
+                return self._real.save(path, state)
+
+            def restore(self, path):
+                return self._real.restore(path)
+
+        monkeypatch.setattr(ckpt, "_checkpointer", Flaky)
+        tel = get_telemetry()
+        before = tel.counter_value("resilience/io_retries")
+        path = str(tmp_path / "ck")
+        ckpt.save_train_state({"w": np.arange(4.0)}, path)
+        got = ckpt.restore_train_state(path)
+        np.testing.assert_array_equal(np.asarray(got["w"]), [0, 1, 2, 3])
+        assert tel.counter_value("resilience/io_retries") == before + 2
+
+
+class TestLaunchRestart:
+    def test_preempted_job_relaunches_until_done(self, tmp_path):
+        import textwrap
+
+        from paddle_tpu.distributed.launch import launch
+
+        script = tmp_path / "worker.py"
+        marker = tmp_path / "first_run_done"
+        script.write_text(textwrap.dedent(f"""
+            import os, sys
+            marker = {str(marker)!r}
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                sys.exit({EXIT_PREEMPTED})   # "preempted": ask to relaunch
+            sys.exit(0)
+        """))
+        tel = get_telemetry()
+        before = tel.counter_value("resilience/restarts")
+        rc = launch(str(script), [], nproc_per_node=1,
+                    log_dir=str(tmp_path / "logs"), max_restarts=2,
+                    restart_backoff=0.01,
+                    extra_env={"JAX_PLATFORMS": "cpu"})
+        assert rc == 0
+        assert tel.counter_value("resilience/restarts") == before + 1
+
+    def test_crash_still_fails_fast(self, tmp_path):
+        from paddle_tpu.distributed.launch import launch
+
+        script = tmp_path / "worker.py"
+        script.write_text("import sys; sys.exit(3)\n")
+        rc = launch(str(script), [], nproc_per_node=1,
+                    log_dir=str(tmp_path / "logs"), max_restarts=2,
+                    restart_backoff=0.01,
+                    extra_env={"JAX_PLATFORMS": "cpu"})
+        assert rc == 3  # only EXIT_PREEMPTED buys a relaunch
+
+
+# ---------------------------------------------------------------------------
+class TestAmpScalerState:
+    def test_load_state_dict_restores_schedule(self):
+        from paddle_tpu.amp import AmpScaler
+
+        src = AmpScaler(enable=True, init_loss_scaling=4096.0,
+                        incr_ratio=3.0, decr_ratio=0.25,
+                        incr_every_n_steps=7, decr_every_n_nan_or_inf=2)
+        src._good_steps, src._bad_steps = 5, 1
+        state = src.state_dict()
+        dst = AmpScaler(enable=True)  # constructor defaults everywhere
+        dst.load_state_dict(state)
+        assert dst.get_init_loss_scaling() == 4096.0
+        assert dst._incr_ratio == 3.0 and dst._decr_ratio == 0.25
+        assert dst._incr_every_n_steps == 7 and dst._decr_every_n == 2
+        assert dst._good_steps == 5 and dst._bad_steps == 1
+
+    def test_backoff_and_current_scale(self):
+        from paddle_tpu.amp import AmpScaler
+        from paddle_tpu.amp.grad_scaler import current_loss_scale
+
+        s = AmpScaler(enable=True, init_loss_scaling=64.0, decr_ratio=0.5)
+        assert current_loss_scale() == 64.0
+        assert s.backoff() == 32.0
+        assert s.backoff(factor=0.25) == 8.0
+        assert s.backoff(factor=0.001, min_scale=1.0) == 1.0
+        assert current_loss_scale() == 1.0
+
+    def test_backoff_is_noop_for_static_scale(self):
+        from paddle_tpu.amp import AmpScaler
+
+        s = AmpScaler(enable=True, init_loss_scaling=1024.0,
+                      use_dynamic_loss_scaling=False)
+        assert s.backoff() == 1024.0  # a static scale is never mutated
+        assert s.get_init_loss_scaling() == 1024.0
+
+
+class TestSanitizerMessage:
+    def test_message_carries_scale_and_hint_and_counter(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.amp import AmpScaler
+        from paddle_tpu.core.sanitizer import raise_if_nonfinite
+
+        AmpScaler(enable=True, init_loss_scaling=2048.0)
+        tel = get_telemetry()
+        before = tel.counter_value("resilience/nonfinite_steps")
+        with pytest.raises(FloatingPointError) as exc:
+            raise_if_nonfinite(["loss", "grad/w"],
+                               jnp.asarray([False, True]))
+        msg = str(exc.value)
+        assert "loss" in msg
+        assert "loss_scale=2048" in msg
+        assert "resilience.StepGuard" in msg
+        assert tel.counter_value("resilience/nonfinite_steps") == before + 1
+
+    def test_explicit_scale_wins(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.sanitizer import raise_if_nonfinite
+
+        with pytest.raises(FloatingPointError, match="loss_scale=7"):
+            raise_if_nonfinite(["x"], jnp.asarray([False]), loss_scale=7.0)
+
+
+# ---------------------------------------------------------------------------
+class TestSchemaPrefix:
+    def _gate(self):
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        try:
+            import check_telemetry_schema as gate
+        finally:
+            sys.path.pop(0)
+        return gate
+
+    def test_require_prefix(self, tmp_path):
+        gate = self._gate()
+        p = str(tmp_path / "t.jsonl")
+        rec = {"ts": 1.0, "step": 1, "tag": "t",
+               "scalars": {"counter/resilience/rollbacks": 1}}
+        with open(p, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+        n, err = gate.validate_file(p, require_prefix=["counter/resilience/"])
+        assert n == 1 and err is None
+        n, err = gate.validate_file(p, require_prefix=["counter/prefetch/"])
+        assert "counter/prefetch/" in err
+
+
+@pytest.mark.slow
+class TestResilienceGateEndToEnd:
+    def test_gate_passes(self, tmp_path):
+        """The CI smoke gate itself: NaN + SIGTERM injected launch run
+        recovers to the uninjected final step (acceptance criteria)."""
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "..", "tools",
+                          "check_resilience.py"),
+             "--json", "--workdir", str(tmp_path / "demo")],
+            capture_output=True, text=True, timeout=580,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stdout + r.stderr
+        out = json.loads(r.stdout)
+        assert out["status"] == "OK"
+        assert out["counters"]["counter/resilience/rollbacks"] >= 1
+        assert out["counters"]["counter/resilience/restarts"] >= 1

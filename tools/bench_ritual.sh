@@ -33,6 +33,13 @@ python tools/check_retrace_budget.py TELEMETRY.jsonl --budget 6
 # committed baseline and burn down; anything NEW fails the ritual.
 python tools/tpu_lint.py paddle_tpu --baseline tools/tpu_lint_baseline.json
 
+# resilience gate: end-to-end recovery on a tiny CPU run — one injected
+# NaN step (skip + rollback) and one delivered SIGTERM (emergency
+# checkpoint → exit 77 → capped relaunch) must still reach the
+# uninjected run's final step count, leave resilience/* telemetry, and
+# quarantine a batch that replays non-finite in isolation.
+JAX_PLATFORMS=cpu python tools/check_resilience.py
+
 if [ -f BENCH_extra.prev.json ]; then
   # LeNet rides per-step dispatch through the remote-TPU tunnel: the r5
   # variance study (tools/profiles/r5_lenet_variance.txt) measured CV 7.6%

@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Resilience smoke gate — recovery is exercised, not claimed.
+
+End-to-end on the CPU backend, against the REAL runtime (StepGuard +
+launch relaunch + fault injection, no mocks):
+
+1. run a tiny seeded training job uninjected → reference final step
+   count;
+2. run the same job under ``distributed.launch`` with a deterministic
+   fault plan — one NaN poisoned into the batch at step N, one real
+   SIGTERM delivered at step M — and a relaunch budget;
+3. assert the injected job still finishes, reaches the SAME final step
+   count, that TELEMETRY.jsonl carries ``resilience/rollbacks >= 1``
+   (the NaN was skipped + rolled back) and ``resilience/restarts >= 1``
+   (the preempted job checkpointed, exited 77, and was relaunched), and
+   that the quarantined batch file reproduces the NaN when replayed
+   through a fresh guarded step in isolation.
+
+Gate conventions per tools/_gate.py (``resilience: OK|FAIL — ...``,
+exit 0/1, ``--json``). Wired into tools/bench_ritual.sh.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_TOOLS)
+sys.path.insert(0, _TOOLS)
+if _REPO not in sys.path:  # runnable from anywhere, not just the repo root
+    sys.path.insert(1, _REPO)
+from _gate import add_gate_args, finish  # noqa: E402
+
+# The demo worker: a guarded train loop over deterministic data. Step
+# position is the data cursor, so a preemption-resumed process continues
+# at exactly the step the emergency checkpoint recorded.
+WORKER = textwrap.dedent("""
+    import json, os
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.profiler.telemetry import get_telemetry
+    from paddle_tpu.resilience import RecoveryPolicy, StepGuard
+
+    STEPS = int(os.environ["DEMO_STEPS"])
+    TEL = os.environ["DEMO_TELEMETRY"]
+
+    paddle.seed(0)
+    net = nn.Linear(8, 4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    step = TrainStep(net, lambda out, y: ((out - y) ** 2).mean(), opt,
+                     guard_updates=True)
+    tel = get_telemetry()
+    guard = StepGuard(
+        step,
+        RecoveryPolicy(max_consecutive_bad=1, snapshot_every=1,
+                       spill_path=os.environ["DEMO_SPILL"],
+                       quarantine_dir=os.environ["DEMO_QUARANTINE"]),
+        on_preempt=lambda: tel.to_jsonl(TEL, tag="resilience_demo"),
+    ).install_preemption()
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(STEPS, 16, 8).astype("float32")
+    ys = rng.randn(STEPS, 16, 4).astype("float32")
+    loss = None
+    for i in range(guard.resume(), STEPS):
+        loss = guard((xs[i],), (ys[i],))
+    with open(os.environ["DEMO_RESULT"], "w") as f:
+        json.dump({"final_step": guard.step_count,
+                   "loss": float(np.asarray(loss._value))}, f)
+    tel.to_jsonl(TEL, step=guard.step_count, tag="resilience_demo")
+""")
+
+
+def _read_counters(tel_path):
+    """Max observed value per counter scalar across all records."""
+    out = {}
+    with open(tel_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            for k, v in json.loads(line).get("scalars", {}).items():
+                if k.startswith("counter/"):
+                    out[k] = max(out.get(k, 0), v)
+    return out
+
+
+def _replay_quarantine(qdir):
+    """Fresh guarded engine, same seed: the quarantined batch must
+    reproduce the non-finite step in isolation."""
+    import numpy as np  # noqa: F401
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.resilience import replay_quarantine
+
+    files = sorted(os.listdir(qdir)) if os.path.isdir(qdir) else []
+    if not files:
+        return False, "no quarantined batch file was written"
+    paddle.seed(0)
+    net = nn.Linear(8, 4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    step = TrainStep(net, lambda out, y: ((out - y) ** 2).mean(), opt,
+                     guard_updates=True)
+    ok, bad = replay_quarantine(step, os.path.join(qdir, files[0]))
+    if ok:
+        return False, f"quarantined batch {files[0]} replayed FINITE"
+    return True, f"{files[0]} reproduces non-finite leaves {bad[:3]}"
+
+
+def run_demo(workdir, steps=10, nan_step=3, sigterm_step=6):
+    """Returns (ok, detail, payload)."""
+    from paddle_tpu.distributed.launch import launch
+
+    worker = os.path.join(workdir, "worker.py")
+    with open(worker, "w") as f:
+        f.write(WORKER)
+    tel_path = os.path.join(workdir, "TELEMETRY.jsonl")
+    base_env = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": _REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "PADDLE_TPU_TELEMETRY": "1",
+        "DEMO_STEPS": str(steps),
+        "DEMO_TELEMETRY": tel_path,
+        "DEMO_SPILL": os.path.join(workdir, "emergency"),
+        "DEMO_QUARANTINE": os.path.join(workdir, "quarantine"),
+        "DEMO_RESULT": os.path.join(workdir, "result.json"),
+    }
+
+    # 1. uninjected reference run
+    ref_env = dict(base_env)
+    ref_env.update({
+        "DEMO_SPILL": os.path.join(workdir, "ref-emergency"),
+        "DEMO_QUARANTINE": os.path.join(workdir, "ref-quarantine"),
+        "DEMO_RESULT": os.path.join(workdir, "ref-result.json"),
+        "DEMO_TELEMETRY": os.path.join(workdir, "ref-telemetry.jsonl"),
+    })
+    r = subprocess.run([sys.executable, worker],
+                       env={**os.environ, **ref_env},
+                       capture_output=True, text=True, timeout=600)
+    if r.returncode != 0:
+        return False, f"uninjected run failed rc={r.returncode}: " \
+                      f"{r.stderr[-400:]}", {}
+    with open(ref_env["DEMO_RESULT"]) as f:
+        ref = json.load(f)
+
+    # 2. injected run under the launch watcher with a relaunch budget
+    inj_env = dict(base_env)
+    inj_env.update({
+        "PADDLE_TPU_INJECT": f"nan@{nan_step},sigterm@{sigterm_step}",
+        "PADDLE_TPU_INJECT_STATE": os.path.join(workdir, "inject-state"),
+    })
+    # telemetry_jsonl: the launcher (this process) owns the restart
+    # counter and appends it to the same stream the workers write — the
+    # production path, not a gate-side special case
+    rc = launch(worker, [], nproc_per_node=1,
+                log_dir=os.path.join(workdir, "logs"), backend="cpu",
+                extra_env=inj_env, max_restarts=2, restart_backoff=0.05,
+                telemetry_jsonl=tel_path)
+    if rc != 0:
+        return False, f"injected run failed rc={rc}", {}
+
+    # 3. assertions
+    with open(base_env["DEMO_RESULT"]) as f:
+        inj = json.load(f)
+    payload = {"ref_final_step": ref["final_step"],
+               "injected_final_step": inj["final_step"]}
+    if inj["final_step"] != ref["final_step"]:
+        return False, (f"final step diverged: injected {inj['final_step']} "
+                       f"vs uninjected {ref['final_step']}"), payload
+
+    from check_telemetry_schema import validate_file
+
+    n, err = validate_file(tel_path,
+                           require=["counter/resilience/rollbacks",
+                                    "counter/resilience/restarts"],
+                           require_prefix=["counter/resilience/"])
+    if err:
+        return False, f"telemetry: {err}", payload
+    counters = _read_counters(tel_path)
+    payload["counters"] = {k: v for k, v in counters.items()
+                           if k.startswith("counter/resilience/")}
+    for need in ("counter/resilience/rollbacks",
+                 "counter/resilience/restarts"):
+        if counters.get(need, 0) < 1:
+            return False, f"{need} = {counters.get(need, 0)}, expected >= 1", \
+                payload
+
+    ok, qdetail = _replay_quarantine(base_env["DEMO_QUARANTINE"])
+    payload["quarantine"] = qdetail
+    if not ok:
+        return False, qdetail, payload
+    return True, (f"recovered through nan@{nan_step} + sigterm@{sigterm_step}"
+                  f" to step {inj['final_step']}; rollbacks="
+                  f"{counters['counter/resilience/rollbacks']:.0f} restarts="
+                  f"{counters['counter/resilience/restarts']:.0f}; "
+                  f"quarantine replay: {qdetail}"), payload
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="End-to-end recovery smoke gate (NaN + SIGTERM "
+                    "injection on a tiny CPU run)")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--nan-step", type=int, default=3)
+    ap.add_argument("--sigterm-step", type=int, default=6)
+    ap.add_argument("--workdir", default=None,
+                    help="keep artifacts here instead of a temp dir")
+    add_gate_args(ap)
+    args = ap.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.workdir:
+        os.makedirs(args.workdir, exist_ok=True)
+        ok, detail, payload = run_demo(args.workdir, args.steps,
+                                       args.nan_step, args.sigterm_step)
+    else:
+        with tempfile.TemporaryDirectory(prefix="resilience-gate-") as d:
+            ok, detail, payload = run_demo(d, args.steps, args.nan_step,
+                                           args.sigterm_step)
+    return finish("resilience", ok, detail, payload=payload,
+                  json_mode=args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,8 +17,11 @@ Usage:
         [--require counter/engine/steps] [--min-records 1]
 
 ``--require NAME`` (repeatable) additionally demands that at least one
-record carries that scalar. Exit 0 on pass; exit 1 with the first
-violation's line number and reason on fail.
+record carries that scalar; ``--require-prefix PREFIX`` (repeatable)
+demands that at least one scalar whose name starts with PREFIX appears
+in some record (e.g. ``--require-prefix counter/resilience/`` asserts a
+run left a resilience trace without naming each counter). Exit 0 on
+pass; exit 1 with the first violation's line number and reason on fail.
 """
 from __future__ import annotations
 
@@ -59,9 +62,10 @@ def validate_record(rec, lineno):
     return None
 
 
-def validate_file(path, require=(), min_records=1):
+def validate_file(path, require=(), min_records=1, require_prefix=()):
     """Returns (n_records, error_message_or_None)."""
     missing = set(require)
+    missing_prefixes = set(require_prefix)
     n = 0
     try:
         with open(path) as f:
@@ -78,12 +82,20 @@ def validate_file(path, require=(), min_records=1):
                     return n, err
                 n += 1
                 missing -= set(rec["scalars"])
+                if missing_prefixes:
+                    missing_prefixes = {
+                        p for p in missing_prefixes
+                        if not any(name.startswith(p)
+                                   for name in rec["scalars"])}
     except OSError as e:
         return 0, f"cannot read {path}: {e}"
     if n < min_records:
         return n, f"{path}: {n} record(s), expected at least {min_records}"
     if missing:
         return n, f"{path}: required scalar(s) never appeared: {sorted(missing)}"
+    if missing_prefixes:
+        return n, (f"{path}: no scalar with required prefix(es): "
+                   f"{sorted(missing_prefixes)}")
     return n, None
 
 
@@ -93,10 +105,14 @@ def main(argv=None):
     ap.add_argument("path")
     ap.add_argument("--require", action="append", default=[],
                     help="scalar name that must appear in >=1 record")
+    ap.add_argument("--require-prefix", action="append", default=[],
+                    help="scalar-name prefix that must match >=1 scalar "
+                         "in >=1 record (e.g. counter/resilience/)")
     ap.add_argument("--min-records", type=int, default=1)
     add_gate_args(ap)
     args = ap.parse_args(argv)
-    n, err = validate_file(args.path, args.require, args.min_records)
+    n, err = validate_file(args.path, args.require, args.min_records,
+                           require_prefix=args.require_prefix)
     payload = {"records": n, "path": args.path}
     if err:
         return finish("telemetry schema", False, err, payload=payload,
