@@ -39,6 +39,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.profiler import spans as _spans
+from paddle_tpu.profiler import xla_cost as _xla_cost
 from paddle_tpu.profiler.retrace import tracked_jit
 from paddle_tpu.profiler.telemetry import get_telemetry
 from paddle_tpu.resilience.watchdog import heartbeat as _watchdog_heartbeat
@@ -523,22 +525,28 @@ class ParallelTrainStep:
         _watchdog_heartbeat()
         t_enter = time.perf_counter()
         compiles_before = self._jitted.tracker.compiles
-        # ONE pytree transfer for the whole batch (single dispatch; an
-        # already-sharded array — e.g. from ``prefetch`` — passes through
-        # without a copy)
-        raw_in, raw_lab = jax.device_put(
-            (_raw_tuple(inputs), _raw_tuple(labels)), self._batch_sharding)
-        lr = self._optimizer.lr_device_scalar()
-        opt_state = self._opt_state
-        if self._offload:
-            # stream host-resident optimizer state into HBM (async device_put)
-            opt_state = jax.tree_util.tree_map(
-                lambda s, sh: jax.device_put(s, sh)
-                if hasattr(s, "shape") else s,
-                opt_state, self._opt_shardings)
-        self._params, self._buffers, new_opt, loss, flags = self._jitted(
-            self._params, self._buffers, opt_state, lr, (raw_in, raw_lab)
-        )
+        with _spans.span("step", cat="step",
+                         step=self._optimizer._global_step):
+            with _spans.span("h2d", cat="h2d"):
+                # ONE pytree transfer for the whole batch (single
+                # dispatch; an already-sharded array — e.g. from
+                # ``prefetch`` — passes through without a copy)
+                raw_in, raw_lab = jax.device_put(
+                    (_raw_tuple(inputs), _raw_tuple(labels)),
+                    self._batch_sharding)
+            lr = self._optimizer.lr_device_scalar()
+            opt_state = self._opt_state
+            if self._offload:
+                # stream host-resident optimizer state into HBM (async
+                # device_put)
+                opt_state = jax.tree_util.tree_map(
+                    lambda s, sh: jax.device_put(s, sh)
+                    if hasattr(s, "shape") else s,
+                    opt_state, self._opt_shardings)
+            with _spans.span("compute", cat="compute"):
+                self._params, self._buffers, new_opt, loss, flags = \
+                    self._jitted(self._params, self._buffers, opt_state, lr,
+                                 (raw_in, raw_lab))
         if self._offload:
             # evacuate the updated state back to host DRAM, freeing HBM
             new_opt = jax.tree_util.tree_map(
@@ -595,12 +603,24 @@ class ParallelTrainStep:
         _watchdog_heartbeat()
         t_enter = time.perf_counter()
 
-        # leading [n_steps] axis is unsharded; ONE pytree transfer for the
-        # whole stacked window (single dispatch instead of one per array)
-        spec = self._batch_sharding.spec
-        win_sharding = NamedSharding(self._mesh, P(*((None,) + tuple(spec))))
-        raw_in, raw_lab = jax.device_put(
-            (_raw_tuple(inputs), _raw_tuple(labels)), win_sharding)
+        # the whole window — h2d, scan compile, LR sampling, dispatch —
+        # lives under one step span; the helper split keeps the long
+        # body at its original indentation
+        with _spans.span("step", cat="step",
+                         step=self._optimizer._global_step):
+            return self._run_steps_in_span(inputs, labels, step_scheduler,
+                                           t_enter)
+
+    def _run_steps_in_span(self, inputs, labels, step_scheduler, t_enter):
+        with _spans.span("h2d", cat="h2d"):
+            # leading [n_steps] axis is unsharded; ONE pytree transfer
+            # for the whole stacked window (single dispatch instead of
+            # one per array)
+            spec = self._batch_sharding.spec
+            win_sharding = NamedSharding(
+                self._mesh, P(*((None,) + tuple(spec))))
+            raw_in, raw_lab = jax.device_put(
+                (_raw_tuple(inputs), _raw_tuple(labels)), win_sharding)
         n_steps = raw_in[0].shape[0]
 
         if self._jitted_multi is None:
@@ -627,6 +647,10 @@ class ParallelTrainStep:
                 donate_argnums=(0, 2) if self._donate else (),
                 out_shardings=self._out_shardings,
             )
+        # attribution: the windowed executable runs n_steps train steps
+        # per invocation while engine/step_ms records per-step time —
+        # MFU must divide the program's flops by the window length
+        _xla_cost.set_steps_per_call("fleet.train_step_multi", int(n_steps))
 
         # per-step LR: a per-iteration scheduler is sampled host-side for
         # every window step, so the scanned steps see exactly the lr
@@ -650,9 +674,10 @@ class ParallelTrainStep:
                 lambda s, sh: jax.device_put(s, sh)
                 if hasattr(s, "shape") else s,
                 opt_state, self._opt_shardings)
-        self._params, self._buffers, new_opt, losses, flags = \
-            self._jitted_multi(self._params, self._buffers, opt_state,
-                               lrs, (raw_in, raw_lab))
+        with _spans.span("compute", cat="compute"):
+            self._params, self._buffers, new_opt, losses, flags = \
+                self._jitted_multi(self._params, self._buffers,
+                                   opt_state, lrs, (raw_in, raw_lab))
         if self._offload:
             # evacuate once per window, freeing HBM between windows
             new_opt = jax.tree_util.tree_map(

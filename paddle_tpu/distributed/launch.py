@@ -30,7 +30,8 @@ import sys
 import time
 from typing import List, Optional
 
-__all__ = ["launch", "get_cluster_env", "watch_local_trainers"]
+__all__ = ["launch", "get_cluster_env", "watch_local_trainers",
+           "rank_telemetry_path"]
 
 
 def _free_ports(n: int) -> List[int]:
@@ -130,8 +131,22 @@ def watch_local_trainers(procs: List[subprocess.Popen],
         return 130
 
 
+def rank_telemetry_path(base: Optional[str], log_dir: str, rank) -> str:
+    """Per-rank telemetry JSONL sink. With a user-provided ``base``
+    (``--telemetry_jsonl`` / PADDLE_TPU_TELEMETRY_JSONL) rank files land
+    beside it as ``<base-stem>.rank<i>.jsonl`` — a SHARED path across
+    ranks would interleave concurrent appends into one corrupt log.
+    Default: ``<log_dir>/telemetry.rank<i>.jsonl``. These are the files
+    ``tools/telemetry_agg.py`` merges into the cluster view."""
+    if base:
+        root, ext = os.path.splitext(base)
+        return f"{root}.rank{rank}{ext or '.jsonl'}"
+    return os.path.join(log_dir, f"telemetry.rank{rank}.jsonl")
+
+
 def _run_job_once(training_script, script_args, envs, log_dir, backend,
-                  extra_env, log_mode: str) -> int:
+                  extra_env, log_mode: str,
+                  telemetry_jsonl: Optional[str] = None) -> int:
     """Spawn every rank, watch fail-fast, surface the failing log tail.
     One launch attempt — the restart policy lives in ``launch``."""
     procs = []
@@ -141,6 +156,11 @@ def _run_job_once(training_script, script_args, envs, log_dir, backend,
         if backend == "cpu":  # simulation mode: each rank is a 1-device CPU
             full_env.setdefault("JAX_PLATFORMS", "cpu")
         rank = env["PADDLE_TRAINER_ID"]
+        # per-rank telemetry sink: the worker's Telemetry flushes a final
+        # record here at exit (and the watchdog dumps here on a hang), so
+        # every rank leaves an aggregatable JSONL with zero script changes
+        full_env["PADDLE_TPU_TELEMETRY_JSONL"] = rank_telemetry_path(
+            telemetry_jsonl, log_dir, rank)
         log_f = open(os.path.join(log_dir, f"workerlog.{rank}"), log_mode)
         logs.append(log_f)
         p = subprocess.Popen(
@@ -197,7 +217,12 @@ def launch(training_script: str, script_args: List[str],
     launcher telemetry record there when the job ends after >= 1
     relaunch — the ``resilience/restarts`` counter lives in THIS
     process, so without a sink it would never reach the JSONL the
-    workers write."""
+    workers write. Every RANK additionally gets its own sink
+    (``rank_telemetry_path``: ``<log_dir>/telemetry.rank<i>.jsonl`` by
+    default) exported as its PADDLE_TPU_TELEMETRY_JSONL — workers flush
+    a final record there at exit, and ``tools/telemetry_agg.py`` merges
+    the per-rank files into one cluster view with straggler
+    detection."""
     from paddle_tpu.profiler.telemetry import get_telemetry
     from paddle_tpu.resilience.retry import backoff_delays
 
@@ -209,12 +234,26 @@ def launch(training_script: str, script_args: List[str],
         max_restarts = int(os.environ.get("PADDLE_TPU_MAX_RESTARTS", "0"))
     if telemetry_jsonl is None:
         telemetry_jsonl = os.environ.get("PADDLE_TPU_TELEMETRY_JSONL")
+    # fresh job ⇒ fresh telemetry: workerlog.<rank> opens with mode "w"
+    # below, but the per-rank telemetry sinks are APPENDED by workers, so
+    # stale files from a previous job in this log_dir (possibly with a
+    # larger world — ghost ranks) would pollute telemetry_agg's cluster
+    # view and its straggler medians. Relaunch attempts keep appending.
+    import glob as _glob
+
+    pattern = rank_telemetry_path(telemetry_jsonl, log_dir, "*")
+    for stale in _glob.glob(pattern):
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
     delays = backoff_delays(max_restarts, base=restart_backoff)
     attempt = 0
     while True:
         rc = _run_job_once(training_script, script_args, envs, log_dir,
                            backend, extra_env,
-                           log_mode="w" if attempt == 0 else "a")
+                           log_mode="w" if attempt == 0 else "a",
+                           telemetry_jsonl=telemetry_jsonl)
         if rc != _preempt_exit_code() or attempt >= max_restarts:
             if telemetry_jsonl and attempt:
                 get_telemetry().to_jsonl(telemetry_jsonl, tag="launch")

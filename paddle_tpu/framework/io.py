@@ -66,13 +66,15 @@ def save(obj, path, protocol=_PROTOCOL, **configs):
     """``configs['cipher_key']``: AES key (bytes) — the file is written
     AES-GCM encrypted (framework.io_crypto; reference
     framework/io/crypto/aes_cipher.cc)."""
+    from ..profiler import spans as _spans
     from ..profiler.telemetry import get_telemetry
 
     tel = get_telemetry()
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with tel.timer("checkpoint/write_ms"):
+    with _spans.span("checkpoint", cat="checkpoint"), \
+            tel.timer("checkpoint/write_ms"):
         payload = _to_saveable(obj)
         key = configs.get("cipher_key")
         if key is not None:
@@ -94,13 +96,15 @@ def load(path, **configs):
     """``configs['cipher_key']``: AES key for a file written with
     ``save(..., cipher_key=...)``; encrypted files are auto-detected and
     loading one without the key raises a clear error."""
+    from ..profiler import spans as _spans
     from ..profiler.telemetry import get_telemetry
 
     tel = get_telemetry()
     return_numpy = configs.get("return_numpy", False)
     from .io_crypto import AESCipher, is_encrypted
 
-    with tel.timer("checkpoint/read_ms"):
+    with _spans.span("checkpoint", cat="checkpoint"), \
+            tel.timer("checkpoint/read_ms"):
         if is_encrypted(path):
             key = configs.get("cipher_key")
             if key is None:

@@ -13,6 +13,7 @@ import numpy as np
 from ..core.tensor import Tensor, no_grad, to_tensor
 from ..metric import Metric
 from ..nn.layer_base import Layer
+from ..profiler import spans as _spans
 from ..resilience import preemption as _preempt
 from . import callbacks as callbacks_mod
 
@@ -102,6 +103,27 @@ class Model:
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, prefetch_depth=0,
             prefetch_buckets=None):
+        """See ``_fit_impl`` for the behavior docs. This boundary owns
+        the root "fit" span of the structured-span hierarchy
+        (fit → epoch → step → h2d/compute/callback/checkpoint) so every
+        exit path — normal, exception, preemption — closes it; the full
+        keyword signature stays here for introspection/IDE surfaces."""
+        with _spans.span("fit", cat="fit"):
+            return self._fit_impl(
+                train_data=train_data, eval_data=eval_data,
+                batch_size=batch_size, epochs=epochs, eval_freq=eval_freq,
+                log_freq=log_freq, save_dir=save_dir, save_freq=save_freq,
+                verbose=verbose, drop_last=drop_last, shuffle=shuffle,
+                num_workers=num_workers, callbacks=callbacks,
+                accumulate_grad_batches=accumulate_grad_batches,
+                num_iters=num_iters, prefetch_depth=prefetch_depth,
+                prefetch_buckets=prefetch_buckets)
+
+    def _fit_impl(self, train_data=None, eval_data=None, batch_size=1,
+                  epochs=1, eval_freq=1, log_freq=10, save_dir=None,
+                  save_freq=1, verbose=2, drop_last=False, shuffle=True,
+                  num_workers=0, callbacks=None, accumulate_grad_batches=1,
+                  num_iters=None, prefetch_depth=0, prefetch_buckets=None):
         """``prefetch_depth`` > 0 stages batches through an
         ``io.DevicePrefetcher``: a background pipeline that many batches
         ahead pads into ``prefetch_buckets`` (fixed compile shapes for
@@ -146,6 +168,11 @@ class Model:
         for epoch in range(epochs):
             if self.stop_training:
                 break
+            # epoch span: explicit enter, exited after the epoch-end
+            # checkpoint below. An exception path may skip the exit —
+            # the span stack self-heals (and the dangling "B" in the
+            # flight recorder is correct forensics: the epoch WAS open).
+            _epoch_span = _spans.span("epoch", cat="epoch").__enter__()
             cbks.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
@@ -175,14 +202,16 @@ class Model:
                             lambda a: Tensor(a) if isinstance(a, jax.Array)
                             else a, batch)
                     inputs, labels = _split_batch(batch)
-                    cbks.on_batch_begin("train", step_i, logs)
-                    out = self.train_batch(inputs, labels)
-                    loss_v, metr = out if isinstance(out, tuple) else (out, [])
-                    logs = {"loss": loss_v, "step": step_i}
-                    for m in self._metrics:
-                        for n, v in zip(_as_list(m.name()), _as_list(m.accumulate())):
-                            logs[n] = v
-                    cbks.on_batch_end("train", step_i, logs)
+                    with _spans.span("step", cat="step", step=it_count):
+                        cbks.on_batch_begin("train", step_i, logs)
+                        out = self.train_batch(inputs, labels)
+                        loss_v, metr = out if isinstance(out, tuple) else (out, [])
+                        logs = {"loss": loss_v, "step": step_i}
+                        for m in self._metrics:
+                            for n, v in zip(_as_list(m.name()), _as_list(m.accumulate())):
+                                logs[n] = v
+                        with _spans.span("callback", cat="callback"):
+                            cbks.on_batch_end("train", step_i, logs)
                     it_count += 1
                     if num_iters is not None and it_count >= num_iters:
                         break
@@ -200,7 +229,9 @@ class Model:
                 if hasattr(lr, "step"):
                     lr.step()
             if save_dir and (epoch + 1) % save_freq == 0:
-                self.save(f"{save_dir}/{epoch}")
+                with _spans.span("checkpoint", cat="checkpoint"):
+                    self.save(f"{save_dir}/{epoch}")
+            _epoch_span.__exit__(None, None, None)
         cbks.on_end("train", logs)
         return self
 

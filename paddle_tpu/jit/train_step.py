@@ -7,6 +7,8 @@ hapi.Model, the fleet data-parallel engine, and bench.py all build on this.
 """
 from __future__ import annotations
 
+import contextlib
+import time
 from functools import partial
 from typing import Any, Callable, Dict, Optional
 
@@ -16,7 +18,9 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 from ..optimizer.optimizer import Optimizer
+from ..profiler import spans as _spans
 from ..profiler.retrace import tracked_jit
+from ..profiler.telemetry import get_telemetry
 from ..resilience.guard import copy_tree as _copy_tree
 from ..resilience.watchdog import heartbeat as _watchdog_heartbeat
 from .functionalize import functionalize, get_buffers, get_params, set_buffers, set_params
@@ -120,6 +124,7 @@ class TrainStep:
         self._jitted = tracked_jit(step_fn, name="jit.train_step",
                                    sig_argnums=(3, 4),
                                    donate_argnums=(0, 2) if donate else ())
+        self._last_step_t = None  # inter-call interval ⇒ steady-state step time
 
     def prefetch(self, batches, depth=2, buckets=None):
         """Wrap a ``(inputs, labels)`` batch iterator in a background
@@ -132,19 +137,30 @@ class TrainStep:
 
     def __call__(self, inputs, labels):
         _watchdog_heartbeat()
-        # ONE pytree transfer for the whole batch (single dispatch; a
-        # device-resident batch — e.g. from ``prefetch`` — passes through)
-        raw_inputs, raw_labels = jax.device_put((
-            tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a)
-                  for a in inputs),
-            tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a)
-                  for a in labels),
-        ))
-        lr = self._optimizer.lr_device_scalar()
-        self._params, self._buffers, self._opt_state, loss, flags = self._jitted(
-            self._params, self._buffers, self._opt_state, lr,
-            (raw_inputs, raw_labels),
-        )
+        compiles_before = self._jitted.tracker.compiles
+        with contextlib.ExitStack() as _stk:
+            if not _spans.in_category("step"):
+                # hapi fit (or another loop-level owner) may already hold
+                # the step span — h2d/compute then nest under it directly
+                _stk.enter_context(_spans.span(
+                    "step", cat="step", step=self._optimizer._global_step))
+            with _spans.span("h2d", cat="h2d"):
+                # ONE pytree transfer for the whole batch (single
+                # dispatch; a device-resident batch — e.g. from
+                # ``prefetch`` — passes through)
+                raw_inputs, raw_labels = jax.device_put((
+                    tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                          for a in inputs),
+                    tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                          for a in labels),
+                ))
+            lr = self._optimizer.lr_device_scalar()
+            with _spans.span("compute", cat="compute"):
+                self._params, self._buffers, self._opt_state, loss, flags = \
+                    self._jitted(
+                        self._params, self._buffers, self._opt_state, lr,
+                        (raw_inputs, raw_labels),
+                    )
         if self._check_nan:
             self._last_flags = flags
             if not self._guard_updates:
@@ -153,6 +169,19 @@ class TrainStep:
                 raise_if_nonfinite(self._nan_names, flags)
         self._optimizer._global_step += 1
         self._dirty = True
+        # steady-state step time from the inter-call interval (dispatch
+        # is async — same rationale as engine/step_ms); the interval
+        # containing a (re)compile is dropped, and the shared pause
+        # filter in observe_interval rejects checkpoint/eval gaps. This
+        # histogram is the MFU denominator for the jit.train_step entry.
+        tel = get_telemetry()
+        if tel.enabled:
+            now = time.perf_counter()
+            last = self._last_step_t
+            if last is not None and now > last \
+                    and self._jitted.tracker.compiles == compiles_before:
+                tel.observe_interval("jit/step_ms", (now - last) * 1e3)
+            self._last_step_t = now
         return Tensor(loss)
 
     # -- resilience (StepGuard engine contract) ------------------------

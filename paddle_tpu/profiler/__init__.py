@@ -16,10 +16,26 @@ StatRegistry) as ONE surface with three sinks:
 to count/time XLA compilations per function and warn (rate-limited) when
 a function retraces more than ``PADDLE_TPU_RETRACE_WARN`` times.
 
+Cost attribution (``xla_cost``): every fresh ``tracked_jit`` compile is
+cost-analyzed (XLA FLOPs / bytes accessed / peak HBM) and combined with
+the ``*step_ms`` histograms and a per-chip peak registry
+(``PADDLE_TPU_PEAK_FLOPS`` / ``PADDLE_TPU_HBM_GBPS`` overrides) into
+live ``gauge/mfu``, achieved HBM GB/s, and a roofline verdict.
+
+Structured spans (``spans``): hierarchical, step-correlated scoped spans
+(fit → epoch → step → h2d/compute/d2h/callback/checkpoint) behind the
+chrome export, plus the always-on bounded **flight recorder** whose
+event tail rides the watchdog/StepGuard crash reports.
+
+Cross-rank aggregation (``aggregate`` + ``tools/telemetry_agg.py``):
+merges the per-rank JSONL files a ``distributed.launch`` job leaves into
+one cluster view with straggler detection.
+
 The legacy span API (``RecordEvent``, ``Profiler``, ``start_profiler``…)
 stays in ``paddle_tpu.utils.profiler`` and is re-exported here so
 ``paddle.profiler.Profiler``-style code ports unchanged.
 """
+from . import aggregate, spans, xla_cost  # noqa: F401
 from ..utils.profiler import (  # noqa: F401
     Profiler,
     RecordEvent,
@@ -28,17 +44,35 @@ from ..utils.profiler import (  # noqa: F401
     start_profiler,
     stop_profiler,
 )
-from .retrace import RetraceTracker, tracked_jit  # noqa: F401
+from .retrace import RetraceTracker, reset_trackers, tracked_jit  # noqa: F401
+from .spans import (  # noqa: F401
+    FlightRecorder,
+    Span,
+    flight_recorder,
+    span,
+)
 from .telemetry import (  # noqa: F401
     Histogram,
     Telemetry,
     get_telemetry,
     sample_device_memory,
 )
+from .xla_cost import (  # noqa: F401
+    CostRecord,
+    capture as capture_compile_cost,
+    chip_peaks,
+    cost_registry,
+    publish_mfu,
+    set_steps_per_call,
+)
 
 __all__ = [
     "Telemetry", "Histogram", "get_telemetry", "sample_device_memory",
-    "tracked_jit", "RetraceTracker",
+    "tracked_jit", "RetraceTracker", "reset_trackers",
+    "Span", "span", "FlightRecorder", "flight_recorder",
+    "CostRecord", "cost_registry", "chip_peaks", "publish_mfu",
+    "set_steps_per_call", "capture_compile_cost",
     "Profiler", "RecordEvent", "record_event", "start_profiler",
     "stop_profiler", "export_chrome_tracing",
+    "spans", "xla_cost", "aggregate",
 ]

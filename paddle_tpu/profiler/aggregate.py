@@ -1,0 +1,159 @@
+"""Cross-rank telemetry aggregation — one cluster view from per-rank JSONL.
+
+``distributed.launch`` gives every worker its own telemetry sink
+(``PADDLE_TPU_TELEMETRY_JSONL`` pointing at
+``<log_dir>/telemetry.rank<i>.jsonl``; the process flushes a final
+record at exit), so an N-rank job leaves N scalar logs. This module
+merges them:
+
+- **per-scalar cluster view** — for every scalar name, the min / median
+  / max across ranks of each rank's *final* value (counters are
+  monotonic, so the last record holds the total; gauges/histograms want
+  the most recent state anyway);
+- **straggler detection** — a data-parallel job runs at the speed of its
+  slowest rank. A rank whose step-latency p50 (any ``hist/*step_ms/p50``
+  scalar) exceeds the cluster median by ``threshold``× is flagged with
+  the metric, its value, and the median it broke from.
+
+Pure host-side file munching — no jax import — so the CLI wrapper
+(``tools/telemetry_agg.py``) stays fast enough for a watch loop.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "read_jsonl", "rank_of_path", "final_scalars", "load_rank_scalars",
+    "cluster_view", "detect_stragglers", "aggregate",
+    "STEP_HIST_PATTERN",
+]
+
+# any per-rank step-latency p50 qualifies for straggler comparison
+# (engine/, executor/, jit/, hapi/ producers all end in step_ms)
+STEP_HIST_PATTERN = re.compile(r"^hist/.*step_ms/p50$")
+
+_RANK_RE = re.compile(r"rank[._-]?(\d+)")
+
+
+def read_jsonl(path: str) -> List[dict]:
+    """Parse one telemetry JSONL log, skipping blank/corrupt lines (a
+    crash mid-write must not take the whole aggregation down)."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and isinstance(rec.get("scalars"), dict):
+                records.append(rec)
+    return records
+
+
+def rank_of_path(path: str, fallback: int) -> int:
+    """Rank from a ``...rank<i>...`` filename, else the caller's index."""
+    m = _RANK_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else fallback
+
+
+def final_scalars(records: Sequence[dict],
+                  tag: Optional[str] = None) -> Dict[str, float]:
+    """Fold a rank's records into its final per-scalar state (later
+    records override earlier ones name-by-name)."""
+    out: Dict[str, float] = {}
+    for rec in records:
+        if tag is not None and rec.get("tag") != tag:
+            continue
+        for name, value in rec["scalars"].items():
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                continue
+            if math.isfinite(float(value)):
+                out[name] = float(value)
+    return out
+
+
+def load_rank_scalars(paths: Sequence[str],
+                      tag: Optional[str] = None) -> Dict[int, Dict[str, float]]:
+    """{rank: final_scalars} over the given per-rank files."""
+    out: Dict[int, Dict[str, float]] = {}
+    for i, path in enumerate(sorted(paths)):
+        rank = rank_of_path(path, i)
+        try:
+            records = read_jsonl(path)
+        except OSError:
+            continue  # a missing/unreadable rank drops out of the view
+        scalars = final_scalars(records, tag=tag)
+        if scalars:
+            out[rank] = scalars
+    return out
+
+
+def _median(values: Sequence[float]) -> float:
+    vs = sorted(values)
+    n = len(vs)
+    mid = n // 2
+    return vs[mid] if n % 2 else (vs[mid - 1] + vs[mid]) / 2.0
+
+
+def cluster_view(rank_scalars: Dict[int, Dict[str, float]]) -> Dict[str, dict]:
+    """{scalar_name: {min, median, max, ranks: {rank: value}}} over every
+    scalar any rank reported (ranks missing a scalar just don't vote)."""
+    names = set()
+    for scalars in rank_scalars.values():
+        names.update(scalars)
+    view: Dict[str, dict] = {}
+    for name in sorted(names):
+        per_rank = {r: s[name] for r, s in rank_scalars.items() if name in s}
+        values = list(per_rank.values())
+        view[name] = {"min": min(values), "median": _median(values),
+                      "max": max(values), "ranks": per_rank}
+    return view
+
+
+def detect_stragglers(rank_scalars: Dict[int, Dict[str, float]],
+                      threshold: float = 1.25) -> List[dict]:
+    """Flag ranks whose step-latency p50 exceeds the cluster median by
+    ``threshold``×. Needs >= 2 ranks reporting the same metric (one rank
+    has no cluster to straggle behind). Returns one finding per
+    (rank, metric), sorted worst-first."""
+    findings: List[dict] = []
+    metrics = set()
+    for scalars in rank_scalars.values():
+        metrics.update(n for n in scalars if STEP_HIST_PATTERN.match(n))
+    for metric in sorted(metrics):
+        per_rank: List[Tuple[int, float]] = [
+            (r, s[metric]) for r, s in sorted(rank_scalars.items())
+            if metric in s]
+        if len(per_rank) < 2:
+            continue
+        med = _median([v for _, v in per_rank])
+        if med <= 0:
+            continue
+        for rank, value in per_rank:
+            if value > threshold * med:
+                findings.append({
+                    "rank": rank, "metric": metric, "value": value,
+                    "cluster_median": med, "ratio": value / med,
+                })
+    findings.sort(key=lambda f: -f["ratio"])
+    return findings
+
+
+def aggregate(paths: Sequence[str], threshold: float = 1.25,
+              tag: Optional[str] = None) -> dict:
+    """One-call cluster report over per-rank JSONL paths."""
+    rank_scalars = load_rank_scalars(paths, tag=tag)
+    return {
+        "ranks": sorted(rank_scalars),
+        "n_ranks": len(rank_scalars),
+        "view": cluster_view(rank_scalars),
+        "stragglers": detect_stragglers(rank_scalars, threshold=threshold),
+        "threshold": threshold,
+    }

@@ -23,17 +23,36 @@ from __future__ import annotations
 import logging
 import os
 import time
+import weakref
 from typing import Optional
 
 import jax
 
 from .telemetry import get_telemetry
 
-__all__ = ["tracked_jit", "RetraceTracker", "retrace_warn_threshold"]
+__all__ = ["tracked_jit", "RetraceTracker", "retrace_warn_threshold",
+           "reset_trackers"]
 
 logger = logging.getLogger("paddle_tpu.profiler")
 
 _WARN_EVERY_S = 30.0  # at most one retrace warning per function per 30 s
+
+# every live tracker, so Telemetry.reset() can clear per-function compile
+# state: without this, back-to-back tests/benches in one process inherit
+# retrace counts (compile/<name> counters reset but tracker.compiles did
+# not, so the next retrace-warning threshold fired early and gates read
+# stale per-function totals)
+_trackers: "weakref.WeakSet[RetraceTracker]" = weakref.WeakSet()
+
+
+def reset_trackers() -> None:
+    """Zero every tracker's compile count and forget seen signatures.
+    Hooked from ``Telemetry.reset()``. A signature seen before the reset
+    counts as a fresh compile after it — jax's own cache may satisfy it
+    instantly, but the accounting starts from zero, which is what test
+    isolation needs."""
+    for t in list(_trackers):
+        t.reset()
 
 
 def retrace_warn_threshold() -> int:
@@ -66,6 +85,12 @@ class RetraceTracker:
     def __init__(self, name: str):
         self.name = name
         self._signatures = set()
+        self.compiles = 0
+        self._last_warn = 0.0
+        _trackers.add(self)
+
+    def reset(self) -> None:
+        self._signatures.clear()
         self.compiles = 0
         self._last_warn = 0.0
 
@@ -154,6 +179,13 @@ def tracked_jit(fn=None, *, name: Optional[str] = None,
         # the honest host-visible cost of the retrace
         tel.observe(f"compile_ms/{label}",
                     (time.perf_counter() - t0) * 1e3)
+        # attribution: cost-analyze the executable this compile produced
+        # (flops/HBM -> MFU). After the call on purpose: lower() reads
+        # only avals, so donated (deleted) buffers are safe, and a failed
+        # compile never reaches here.
+        from . import xla_cost
+
+        xla_cost.capture(label, jitted, args, kwargs)
         return out
 
     wrapper.__name__ = f"tracked_{label}"

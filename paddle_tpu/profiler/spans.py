@@ -1,0 +1,302 @@
+"""Structured hierarchical spans + the crash flight recorder.
+
+The reference's ``platform/profiler.h`` ``RecordEvent`` feeds two
+consumers: a live timeline (device_tracer) and the post-mortem the
+tuning loop reads. PR 1 reproduced only the flat half — an unstructured
+``_host_spans`` list in ``utils/profiler.py`` that grew without bound
+and carried no hierarchy. This module is the structured replacement:
+
+- **Spans** — scoped, nested, step-correlated. A span records its
+  parent (the innermost open span on the same thread), a process-unique
+  ``span_id``, and the training ``step`` it belongs to (inherited from
+  the nearest enclosing span that set one), so a timeline event can
+  always be traced back to *which step of which epoch of which fit call*
+  produced it. The canonical hierarchy the engines emit is
+  ``fit → epoch → step → {h2d, compute, d2h, callback, checkpoint}``.
+- **Window store** — completed spans recorded inside a profiling window
+  (``utils.profiler.start_profiler``), exported as properly-nested
+  chrome trace events. Bounded (``PADDLE_TPU_SPAN_WINDOW`` spans, FIFO)
+  and drained by each export, so a long profiling session can no longer
+  leak host memory (the PR 1 ``_host_spans`` bug).
+- **Flight recorder** — an always-on bounded ring of span enter/exit
+  events (``PADDLE_TPU_FLIGHT_EVENTS``, default 512). Recording is two
+  deque appends per span — cheap enough to leave on in production — and
+  the last-N-events tail is attached to the resilience watchdog dump
+  and the StepGuard give-up report, so a hang or a poisoned run comes
+  with the event history explaining what the process was *doing*, not
+  just where its threads were parked.
+
+Span enter/exit must stay OUTSIDE compiled regions (host code only):
+under a jit trace a span would measure trace time once and then vanish
+from the compiled program — the same class of mistake tpu-lint R8 flags
+for Telemetry calls under trace.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+__all__ = [
+    "Span", "span", "current_span", "FlightRecorder", "flight_recorder",
+    "SpanStore", "window_store", "open_window", "close_window",
+    "window_active", "chrome_events", "drain_window",
+]
+
+_ids = itertools.count(1)  # process-unique span ids (GIL-atomic next())
+_tls = threading.local()   # per-thread stack of open spans
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current_span() -> Optional["Span"]:
+    """Innermost open span on this thread (None outside any span)."""
+    st = _stack()
+    return st[-1] if st else None
+
+
+def in_category(cat: str) -> bool:
+    """True when any open span on this thread has category ``cat`` —
+    engines use this to avoid double-opening a "step" span when a
+    higher-level loop (hapi fit) already holds one."""
+    return any(s.cat == cat for s in _stack())
+
+
+class SpanStore:
+    """Bounded FIFO of completed-span records for the profiling window.
+
+    Each record is ``(name, cat, ts_us, dur_us, tid, span_id, parent_id,
+    step)``. Bounded: when the window overflows, the OLDEST spans fall
+    out — an export of a too-long window shows the most recent activity,
+    and memory stays flat either way."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        cap = capacity or _env_int("PADDLE_TPU_SPAN_WINDOW", 65536)
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=cap)
+        self.dropped = 0
+
+    def __len__(self) -> int:
+        return len(self._spans)
+
+    def add(self, rec) -> None:
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self.dropped += 1
+            self._spans.append(rec)
+
+    def drain(self) -> List[tuple]:
+        """Return all records and clear — each export owns its window."""
+        with self._lock:
+            out = list(self._spans)
+            self._spans.clear()
+            self.dropped = 0
+        return out
+
+    def snapshot(self) -> List[tuple]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self.dropped = 0
+
+
+class FlightRecorder:
+    """Always-on bounded ring of span ENTER/EXIT events.
+
+    Events are ``(phase, name, cat, ts_us, dur_us, tid, span_id,
+    parent_id, step)`` with phase ``"B"``/``"E"``. Keeping both phases
+    (not just completed spans) is the point: at crash time the tail
+    shows which spans were OPEN — ``step#842 B, h2d B, h2d E, compute
+    B`` and nothing after means the hang is inside the compiled step,
+    not the input pipeline."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        cap = capacity or _env_int("PADDLE_TPU_FLIGHT_EVENTS", 512)
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=cap)
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def record(self, phase, name, cat, ts_us, dur_us, tid, span_id,
+               parent_id, step) -> None:
+        with self._lock:
+            self._ring.append((phase, name, cat, ts_us, dur_us, tid,
+                               span_id, parent_id, step))
+
+    def tail(self, n: Optional[int] = None) -> List[tuple]:
+        with self._lock:
+            events = list(self._ring)
+        return events if n is None else events[-n:]
+
+    def dump(self, n: Optional[int] = None) -> List[dict]:
+        keys = ("phase", "name", "cat", "ts_us", "dur_us", "tid",
+                "span_id", "parent_id", "step")
+        return [dict(zip(keys, ev)) for ev in self.tail(n)]
+
+    def format_tail(self, n: Optional[int] = None) -> str:
+        """Human-readable tail for crash reports, newest last."""
+        events = self.tail(n)
+        if not events:
+            return "(flight recorder empty)"
+        t_end = events[-1][3]
+        lines = []
+        for phase, name, cat, ts, dur, tid, sid, pid, step in events:
+            dt = (ts - t_end) / 1e6
+            stepinfo = f" step={step}" if step is not None else ""
+            durinfo = f" {dur / 1e3:.3f}ms" if phase == "E" else ""
+            lines.append(f"[{dt:+9.3f}s] {phase} {name} ({cat})"
+                         f"{stepinfo} span={sid}"
+                         + (f" parent={pid}" if pid else "") + durinfo)
+        return "\n".join(lines)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+_window = SpanStore()
+_flight = FlightRecorder()
+_window_active = False
+
+
+def window_store() -> SpanStore:
+    return _window
+
+
+def flight_recorder() -> FlightRecorder:
+    return _flight
+
+
+def window_active() -> bool:
+    return _window_active
+
+
+def open_window(clear: bool = True) -> None:
+    """Start recording completed spans into the window store. With
+    ``clear`` (the default for a FRESH window) previous leftovers are
+    dropped; re-opening while a window is live must pass ``clear=False``
+    so the outer window's spans survive."""
+    global _window_active
+    if clear:
+        _window.clear()
+    _window_active = True
+
+
+def close_window() -> None:
+    """Stop window recording. Does NOT drain: the spans stay available
+    for an export after the window closed (exports drain)."""
+    global _window_active
+    _window_active = False
+
+
+def drain_window() -> List[tuple]:
+    return _window.drain()
+
+
+class Span:
+    """Scoped span. Context manager; re-entrant use is a fresh span.
+
+    ``step`` is inherited from the nearest enclosing span that set one,
+    so instrumented leaf operations (h2d, compute, checkpoint) are
+    step-correlated without every call site threading the step through.
+    """
+
+    __slots__ = ("name", "cat", "step", "span_id", "parent_id", "tid",
+                 "ts_us", "dur_us", "_t0")
+
+    def __init__(self, name: str, cat: str = "host",
+                 step: Optional[int] = None):
+        self.name = name
+        self.cat = cat
+        self.step = step
+        self.span_id = None
+        self.parent_id = None
+        self.tid = None
+        self.ts_us = None
+        self.dur_us = None
+
+    def __enter__(self) -> "Span":
+        st = _stack()
+        parent = st[-1] if st else None
+        self.span_id = next(_ids)
+        self.parent_id = parent.span_id if parent is not None else 0
+        if self.step is None and parent is not None:
+            self.step = parent.step
+        self.tid = threading.get_ident()
+        st.append(self)
+        self._t0 = time.perf_counter()
+        self.ts_us = self._t0 * 1e6
+        _flight.record("B", self.name, self.cat, self.ts_us, 0.0, self.tid,
+                       self.span_id, self.parent_id, self.step)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        t1 = time.perf_counter()
+        self.dur_us = (t1 - self._t0) * 1e6
+        st = _stack()
+        # tolerate a torn stack (an enclosing span leaked by an exception
+        # path that bypassed __exit__): unwind to self so one bad scope
+        # cannot corrupt parentage for the rest of the process
+        while st and st[-1] is not self:
+            st.pop()
+        if st:
+            st.pop()
+        _flight.record("E", self.name, self.cat, t1 * 1e6, self.dur_us,
+                       self.tid, self.span_id, self.parent_id, self.step)
+        if _window_active:
+            _window.add((self.name, self.cat, self.ts_us, self.dur_us,
+                         self.tid, self.span_id, self.parent_id, self.step))
+        return False
+
+
+def span(name: str, cat: str = "host", step: Optional[int] = None) -> Span:
+    """``with span("h2d", cat="h2d"): ...`` — the one-liner call sites use."""
+    return Span(name, cat=cat, step=step)
+
+
+def mark(name: str, cat: str = "host", step: Optional[int] = None) -> None:
+    """Zero-duration marker span (``Profiler.step()`` boundaries)."""
+    with Span(name, cat=cat, step=step):
+        pass
+
+
+def chrome_events(records=None, pid: Optional[int] = None) -> List[dict]:
+    """Convert window span records to chrome://tracing complete events.
+
+    Nesting falls out of ts/dur scoping per tid; ``args`` carries the
+    structured identity (span_id/parent_id/step) so downstream tools can
+    rebuild the tree without re-deriving containment."""
+    if records is None:
+        records = drain_window()
+    pid = pid if pid is not None else os.getpid()
+    events = []
+    for name, cat, ts, dur, tid, sid, par, step in records:
+        args = {"span_id": sid, "parent_id": par}
+        if step is not None:
+            args["step"] = step
+        events.append({"name": name, "ph": "X", "ts": ts, "dur": dur,
+                       "pid": pid, "tid": tid, "cat": cat, "args": args})
+    return events

@@ -174,6 +174,14 @@ class Telemetry:
                 h = self._hists[name] = Histogram()
             return h
 
+    def hist_summary(self, name: str) -> Optional[Dict[str, float]]:
+        """Summary of an existing histogram, or None — never creates
+        one (readers like the MFU publisher must not seed empty hists
+        into every snapshot)."""
+        with self._lock:
+            h = self._hists.get(name)
+        return h.summary() if h is not None else None
+
     def timer(self, name: str) -> _Timer:
         return _Timer(self, name)
 
@@ -235,6 +243,16 @@ class Telemetry:
                  append: bool = True) -> str:
         """Append one flat scalar record (the documented schema) to
         ``path``. ``extra`` scalars merge on top of the snapshot."""
+        try:
+            # refresh gauge/mfu + per-entry attribution gauges from the
+            # latest cost records and step histograms, so every exported
+            # record carries a current MFU (lazy import: xla_cost imports
+            # this module)
+            from . import xla_cost
+
+            xla_cost.publish_mfu(self)
+        except Exception:
+            pass  # attribution must never block a telemetry export
         scalars = self.scalars()
         for k, v in (extra or {}).items():
             f = _coerce_scalar(v)
@@ -252,17 +270,51 @@ class Telemetry:
 
     def reset(self) -> None:
         """Drop gauges/histograms and zero the counters this object
-        created (other StatRegistry stats are left alone)."""
+        created (other StatRegistry stats are left alone). Also resets
+        the sibling per-function compile state: the ``tracked_jit``
+        retrace trackers and the XLA cost registry — without that,
+        back-to-back tests/benches inherit retrace counts and stale
+        attribution (lazy imports: both modules import this one)."""
         with self._lock:
             self._gauges.clear()
             self._hists.clear()
             names = list(self._counter_names)
         for n in names:
             monitor.stat_reset(n)
+        try:
+            from .retrace import reset_trackers
+
+            reset_trackers()
+        except Exception:
+            pass
+        try:
+            from .xla_cost import reset as _xla_reset
+
+            _xla_reset()
+        except Exception:
+            pass
 
 
 _telemetry: Optional[Telemetry] = None
 _telemetry_lock = threading.Lock()
+
+
+def _flush_on_exit() -> None:
+    """Final telemetry record to the env-configured sink at interpreter
+    exit. This is how ``distributed.launch`` workers leave their
+    per-rank JSONL (the launcher exports PADDLE_TPU_TELEMETRY_JSONL as
+    ``<log_dir>/telemetry.rank<i>.jsonl`` per rank) without every
+    training script remembering a to_jsonl call; ``tools/telemetry_agg``
+    merges the files afterwards. ``os._exit`` paths (watchdog) skip
+    atexit — the watchdog writes its record explicitly first."""
+    sink = os.environ.get("PADDLE_TPU_TELEMETRY_JSONL")
+    tel = _telemetry
+    if not sink or tel is None or not tel.enabled:
+        return
+    try:
+        tel.to_jsonl(sink, tag="exit")
+    except Exception:
+        pass  # interpreter teardown: never raise
 
 
 def get_telemetry() -> Telemetry:
@@ -270,15 +322,30 @@ def get_telemetry() -> Telemetry:
     if _telemetry is None:
         with _telemetry_lock:
             if _telemetry is None:
+                import atexit
+
                 _telemetry = Telemetry()
+                atexit.register(_flush_on_exit)
     return _telemetry
+
+
+if os.environ.get("PADDLE_TPU_TELEMETRY_JSONL"):
+    # a sink is configured (e.g. this is a distributed.launch rank):
+    # instantiate now so the atexit flush is registered even if the
+    # process never touches telemetry before exiting — otherwise a rank
+    # that crashes during setup leaves no JSONL and silently drops out
+    # of the telemetry_agg cluster view
+    get_telemetry()
 
 
 def sample_device_memory(telemetry: Optional[Telemetry] = None) -> dict:
     """Device-memory gauges (the reference's STAT_gpu0_mem_size twin):
     ``device/live_bytes`` sums ``jax.live_arrays()``; when the backend
-    reports allocator stats (TPU does), ``device/bytes_in_use`` and
-    ``device/peak_bytes_in_use`` mirror them."""
+    reports allocator stats (TPU does), per-device gauges
+    ``device/bytes_in_use.d<i>``/``device/peak_bytes_in_use.d<i>`` are
+    emitted for EVERY addressable device and the legacy unsuffixed names
+    carry the summed total — reading only device 0 under-reported every
+    multi-chip process by a factor of the local device count."""
     import jax
 
     tel = telemetry or get_telemetry()
@@ -288,14 +355,25 @@ def sample_device_memory(telemetry: Optional[Telemetry] = None) -> dict:
             sum(getattr(a, "nbytes", 0) for a in jax.live_arrays()))
     except Exception:
         pass
+    totals = {"bytes_in_use": 0.0, "peak_bytes_in_use": 0.0}
+    seen = {k: False for k in totals}
     try:
-        stats = jax.devices()[0].memory_stats() or {}
-        for src, dst in (("bytes_in_use", "device/bytes_in_use"),
-                         ("peak_bytes_in_use", "device/peak_bytes_in_use")):
-            if src in stats:
-                out[dst] = float(stats[src])
+        for i, dev in enumerate(jax.local_devices()):
+            try:
+                stats = dev.memory_stats() or {}
+            except Exception:
+                continue  # CPU backends may not implement memory_stats
+            for src in totals:
+                if src in stats:
+                    v = float(stats[src])
+                    out[f"device/{src}.d{i}"] = v
+                    totals[src] += v
+                    seen[src] = True
     except Exception:
-        pass  # CPU backends may not implement memory_stats
+        pass
+    for src, any_seen in seen.items():
+        if any_seen:
+            out[f"device/{src}"] = totals[src]
     for k, v in out.items():
         tel.gauge(k, v)
     return out

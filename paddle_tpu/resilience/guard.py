@@ -342,11 +342,18 @@ class StepGuard:
             return  # in-jit select already skipped the update
         if self._rollbacks_since_good >= pol.max_rollbacks:
             shown = ", ".join(bad_names[:8])
+            try:
+                from ..profiler.spans import flight_recorder
+
+                tail = ("\n-- flight recorder (last span events, newest "
+                        "last) --\n" + flight_recorder().format_tail(20))
+            except Exception:
+                tail = ""
             raise FloatingPointError(
                 f"StepGuard: giving up after {self._rollbacks_since_good} "
                 f"rollbacks without a finite step (step {step_i}, "
                 f"non-finite: {shown}). Quarantined batches are under "
-                f"{pol.quarantine_dir!r} for repro.")
+                f"{pol.quarantine_dir!r} for repro." + tail)
         self._engine.restore_state(self._snap)
         self._apply_opt_meta(self._snap_meta)
         tel.counter("resilience/rollbacks")

@@ -53,6 +53,17 @@ def dump_stacks(extra: str = "") -> str:
         lines.append(json.dumps(get_telemetry().scalars(), sort_keys=True))
     except Exception:
         pass  # a dump must never fail because telemetry did
+    try:
+        from ..profiler.spans import flight_recorder
+
+        # the event history BEFORE the hang: which fit/epoch/step was
+        # open, whether the process died in h2d, compute, a callback, or
+        # a checkpoint — the question a bare thread-stack dump can't
+        # answer ("B" with no matching "E" = still open at dump time)
+        lines.append("-- flight recorder (last span events, newest last) --")
+        lines.append(flight_recorder().format_tail())
+    except Exception:
+        pass  # ditto: the dump outranks its decorations
     return "\n".join(lines)
 
 

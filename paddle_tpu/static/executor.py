@@ -17,6 +17,8 @@ import numpy as np
 
 from ..core.enforce import InvalidArgumentError, enforce
 from ..core.tensor import Parameter, Tensor
+from ..profiler import spans as _spans
+from ..profiler import xla_cost as _xla_cost
 from ..profiler.retrace import tracked_jit
 from ..profiler.telemetry import get_telemetry
 from ..resilience.watchdog import heartbeat as _watchdog_heartbeat
@@ -91,6 +93,7 @@ class Executor:
         self._cache: Dict[tuple, Any] = {}
         self._opt_states: Dict[int, dict] = {}
         self._last_run_t = None  # inter-run interval ⇒ async step time
+        self._last_multi_t = None  # run_steps window interval anchor
 
     def close(self):
         self._cache.clear()
@@ -121,7 +124,8 @@ class Executor:
             # ONE async pytree transfer for all host-resident feed vars —
             # a per-var jnp.asarray in the loop dispatches one H2D per
             # leaf (tpu-lint R4, the regression class PR 2 eliminated)
-            feed_raw.update(jax.device_put(host))
+            with _spans.span("h2d", cat="h2d"):
+                feed_raw.update(jax.device_put(host))
         fetch_ids = []
         for f in fetch_list:
             if isinstance(f, Tensor):
@@ -145,11 +149,18 @@ class Executor:
             # the first runner call) is not a step — drop the anchor
             self._last_run_t = None
         runner = self._cache[key]
-        outs = runner(feed_raw)
+        with _spans.span("compute", cat="compute"):
+            outs = runner(feed_raw)
         t_run = time.perf_counter()
         if tel.enabled:
             tel.counter("executor/runs")
             tel.observe("executor/feed_ms", (t_fed - t_enter) * 1e3)
+            # a run() between run_steps windows invalidates the window
+            # anchor (and vice versa below): an interval spanning the
+            # OTHER path's work is not a step/window time and would
+            # pollute the shared executor/step_ms histogram — the MFU
+            # denominator — by the window-length factor
+            self._last_multi_t = None
             if not fresh_compile:
                 # run_ms is HOST time in the runner (dispatch + param
                 # commit; near-zero on the async path) — a compiling
@@ -166,7 +177,8 @@ class Executor:
             self._last_run_t = t_run
             _host_profiler.add_counter_snapshot("executor.run")
         if return_numpy:
-            res = [np.asarray(o) for o in outs]
+            with _spans.span("d2h", cat="d2h"):
+                res = [np.asarray(o) for o in outs]
             if tel.enabled:
                 # fetch = materializing device results on the host; this
                 # blocks on the program, so it also covers device time
@@ -622,7 +634,8 @@ class Executor:
         if host:
             # ONE async pytree transfer instead of one H2D dispatch per
             # feed var (tpu-lint R4)
-            feed_raw.update(jax.device_put(host))
+            with _spans.span("h2d", cat="h2d"):
+                feed_raw.update(jax.device_put(host))
         fetch_ids = []
         for f in (fetch_list or []):
             if isinstance(f, Tensor):
@@ -637,12 +650,35 @@ class Executor:
                          for n, v in feed_raw.items())),
             tuple(fetch_ids), len(program.ops),
         )
-        if key not in self._cache:
+        fresh_compile = key not in self._cache
+        if fresh_compile:
             self._cache[key] = self._compile_multi(
                 program, fetch_ids, n_steps, windowed)
-        outs = self._cache[key](feed_raw, step_scheduler)
+            self._last_multi_t = None  # compile interval is not a window
+        # attribution: the windowed executable runs n_steps train steps
+        # per invocation; executor/step_ms below records PER-STEP time,
+        # so MFU divides the program's flops by the window length
+        _xla_cost.set_steps_per_call("executor.run_steps", n_steps)
+        with _spans.span("compute", cat="compute"):
+            outs = self._cache[key](feed_raw, step_scheduler)
+        tel = get_telemetry()
+        if tel.enabled:
+            # steady-state per-step time from the inter-window interval
+            # (dispatch is async; same rationale + shared pause filter as
+            # executor/step_ms on the per-run path, which this histogram
+            # deliberately shares — a window of N steps contributes its
+            # interval / N)
+            now = time.perf_counter()
+            last = self._last_multi_t
+            if last is not None and now > last and not fresh_compile \
+                    and n_steps:
+                tel.observe_interval("executor/step_ms",
+                                     (now - last) * 1e3 / n_steps)
+            self._last_multi_t = now
+            self._last_run_t = None  # see run(): cross-path invalidation
         if return_numpy:
-            return [np.asarray(o) for o in outs]
+            with _spans.span("d2h", cat="d2h"):
+                return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]
 
     def _compile_multi(self, program: Program, fetch_ids, n_steps, windowed):

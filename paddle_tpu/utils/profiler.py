@@ -5,13 +5,18 @@ TPU-native: scoped host annotations map to jax.profiler.TraceAnnotation
 (visible in the XPlane/perfetto timeline alongside device kernels — the role
 CUPTI DeviceTracer plays in the reference), and start/stop profiling captures
 a full XLA trace viewable in TensorBoard/perfetto.
+
+Span storage is ``paddle_tpu.profiler.spans``: every ``RecordEvent`` is a
+structured span (nested, step-correlated, feeding the always-on flight
+recorder), the profiling window is BOUNDED (``PADDLE_TPU_SPAN_WINDOW``),
+and each chrome export drains it — the unbounded ``_host_spans`` list this
+module used to keep is gone.
 """
 from __future__ import annotations
 
 import contextlib
 import json
 import os
-import threading
 import time
 from collections import defaultdict
 
@@ -24,17 +29,24 @@ __all__ = [
 ]
 
 _host_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
-_host_spans = []  # (name, start_us, dur_us, tid) for the chrome timeline
 _counter_events = []  # (name, ts_us, scalars) — telemetry snapshots
-_spans_active = False  # spans record only inside a profiling window
 _device_tracing = False  # whether jax.profiler.start_trace is live
 _trace_dir = None
+
+
+def _spans():
+    # lazy: paddle_tpu.profiler's __init__ re-exports THIS module, so a
+    # module-level "from ..profiler import spans" would deadlock the
+    # circular import when utils.profiler is imported first
+    from ..profiler import spans
+
+    return spans
 
 
 def spans_active() -> bool:
     """True inside a profiling window — instrumented hot paths use this to
     gate per-step counter snapshots (free outside a window)."""
-    return _spans_active
+    return _spans().window_active()
 
 
 def add_counter_snapshot(name="telemetry", scalars=None):
@@ -47,7 +59,7 @@ def add_counter_snapshot(name="telemetry", scalars=None):
     coerce gauges (possibly blocking on a not-yet-ready device array —
     serializing the very pipeline being profiled) and compute histogram
     percentiles on every step."""
-    if not _spans_active:
+    if not _spans().window_active():
         return
     if scalars is None:
         from ..profiler.telemetry import get_telemetry
@@ -57,46 +69,48 @@ def add_counter_snapshot(name="telemetry", scalars=None):
 
 
 class RecordEvent:
-    """Scoped event: host wall-time accounting + device trace annotation."""
+    """Scoped event: host wall-time accounting + device trace annotation
+    + one structured span (nesting/step inherited from any enclosing
+    engine span; recorded by the flight recorder and, inside a window,
+    the bounded span store)."""
 
     def __init__(self, name, event_type=None):
         self.name = name
         self._ann = jax.profiler.TraceAnnotation(name)
+        self._span = _spans().Span(name, cat="host")
 
     def __enter__(self):
         self._t0 = time.perf_counter()
+        self._span.__enter__()
         self._ann.__enter__()
         return self
 
     def __exit__(self, *exc):
         self._ann.__exit__(*exc)
-        t1 = time.perf_counter()
-        dt = t1 - self._t0
+        self._span.__exit__(*exc)
+        dt = time.perf_counter() - self._t0
         ev = _host_events[self.name]
         ev[0] += 1
         ev[1] += dt
-        if _spans_active:  # unbounded outside a window ⇒ gated
-            _host_spans.append((self.name, self._t0 * 1e6, dt * 1e6,
-                                threading.get_ident()))
         return False
 
 
 def export_chrome_tracing(path: str):
-    """Write the host event spans as a chrome://tracing (catapult) JSON —
+    """Write the window's spans as a chrome://tracing (catapult) JSON —
     the role of the reference's protobuf timeline (platform/profiler.proto →
     chrome timeline); the device-side kernel timeline is the jax trace in
-    ``log_dir`` (TensorBoard/perfetto)."""
+    ``log_dir`` (TensorBoard/perfetto). Spans nest (engine hierarchy
+    fit → epoch → step → h2d/compute/d2h/...) and carry
+    ``span_id``/``parent_id``/``step`` in ``args``. DRAINS the window:
+    each export owns its spans, so repeated windows cannot accumulate."""
     pid = os.getpid()
-    events = [
-        {"name": name, "ph": "X", "ts": ts, "dur": dur,
-         "pid": pid, "tid": tid, "cat": "host"}
-        for name, ts, dur, tid in _host_spans
-    ]
+    events = _spans().chrome_events(pid=pid)
     # telemetry counter snapshots ride along as instant events ("i") so
     # counter values line up against the spans in the same timeline; a
     # final snapshot is always appended so the export carries the
     # end-of-window counter state even if no step sampled one
     snaps = list(_counter_events)
+    del _counter_events[:]  # drained with the spans (same window scope)
     try:
         from ..profiler.telemetry import get_telemetry
 
@@ -129,15 +143,15 @@ def start_profiler(state="All", tracer_option="Default",
     snapshots record for chrome export without paying for (or requiring)
     a full XLA device trace — the cheap mode tests and always-on step
     sampling use."""
-    global _trace_dir, _spans_active, _device_tracing
+    global _trace_dir, _device_tracing
     _trace_dir = log_dir
-    if not _spans_active:
-        # export covers THIS window, not process lifetime — but re-entering
-        # while a window is live (e.g. a host-only window opened inside a
-        # device-trace window) must NOT wipe the outer window's spans
-        _host_spans.clear()
+    fresh = not _spans().window_active()
+    if fresh:
         _counter_events.clear()
-    _spans_active = True
+    # export covers THIS window, not process lifetime — but re-entering
+    # while a window is live (e.g. a host-only window opened inside a
+    # device-trace window) must NOT wipe the outer window's spans
+    _spans().open_window(clear=fresh)
     if device_trace:
         os.makedirs(log_dir, exist_ok=True)
         jax.profiler.start_trace(log_dir)
@@ -148,8 +162,8 @@ def start_profiler(state="All", tracer_option="Default",
 
 
 def stop_profiler(sorted_key="total", profile_path="/tmp/profile"):
-    global _spans_active, _device_tracing
-    _spans_active = False
+    global _device_tracing
+    _spans().close_window()
     if _device_tracing:
         jax.profiler.stop_trace()
         _device_tracing = False
@@ -194,9 +208,9 @@ class Profiler:
         self._running = True
 
     def stop(self):
-        global _spans_active, _device_tracing
+        global _device_tracing
         if self._running:
-            _spans_active = False
+            _spans().close_window()
             if _device_tracing:
                 jax.profiler.stop_trace()
                 _device_tracing = False
@@ -204,10 +218,9 @@ class Profiler:
 
     def step(self, num_samples=None):
         self._step_count = getattr(self, "_step_count", 0) + 1
-        if _spans_active:
-            _host_spans.append((f"ProfilerStep#{self._step_count}",
-                                time.perf_counter() * 1e6, 0.0,
-                                threading.get_ident()))
+        sp = _spans()
+        if sp.window_active():
+            sp.mark(f"ProfilerStep#{self._step_count}", cat="marker")
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):

@@ -1,0 +1,550 @@
+"""Cost attribution (xla_cost), structured spans + flight recorder, and
+cross-rank telemetry aggregation (ISSUE 5 acceptance):
+
+- cost_analysis capture on a jitted matmul (flops > 0 on the CPU backend)
+- MFU gauge math against hand-computed values (+ roofline verdicts)
+- nested span -> chrome JSON structure golden
+- flight-recorder ring bounding + presence in a watchdog dump / StepGuard
+  give-up report
+- telemetry_agg straggler detection on synthetic 4-rank JSONL, and the
+  end-to-end 2-process distributed.launch -> per-rank JSONL -> aggregate
+  path
+- satellites: per-device memory gauges, Telemetry.reset() clearing the
+  retrace tracker, legacy span-window bounding/drain, schema + gate CLI
+  contracts
+"""
+import json
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.profiler import (
+    aggregate as agg,
+    get_telemetry,
+    sample_device_memory,
+    spans,
+    tracked_jit,
+    xla_cost,
+)
+from paddle_tpu.profiler.spans import FlightRecorder, Span, SpanStore
+
+
+@pytest.fixture
+def tel():
+    t = get_telemetry()
+    t.reset()  # also resets retrace trackers + the cost registry/peaks
+    yield t
+    t.reset()
+
+
+# ---------------------------------------------------------------------------
+# cost capture
+# ---------------------------------------------------------------------------
+
+class TestCostCapture:
+    def test_jitted_matmul_records_flops(self, tel):
+        f = tracked_jit(lambda a, b: a @ b, name="attr.mm")
+        a = jnp.ones((32, 64), jnp.float32)
+        b = jnp.ones((64, 16), jnp.float32)
+        f(a, b)
+        rec = xla_cost.cost_registry().latest()["attr.mm"]
+        # XLA counts 2*M*K*N flops for the matmul
+        assert rec.flops >= 2 * 32 * 64 * 16
+        assert rec.bytes_accessed > 0
+        assert rec.peak_hbm_bytes > 0  # >= argument+output bytes estimate
+        scalars = tel.scalars()
+        assert scalars["gauge/compile/flops"] == rec.flops
+        assert scalars["gauge/compile/attr.mm/flops"] == rec.flops
+        assert scalars["gauge/compile/peak_hbm_bytes"] > 0
+
+    def test_full_mode_exact_memory_analysis(self, tel, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_COST_ANALYSIS", "full")
+        f = tracked_jit(lambda a: a @ a, name="attr.mm_full")
+        f(jnp.ones((48, 48), jnp.float32))
+        rec = xla_cost.cost_registry().latest()["attr.mm_full"]
+        assert rec.estimated is False  # compiled.memory_analysis() ran
+        assert rec.flops >= 2 * 48 * 48 * 48
+        assert rec.peak_hbm_bytes > 0
+
+    def test_off_mode_records_nothing(self, tel, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_COST_ANALYSIS", "0")
+        f = tracked_jit(lambda a: a + 1, name="attr.off")
+        f(jnp.ones((4,), jnp.float32))
+        assert "attr.off" not in xla_cost.cost_registry().latest()
+
+    def test_per_shape_bucket_records(self, tel):
+        f = tracked_jit(lambda a: a * 2, name="attr.buckets")
+        f(jnp.ones((8, 4), jnp.float32))
+        f(jnp.ones((16, 4), jnp.float32))  # second bucket, second compile
+        buckets = xla_cost.cost_registry().entries()["attr.buckets"]
+        assert len(buckets) == 2
+        assert {"float32[8,4]", "float32[16,4]"} == set(buckets)
+
+
+# ---------------------------------------------------------------------------
+# MFU / roofline math
+# ---------------------------------------------------------------------------
+
+class TestMfuMath:
+    def _peaks(self, monkeypatch, flops="1e12", gbps="100"):
+        monkeypatch.setenv("PADDLE_TPU_PEAK_FLOPS", flops)
+        monkeypatch.setenv("PADDLE_TPU_HBM_GBPS", gbps)
+        xla_cost.reset()  # drop the cached peaks so the env applies
+
+    def test_mfu_hand_computed(self, tel, monkeypatch):
+        self._peaks(monkeypatch)  # peak 1e12 FLOP/s, 100 GB/s
+        xla_cost.record_compile("jit.train_step", flops=5e9,
+                                bytes_accessed=2e8, argument_bytes=1000,
+                                output_bytes=500, bucket="t", telemetry=tel)
+        for _ in range(8):
+            tel.observe("jit/step_ms", 10.0)
+        out = xla_cost.publish_mfu(tel)
+        m = out["jit.train_step"]
+        # 5e9 flops / 10ms / 1e12 peak = 50%
+        assert m["mfu_pct"] == pytest.approx(50.0)
+        # 2e8 bytes / 10ms = 20 GB/s achieved
+        assert m["hbm_gbps"] == pytest.approx(20.0)
+        # intensity 25 flop/B > balance 10 flop/B -> compute-bound
+        assert m["verdict"] == "compute-bound"
+        scalars = tel.scalars()
+        assert scalars["gauge/mfu"] == pytest.approx(50.0)
+        assert scalars["gauge/mfu/jit.train_step"] == pytest.approx(50.0)
+        assert scalars["gauge/roofline/jit.train_step"] == 1.0
+
+    def test_memory_bound_verdict(self, tel, monkeypatch):
+        self._peaks(monkeypatch)
+        xla_cost.record_compile("jit.train_step", flops=1e8,
+                                bytes_accessed=1e8, bucket="t",
+                                telemetry=tel)
+        tel.observe("jit/step_ms", 10.0)
+        out = xla_cost.publish_mfu(tel)
+        # intensity 1 flop/B < balance 10 flop/B
+        assert out["jit.train_step"]["verdict"] == "memory-bound"
+        assert tel.scalars()["gauge/roofline/jit.train_step"] == 0.0
+
+    def test_mfu_clamped_to_100(self, tel, monkeypatch):
+        self._peaks(monkeypatch, flops="1e6")  # absurdly low peak
+        xla_cost.record_compile("jit.train_step", flops=1e12, bucket="t",
+                                telemetry=tel)
+        tel.observe("jit/step_ms", 1.0)
+        out = xla_cost.publish_mfu(tel)
+        assert out["jit.train_step"]["mfu_pct"] == 100.0  # schema bound
+
+    def test_windowed_entry_divides_by_steps_per_call(self, tel, monkeypatch):
+        self._peaks(monkeypatch)
+        xla_cost.record_compile("executor.run_steps", flops=1e10,
+                                bucket="t", telemetry=tel)
+        xla_cost.set_steps_per_call("executor.run_steps", 10)
+        tel.observe("executor/step_ms", 10.0)  # per-STEP time
+        out = xla_cost.publish_mfu(tel)
+        # 1e10/10 per step / 10ms / 1e12 = 10%
+        assert out["executor.run_steps"]["mfu_pct"] == pytest.approx(10.0)
+
+    def test_no_step_hist_no_mfu(self, tel):
+        xla_cost.record_compile("jit.eval_step", flops=1e9, bucket="t",
+                                telemetry=tel)
+        assert "jit.eval_step" not in xla_cost.publish_mfu(tel)
+
+    def test_live_mfu_from_real_train_steps(self, tel):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        step = paddle.jit.TrainStep(net, loss_fn=nn.CrossEntropyLoss(),
+                                    optimizer=opt)
+        x = np.random.RandomState(0).rand(16, 8).astype("float32")
+        y = np.random.RandomState(1).randint(0, 4, 16).astype("int64")
+        for _ in range(5):
+            step((x,), (y,))
+        out = xla_cost.publish_mfu(tel)
+        assert "jit.train_step" in out  # jit/step_ms hist fed the MFU
+        scalars = tel.scalars()
+        assert 0 < scalars["gauge/mfu"] <= 100
+        assert scalars["gauge/compile/flops"] > 0
+
+
+# ---------------------------------------------------------------------------
+# structured spans -> chrome golden
+# ---------------------------------------------------------------------------
+
+class TestSpanChrome:
+    def test_nested_span_chrome_structure_golden(self):
+        spans.open_window()
+        try:
+            with Span("fit", cat="fit"):
+                with Span("epoch", cat="epoch"):
+                    with Span("step", cat="step", step=7):
+                        with Span("h2d", cat="h2d"):
+                            pass
+                        with Span("compute", cat="compute"):
+                            pass
+        finally:
+            spans.close_window()
+        events = {e["name"]: e for e in spans.chrome_events()}
+        assert set(events) == {"fit", "epoch", "step", "h2d", "compute"}
+        # golden structure: the parent chain and the step correlation
+        assert events["epoch"]["args"]["parent_id"] == \
+            events["fit"]["args"]["span_id"]
+        assert events["step"]["args"]["parent_id"] == \
+            events["epoch"]["args"]["span_id"]
+        for leaf in ("h2d", "compute"):
+            assert events[leaf]["args"]["parent_id"] == \
+                events["step"]["args"]["span_id"]
+            assert events[leaf]["args"]["step"] == 7  # inherited
+        assert events["fit"]["args"]["parent_id"] == 0  # root
+        # proper nesting: child intervals inside the parent's
+        for child, parent in (("h2d", "step"), ("step", "epoch"),
+                              ("epoch", "fit")):
+            c, p = events[child], events[parent]
+            assert c["ts"] >= p["ts"]
+            assert c["ts"] + c["dur"] <= p["ts"] + p["dur"] + 1e-3
+        assert all(e["ph"] == "X" for e in events.values())
+
+    def test_engine_step_spans_nest_under_fit(self, tel):
+        """hapi fit emits fit -> epoch -> step, and the TrainStep engine
+        attaches h2d/compute under the fit-owned step span instead of
+        opening a second one."""
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.hapi import Model
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 2))
+        model = Model(net)
+        model.prepare(paddle.optimizer.Adam(learning_rate=1e-3,
+                                            parameters=net.parameters()),
+                      nn.CrossEntropyLoss())
+        x = np.random.RandomState(0).rand(8, 4).astype("float32")
+        y = np.random.RandomState(1).randint(0, 2, (8, 1)).astype("int64")
+        spans.open_window()
+        try:
+            model.fit([((x,), (y,))] * 3, epochs=1, verbose=0)
+        finally:
+            spans.close_window()
+        recs = spans.drain_window()
+        names = [r[0] for r in recs]
+        assert "fit" in names and "epoch" in names
+        by_id = {r[5]: r for r in recs}
+        steps = [r for r in recs if r[0] == "step"]
+        computes = [r for r in recs if r[0] == "compute"]
+        assert len(steps) == 3 and len(computes) == 3
+        for c in computes:
+            parent = by_id[c[6]]
+            assert parent[0] == "step"      # no doubled step span
+            assert by_id[parent[6]][0] == "epoch"
+
+    def test_window_store_bounded(self):
+        store = SpanStore(capacity=4)
+        for i in range(10):
+            store.add((f"s{i}", "host", 0.0, 1.0, 0, i, 0, None))
+        assert len(store) == 4
+        assert store.dropped == 6
+        names = [r[0] for r in store.drain()]
+        assert names == ["s6", "s7", "s8", "s9"]  # oldest fell out
+        assert len(store) == 0
+
+    def test_legacy_export_drains_window(self, tmp_path):
+        """Satellite: the PR 1 _host_spans leak — the window is bounded
+        and each chrome export drains it."""
+        from paddle_tpu.utils import profiler as host_prof
+
+        host_prof.start_profiler(device_trace=False)
+        with host_prof.RecordEvent("legacy_span"):
+            pass
+        host_prof.stop_profiler(profile_path=None)
+        p1 = host_prof.export_chrome_tracing(str(tmp_path / "t1.json"))
+        ev1 = [e for e in json.load(open(p1))["traceEvents"]
+               if e["ph"] == "X"]
+        assert any(e["name"] == "legacy_span" for e in ev1)
+        p2 = host_prof.export_chrome_tracing(str(tmp_path / "t2.json"))
+        ev2 = [e for e in json.load(open(p2))["traceEvents"]
+               if e["ph"] == "X"]
+        assert not ev2  # drained by the first export
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_bounded(self):
+        ring = FlightRecorder(capacity=8)
+        for i in range(20):
+            ring.record("B", f"e{i}", "host", float(i), 0.0, 0, i, 0, None)
+        assert len(ring) == 8
+        names = [ev[1] for ev in ring.tail()]
+        assert names == [f"e{i}" for i in range(12, 20)]  # newest kept
+        assert len(ring.tail(3)) == 3
+        assert ring.dump(2)[-1]["name"] == "e19"
+
+    def test_watchdog_dump_carries_flight_tail(self):
+        from paddle_tpu.resilience.watchdog import dump_stacks
+
+        with spans.span("step", cat="step", step=4242):
+            pass
+        report = dump_stacks()
+        assert "flight recorder" in report
+        assert "step=4242" in report
+
+    def test_guard_giveup_carries_flight_tail(self, tmp_path):
+        from paddle_tpu.resilience.guard import RecoveryPolicy, StepGuard
+
+        class FakeEngine:
+            _guard_updates = True
+
+        with spans.span("step", cat="step", step=77):
+            pass
+        guard = StepGuard(FakeEngine(), RecoveryPolicy(
+            max_consecutive_bad=1, max_rollbacks=0, quarantine_dir=None))
+        with pytest.raises(FloatingPointError) as ei:
+            guard._handle_bad(5, (), (), ["loss"])
+        assert "flight recorder" in str(ei.value)
+        assert "step=77" in str(ei.value)
+
+    def test_open_spans_visible_as_unmatched_B(self):
+        ring = spans.flight_recorder()
+        sp = Span("hang_probe", cat="compute").__enter__()
+        try:
+            phases = [(ev[0], ev[1]) for ev in ring.tail()]
+            assert ("B", "hang_probe") in phases
+            assert ("E", "hang_probe") not in phases  # still open = hung here
+        finally:
+            sp.__exit__(None, None, None)
+
+
+# ---------------------------------------------------------------------------
+# satellites: reset + per-device memory
+# ---------------------------------------------------------------------------
+
+class TestTelemetryResetSatellite:
+    def test_reset_clears_retrace_tracker(self, tel):
+        f = tracked_jit(lambda x: x + 1, name="attr.reset")
+        f(jnp.ones((2,), jnp.float32))
+        f(jnp.ones((3,), jnp.float32))
+        assert f.tracker.compiles == 2
+        tel.reset()
+        assert f.tracker.compiles == 0
+        assert "attr.reset" not in xla_cost.cost_registry().latest()
+        # a signature seen before the reset counts as a fresh compile
+        # after it: the accounting starts from zero for the next test
+        f(jnp.ones((2,), jnp.float32))
+        assert f.tracker.compiles == 1
+        assert tel.counter_value("compile/attr.reset") == 1
+
+
+class TestPerDeviceMemory:
+    def test_multi_device_gauges_and_sum(self, tel, monkeypatch):
+        class FakeDev:
+            def __init__(self, n):
+                self._n = n
+
+            def memory_stats(self):
+                return {"bytes_in_use": self._n,
+                        "peak_bytes_in_use": 2 * self._n}
+
+        monkeypatch.setattr(jax, "local_devices",
+                            lambda: [FakeDev(100.0), FakeDev(250.0)])
+        out = sample_device_memory(tel)
+        assert out["device/bytes_in_use.d0"] == 100.0
+        assert out["device/bytes_in_use.d1"] == 250.0
+        assert out["device/bytes_in_use"] == 350.0      # aggregate name kept
+        assert out["device/peak_bytes_in_use"] == 700.0
+        scalars = tel.scalars()
+        assert scalars["gauge/device/bytes_in_use.d1"] == 250.0
+        assert scalars["gauge/device/bytes_in_use"] == 350.0
+
+    def test_backend_without_memory_stats(self, tel, monkeypatch):
+        class Bare:
+            def memory_stats(self):
+                return None
+
+        monkeypatch.setattr(jax, "local_devices", lambda: [Bare()])
+        out = sample_device_memory(tel)
+        assert "device/bytes_in_use" not in out  # no fake zeros
+        assert "device/live_bytes" in out
+
+
+# ---------------------------------------------------------------------------
+# cross-rank aggregation
+# ---------------------------------------------------------------------------
+
+def _write_rank_files(tmp_path, p50s, metric="hist/engine/step_ms/p50"):
+    paths = []
+    for rank, v in enumerate(p50s):
+        path = tmp_path / f"telemetry.rank{rank}.jsonl"
+        recs = [
+            {"ts": 1.0, "step": 0, "tag": "t",
+             "scalars": {metric: v / 2, "counter/engine/steps": 50}},
+            {"ts": 2.0, "step": 1, "tag": "t",
+             "scalars": {metric: v, "counter/engine/steps": 100}},
+        ]
+        path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        paths.append(str(path))
+    return paths
+
+
+class TestAggregation:
+    def test_straggler_detection_synthetic_4rank(self, tmp_path):
+        paths = _write_rank_files(tmp_path, [10.0, 10.0, 10.0, 30.0])
+        res = agg.aggregate(paths, threshold=1.25)
+        assert res["n_ranks"] == 4
+        view = res["view"]["hist/engine/step_ms/p50"]
+        assert view["median"] == 10.0 and view["max"] == 30.0
+        assert view["ranks"][3] == 30.0  # LAST record wins per rank
+        assert len(res["stragglers"]) == 1
+        s = res["stragglers"][0]
+        assert s["rank"] == 3 and s["ratio"] == pytest.approx(3.0)
+
+    def test_no_straggler_within_threshold(self, tmp_path):
+        paths = _write_rank_files(tmp_path, [10.0, 11.0, 10.5, 12.0])
+        assert agg.aggregate(paths, threshold=1.25)["stragglers"] == []
+
+    def test_single_rank_never_straggles(self, tmp_path):
+        paths = _write_rank_files(tmp_path, [10.0])
+        assert agg.aggregate(paths)["stragglers"] == []
+
+    def test_corrupt_lines_skipped(self, tmp_path):
+        p = tmp_path / "telemetry.rank0.jsonl"
+        p.write_text('{"ts": 1.0, "step": 0, "tag": "t", "scalars": '
+                     '{"a": 1}}\n{truncated-by-a-crash')
+        assert agg.read_jsonl(str(p)) and len(agg.read_jsonl(str(p))) == 1
+
+    def test_cli_report_and_gate_mode(self, tmp_path, capsys):
+        import tools.telemetry_agg as cli
+
+        _write_rank_files(tmp_path, [10.0, 10.0, 10.0, 30.0])
+        rc = cli.main([str(tmp_path), "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["n_ranks"] == 4 and out["stragglers"][0]["rank"] == 3
+        rc = cli.main([str(tmp_path), "--fail-on-straggler"])
+        captured = capsys.readouterr().out
+        assert rc == 1
+        assert "rank 3" in captured
+        rc = cli.main([str(tmp_path / "nothing-here")])
+        capsys.readouterr()
+        assert rc == 1
+
+    def test_two_rank_launch_to_aggregate_acceptance(self, tmp_path):
+        """End-to-end: a 2-process distributed.launch run leaves
+        per-rank JSONL (launcher env + atexit flush, no script support
+        needed), and telemetry_agg reports per-rank step_ms and flags
+        the synthetic straggler."""
+        from paddle_tpu.distributed.launch import launch
+
+        script = tmp_path / "worker.py"
+        script.write_text(textwrap.dedent("""
+            import os
+            from paddle_tpu.profiler import get_telemetry
+
+            tel = get_telemetry()
+            rank = int(os.environ["PADDLE_TRAINER_ID"])
+            for _ in range(6):
+                tel.observe("engine/step_ms", 10.0 if rank == 0 else 40.0)
+            tel.counter("engine/steps", 6)
+        """))
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        log_dir = str(tmp_path / "logs")
+        rc = launch(str(script), [], nproc_per_node=2, log_dir=log_dir,
+                    extra_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo})
+        assert rc == 0
+        files = sorted(os.listdir(log_dir))
+        assert "telemetry.rank0.jsonl" in files
+        assert "telemetry.rank1.jsonl" in files
+        res = agg.aggregate(
+            [os.path.join(log_dir, f"telemetry.rank{r}.jsonl")
+             for r in (0, 1)], threshold=1.25)
+        view = res["view"]["hist/engine/step_ms/p50"]
+        assert view["ranks"][0] == pytest.approx(10.0)
+        assert view["ranks"][1] == pytest.approx(40.0)
+        assert [s["rank"] for s in res["stragglers"]] == [1]
+
+
+# ---------------------------------------------------------------------------
+# gates: check_attribution + schema extensions
+# ---------------------------------------------------------------------------
+
+def _bench_record(scalars):
+    return json.dumps({"ts": 1.0, "step": 0, "tag": "bench/cfg",
+                       "scalars": scalars}) + "\n"
+
+
+class TestAttributionGate:
+    GOOD = {"gauge/compile/flops": 1e9, "gauge/compile/peak_hbm_bytes": 1e6,
+            "gauge/mfu": 42.0}
+
+    def test_pass(self, tmp_path):
+        import tools.check_attribution as gate
+
+        p = tmp_path / "t.jsonl"
+        p.write_text(_bench_record(self.GOOD))
+        assert gate.main([str(p)]) == 0
+
+    @pytest.mark.parametrize("breakage", [
+        {"gauge/compile/flops": 0},          # zero flops
+        {"gauge/compile/peak_hbm_bytes": 0},  # no memory accounting
+        {"gauge/mfu": 0},                     # MFU never connected
+    ])
+    def test_fail_on_missing_or_zero(self, tmp_path, breakage):
+        import tools.check_attribution as gate
+
+        p = tmp_path / "t.jsonl"
+        p.write_text(_bench_record({**self.GOOD, **breakage}))
+        assert gate.main([str(p)]) == 1
+
+    def test_fail_when_scalar_absent_or_no_bench_records(self, tmp_path):
+        import tools.check_attribution as gate
+
+        scalars = dict(self.GOOD)
+        del scalars["gauge/mfu"]
+        p = tmp_path / "t.jsonl"
+        p.write_text(_bench_record(scalars))
+        assert gate.main([str(p)]) == 1
+        q = tmp_path / "empty.jsonl"
+        q.write_text(json.dumps({"ts": 1.0, "step": 0, "tag": "telemetry",
+                                 "scalars": {}}) + "\n")
+        assert gate.main([str(q)]) == 1  # zero bench records = fail
+
+    def test_json_mode_payload(self, tmp_path, capsys):
+        import tools.check_attribution as gate
+
+        p = tmp_path / "t.jsonl"
+        p.write_text(_bench_record(self.GOOD))
+        assert gate.main([str(p), "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["status"] == "OK" and out["records_checked"] == 1
+
+
+class TestSchemaAttributionNames:
+    def test_mfu_range_enforced(self, tmp_path):
+        import tools.check_telemetry_schema as cts
+
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(json.dumps(
+            {"ts": 1.0, "step": None, "tag": "t",
+             "scalars": {"gauge/mfu": 150.0}}) + "\n")
+        _, err = cts.validate_file(str(bad))
+        assert err and "gauge/mfu" in err
+        ok = tmp_path / "ok.jsonl"
+        ok.write_text(json.dumps(
+            {"ts": 1.0, "step": None, "tag": "t",
+             "scalars": {"gauge/mfu": 99.9,
+                         "gauge/mfu/jit.train_step": 0.0}}) + "\n")
+        assert cts.validate_file(str(ok))[1] is None
+
+    def test_compile_nonnegative_enforced(self, tmp_path):
+        import tools.check_telemetry_schema as cts
+
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(json.dumps(
+            {"ts": 1.0, "step": None, "tag": "t",
+             "scalars": {"gauge/compile/flops": -1.0}}) + "\n")
+        _, err = cts.validate_file(str(bad))
+        assert err and "gauge/compile/flops" in err

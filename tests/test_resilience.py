@@ -281,6 +281,11 @@ class TestWatchdog:
             assert "MainThread" in dumps[0]
             assert "-- telemetry --" in dumps[0]
             assert "maybe_slow" in dumps[0]
+            # ... and the flight-recorder event tail: the span history
+            # explaining what the process was doing before the hang
+            # (the engine's step/h2d/compute spans are in the ring)
+            assert "-- flight recorder" in dumps[0]
+            assert "compute (compute)" in dumps[0]
             report_file = os.path.join(str(tmp_path),
                                        f"watchdog-{os.getpid()}.txt")
             assert os.path.exists(report_file)

@@ -25,6 +25,12 @@ python tools/check_telemetry_schema.py TELEMETRY.jsonl
 # (io.ShapeBuckets / DevicePrefetcher) is the fix when this fires.
 python tools/check_retrace_budget.py TELEMETRY.jsonl --budget 6
 
+# attribution gate: every bench config must carry cost attribution —
+# non-zero compile/flops and compile/peak_hbm_bytes from the XLA cost
+# model plus a live gauge/mfu. Perf numbers without a denominator are
+# how a rig quietly settles at 8% MFU; this keeps the denominator wired.
+python tools/check_attribution.py TELEMETRY.jsonl
+
 # tpu-lint gate: the STATIC twin of the retrace-budget gate — AST
 # analysis over the framework for tracer-safety hazards (R1-R8: tracer
 # concretization, data-dependent control flow, retrace signatures,

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Gate a bench telemetry log on cost attribution being present.
+
+Every future perf PR is judged against the attribution layer
+(``paddle_tpu.profiler.xla_cost``): FLOPs/HBM per compiled executable,
+MFU against the chip's peak. A bench run that silently stopped recording
+those (a refactor bypassing ``tracked_jit``, cost analysis erroring out,
+``PADDLE_TPU_COST_ANALYSIS=0`` leaking into the rig env) would make the
+MFU columns quietly vanish — this gate makes that loud: every
+``bench/*``-tagged record in TELEMETRY.jsonl must carry
+
+- ``gauge/compile/flops`` > 0        (XLA counted the program's work),
+- ``gauge/compile/peak_hbm_bytes`` > 0  (memory accounting present),
+- ``gauge/mfu`` in (0, 100]          (the step-latency histograms and
+                                      per-chip peak registry connected).
+
+Usage:
+    python tools/check_attribution.py TELEMETRY.jsonl \
+        [--tag-prefix bench/] [--json]
+
+Summary line, exit codes, and ``--json`` follow the shared gate
+conventions (tools/_gate.py): exit 0 on pass, 1 on any missing/zero
+attribution scalar, zero matching records, or an unreadable log.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _gate import add_gate_args, finish  # noqa: E402
+
+GATE = "attribution"
+
+REQUIRED = (
+    ("gauge/compile/flops", lambda v: v > 0, "> 0"),
+    ("gauge/compile/peak_hbm_bytes", lambda v: v > 0, "> 0"),
+    ("gauge/mfu", lambda v: 0 < v <= 100, "in (0, 100]"),
+)
+
+
+def check_file(path, tag_prefix="bench/"):
+    """Returns (n_checked, [violations])."""
+    n = 0
+    violations = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"line {lineno}: invalid JSON: {e}")
+            if not isinstance(rec, dict):
+                continue
+            tag = rec.get("tag", "")
+            if not isinstance(tag, str) or not tag.startswith(tag_prefix):
+                continue
+            n += 1
+            scalars = rec.get("scalars") or {}
+            for name, ok, want in REQUIRED:
+                v = scalars.get(name)
+                if v is None:
+                    violations.append(
+                        f"line {lineno} ({tag}): {name} missing")
+                elif not isinstance(v, (int, float)) or not ok(float(v)):
+                    violations.append(
+                        f"line {lineno} ({tag}): {name} = {v!r}, "
+                        f"want {want}")
+    return n, violations
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Fail when a bench record lacks cost attribution "
+                    "(compile/flops, compile/peak_hbm_bytes, mfu)")
+    ap.add_argument("path")
+    ap.add_argument("--tag-prefix", default="bench/",
+                    help="records whose tag starts with this are checked "
+                         "(default bench/)")
+    add_gate_args(ap)
+    args = ap.parse_args(argv)
+    try:
+        n, violations = check_file(args.path, tag_prefix=args.tag_prefix)
+    except (OSError, ValueError) as e:
+        return finish(GATE, False, str(e), json_mode=args.json)
+    payload = {"records_checked": n, "violations": violations,
+               "tag_prefix": args.tag_prefix}
+    if n == 0:
+        return finish(
+            GATE, False,
+            f"no records tagged {args.tag_prefix}* in {args.path} — the "
+            f"bench run recorded no attributable configs",
+            payload=payload, json_mode=args.json)
+    if violations:
+        detail = (f"{len(violations)} violation(s) over {n} bench "
+                  f"record(s): " + "; ".join(violations[:4])
+                  + (" …" if len(violations) > 4 else "")
+                  + " — every config must compile through tracked_jit "
+                    "with PADDLE_TPU_COST_ANALYSIS enabled")
+        return finish(GATE, False, detail, payload=payload,
+                      json_mode=args.json)
+    return finish(GATE, True,
+                  f"{n} bench record(s) carry compile/flops, "
+                  f"compile/peak_hbm_bytes, and mfu",
+                  payload=payload, json_mode=args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

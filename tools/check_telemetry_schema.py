@@ -59,6 +59,17 @@ def validate_record(rec, lineno):
                     f"is not a number")
         if not math.isfinite(float(value)):
             return f"line {lineno}: scalar {name!r} is not finite: {value!r}"
+        # attribution-layer name contracts (profiler.xla_cost): MFU is a
+        # percentage of peak — a value past 100 means the flops, the
+        # step histogram, and the chip-peak registry disagree about
+        # units; compile/* accounting can never be negative
+        if name == "gauge/mfu" or name.startswith("gauge/mfu/"):
+            if not (0 <= float(value) <= 100):
+                return (f"line {lineno}: scalar {name!r} = {value!r} "
+                        f"outside [0, 100] (MFU is a % of chip peak)")
+        if name.startswith("gauge/compile/") and float(value) < 0:
+            return (f"line {lineno}: scalar {name!r} = {value!r} "
+                    f"is negative (flops/bytes accounting)")
     return None
 
 

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Merge per-rank telemetry JSONL logs into one cluster view.
+
+A ``paddle_tpu.distributed.launch`` job leaves one scalar log per rank
+(``<log_dir>/telemetry.rank<i>.jsonl`` — the launcher exports each
+worker's PADDLE_TPU_TELEMETRY_JSONL and the worker flushes a final
+record at exit). This tool merges them (paddle_tpu.profiler.aggregate):
+
+- per-rank table of the headline scalars (step-latency p50s, MFU,
+  engine/executor step counters);
+- per-scalar min / median / max across ranks;
+- **straggler detection**: a rank whose ``hist/*step_ms/p50`` exceeds
+  the cluster median by ``--threshold``x (default 1.25) is flagged —
+  a data-parallel job runs at the speed of its slowest rank, so one
+  straggler silently taxes every chip in the ring.
+
+Usage:
+    python tools/telemetry_agg.py LOG_DIR              # telemetry.rank*.jsonl
+    python tools/telemetry_agg.py rank0.jsonl rank1.jsonl ...
+    python tools/telemetry_agg.py LOG_DIR --threshold 1.5 --json
+    python tools/telemetry_agg.py LOG_DIR --fail-on-straggler   # gate mode
+
+Exit code 0; with ``--fail-on-straggler``, 1 when any rank is flagged
+(CI cadence checks). ``--json`` emits the full aggregate object.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_aggregate():
+    """Load profiler/aggregate.py by path: it is dependency-free (no
+    jax), and importing it through the package would drag the whole
+    framework (and a jax init) into a file-munching CLI."""
+    import importlib.util
+
+    path = os.path.join(_REPO, "paddle_tpu", "profiler", "aggregate.py")
+    spec = importlib.util.spec_from_file_location("_ptpu_aggregate", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+agg = _load_aggregate()
+
+# scalars worth a per-rank column when present (everything else is still
+# in --json / the min-median-max view)
+_HEADLINE = (
+    "hist/engine/step_ms/p50", "hist/executor/step_ms/p50",
+    "hist/jit/step_ms/p50", "hist/hapi/step_ms/p50",
+    "gauge/mfu", "counter/engine/steps", "counter/executor/runs",
+    "gauge/engine/tokens_per_s",
+)
+
+
+def _resolve_paths(args_paths):
+    paths = []
+    for p in args_paths:
+        if os.path.isdir(p):
+            hits = sorted(glob.glob(os.path.join(p, "telemetry.rank*.jsonl")))
+            if not hits:  # fall back to any jsonl in the dir
+                hits = sorted(glob.glob(os.path.join(p, "*.jsonl")))
+            paths.extend(hits)
+        else:
+            paths.append(p)
+    return paths
+
+
+def format_report(result) -> str:
+    lines = []
+    ranks = result["ranks"]
+    view = result["view"]
+    lines.append(f"telemetry aggregate: {result['n_ranks']} rank(s): "
+                 + ", ".join(str(r) for r in ranks))
+    headline = [n for n in _HEADLINE if n in view]
+    if headline:
+        width = max(len(n) for n in headline)
+        lines.append(f"{'scalar':<{width}}  " +
+                     "  ".join(f"rank{r:>2}" for r in ranks) +
+                     "    min / median / max")
+        for name in headline:
+            row = view[name]
+            cells = "  ".join(
+                f"{row['ranks'][r]:6.2f}" if r in row["ranks"] else "     -"
+                for r in ranks)
+            lines.append(
+                f"{name:<{width}}  {cells}    "
+                f"{row['min']:.2f} / {row['median']:.2f} / {row['max']:.2f}")
+    stragglers = result["stragglers"]
+    if stragglers:
+        lines.append(f"stragglers (> {result['threshold']:.2f}x cluster "
+                     f"median step-latency p50):")
+        for s in stragglers:
+            lines.append(
+                f"  rank {s['rank']}: {s['metric']} = {s['value']:.2f} ms "
+                f"({s['ratio']:.2f}x the cluster median "
+                f"{s['cluster_median']:.2f} ms)")
+    else:
+        lines.append("stragglers: none")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Merge per-rank telemetry JSONL into a cluster view "
+                    "with straggler detection")
+    ap.add_argument("paths", nargs="+",
+                    help="per-rank JSONL files, or a log dir holding "
+                         "telemetry.rank*.jsonl")
+    ap.add_argument("--threshold", type=float, default=1.25,
+                    help="straggler ratio vs cluster median step-latency "
+                         "p50 (default 1.25)")
+    ap.add_argument("--tag", default=None,
+                    help="only fold records with this tag")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full aggregate object as JSON")
+    ap.add_argument("--fail-on-straggler", action="store_true",
+                    help="exit 1 when any rank is flagged (gate mode)")
+    args = ap.parse_args(argv)
+    paths = _resolve_paths(args.paths)
+    if not paths:
+        print(f"telemetry aggregate: no JSONL files under {args.paths}",
+              file=sys.stderr)
+        return 1
+    result = agg.aggregate(paths, threshold=args.threshold, tag=args.tag)
+    if not result["n_ranks"]:
+        print("telemetry aggregate: no parsable records in "
+              + ", ".join(paths), file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(result, indent=2, sort_keys=True))
+    else:
+        print(format_report(result))
+    if args.fail_on_straggler and result["stragglers"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
