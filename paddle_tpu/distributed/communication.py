@@ -11,6 +11,16 @@ TPU-native dual path:
 - **eager multi-host**: ``multihost_utils`` process-level collectives over
   DCN (replaces Gloo CPU collectives, platform/gloo_context.cc).
 Single-process eager calls are identities, matching a world of size 1.
+
+Hang conversion: every eager multi-host collective runs under a
+``resilience.cluster.CollectiveGuard`` when
+``PADDLE_TPU_COLLECTIVE_TIMEOUT_S`` > 0 — a peer that died mid-call
+otherwise parks this rank forever inside the blocking collective, which
+no in-process watchdog can unwind. The guard converts the hang into a
+stack dump + the restartable ``EXIT_WATCHDOG`` exit the
+``distributed.launch`` supervisor relaunches against the last committed
+checkpoint. (Staged in-jit collectives are XLA's to schedule and are not
+wrapped.)
 """
 from __future__ import annotations
 
@@ -99,6 +109,16 @@ def _in_trace(x) -> bool:
     return isinstance(x, jax.core.Tracer)
 
 
+def _hang_guard(name: str):
+    """CollectiveGuard context for ONE eager multi-host call (no-op
+    unless PADDLE_TPU_COLLECTIVE_TIMEOUT_S is set — see module
+    docstring). Lazy import: the eager DCN path is not hot, and the
+    staged path must not pay a resilience import."""
+    from ..resilience.cluster import collective_guard
+
+    return collective_guard(f"communication.{name}")
+
+
 def _reduce_fn(op):
     return {
         ReduceOp.SUM: jax.lax.psum,
@@ -117,7 +137,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     elif get_world_size() > 1:
         from jax.experimental import multihost_utils
 
-        stacked = multihost_utils.process_allgather(np.asarray(raw))
+        with _hang_guard("all_reduce"):
+            stacked = multihost_utils.process_allgather(np.asarray(raw))
         red = {
             ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max, ReduceOp.MIN: np.min,
             ReduceOp.PROD: np.prod, ReduceOp.AVG: np.mean,
@@ -140,7 +161,8 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     elif get_world_size() > 1:
         from jax.experimental import multihost_utils
 
-        stacked = multihost_utils.process_allgather(np.asarray(raw))
+        with _hang_guard("all_gather"):
+            stacked = multihost_utils.process_allgather(np.asarray(raw))
         parts = [jnp.asarray(stacked[i]) for i in range(stacked.shape[0])]
     else:
         parts = [raw]
@@ -166,7 +188,8 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
         from jax.experimental import multihost_utils
         from .parallel import get_rank
 
-        stacked = multihost_utils.process_allgather(np.asarray(raw))
+        with _hang_guard("reduce_scatter"):
+            stacked = multihost_utils.process_allgather(np.asarray(raw))
         total = stacked.sum(axis=0)
         n = get_world_size()
         shard = np.split(total, n, axis=0)[get_rank()]
@@ -189,11 +212,11 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     elif get_world_size() > 1:
         from jax.experimental import multihost_utils
 
-        out = jnp.asarray(
-            multihost_utils.broadcast_one_to_all(
+        with _hang_guard("broadcast"):
+            gathered = multihost_utils.broadcast_one_to_all(
                 np.asarray(raw), is_source=(jax.process_index() == src)
             )
-        )
+        out = jnp.asarray(gathered)
     else:
         out = raw
     if isinstance(tensor, Tensor):
@@ -220,9 +243,10 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
                           for t in tensor_list])
     from jax.experimental import multihost_utils
 
-    all_ = multihost_utils.broadcast_one_to_all(
-        src_stack, is_source=(jax.process_index() == src)
-    )
+    with _hang_guard("scatter"):
+        all_ = multihost_utils.broadcast_one_to_all(
+            src_stack, is_source=(jax.process_index() == src)
+        )
     tensor._value = jnp.asarray(all_[get_rank()])
     return tensor
 
@@ -238,7 +262,9 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
         from jax.experimental import multihost_utils
         from .parallel import get_rank
 
-        stacked = multihost_utils.process_allgather(np.stack([np.asarray(r) for r in raws]))
+        with _hang_guard("alltoall"):
+            stacked = multihost_utils.process_allgather(
+                np.stack([np.asarray(r) for r in raws]))
         # stacked: [world, world, ...]; rank r receives stacked[s][r] for all s
         parts = [jnp.asarray(stacked[s][get_rank()]) for s in range(stacked.shape[0])]
     else:
@@ -254,7 +280,8 @@ def barrier(group=None):
     if get_world_size() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+        with _hang_guard("barrier"):
+            multihost_utils.sync_global_devices("paddle_tpu_barrier")
 
 
 def send(tensor, dst=0, group=None, sync_op=True):

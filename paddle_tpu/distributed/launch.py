@@ -5,14 +5,32 @@ Spawns one trainer process per device/proc on this host, wires the
 reference's env-var contract (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
 PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT) plus the JAX-native
 coordinator vars consumed by init_parallel_env, streams per-rank logs to a
-log dir, and fail-fast watches the children (watch_local_trainers parity:
-any child death tears the job down; no rank replacement — recovery is
-checkpoint-based, matching the reference's elastic posture). One
-exception to fail-fast: a job exiting with the resilience
-``EXIT_PREEMPTED`` code (SIGTERM → emergency checkpoint, see
-``paddle_tpu.resilience.preemption``) is relaunched whole with capped
-restarts and exponential backoff (``--max_restarts`` /
-``PADDLE_TPU_MAX_RESTARTS``) — elastic parity, PARITY row 80.
+log dir, and supervises the children (watch_local_trainers parity: any
+child death tears the job down; no rank replacement — recovery is
+checkpoint-based, matching the reference's elastic posture).
+
+Elastic relaunch (``--max_restarts`` / ``PADDLE_TPU_MAX_RESTARTS``,
+PARITY row 80/80b): a torn-down job is relaunched WHOLE, with capped
+attempts and deterministic exponential backoff, when the teardown was a
+*recoverable* fault — the ranks resume from their last committed
+checkpoint (``resilience.cluster.ClusterCheckpoint`` / StepGuard spill):
+
+- exit **77** (``EXIT_PREEMPTED``): a rank checkpointed on SIGTERM and
+  asked to be relaunched;
+- exit **113** (``EXIT_WATCHDOG``): a rank self-aborted on a hang (step
+  watchdog or a ``CollectiveGuard``/checkpoint-barrier timeout) — the
+  exact case relaunch exists for;
+- a **signal-killed rank** (negative returncode: SIGKILL/OOM/bus error)
+  or a rank whose heartbeat file (``--rank_hang_timeout``) went stale —
+  detected by the supervisor, the survivors are torn down so nobody
+  blocks forever in a collective, and the job restarts.
+
+Every other non-zero exit (a Python traceback, an assertion) keeps the
+reference's fail-fast contract — relaunching a deterministic crash just
+burns the restart budget. Telemetry: ``resilience/job_restarts`` (all
+relaunches), ``resilience/restarts`` (preemption relaunches, the
+original counter), ``resilience/rank_failures`` (+ per-rank
+``resilience/rank_failures.rank<i>``).
 
 Multi-host: pass ``--ips host1,host2`` and run the same command on every
 host (reference contract); rank 0's host:port becomes the JAX coordinator.
@@ -31,7 +49,8 @@ import time
 from typing import List, Optional
 
 __all__ = ["launch", "get_cluster_env", "watch_local_trainers",
-           "rank_telemetry_path"]
+           "supervise_local_trainers", "rank_telemetry_path",
+           "heartbeat_path"]
 
 
 def _free_ports(n: int) -> List[int]:
@@ -89,46 +108,91 @@ def get_cluster_env(node_ip: str, ips: List[str], nproc_per_node: int,
     return envs, all_eps
 
 
-def watch_local_trainers(procs: List[subprocess.Popen],
-                         poll_interval: float = 1.0) -> int:
-    """Fail-fast watch (launch_utils.py:556): block until all children exit
-    cleanly, or kill the survivors as soon as one fails. Returns the job's
-    exit code."""
+def _teardown(procs: List[subprocess.Popen], grace_s: float = 10.0,
+              sig: int = signal.SIGTERM, mark: bool = True) -> None:
+    """Terminate every still-running child (marking it so the log report
+    does not blame it), escalating to SIGKILL after ``grace_s`` — a rank
+    hung in a collective ignores SIGTERM forever. The Ctrl-C path reuses
+    this with ``sig=SIGINT, mark=False`` (children get their own
+    KeyboardInterrupt; nobody was "killed by the watcher")."""
+    for q in procs:
+        if q.poll() is None:
+            if mark:
+                q.killed_by_watcher = True
+            q.send_signal(sig)
+    deadline = time.time() + grace_s
+    for q in procs:
+        if q.poll() is None:
+            try:
+                q.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                q.kill()
+
+
+def supervise_local_trainers(procs: List[subprocess.Popen],
+                             poll_interval: float = 1.0,
+                             heartbeat_files: Optional[List[str]] = None,
+                             hang_timeout: float = 0.0):
+    """Supervisor loop (launch_utils.py:556 fail-fast watch, grown
+    rank-failure detection): block until all children exit cleanly, or
+    tear the job down as soon as one rank fails — by exiting non-zero,
+    by dying to a signal (negative returncode: SIGKILL/OOM), or, with
+    ``hang_timeout`` > 0, by letting its heartbeat file go stale (a rank
+    alive-but-stuck in a collective; teardown here is what keeps the
+    OTHER ranks from blocking forever). Returns ``(rc, events)`` where
+    ``events`` is a list of ``{"rank", "kind": "exit"|"signal"|"hang",
+    "rc"}`` failure records the launcher folds into telemetry.
+
+    A hang resolves to ``EXIT_WATCHDOG`` — the same restartable code a
+    rank's own watchdog uses, because it is the same fault observed from
+    outside. ``hang_timeout`` must cover the slowest legitimate
+    heartbeat gap INCLUDING worker startup (import + first-step
+    compile), the watchdog-deadline sizing rule.
+    """
+    events: List[dict] = []
+    start = time.time()
     try:
         while True:
             alive = False
-            for p in procs:
+            for rank, p in enumerate(procs):
                 rc = p.poll()
                 if rc is None:
                     alive = True
                 elif rc != 0:
-                    for q in procs:
-                        if q.poll() is None:
-                            # mark survivors we are about to kill so the log
-                            # report does not blame them for the failure
-                            q.killed_by_watcher = True
-                            q.terminate()
-                    deadline = time.time() + 10
-                    for q in procs:
-                        if q.poll() is None:
-                            try:
-                                q.wait(timeout=max(0.1, deadline - time.time()))
-                            except subprocess.TimeoutExpired:
-                                q.kill()
-                    return rc
+                    events.append({"rank": rank,
+                                   "kind": "signal" if rc < 0 else "exit",
+                                   "rc": rc})
+                    _teardown(procs)
+                    return rc, events
             if not alive:
-                return 0
+                return 0, events
+            if hang_timeout > 0 and heartbeat_files:
+                now = time.time()
+                for rank, (p, hb) in enumerate(zip(procs, heartbeat_files)):
+                    if p.poll() is not None:
+                        continue
+                    try:
+                        last = os.path.getmtime(hb)
+                    except OSError:
+                        last = start  # no beat yet: count from job start
+                    stale = now - max(last, start)
+                    if stale > hang_timeout:
+                        events.append({"rank": rank, "kind": "hang",
+                                       "rc": None, "stale_s": stale})
+                        _teardown(procs)
+                        return _watchdog_exit_code(), events
             time.sleep(poll_interval)
     except KeyboardInterrupt:
-        for q in procs:
-            if q.poll() is None:
-                q.send_signal(signal.SIGINT)
-        for q in procs:
-            try:
-                q.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                q.kill()
-        return 130
+        _teardown(procs, sig=signal.SIGINT, mark=False)
+        return 130, events
+
+
+def watch_local_trainers(procs: List[subprocess.Popen],
+                         poll_interval: float = 1.0) -> int:
+    """Back-compat fail-fast watch: ``supervise_local_trainers`` without
+    heartbeat/hang detection, returning only the exit code."""
+    rc, _events = supervise_local_trainers(procs, poll_interval)
+    return rc
 
 
 def rank_telemetry_path(base: Optional[str], log_dir: str, rank) -> str:
@@ -144,15 +208,31 @@ def rank_telemetry_path(base: Optional[str], log_dir: str, rank) -> str:
     return os.path.join(log_dir, f"telemetry.rank{rank}.jsonl")
 
 
+def heartbeat_path(log_dir: str, rank) -> str:
+    """Per-rank heartbeat file the supervisor's hang detection watches.
+    Exported to each worker as ``PADDLE_TPU_HEARTBEAT_FILE`` and touched
+    by ``resilience.watchdog.heartbeat`` at every step boundary (the
+    same cadence that feeds the in-process watchdog)."""
+    return os.path.join(log_dir, f"heartbeat.rank{rank}")
+
+
 def _run_job_once(training_script, script_args, envs, log_dir, backend,
                   extra_env, log_mode: str,
-                  telemetry_jsonl: Optional[str] = None) -> int:
-    """Spawn every rank, watch fail-fast, surface the failing log tail.
-    One launch attempt — the restart policy lives in ``launch``."""
+                  telemetry_jsonl: Optional[str] = None,
+                  rank_hang_timeout: float = 0.0,
+                  poll_interval: float = 1.0,
+                  attempt: int = 0):
+    """Spawn every rank, supervise, surface the failing log tail. One
+    launch attempt — the restart policy lives in ``launch``. Returns
+    ``(rc, events)`` from ``supervise_local_trainers``."""
     procs = []
     logs = []
+    hb_files = []
     for local_rank, env in enumerate(envs):
         full_env = {**os.environ, **env, **(extra_env or {})}
+        # attempt stamp: lets ClusterCheckpoint's commit barrier tell a
+        # live rank's ack from one a killed previous attempt left behind
+        full_env["PADDLE_TPU_LAUNCH_ATTEMPT"] = str(attempt)
         if backend == "cpu":  # simulation mode: each rank is a 1-device CPU
             full_env.setdefault("JAX_PLATFORMS", "cpu")
         rank = env["PADDLE_TRAINER_ID"]
@@ -161,6 +241,9 @@ def _run_job_once(training_script, script_args, envs, log_dir, backend,
         # every rank leaves an aggregatable JSONL with zero script changes
         full_env["PADDLE_TPU_TELEMETRY_JSONL"] = rank_telemetry_path(
             telemetry_jsonl, log_dir, rank)
+        hb = heartbeat_path(log_dir, rank)
+        hb_files.append(hb)
+        full_env["PADDLE_TPU_HEARTBEAT_FILE"] = hb
         log_f = open(os.path.join(log_dir, f"workerlog.{rank}"), log_mode)
         logs.append(log_f)
         p = subprocess.Popen(
@@ -168,10 +251,13 @@ def _run_job_once(training_script, script_args, envs, log_dir, backend,
             env=full_env, stdout=log_f, stderr=subprocess.STDOUT,
         )
         procs.append(p)
-    rc = watch_local_trainers(procs)
+    rc, events = supervise_local_trainers(
+        procs, poll_interval=poll_interval, heartbeat_files=hb_files,
+        hang_timeout=rank_hang_timeout)
     for f in logs:
         f.close()
     if rc not in (0, _preempt_exit_code()):
+        hung = {e["rank"]: e for e in events if e["kind"] == "hang"}
         # surface the failing rank's tail, like the reference's log pull
         for local_rank, env in enumerate(envs):
             rank = env["PADDLE_TRAINER_ID"]
@@ -180,22 +266,39 @@ def _run_job_once(training_script, script_args, envs, log_dir, backend,
                 with open(path) as f:
                     tail = f.readlines()[-20:]
                 p = procs[local_rank]
-                if getattr(p, "killed_by_watcher", False):
+                if local_rank in hung:
+                    sys.stderr.write(
+                        f"----- rank {rank} hung (no heartbeat for "
+                        f"{hung[local_rank]['stale_s']:.1f}s); job torn "
+                        "down; log tail -----\n")
+                    sys.stderr.writelines(tail)
+                elif getattr(p, "killed_by_watcher", False):
                     sys.stderr.write(
                         f"----- rank {rank} terminated by watcher after "
                         "another rank failed -----\n")
+                elif p.returncode is not None and p.returncode < 0:
+                    sys.stderr.write(
+                        f"----- rank {rank} killed by signal "
+                        f"{-p.returncode}; log tail -----\n")
+                    sys.stderr.writelines(tail)
                 elif p.returncode not in (0, None):
                     sys.stderr.write(f"----- rank {rank} failed; log tail -----\n")
                     sys.stderr.writelines(tail)
             except OSError:
                 pass
-    return rc
+    return rc, events
 
 
 def _preempt_exit_code() -> int:
     from paddle_tpu.resilience.preemption import EXIT_PREEMPTED
 
     return EXIT_PREEMPTED
+
+
+def _watchdog_exit_code() -> int:
+    from paddle_tpu.resilience.watchdog import EXIT_WATCHDOG
+
+    return EXIT_WATCHDOG
 
 
 def launch(training_script: str, script_args: List[str],
@@ -205,13 +308,17 @@ def launch(training_script: str, script_args: List[str],
            extra_env: Optional[dict] = None,
            max_restarts: Optional[int] = None,
            restart_backoff: float = 1.0,
-           telemetry_jsonl: Optional[str] = None) -> int:
-    """Launch + watch the local ranks; with ``max_restarts`` > 0 (or
-    ``PADDLE_TPU_MAX_RESTARTS``), a job that exits with the resilience
-    ``EXIT_PREEMPTED`` code (its ranks checkpointed and asked to be
-    relaunched — see ``paddle_tpu.resilience.preemption``) is restarted
-    with capped attempts and deterministic exponential backoff. Any
-    other non-zero exit keeps the reference's fail-fast contract.
+           telemetry_jsonl: Optional[str] = None,
+           rank_hang_timeout: Optional[float] = None) -> int:
+    """Launch + supervise the local ranks; with ``max_restarts`` > 0 (or
+    ``PADDLE_TPU_MAX_RESTARTS``), a job torn down by a RECOVERABLE fault
+    is restarted whole with capped attempts and deterministic
+    exponential backoff (see module docstring): exit 77 (preempted,
+    checkpointed), exit 113 (watchdog/collective-timeout self-abort), a
+    signal-killed rank, or — with ``rank_hang_timeout`` > 0 (or
+    ``PADDLE_TPU_RANK_HANG_TIMEOUT``) — a rank whose per-step heartbeat
+    file went stale. Any other non-zero exit keeps the reference's
+    fail-fast contract.
 
     ``telemetry_jsonl`` (or ``PADDLE_TPU_TELEMETRY_JSONL``): append one
     launcher telemetry record there when the job ends after >= 1
@@ -234,33 +341,69 @@ def launch(training_script: str, script_args: List[str],
         max_restarts = int(os.environ.get("PADDLE_TPU_MAX_RESTARTS", "0"))
     if telemetry_jsonl is None:
         telemetry_jsonl = os.environ.get("PADDLE_TPU_TELEMETRY_JSONL")
+    if rank_hang_timeout is None:
+        rank_hang_timeout = float(
+            os.environ.get("PADDLE_TPU_RANK_HANG_TIMEOUT", "0") or 0)
     # fresh job ⇒ fresh telemetry: workerlog.<rank> opens with mode "w"
     # below, but the per-rank telemetry sinks are APPENDED by workers, so
     # stale files from a previous job in this log_dir (possibly with a
     # larger world — ghost ranks) would pollute telemetry_agg's cluster
     # view and its straggler medians. Relaunch attempts keep appending.
+    # Heartbeat files are stale the same way: a previous job's fresh
+    # mtimes would mask a rank of THIS job hanging before its first beat.
     import glob as _glob
 
     pattern = rank_telemetry_path(telemetry_jsonl, log_dir, "*")
-    for stale in _glob.glob(pattern):
+    for stale in (_glob.glob(pattern)
+                  + _glob.glob(heartbeat_path(log_dir, "*"))):
         try:
             os.remove(stale)
         except OSError:
             pass
     delays = backoff_delays(max_restarts, base=restart_backoff)
+    tel = get_telemetry()
     attempt = 0
+    rank_failures = 0
     while True:
-        rc = _run_job_once(training_script, script_args, envs, log_dir,
-                           backend, extra_env,
-                           log_mode="w" if attempt == 0 else "a",
-                           telemetry_jsonl=telemetry_jsonl)
-        if rc != _preempt_exit_code() or attempt >= max_restarts:
-            if telemetry_jsonl and attempt:
-                get_telemetry().to_jsonl(telemetry_jsonl, tag="launch")
+        rc, events = _run_job_once(training_script, script_args, envs,
+                                   log_dir, backend, extra_env,
+                                   log_mode="w" if attempt == 0 else "a",
+                                   telemetry_jsonl=telemetry_jsonl,
+                                   rank_hang_timeout=rank_hang_timeout,
+                                   attempt=attempt)
+        for ev in events:
+            if ev["kind"] in ("signal", "hang"):
+                rank_failures += 1
+                tel.counter("resilience/rank_failures")
+                # events carry LOCAL proc indices; the counter gets the
+                # global trainer id (they differ on multi-node launches)
+                gid = envs[ev["rank"]]["PADDLE_TRAINER_ID"]
+                tel.counter(f"resilience/rank_failures.rank{gid}")
+        restartable = (rc == _preempt_exit_code()
+                       or rc == _watchdog_exit_code()
+                       or rc < 0)
+        if not restartable or attempt >= max_restarts:
+            if telemetry_jsonl and (attempt or rank_failures):
+                # the launcher owns job_restarts/rank_failures — without
+                # this flush they would never reach the JSONL the
+                # workers (and telemetry_agg) share
+                tel.to_jsonl(telemetry_jsonl, tag="launch")
+            if rc < 0:
+                # a signal-killed rank surfacing as the job's exit: the
+                # shell convention is 128+signum (a raw negative would
+                # wrap to a meaningless status through sys.exit)
+                rc = 128 + (-rc)
             return rc
-        get_telemetry().counter("resilience/restarts")
+        tel.counter("resilience/job_restarts")
+        if rc == _preempt_exit_code():
+            # the original preemption-relaunch counter keeps its narrow
+            # meaning (tools/check_resilience.py gates on it)
+            tel.counter("resilience/restarts")
+        why = {_preempt_exit_code(): "preempted",
+               _watchdog_exit_code(): "hung/self-aborted"}.get(
+                   rc, "rank failure")
         sys.stderr.write(
-            f"[launch] job preempted (exit {rc}); relaunching in "
+            f"[launch] job {why} (exit {rc}); relaunching in "
             f"{delays[attempt]:.2f}s (attempt {attempt + 1}/{max_restarts})\n")
         time.sleep(delays[attempt])
         attempt += 1
@@ -280,8 +423,17 @@ def main(argv=None):
     parser.add_argument("--backend", type=str, default=None,
                         choices=[None, "cpu", "tpu"])
     parser.add_argument("--max_restarts", type=int, default=None,
-                        help="relaunch budget for EXIT_PREEMPTED jobs "
-                             "(default: PADDLE_TPU_MAX_RESTARTS or 0)")
+                        help="relaunch budget for recoverable job exits "
+                             "(preempted 77, watchdog 113, signal-killed "
+                             "or hung rank; default: "
+                             "PADDLE_TPU_MAX_RESTARTS or 0)")
+    parser.add_argument("--rank_hang_timeout", type=float, default=None,
+                        help="seconds without a per-rank heartbeat-file "
+                             "touch before the supervisor declares the "
+                             "rank hung and tears the job down for "
+                             "relaunch; must cover worker startup + first "
+                             "compile (default: "
+                             "PADDLE_TPU_RANK_HANG_TIMEOUT or 0 = off)")
     parser.add_argument("--restart_backoff", type=float, default=1.0,
                         help="base seconds of the deterministic "
                              "exponential relaunch backoff")
@@ -298,7 +450,8 @@ def main(argv=None):
                 log_dir=args.log_dir, backend=args.backend,
                 max_restarts=args.max_restarts,
                 restart_backoff=args.restart_backoff,
-                telemetry_jsonl=args.telemetry_jsonl)
+                telemetry_jsonl=args.telemetry_jsonl,
+                rank_hang_timeout=args.rank_hang_timeout)
     sys.exit(rc)
 
 

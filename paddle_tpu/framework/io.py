@@ -2,19 +2,161 @@
 python/paddle/framework/io.py:494,665 in the reference: pickle a (nested)
 state_dict of numpy-converted tensors to a single file. Sharded/distributed
 checkpoints use paddle_tpu.incubate.checkpoint (orbax-backed) instead.
+
+Durability contract (resilience layer): every write commits atomically
+through :func:`atomic_replace` (write a temp sibling, fsync, rename) — a
+preemption or crash mid-save can never leave a torn file at the final
+path. On read, a shard that sits next to a ``manifest.json`` (the
+integrity record a coordinated cluster checkpoint commits — see
+``paddle_tpu.resilience.cluster``) is verified against its recorded
+CRC32 + size first; a mismatch raises :class:`CheckpointIntegrityError`
+so callers (``ClusterCheckpoint.restore``) can fall back to the last
+committed-good generation instead of silently loading garbage.
 """
 from __future__ import annotations
 
+import json
 import os
 import pickle
+import zlib
 
 import numpy as np
 
 from ..core.tensor import Parameter, Tensor
 
-__all__ = ["save", "load"]
+__all__ = ["save", "load", "atomic_replace", "file_crc32", "fsync_dir",
+           "fsync_tree", "verify_against_manifest",
+           "CheckpointIntegrityError", "MANIFEST_NAME"]
 
 _PROTOCOL = 4
+
+# The integrity record a coordinated checkpoint commits beside its
+# shards: {"files": {<basename>: {"crc32": int, "size": int}}, ...}.
+MANIFEST_NAME = "manifest.json"
+
+
+class CheckpointIntegrityError(OSError):
+    """A checkpoint file disagrees with its committed manifest (torn
+    write, bit rot, post-commit corruption). The file is left in place —
+    recovery is the CALLER's fallback to an older committed generation
+    (``resilience.cluster.ClusterCheckpoint.restore`` does this
+    automatically); deleting evidence here would destroy the forensics
+    and any still-good sibling shards."""
+
+
+def file_crc32(path, chunk_size=1 << 20) -> int:
+    """Streaming CRC32 of a file (zlib, unsigned)."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(chunk_size)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _fsync_file(path) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path) -> None:
+    """fsync a DIRECTORY so a just-renamed entry survives power loss —
+    rename() orders the entry in memory only; the directory inode still
+    needs its own flush. Best-effort on filesystems without dir fds."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def fsync_tree(root) -> None:
+    """fsync every file and directory under ``root`` (a directory-valued
+    checkpoint — e.g. an orbax tree — about to be commit-renamed)."""
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            try:
+                _fsync_file(os.path.join(dirpath, name))
+            except OSError:
+                pass
+        fsync_dir(dirpath)
+
+
+def atomic_replace(path, write_fn) -> None:
+    """The shared write-temp → fsync → rename commit helper: every
+    checkpoint-bearing path (``save``, the StepGuard spill, the
+    coordinated cluster commit) routes through this so no writer ever
+    touches its final destination non-atomically. ``write_fn(tmp_path)``
+    must create ``tmp_path``; on any failure the temp is removed and the
+    previously committed file (if any) is untouched."""
+    path = os.path.abspath(path)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        write_fn(tmp)
+        _fsync_file(tmp)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(os.path.dirname(path))
+
+
+def verify_against_manifest(path):
+    """If ``path`` sits beside a ``manifest.json`` that lists its
+    basename, check recorded size + CRC32. Returns True when verified,
+    None when no manifest covers the file, and raises
+    :class:`CheckpointIntegrityError` on any mismatch (or an unreadable
+    manifest — an integrity record you cannot read protects nothing)."""
+    path = os.path.abspath(path)
+    man_path = os.path.join(os.path.dirname(path), MANIFEST_NAME)
+    if not os.path.exists(man_path):
+        return None
+    try:
+        with open(man_path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointIntegrityError(
+            f"unreadable checkpoint manifest {man_path}: {e}")
+    entry = (manifest.get("files") or {}).get(os.path.basename(path))
+    if entry is None:
+        return None  # manifest present but does not cover this file
+    try:
+        size = os.path.getsize(path)
+    except OSError as e:
+        raise CheckpointIntegrityError(
+            f"{path} listed in {man_path} but unreadable: {e}")
+    if int(entry.get("size", -1)) != size:
+        raise CheckpointIntegrityError(
+            f"{path}: size {size} != manifest {entry.get('size')} "
+            f"(torn write?) — fall back to the last committed-good "
+            f"checkpoint generation")
+    try:
+        crc = file_crc32(path)
+    except OSError as e:
+        # EIO / EACCES / stale NFS handle mid-read: as unreadable as a
+        # missing shard — must fall back, not crash the restore
+        raise CheckpointIntegrityError(
+            f"{path} listed in {man_path} but unreadable: {e}")
+    if int(entry.get("crc32", -1)) != crc:
+        raise CheckpointIntegrityError(
+            f"{path}: crc32 {crc:#010x} != manifest "
+            f"{int(entry.get('crc32', 0)):#010x} (corrupt shard) — fall "
+            f"back to the last committed-good checkpoint generation")
+    return True
 
 
 def _to_saveable(obj):
@@ -80,11 +222,15 @@ def save(obj, path, protocol=_PROTOCOL, **configs):
         if key is not None:
             from .io_crypto import AESCipher
 
-            AESCipher(key).encrypt_to_file(
-                pickle.dumps(payload, protocol=protocol), path)
+            blob = pickle.dumps(payload, protocol=protocol)
+            atomic_replace(
+                path, lambda tmp: AESCipher(key).encrypt_to_file(blob, tmp))
         else:
-            with open(path, "wb") as f:
-                pickle.dump(payload, f, protocol=protocol)
+            def _write(tmp):
+                with open(tmp, "wb") as f:
+                    pickle.dump(payload, f, protocol=protocol)
+
+            atomic_replace(path, _write)
     tel.counter("checkpoint/writes")
     try:
         tel.counter("checkpoint/write_bytes", os.path.getsize(path))
@@ -95,7 +241,17 @@ def save(obj, path, protocol=_PROTOCOL, **configs):
 def load(path, **configs):
     """``configs['cipher_key']``: AES key for a file written with
     ``save(..., cipher_key=...)``; encrypted files are auto-detected and
-    loading one without the key raises a clear error."""
+    loading one without the key raises a clear error.
+
+    Integrity: when ``path`` is covered by a sibling ``manifest.json``
+    (a committed coordinated-checkpoint shard), its CRC32/size are
+    verified BEFORE unpickling; a mismatch raises
+    :class:`CheckpointIntegrityError` (``ClusterCheckpoint.restore``
+    turns that into an automatic fallback to the previous committed-good
+    generation). ``configs['verify']=False`` skips that re-check for a
+    caller that has ALREADY hashed the file this read (restore runs
+    ``verify_generation`` first — a second full read of a multi-GB shard
+    buys nothing on the recovery path)."""
     from ..profiler import spans as _spans
     from ..profiler.telemetry import get_telemetry
 
@@ -103,6 +259,8 @@ def load(path, **configs):
     return_numpy = configs.get("return_numpy", False)
     from .io_crypto import AESCipher, is_encrypted
 
+    if configs.get("verify", True) and verify_against_manifest(path):
+        tel.counter("ckpt/manifest_verified")
     with _spans.span("checkpoint", cat="checkpoint"), \
             tel.timer("checkpoint/read_ms"):
         if is_encrypted(path):

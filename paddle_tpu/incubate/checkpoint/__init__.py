@@ -68,11 +68,18 @@ def save_train_state(state: Dict[str, Any], path: str):
         _checkpointer().save(tmp, state)
 
     _io_retry(_write)
+    # flush the tree BEFORE the commit rename (shared durability contract
+    # with framework.io.atomic_replace): the rename must never point at
+    # data still sitting in the page cache when a preemption lands
+    from ...framework.io import fsync_dir, fsync_tree
+
+    fsync_tree(tmp)
     if os.path.exists(path):
         if os.path.exists(old):
             shutil.rmtree(old)
         os.rename(path, old)
     os.rename(tmp, path)
+    fsync_dir(os.path.dirname(path))
     if os.path.exists(old):
         shutil.rmtree(old)
 
@@ -143,11 +150,17 @@ class CheckpointSaver:
             _checkpointer().save(tmp, state)
 
         _io_retry(_write)
+        from ...framework.io import atomic_replace, fsync_dir, fsync_tree
+
+        fsync_tree(tmp)
         os.rename(tmp, final)
-        with open(os.path.join(self.root, "LATEST.tmp"), "w") as fh:
-            json.dump({"number": number, "meta": meta or {}}, fh)
-        os.replace(os.path.join(self.root, "LATEST.tmp"),
-                   os.path.join(self.root, "LATEST"))
+        fsync_dir(self.root)
+
+        def _write_latest(tmp_path):
+            with open(tmp_path, "w") as fh:
+                json.dump({"number": number, "meta": meta or {}}, fh)
+
+        atomic_replace(os.path.join(self.root, "LATEST"), _write_latest)
         self._gc()
 
     def restore(self, number: Optional[int] = None):

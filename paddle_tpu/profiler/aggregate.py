@@ -13,7 +13,13 @@ merges them:
 - **straggler detection** — a data-parallel job runs at the speed of its
   slowest rank. A rank whose step-latency p50 (any ``hist/*step_ms/p50``
   scalar) exceeds the cluster median by ``threshold``× is flagged with
-  the metric, its value, and the median it broke from.
+  the metric, its value, and the median it broke from;
+- **dead-rank detection** — with ``expected_ranks``, a rank whose
+  telemetry log is missing (it died before the atexit flush) or holds
+  no parsable record (truncated mid-write) becomes an explicit finding
+  instead of silently shrinking every cluster median — an N-1-rank
+  aggregate that LOOKS healthy is the most dangerous report this tool
+  could produce.
 
 Pure host-side file munching — no jax import — so the CLI wrapper
 (``tools/telemetry_agg.py``) stays fast enough for a watch loop.
@@ -28,7 +34,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "read_jsonl", "rank_of_path", "final_scalars", "load_rank_scalars",
-    "cluster_view", "detect_stragglers", "aggregate",
+    "cluster_view", "detect_stragglers", "detect_dead_ranks", "aggregate",
     "STEP_HIST_PATTERN",
 ]
 
@@ -146,14 +152,68 @@ def detect_stragglers(rank_scalars: Dict[int, Dict[str, float]],
     return findings
 
 
+def detect_dead_ranks(paths: Sequence[str],
+                      rank_scalars: Dict[int, Dict[str, float]],
+                      expected_ranks: int) -> List[dict]:
+    """One finding per expected rank that contributed NO scalars —
+    distinguishing a missing log (the rank died before its atexit flush
+    ever ran) from a present-but-unparsable one (truncated mid-write by
+    a SIGKILL). Sorted by rank."""
+    rank_paths: Dict[int, str] = {}
+    for i, path in enumerate(sorted(paths)):
+        rank_paths.setdefault(rank_of_path(path, i), path)
+    findings: List[dict] = []
+    for rank in range(int(expected_ranks)):
+        if rank in rank_scalars:
+            continue
+        path = rank_paths.get(rank)
+        if path is None:
+            findings.append({
+                "rank": rank, "reason": "missing telemetry log "
+                "(rank died before its atexit flush)"})
+        else:
+            findings.append({
+                "rank": rank, "path": path,
+                "reason": "no parsable telemetry record "
+                "(log truncated/empty — rank died mid-write)"})
+    return findings
+
+
 def aggregate(paths: Sequence[str], threshold: float = 1.25,
-              tag: Optional[str] = None) -> dict:
-    """One-call cluster report over per-rank JSONL paths."""
-    rank_scalars = load_rank_scalars(paths, tag=tag)
-    return {
+              tag: Optional[str] = None,
+              expected_ranks: Optional[int] = None) -> dict:
+    """One-call cluster report over per-rank JSONL paths. Each file is
+    parsed exactly once; with a ``tag`` filter the records are folded
+    twice — tag-filtered for the view, unfiltered for liveness — rather
+    than re-read."""
+    rank_records: Dict[int, List[dict]] = {}
+    for i, path in enumerate(sorted(paths)):
+        try:
+            rank_records[rank_of_path(path, i)] = read_jsonl(path)
+        except OSError:
+            continue  # a missing/unreadable rank drops out of the view
+
+    def _fold(fold_tag: Optional[str]) -> Dict[int, Dict[str, float]]:
+        out: Dict[int, Dict[str, float]] = {}
+        for rank, records in rank_records.items():
+            scalars = final_scalars(records, tag=fold_tag)
+            if scalars:
+                out[rank] = scalars
+        return out
+
+    rank_scalars = _fold(tag)
+    result = {
         "ranks": sorted(rank_scalars),
         "n_ranks": len(rank_scalars),
         "view": cluster_view(rank_scalars),
         "stragglers": detect_stragglers(rank_scalars, threshold=threshold),
         "threshold": threshold,
     }
+    if expected_ranks is not None:
+        # liveness is judged on UNFILTERED records: a healthy rank whose
+        # records all carry a different tag must not be reported dead
+        alive = rank_scalars if tag is None else _fold(None)
+        result["expected_ranks"] = int(expected_ranks)
+        result["dead_ranks"] = detect_dead_ranks(paths, alive,
+                                                 expected_ranks)
+    return result

@@ -17,16 +17,32 @@ saves, ``distributed.launch`` fail-fast watching):
 - :func:`retry_call` (``retry.py``) — deterministic exponential backoff
   for checkpoint/staging I/O;
 - :class:`FaultInjector` (``inject.py``) — deterministic, env/API-driven
-  fault injection (NaN batch, SIGTERM, slow step, worker kill) so every
-  path above stays exercised by tests and the
-  ``tools/check_resilience.py`` CI gate.
+  fault injection (NaN batch, SIGTERM, slow step, worker kill, rank
+  kill/hang, checkpoint corruption) so every path above stays exercised
+  by tests and the ``tools/check_resilience.py`` /
+  ``tools/check_cluster_resilience.py`` CI gates;
+- :class:`ClusterCheckpoint` / :class:`CollectiveGuard` (``cluster.py``)
+  — coordinated manifest-verified checkpointing across ranks, with
+  barrier/collective hangs converted into the restartable
+  ``EXIT_WATCHDOG`` exit the ``distributed.launch`` supervisor
+  relaunches (README "Fault tolerance → Distributed recovery").
 
 Telemetry: ``resilience/{nonfinite_steps,rollbacks,quarantined_batches,
-worker_respawns,restarts,watchdog_dumps,io_retries,spills,resumes,
-preempt_exits}`` counters (README "Fault tolerance").
+worker_respawns,restarts,job_restarts,rank_failures,watchdog_dumps,
+collective_timeouts,io_retries,spills,resumes,preempt_exits}`` counters
+plus ``ckpt/{commits,commit_ms,restores,manifest_verified,
+manifest_fallbacks}`` (README "Fault tolerance").
 """
 from __future__ import annotations
 
+from .cluster import (  # noqa: F401
+    ClusterCheckpoint,
+    CollectiveGuard,
+    CollectiveTimeout,
+    collective_guard,
+    corrupt_one_shard,
+    verify_generation,
+)
 from .guard import (  # noqa: F401
     RecoveryPolicy,
     StepGuard,
@@ -61,6 +77,8 @@ from .watchdog import (  # noqa: F401
 )
 
 __all__ = [
+    "ClusterCheckpoint", "CollectiveGuard", "CollectiveTimeout",
+    "collective_guard", "corrupt_one_shard", "verify_generation",
     "RecoveryPolicy", "StepGuard", "finite_report", "quarantine_batch",
     "load_quarantine", "replay_quarantine",
     "FaultInjector", "install_injector", "active_injector", "clear_injector",

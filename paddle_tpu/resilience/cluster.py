@@ -1,0 +1,573 @@
+"""Cluster-level fault tolerance — coordinated checkpoints with
+integrity manifests, and hang→restartable-exit conversion.
+
+The single-process resilience layer (StepGuard / Watchdog / preemption)
+defends one rank; a multi-process job dies in three ways those layers
+cannot see:
+
+- a rank is SIGKILLed (OOM killer, scheduler) — the survivors block
+  forever in the next collective;
+- a rank hangs inside a collective — no crash, no heartbeat, no exit;
+- a checkpoint is torn or bit-rotted — every rank resumes from garbage,
+  or worse, from *different* steps.
+
+This module closes all three:
+
+:class:`ClusterCheckpoint` — coordinated, manifest-verified
+checkpointing over a shared filesystem. Every rank writes its shard into
+a ``gen-<g>.tmp`` staging dir (through the atomic
+``framework.io.atomic_replace`` commit helper) and publishes an ack with
+the shard's CRC32 + size; rank 0 waits for all acks, verifies every rank
+acked the SAME step, writes ``manifest.json`` (per-file CRC32/size, the
+step/loader cursor, world size), fsyncs, and atomically renames the
+staging dir to ``gen-<g>`` — the commit point. Non-zero ranks block on
+the committed dir appearing. ``restore`` walks committed generations
+newest-first, verifies the FULL manifest (every shard, not just its
+own), and falls back one generation on any mismatch — deleting nothing,
+so a corrupt generation stays on disk as evidence and the older
+generations stay restorable.
+
+:class:`CollectiveGuard` — a deadline around one blocking collective
+(the eager DCN paths in ``distributed.communication``, the ack/commit
+waits here). A peer that died mid-collective parks this rank forever;
+the guard converts that into a stack dump + ``EXIT_WATCHDOG`` so the
+``distributed.launch`` supervisor can relaunch the whole job against the
+last committed checkpoint instead of burning the reservation.
+
+Rendezvous is the shared filesystem (the launcher's single-host contract
+and NFS/GCS-fuse multi-host deployments); no sockets, so a rank death at
+ANY point leaves a debuggable directory, and the protocol needs no
+separate store process.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..framework import io as _io
+from ..profiler.telemetry import get_telemetry
+from .watchdog import EXIT_WATCHDOG, dump_stacks
+
+__all__ = [
+    "ClusterCheckpoint", "CollectiveGuard", "CollectiveTimeout",
+    "collective_guard", "corrupt_one_shard", "verify_generation",
+]
+
+_ENV_BARRIER_TIMEOUT = "PADDLE_TPU_CKPT_BARRIER_TIMEOUT_S"
+_ENV_COLLECTIVE_TIMEOUT = "PADDLE_TPU_COLLECTIVE_TIMEOUT_S"
+# exported by the launch supervisor: which relaunch attempt this worker
+# belongs to. Stamped into checkpoint acks so rank 0 can tell a live
+# peer's ack from a stale one a killed previous attempt left in the
+# same staging dir (same generation, same step, different state).
+_ENV_LAUNCH_ATTEMPT = "PADDLE_TPU_LAUNCH_ATTEMPT"
+
+
+def _launch_attempt() -> int:
+    try:
+        return int(os.environ.get(_ENV_LAUNCH_ATTEMPT, "0") or 0)
+    except ValueError:
+        return 0
+
+
+# rank 0's per-run random identity, published into the staging dir so
+# peers can echo it in their acks (see ClusterCheckpoint._commit)
+_TOKEN_NAME = "commit-token"
+
+
+def _read_token(staging_dir: str) -> Optional[str]:
+    try:
+        with open(os.path.join(staging_dir, _TOKEN_NAME)) as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
+def _report_timeout(extra: str, tag: str) -> str:
+    """The shared hang→restartable-exit bookkeeping: bump the counter
+    FIRST (so the dump's own telemetry snapshot and the JSONL flush can
+    observe it), dump every thread's stack, flush to the rank's JSONL
+    sink. Returns the report; the caller owns the actual exit (os._exit
+    from a timer thread, sys.exit from a controlled wait)."""
+    tel = get_telemetry()
+    tel.counter("resilience/collective_timeouts")
+    report = dump_stacks(extra=extra)
+    sink = os.environ.get("PADDLE_TPU_TELEMETRY_JSONL")
+    if sink:
+        try:
+            tel.to_jsonl(sink, tag=tag)
+        except Exception:
+            pass  # the exit must not be blocked by a bad sink
+    return report
+
+
+class CollectiveTimeout(RuntimeError):
+    """A cross-rank wait (checkpoint ack/commit barrier) exceeded its
+    deadline — some peer is dead or hung. The caller converts this into
+    a restartable exit; blocking forever is the one unacceptable
+    outcome."""
+
+
+# -- hang→exit conversion for blocking collectives --------------------------
+
+class CollectiveGuard:
+    """Deadline around ONE blocking collective call.
+
+    A hung collective cannot be interrupted from its own thread — the
+    thread is inside a blocking C call. The guard arms a timer thread;
+    if the wrapped block has not exited when the deadline fires, it
+    dumps every Python thread's stack (the post-mortem the hang would
+    otherwise never yield), flushes telemetry to the rank's JSONL sink,
+    and ``os._exit(EXIT_WATCHDOG)`` — the restartable exit code the
+    launch supervisor relaunches under ``--max_restarts``. ``os._exit``,
+    not ``sys.exit``: SystemExit raised on the timer thread would kill
+    only the timer.
+
+    ``abort=False`` runs ``on_timeout(report)`` instead and disarms —
+    for tests and embedders that own teardown.
+    """
+
+    def __init__(self, timeout_s: float, name: str = "collective",
+                 abort: bool = True, exit_code: int = EXIT_WATCHDOG,
+                 on_timeout=None):
+        self.timeout_s = float(timeout_s)
+        self.name = name
+        self.abort = abort
+        self.exit_code = int(exit_code)
+        self.on_timeout = on_timeout
+        self.fired = False
+        self._timer: Optional[threading.Timer] = None
+
+    def _fire(self) -> None:
+        self.fired = True
+        report = _report_timeout(
+            extra=f"collective {self.name!r} exceeded {self.timeout_s:.1f}s "
+                  f"— peer dead or hung; converting to restartable exit "
+                  f"{self.exit_code}",
+            tag="collective_timeout")
+        if self.abort:
+            sys.stderr.write(report + "\n")
+            sys.stderr.flush()
+            os._exit(self.exit_code)
+        if self.on_timeout is not None:
+            try:
+                self.on_timeout(report)
+            except Exception:
+                pass
+
+    def __enter__(self) -> "CollectiveGuard":
+        self._timer = threading.Timer(self.timeout_s, self._fire)
+        self._timer.daemon = True
+        self._timer.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._timer is not None:
+            self._timer.cancel()
+        return False
+
+
+class _NullGuard:
+    fired = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def collective_guard(name: str):
+    """Context manager the eager collectives wrap themselves in. Armed
+    only when ``PADDLE_TPU_COLLECTIVE_TIMEOUT_S`` > 0 (off by default —
+    a legitimate first-step compile can take minutes; size the timeout
+    to the slowest legitimate collective, the watchdog-deadline rule)."""
+    try:
+        timeout = float(os.environ.get(_ENV_COLLECTIVE_TIMEOUT, "0") or 0)
+    except ValueError:
+        timeout = 0.0
+    if timeout <= 0:
+        return _NullGuard()
+    return CollectiveGuard(timeout, name=name)
+
+
+# -- coordinated checkpointing ----------------------------------------------
+
+def _to_host(tree):
+    """Device→host conversion of every array leaf (a checkpoint shard is
+    a host artifact; pickling a live jax.Array would drag device buffers
+    and platform state into the file)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: np.asarray(a) if hasattr(a, "dtype") else a, tree)
+
+
+def verify_generation(gen_dir: str) -> dict:
+    """Verify EVERY file listed in a committed generation's manifest
+    (size + CRC32). Returns the parsed manifest; raises
+    ``framework.io.CheckpointIntegrityError`` on the first mismatch or
+    an unreadable/missing manifest."""
+    man_path = os.path.join(gen_dir, _io.MANIFEST_NAME)
+    if not os.path.exists(man_path):
+        raise _io.CheckpointIntegrityError(
+            f"{gen_dir}: committed generation has no manifest")
+    try:
+        with open(man_path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise _io.CheckpointIntegrityError(
+            f"unreadable checkpoint manifest {man_path}: {e}")
+    for name in sorted(manifest.get("files") or {}):
+        # verify_against_manifest re-reads the manifest per file; fine —
+        # generations are small in file COUNT (one shard per rank)
+        _io.verify_against_manifest(os.path.join(gen_dir, name))
+    return manifest
+
+
+def corrupt_one_shard(gen_dir: str) -> Optional[str]:
+    """Flip the last byte of the first shard in a committed generation —
+    the deterministic ``corrupt_ckpt@n`` fault. The manifest is left
+    intact, so verification (not luck) must catch the damage."""
+    for name in sorted(os.listdir(gen_dir)):
+        if name.startswith("shard-"):
+            path = os.path.join(gen_dir, name)
+            with open(path, "r+b") as f:
+                f.seek(-1, os.SEEK_END)
+                last = f.read(1)
+                f.seek(-1, os.SEEK_END)
+                f.write(bytes([last[0] ^ 0xFF]))
+            return path
+    return None
+
+
+class ClusterCheckpoint:
+    """Coordinated, manifest-verified checkpoint generations under one
+    root directory shared by every rank.
+
+    Layout::
+
+        <root>/gen-0/              # committed (the rename IS the commit)
+            shard-rank0.ckpt       # framework.io.save payload per rank
+            shard-rank1.ckpt
+            ack-rank0.json         # {"file","crc32","size","step",
+            ack-rank1.json         #  "attempt","token"}
+            manifest.json          # per-file crc32+size, step, world_size
+        <root>/gen-1.tmp/          # in-flight staging (never read back;
+                                   #  holds rank 0's commit-token file)
+
+    ``step`` is the LOADER CURSOR — the next step the training loop will
+    run. All ranks must call ``save`` at the same loop positions (the
+    protocol cross-checks the acked steps and refuses to commit a
+    diverged job). ``restore`` returns ``{"state", "step", "meta",
+    "generation"}`` from the newest generation whose manifest fully
+    verifies, falling back one generation per mismatch and deleting
+    nothing.
+
+    Rank/world default from the launcher env (``PADDLE_TRAINER_ID`` /
+    ``PADDLE_TRAINERS_NUM``); a single process degenerates to an atomic
+    manifest-verified local checkpoint.
+
+    ``hang_exit``: a barrier deadline (peer died mid-save) exits with
+    ``EXIT_WATCHDOG`` — restartable under the launch supervisor — after
+    flushing telemetry. ``hang_exit=False`` raises
+    :class:`CollectiveTimeout` instead (tests, embedders).
+    """
+
+    def __init__(self, root: str, rank: Optional[int] = None,
+                 world_size: Optional[int] = None,
+                 barrier_timeout_s: Optional[float] = None,
+                 poll_s: float = 0.05, keep_max: int = 0,
+                 hang_exit: bool = True):
+        self.root = os.path.abspath(root)
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)
+                        if rank is None else rank)
+        self.world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)
+                              if world_size is None else world_size)
+        if barrier_timeout_s is None:
+            barrier_timeout_s = float(
+                os.environ.get(_ENV_BARRIER_TIMEOUT, "120") or 120)
+        self.barrier_timeout_s = float(barrier_timeout_s)
+        self.poll_s = float(poll_s)
+        self.keep_max = int(keep_max)
+        self.hang_exit = bool(hang_exit)
+        os.makedirs(self.root, exist_ok=True)
+        # next generation is derived ONCE, before any rank can commit in
+        # this attempt: scanning inside save() would race a fast peer's
+        # commit and split the job across two generation numbers. Every
+        # rank scans the same committed set at construction (commits
+        # only happen after ALL ranks ack, and no rank acks before it is
+        # constructed), so the sequence of save() calls agrees by
+        # construction.
+        gens = self.generations()
+        self._next_gen = (gens[-1] + 1) if gens else 0
+        # run identity: rank 0 publishes this into the staging dir as
+        # ``commit-token`` and only accepts acks echoing it back, so an
+        # ack left by a KILLED previous run — which can carry the same
+        # step, matching bytes, and (outside the launch supervisor) the
+        # same attempt stamp 0 — can never be paired with this run's
+        # shards. os.urandom: no shared env or rendezvous needed.
+        self._token = os.urandom(8).hex()
+
+    # -- generation bookkeeping -------------------------------------------
+    def _gen_dir(self, g: int) -> str:
+        return os.path.join(self.root, f"gen-{int(g)}")
+
+    def generations(self):
+        """Committed generation numbers, oldest first. Only fully
+        committed dirs count — a ``.tmp`` staging dir from a crashed
+        save is invisible here (and harmlessly re-staged over)."""
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for name in names:
+            if name.startswith("gen-") and not name.endswith(".tmp"):
+                try:
+                    out.append(int(name.split("-", 1)[1]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    # -- barrier primitives ------------------------------------------------
+    def _wait_for(self, predicate, what: str) -> None:
+        deadline = time.monotonic() + self.barrier_timeout_s
+        while not predicate():
+            if time.monotonic() > deadline:
+                raise CollectiveTimeout(
+                    f"rank {self.rank}: gave up waiting for {what} after "
+                    f"{self.barrier_timeout_s:.1f}s — a peer rank is dead "
+                    f"or hung")
+            time.sleep(self.poll_s)
+
+    def _hang_to_exit(self, e: CollectiveTimeout) -> None:
+        report = _report_timeout(
+            extra=f"{e}; exiting {EXIT_WATCHDOG} for relaunch from the "
+                  f"last committed checkpoint",
+            tag="ckpt_barrier_timeout")
+        sys.stderr.write(report + "\n")
+        # sys.exit, not os._exit: this thread is in a controlled wait
+        # (not stuck in a C call), so finally blocks may run
+        sys.exit(EXIT_WATCHDOG)
+
+    # -- save --------------------------------------------------------------
+    def save(self, step: int, state, meta: Optional[Dict[str, Any]] = None
+             ) -> int:
+        """Coordinated commit of one generation; returns its number.
+        ``state`` is this RANK's shard (any pytree; leaves are
+        host-converted). Blocks until the generation is committed (rank
+        0) or observed committed (others)."""
+        tel = get_telemetry()
+        try:
+            with tel.timer("ckpt/commit_ms"):
+                g = self._save(int(step), state, meta or {})
+        except CollectiveTimeout as e:
+            if not self.hang_exit:
+                raise
+            self._hang_to_exit(e)
+        tel.counter("ckpt/commits")
+        return g
+
+    def _save(self, step: int, state, meta: Dict[str, Any]) -> int:
+        g = self._next_gen
+        tmp = self._gen_dir(g) + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        shard = f"shard-rank{self.rank}.ckpt"
+        shard_path = os.path.join(tmp, shard)
+        payload = {"state": _to_host(state), "step": int(step),
+                   "rank": self.rank, "meta": meta}
+        _io.save(payload, shard_path)  # atomic within the staging dir
+        if self.rank == 0:
+            def _write_token(tmp_path):
+                with open(tmp_path, "w") as f:
+                    f.write(self._token)
+
+            _io.atomic_replace(os.path.join(tmp, _TOKEN_NAME), _write_token)
+        ack = {"file": shard, "crc32": _io.file_crc32(shard_path),
+               "size": os.path.getsize(shard_path), "step": int(step),
+               "attempt": _launch_attempt(),
+               "token": self._token if self.rank == 0
+               else _read_token(tmp)}
+
+        def _write_ack(tmp_path):
+            with open(tmp_path, "w") as f:
+                json.dump(ack, f)
+
+        ack_path = os.path.join(tmp, f"ack-rank{self.rank}.json")
+        _io.atomic_replace(ack_path, _write_ack)
+        if self.rank == 0:
+            self._commit(g, tmp, step, meta)
+        else:
+            # wait for the commit, keeping the ack stamped with the
+            # CURRENT commit-token: this rank may have staged before
+            # rank 0 published its token (ack carries None or a dead
+            # run's token) — rank 0 ignores such an ack, so re-ack as
+            # soon as the fresh token appears
+            def _committed_or_reack() -> bool:
+                if os.path.isdir(self._gen_dir(g)):
+                    return True
+                tok = _read_token(tmp)
+                if tok is not None and tok != ack.get("token"):
+                    ack["token"] = tok
+                    _io.atomic_replace(ack_path, _write_ack)
+                return False
+
+            self._wait_for(_committed_or_reack,
+                           f"rank 0 to commit generation {g} "
+                           f"(step {step})")
+        self._next_gen = g + 1
+        return g
+
+    def _commit(self, g: int, tmp: str, step: int,
+                meta: Dict[str, Any]) -> None:
+        """Rank 0's side of the barrier: wait until every rank's ack is
+        CONSISTENT — carrying THIS run's commit-token, for THIS step,
+        and matching the shard bytes on disk (size + CRC32 re-verified
+        at commit time). The re-verification is what makes stale staging
+        FILES harmless: a killed attempt leaves its old shard/ack in
+        ``gen-<g>.tmp``, and the relaunched attempt overwrites both
+        (shard first, ack after, each atomic) — an ack observed
+        mid-overwrite simply fails the consistency check and is re-read
+        on the next poll. The token is what makes stale ACKS harmless
+        even when their step and bytes verify perfectly: rank 0 only
+        accepts acks echoing the random token it published into the
+        staging dir THIS run (peers re-ack when the token file changes),
+        so a dead run's ack — which outside the launch supervisor would
+        carry the same attempt stamp 0 — can never be paired with this
+        run's shards. The supervisor's attempt stamp
+        (``PADDLE_TPU_LAUNCH_ATTEMPT``) is still cross-checked as a
+        cheap belt-and-braces diagnostic. A genuinely diverged peer
+        (acking a different step) therefore surfaces as a barrier
+        timeout → restartable exit, never as a committed checkpoint
+        mixing state from different steps, attempts, or runs."""
+        attempt = _launch_attempt()
+        verified: Dict[int, dict] = {}
+        # CRC memo keyed on (inode, mtime_ns, size): shards only ever
+        # change by atomic_replace rename (new inode), so an unchanged
+        # signature means unchanged bytes — a stale multi-GB shard from
+        # a killed attempt is hashed once, not on every 50 ms poll tick
+        crc_memo: Dict[str, tuple] = {}
+
+        def _shard_crc(path: str) -> tuple:
+            st = os.stat(path)
+            sig = (st.st_ino, st.st_mtime_ns, st.st_size)
+            hit = crc_memo.get(path)
+            if hit is None or hit[0] != sig:
+                crc_memo[path] = hit = (sig, _io.file_crc32(path))
+            return st.st_size, hit[1]
+
+        def _acks_consistent() -> bool:
+            for r in range(self.world_size):
+                if r in verified:
+                    continue  # checked once; same-attempt acks are final
+                p = os.path.join(tmp, f"ack-rank{r}.json")
+                try:
+                    with open(p) as f:
+                        a = json.load(f)
+                    size, crc = _shard_crc(os.path.join(tmp, a["file"]))
+                    ok = (a.get("token") == self._token
+                          and int(a.get("attempt", 0)) == attempt
+                          and int(a["step"]) == int(step)
+                          and size == int(a["size"])
+                          and crc == int(a["crc32"]))
+                except (OSError, ValueError, KeyError, TypeError):
+                    ok = False
+                if not ok:
+                    return False  # absent, stale, or mid-write: re-poll
+                verified[r] = a
+            return len(verified) == self.world_size
+
+        self._wait_for(_acks_consistent,
+                       f"all {self.world_size} consistent rank acks for "
+                       f"generation {g} (step {step})")
+        manifest = {
+            "format": 1, "generation": int(g), "step": int(step),
+            "world_size": self.world_size, "ts": time.time(),
+            "files": {a["file"]: {"crc32": int(a["crc32"]),
+                                  "size": int(a["size"])}
+                      for a in verified.values()},
+            "meta": meta,
+        }
+
+        def _write_manifest(tmp_path):
+            with open(tmp_path, "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+
+        _io.atomic_replace(os.path.join(tmp, _io.MANIFEST_NAME),
+                           _write_manifest)
+        # prune staging leftovers (``*.tmp-<pid>`` from a rank killed
+        # mid-write in an earlier attempt) so the rename below commits
+        # exactly the manifest-listed shards plus their acks — nothing
+        # a later attempt or a human inspecting gen-<g> could mistake
+        # for real state. Every live rank has acked by now, and the
+        # supervisor kills the whole process group before a relaunch,
+        # so nothing is still writing into this dir.
+        keep = (set(manifest["files"]) | {_io.MANIFEST_NAME}
+                | {f"ack-rank{r}.json" for r in range(self.world_size)})
+        for name in os.listdir(tmp):
+            if name not in keep:
+                try:
+                    os.unlink(os.path.join(tmp, name))
+                except OSError:
+                    pass
+        _io.fsync_tree(tmp)
+        os.rename(tmp, self._gen_dir(g))  # the commit point
+        _io.fsync_dir(self.root)
+        from .inject import active_injector
+
+        inj = active_injector()
+        if inj is not None and inj.corrupt_ckpt_due(g):
+            # post-commit corruption (manifest left truthful): restore
+            # must catch this by verification, and fall back
+            corrupt_one_shard(self._gen_dir(g))
+        self._gc()
+
+    def _gc(self) -> None:
+        """Optional retention (``keep_max`` > 0): drop the OLDEST
+        committed generations beyond the cap. Integrity fallback never
+        deletes; only this explicitly-requested retention does."""
+        if self.keep_max <= 0:
+            return
+        gens = self.generations()
+        while len(gens) > self.keep_max:
+            shutil.rmtree(self._gen_dir(gens.pop(0)), ignore_errors=True)
+
+    # -- restore -----------------------------------------------------------
+    def restore(self) -> Optional[Dict[str, Any]]:
+        """Newest committed generation that fully verifies, as
+        ``{"state", "step", "meta", "generation"}`` — or None on a fresh
+        run. Every fallback (corrupt shard, unreadable manifest, world
+        mismatch) is counted in ``ckpt/manifest_fallbacks`` and leaves
+        the rejected generation on disk untouched."""
+        tel = get_telemetry()
+        for g in reversed(self.generations()):
+            gen_dir = self._gen_dir(g)
+            try:
+                manifest = verify_generation(gen_dir)
+                if int(manifest.get("world_size", -1)) != self.world_size:
+                    raise _io.CheckpointIntegrityError(
+                        f"{gen_dir}: committed by a {manifest.get('world_size')}"
+                        f"-rank job, this job has {self.world_size} ranks")
+                shard = os.path.join(gen_dir, f"shard-rank{self.rank}.ckpt")
+                # verify_generation just hashed every listed file, this
+                # shard included — skip load's second full read
+                payload = _io.load(shard, verify=False)
+                tel.counter("ckpt/manifest_verified")
+            except _io.CheckpointIntegrityError as e:
+                tel.counter("ckpt/manifest_fallbacks")
+                sys.stderr.write(
+                    f"[cluster-ckpt] generation {g} rejected ({e}); falling "
+                    f"back one generation (nothing deleted)\n")
+                continue
+            tel.counter("ckpt/restores")
+            return {"state": payload["state"], "step": int(payload["step"]),
+                    "meta": payload.get("meta") or manifest.get("meta", {}),
+                    "generation": g}
+        return None

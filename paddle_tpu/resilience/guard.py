@@ -87,12 +87,19 @@ def quarantine_batch(directory: str, step: int, inputs, labels,
             arrays[f"{prefix}_{i}"] = np.asarray(leaf)
     meta = {"step": int(step), "bad": list(bad_names), "ts": time.time(),
             "n_inputs": counts["input"], "n_labels": counts["label"]}
-    np.savez(path,
-             __meta__=np.frombuffer(json.dumps(meta).encode(),
-                                    dtype=np.uint8),
-             __treedefs__=np.frombuffer(pickle.dumps(treedefs),
-                                        dtype=np.uint8),
-             **arrays)
+
+    def _write(tmp):
+        with open(tmp, "wb") as f:
+            np.savez(f,
+                     __meta__=np.frombuffer(json.dumps(meta).encode(),
+                                            dtype=np.uint8),
+                     __treedefs__=np.frombuffer(pickle.dumps(treedefs),
+                                                dtype=np.uint8),
+                     **arrays)
+
+    from ..framework.io import atomic_replace
+
+    atomic_replace(path, _write)
     return path
 
 
@@ -242,6 +249,8 @@ class StepGuard:
         if inj is not None:
             inj.maybe_sigterm(step_i)
             self._check_preemption()  # same boundary sees the injected signal
+            inj.maybe_kill_rank(step_i)   # SIGKILL: never returns if due
+            inj.maybe_hang_rank(step_i)   # heartbeat starvation if due
             inputs = inj.corrupt_batch(step_i, inputs)
             inj.maybe_slow(step_i)
         if self._snap is None:

@@ -15,11 +15,21 @@ consulted by the runtime itself:
   process, driving the preemption path end-to-end;
 - ``worker_kill_due(batch_idx)`` — the DataLoader multiprocess iterator
   SIGKILLs the worker that produced the given batch, driving the
-  respawn/re-enqueue path.
+  respawn/re-enqueue path;
+- ``maybe_kill_rank(step)`` — StepGuard SIGKILLs THIS process when its
+  trainer rank matches the plan (``kill_rank@step:r``), driving the
+  launch supervisor's rank-failure detection + elastic relaunch;
+- ``maybe_hang_rank(step)`` — StepGuard parks the rank in a long sleep
+  (``hang_rank@step:r``), starving its heartbeat file so the supervisor
+  detects a hung rank;
+- ``corrupt_ckpt_due(generation)`` — ``ClusterCheckpoint`` flips a byte
+  in one committed shard AFTER the commit (``corrupt_ckpt@n``), so the
+  manifest-verified restore path must catch it and fall back.
 
 Env-driven for subprocess runs (the CI smoke gate, launch children):
 
     PADDLE_TPU_INJECT="nan@3,sigterm@7,slow@5:1.5,kill_worker@2"
+    PADDLE_TPU_INJECT="kill_rank@4:1,hang_rank@2:0,corrupt_ckpt@1"
 
 One-shot semantics: every injection fires at most once per injector.
 Cross-process one-shot (a relaunched job must not re-receive the same
@@ -53,6 +63,15 @@ class FaultInjector:
         slow_steps: ``{step: seconds}`` boundary sleeps (watchdog food).
         kill_worker_batches: batch indices after whose delivery the
             producing DataLoader worker is SIGKILLed.
+        kill_rank_steps: ``{step: rank}`` — SIGKILL this process at the
+            step boundary when its trainer rank matches.
+        hang_rank_steps: ``{step: rank}`` — park this process in a
+            ``hang_seconds`` sleep (heartbeat starvation) when its
+            trainer rank matches.
+        corrupt_ckpt_gens: committed cluster-checkpoint generation
+            ordinals to bit-flip post-commit.
+        hang_seconds: duration of an injected hang — long enough that
+            only supervisor detection (not the sleep ending) can end it.
         state_dir: directory for cross-process one-shot markers; a fault
             whose marker file exists never fires again (survives the
             relaunch the fault itself provokes).
@@ -62,12 +81,22 @@ class FaultInjector:
                  sigterm_steps: Iterable[int] = (),
                  slow_steps: Optional[Dict[int, float]] = None,
                  kill_worker_batches: Iterable[int] = (),
+                 kill_rank_steps: Optional[Dict[int, int]] = None,
+                 hang_rank_steps: Optional[Dict[int, int]] = None,
+                 corrupt_ckpt_gens: Iterable[int] = (),
+                 hang_seconds: float = 3600.0,
                  state_dir: Optional[str] = None):
         self.nan_steps = {int(s) for s in nan_steps}
         self.sigterm_steps = {int(s) for s in sigterm_steps}
         self.slow_steps = {int(k): float(v)
                            for k, v in (slow_steps or {}).items()}
         self.kill_worker_batches = {int(b) for b in kill_worker_batches}
+        self.kill_rank_steps = {int(k): int(v)
+                                for k, v in (kill_rank_steps or {}).items()}
+        self.hang_rank_steps = {int(k): int(v)
+                                for k, v in (hang_rank_steps or {}).items()}
+        self.corrupt_ckpt_gens = {int(g) for g in corrupt_ckpt_gens}
+        self.hang_seconds = float(hang_seconds)
         self.state_dir = state_dir
         self._fired: Set[str] = set()
 
@@ -75,9 +104,12 @@ class FaultInjector:
     @classmethod
     def from_spec(cls, spec: str, state_dir: Optional[str] = None
                   ) -> "FaultInjector":
-        """Parse ``"nan@3,sigterm@7,slow@5:1.5,kill_worker@2"``."""
-        nan, sig, kill = [], [], []
+        """Parse ``"nan@3,sigterm@7,slow@5:1.5,kill_worker@2,
+        kill_rank@4:1,hang_rank@2:0,corrupt_ckpt@1"``."""
+        nan, sig, kill, corrupt = [], [], [], []
         slow: Dict[int, float] = {}
+        kill_rank: Dict[int, int] = {}
+        hang_rank: Dict[int, int] = {}
         for part in spec.split(","):
             part = part.strip()
             if not part:
@@ -93,10 +125,18 @@ class FaultInjector:
                 sig.append(int(where))
             elif kind == "kill_worker":
                 kill.append(int(where))
+            elif kind in ("kill_rank", "hang_rank"):
+                step, _, r = where.partition(":")
+                target = kill_rank if kind == "kill_rank" else hang_rank
+                target[int(step)] = int(r or 0)
+            elif kind == "corrupt_ckpt":
+                corrupt.append(int(where))
             else:
                 raise ValueError(f"unknown fault kind {kind!r} in {spec!r}")
         return cls(nan_steps=nan, sigterm_steps=sig, slow_steps=slow,
-                   kill_worker_batches=kill, state_dir=state_dir)
+                   kill_worker_batches=kill, kill_rank_steps=kill_rank,
+                   hang_rank_steps=hang_rank, corrupt_ckpt_gens=corrupt,
+                   state_dir=state_dir)
 
     @classmethod
     def from_env(cls, env=None) -> Optional["FaultInjector"]:
@@ -165,6 +205,51 @@ class FaultInjector:
     def worker_kill_due(self, batch_idx: int) -> bool:
         return (int(batch_idx) in self.kill_worker_batches
                 and self._once(f"kill_worker@{batch_idx}"))
+
+    @staticmethod
+    def _rank() -> int:
+        """This process's trainer rank, from the launcher env contract
+        (no jax import — the injector must work before/without device
+        init)."""
+        try:
+            return int(os.environ.get("PADDLE_TRAINER_ID")
+                       or os.environ.get("PROCESS_ID") or 0)
+        except ValueError:
+            return 0
+
+    def maybe_kill_rank(self, step: int) -> bool:
+        """SIGKILL this process at a scheduled (step, rank) boundary —
+        the un-catchable death the launch supervisor must detect. The
+        one-shot marker is written BEFORE the kill (the whole point is
+        that the relaunched rank survives the same step)."""
+        r = self.kill_rank_steps.get(int(step))
+        if r is None or r != self._rank():
+            return False
+        if not self._once(f"kill_rank@{step}:{r}"):
+            return False
+        self._count("kill_rank")
+        os.kill(os.getpid(), signal.SIGKILL)
+        return True  # unreachable; documents intent
+
+    def maybe_hang_rank(self, step: int) -> float:
+        """Park this rank in a long sleep at a scheduled (step, rank)
+        boundary, starving its heartbeat file. Ends only by supervisor
+        teardown (SIGTERM interrupts the sleep; the marker, written
+        before sleeping, keeps the relaunch hang-free)."""
+        r = self.hang_rank_steps.get(int(step))
+        if r is None or r != self._rank() \
+                or not self._once(f"hang_rank@{step}:{r}"):
+            return 0.0
+        self._count("hang_rank")
+        time.sleep(self.hang_seconds)
+        return self.hang_seconds
+
+    def corrupt_ckpt_due(self, generation: int) -> bool:
+        """True exactly once when committed generation ``generation`` is
+        scheduled for post-commit corruption (the byte flip itself lives
+        in ``resilience.cluster.corrupt_one_shard``)."""
+        return (int(generation) in self.corrupt_ckpt_gens
+                and self._once(f"corrupt_ckpt@{generation}"))
 
     @staticmethod
     def _count(kind: str):

@@ -172,6 +172,45 @@ class Watchdog:
 
 _active: Optional[Watchdog] = None
 
+# -- cross-process heartbeat file -------------------------------------------
+# The launch supervisor watches a per-rank heartbeat FILE
+# (PADDLE_TPU_HEARTBEAT_FILE, exported by distributed.launch) so it can
+# tell a hung rank from a slow one without any in-process cooperation
+# beyond the beats the engines already emit. Touches are rate-limited:
+# the supervisor's staleness threshold is seconds, so sub-second mtime
+# resolution buys nothing and a touch-per-step would put filesystem
+# metadata traffic on the hot path.
+_HB_ENV = "PADDLE_TPU_HEARTBEAT_FILE"
+_HB_MIN_INTERVAL_S = 0.5
+_UNSET = object()
+_hb_path = _UNSET
+_hb_last = 0.0
+
+
+def _touch_heartbeat_file() -> None:
+    global _hb_path, _hb_last
+    if _hb_path is _UNSET:  # resolve the env contract once
+        _hb_path = os.environ.get(_HB_ENV) or None
+    if _hb_path is None:
+        return
+    now = time.monotonic()
+    if now - _hb_last < _HB_MIN_INTERVAL_S:
+        return
+    _hb_last = now
+    try:
+        with open(_hb_path, "a"):
+            pass
+        os.utime(_hb_path, None)
+    except OSError:
+        pass  # a beat must never crash the step that emitted it
+
+
+def _reset_heartbeat_file_cache() -> None:
+    """Re-read PADDLE_TPU_HEARTBEAT_FILE on the next beat (tests)."""
+    global _hb_path, _hb_last
+    _hb_path = _UNSET
+    _hb_last = 0.0
+
 
 def install_watchdog(deadline_s: float, **kwargs) -> Watchdog:
     """Create, start, and register the process-wide watchdog the engines'
@@ -195,8 +234,11 @@ def current_watchdog() -> Optional[Watchdog]:
 
 
 def heartbeat(step: Optional[int] = None) -> None:
-    """Step-boundary beat — the one call sites use. No-op (one global
-    read) when no watchdog is installed."""
+    """Step-boundary beat — the one call sites use. Feeds the in-process
+    watchdog (when armed) AND the per-rank heartbeat file the launch
+    supervisor watches (when PADDLE_TPU_HEARTBEAT_FILE is exported).
+    Near-no-op (two global reads) when neither is configured."""
     w = _active
     if w is not None:
         w.beat(step)
+    _touch_heartbeat_file()

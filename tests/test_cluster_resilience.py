@@ -1,0 +1,715 @@
+"""Cluster-level fault tolerance: coordinated manifest-verified
+checkpoints, rank-failure detection + elastic relaunch, and the
+multi-process fault-injection plans that keep both exercised —
+bit-flipped shard → manifest fallback one generation (nothing deleted);
+barrier timeout → CollectiveTimeout → restartable EXIT_WATCHDOG;
+SIGKILLed / hung / watchdog-aborted ranks relaunched under the same
+``--max_restarts`` budget; a 2-process kill_rank run resumes from the
+last committed loader cursor with no batch replayed twice; dead ranks
+surface as telemetry_agg findings instead of shrinking the medians."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.framework import io as fio
+from paddle_tpu.jit.train_step import TrainStep
+from paddle_tpu.profiler.telemetry import get_telemetry
+from paddle_tpu.resilience import (
+    ClusterCheckpoint,
+    CollectiveGuard,
+    CollectiveTimeout,
+    EXIT_WATCHDOG,
+    FaultInjector,
+    clear_injector,
+    corrupt_one_shard,
+    install_injector,
+    verify_generation,
+)
+from paddle_tpu.distributed.launch import launch
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+sys.path.insert(0, _TOOLS)
+import check_telemetry_schema as schema_gate  # noqa: E402
+
+
+def _mse(out, y):
+    return ((out - y) ** 2).mean()
+
+
+def _build_step(seed=0):
+    paddle.seed(seed)
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    return TrainStep(net, _mse, opt, guard_updates=True)
+
+
+# ---------------------------------------------------------------------------
+class TestAtomicIO:
+    def test_save_commits_atomically_and_roundtrips(self, tmp_path):
+        path = str(tmp_path / "m.pdparams")
+        fio.save({"w": paddle.to_tensor(np.arange(4.0, dtype="float32"))},
+                 path)
+        out = fio.load(path)
+        np.testing.assert_allclose(np.asarray(out["w"].numpy()),
+                                   [0, 1, 2, 3])
+        # no temp siblings survive a successful commit
+        assert [n for n in os.listdir(tmp_path) if ".tmp-" in n] == []
+
+    def test_atomic_replace_failure_keeps_committed_file(self, tmp_path):
+        path = str(tmp_path / "f.bin")
+        fio.atomic_replace(path, lambda t: open(t, "wb").write(b"v1"))
+
+        def boom(tmp):
+            with open(tmp, "wb") as f:
+                f.write(b"half-written")
+            raise OSError("disk died mid-write")
+
+        with pytest.raises(OSError, match="disk died"):
+            fio.atomic_replace(path, boom)
+        assert open(path, "rb").read() == b"v1"  # old commit intact
+        assert [n for n in os.listdir(tmp_path) if ".tmp-" in n] == []
+
+    def test_load_verifies_manifest_and_rejects_corruption(self, tmp_path):
+        path = str(tmp_path / "shard-rank0.ckpt")
+        fio.save({"x": np.ones(8, np.float32)}, path)
+        manifest = {"files": {"shard-rank0.ckpt": {
+            "crc32": fio.file_crc32(path), "size": os.path.getsize(path)}}}
+        with open(tmp_path / fio.MANIFEST_NAME, "w") as f:
+            json.dump(manifest, f)
+        fio.load(path)  # verifies clean
+        with open(path, "r+b") as f:  # flip one byte
+            f.seek(-1, os.SEEK_END)
+            b = f.read(1)
+            f.seek(-1, os.SEEK_END)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(fio.CheckpointIntegrityError, match="crc32"):
+            fio.load(path)
+
+    def test_manifest_size_mismatch_rejected(self, tmp_path):
+        path = str(tmp_path / "shard-rank0.ckpt")
+        fio.save({"x": np.ones(2, np.float32)}, path)
+        with open(tmp_path / fio.MANIFEST_NAME, "w") as f:
+            json.dump({"files": {"shard-rank0.ckpt": {
+                "crc32": fio.file_crc32(path),
+                "size": os.path.getsize(path) + 1}}}, f)
+        with pytest.raises(fio.CheckpointIntegrityError, match="size"):
+            fio.load(path)
+
+    def test_unreadable_shard_is_integrity_error_not_crash(
+            self, tmp_path, monkeypatch):
+        """EIO/EACCES/stale-NFS while hashing a listed shard must
+        surface as CheckpointIntegrityError so restore() falls back a
+        generation instead of dying with a raw OSError."""
+        path = str(tmp_path / "shard-rank0.ckpt")
+        fio.save({"x": np.ones(2, np.float32)}, path)
+        with open(tmp_path / fio.MANIFEST_NAME, "w") as f:
+            json.dump({"files": {"shard-rank0.ckpt": {
+                "crc32": fio.file_crc32(path),
+                "size": os.path.getsize(path)}}}, f)
+
+        def eio(_, **kw):
+            raise OSError(5, "Input/output error")
+
+        monkeypatch.setattr(fio, "file_crc32", eio)
+        with pytest.raises(fio.CheckpointIntegrityError, match="unreadable"):
+            fio.verify_against_manifest(path)
+
+    def test_uncovered_file_loads_without_manifest_check(self, tmp_path):
+        # a manifest that does not list the file must not block the load
+        path = str(tmp_path / "other.pdparams")
+        fio.save({"x": 1}, path)
+        with open(tmp_path / fio.MANIFEST_NAME, "w") as f:
+            json.dump({"files": {"shard-rank0.ckpt": {"crc32": 0,
+                                                      "size": 0}}}, f)
+        assert fio.load(path)["x"] == 1
+
+
+# ---------------------------------------------------------------------------
+class TestClusterCheckpoint:
+    def _save_world2(self, root, step, value):
+        cks = [ClusterCheckpoint(str(root), rank=r, world_size=2,
+                                 barrier_timeout_s=20, hang_exit=False)
+               for r in range(2)]
+        out = [None, None]
+
+        def run(r):
+            out[r] = cks[r].save(step, {"w": np.full((3,), value + r)})
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        return cks, out
+
+    def test_coordinated_commit_writes_full_manifest(self, tmp_path):
+        cks, gens = self._save_world2(tmp_path, step=2, value=1.0)
+        assert gens == [0, 0]
+        man = verify_generation(str(tmp_path / "gen-0"))
+        assert man["step"] == 2 and man["world_size"] == 2
+        assert sorted(man["files"]) == ["shard-rank0.ckpt",
+                                        "shard-rank1.ckpt"]
+        # every rank restores ITS shard at the committed cursor
+        for r, ck in enumerate(cks):
+            p = ck.restore()
+            assert p["step"] == 2 and p["generation"] == 0
+            np.testing.assert_allclose(p["state"]["w"], 1.0 + r)
+
+    def test_bitflip_falls_back_one_generation_deleting_nothing(
+            self, tmp_path):
+        ck = ClusterCheckpoint(str(tmp_path), rank=0, world_size=1,
+                               hang_exit=False)
+        ck.save(2, {"w": np.full(4, 2.0)})
+        ck.save(4, {"w": np.full(4, 4.0)})
+        corrupt_one_shard(str(tmp_path / "gen-1"))
+        before = get_telemetry().counter_value("ckpt/manifest_fallbacks")
+        p = ck.restore()
+        assert p["generation"] == 0 and p["step"] == 2
+        np.testing.assert_allclose(p["state"]["w"], 2.0)
+        assert get_telemetry().counter_value(
+            "ckpt/manifest_fallbacks") == before + 1
+        # the corrupt generation stays on disk as evidence
+        assert (tmp_path / "gen-1").is_dir()
+
+    def test_corrupt_ckpt_injection_hooks_the_commit(self, tmp_path):
+        install_injector(FaultInjector(corrupt_ckpt_gens=[1]))
+        try:
+            ck = ClusterCheckpoint(str(tmp_path), rank=0, world_size=1,
+                                   hang_exit=False)
+            ck.save(2, {"w": np.full(4, 2.0)})
+            ck.save(4, {"w": np.full(4, 4.0)})  # committed then bit-flipped
+            p = ck.restore()
+            assert p["generation"] == 0 and p["step"] == 2
+        finally:
+            clear_injector()
+
+    def test_commit_prunes_stale_staging_orphans(self, tmp_path):
+        """A rank SIGKILLed inside atomic_replace's write_fn leaves a
+        ``*.tmp-<pid>`` sibling in the staging dir; the relaunched
+        attempt re-stages over the shard but the orphan must not be
+        renamed into the committed generation."""
+        stale = tmp_path / "gen-0.tmp" / "shard-rank0.ckpt.tmp-99999"
+        stale.parent.mkdir()
+        stale.write_bytes(b"torn half-write from a killed attempt")
+        ck = ClusterCheckpoint(str(tmp_path), rank=0, world_size=1,
+                               hang_exit=False)
+        ck.save(2, {"w": np.full(4, 2.0)})
+        committed = sorted(p.name for p in (tmp_path / "gen-0").iterdir())
+        assert committed == ["ack-rank0.json", "manifest.json",
+                             "shard-rank0.ckpt"]
+        assert ck.restore()["step"] == 2
+
+    def test_fresh_run_restores_none(self, tmp_path):
+        assert ClusterCheckpoint(str(tmp_path), rank=0,
+                                 world_size=1).restore() is None
+
+    def test_world_size_mismatch_is_a_fallback_not_garbage(self, tmp_path):
+        ck1 = ClusterCheckpoint(str(tmp_path), rank=0, world_size=1,
+                                hang_exit=False)
+        ck1.save(3, {"w": np.ones(2)})
+        ck2 = ClusterCheckpoint(str(tmp_path), rank=0, world_size=2,
+                                hang_exit=False)
+        assert ck2.restore() is None  # 1-rank generation skipped, counted
+
+    def test_stale_attempt_acks_never_commit(self, tmp_path, monkeypatch):
+        """An ack a KILLED previous attempt left in the staging dir —
+        same generation, same step, CRC matching its stale shard — must
+        not let rank 0 commit a checkpoint pairing live and dead
+        attempts' state; only an ack stamped with the CURRENT launch
+        attempt does."""
+        monkeypatch.setenv("PADDLE_TPU_LAUNCH_ATTEMPT", "1")
+        ck0 = ClusterCheckpoint(str(tmp_path), rank=0, world_size=2,
+                                barrier_timeout_s=0.6, poll_s=0.02,
+                                hang_exit=False)
+        staging = tmp_path / "gen-0.tmp"
+        staging.mkdir()
+        shard = staging / "shard-rank1.ckpt"
+        fio.save({"w": np.ones(2)}, str(shard))
+        ack = {"file": "shard-rank1.ckpt",
+               "crc32": fio.file_crc32(str(shard)),
+               "size": os.path.getsize(str(shard)), "step": 2,
+               "attempt": 0,  # the dead attempt's stamp
+               "token": ck0._token}
+        (staging / "ack-rank1.json").write_text(json.dumps(ack))
+        with pytest.raises(CollectiveTimeout):
+            ck0.save(2, {"w": np.zeros(2)})
+        # the same ack re-stamped by a live attempt-1 rank commits
+        ack["attempt"] = 1
+        (staging / "ack-rank1.json").write_text(json.dumps(ack))
+        assert ck0.save(2, {"w": np.zeros(2)}) == 0
+        assert verify_generation(str(tmp_path / "gen-0"))["step"] == 2
+
+    def test_dead_runs_acks_never_commit_without_supervisor(
+            self, tmp_path, monkeypatch):
+        """Outside the launch supervisor every run stamps attempt 0, so
+        the attempt check alone cannot tell a killed run's leftover ack
+        from a live peer's — the per-run commit-token must: an ack whose
+        step AND bytes verify but whose token belongs to the dead run
+        times out instead of committing a checkpoint mixing two runs'
+        state."""
+        monkeypatch.delenv("PADDLE_TPU_LAUNCH_ATTEMPT", raising=False)
+        ck0 = ClusterCheckpoint(str(tmp_path), rank=0, world_size=2,
+                                barrier_timeout_s=0.6, poll_s=0.02,
+                                hang_exit=False)
+        staging = tmp_path / "gen-0.tmp"
+        staging.mkdir()
+        shard = staging / "shard-rank1.ckpt"
+        fio.save({"w": np.ones(2)}, str(shard))
+        (staging / "ack-rank1.json").write_text(json.dumps(
+            {"file": "shard-rank1.ckpt",
+             "crc32": fio.file_crc32(str(shard)),
+             "size": os.path.getsize(str(shard)), "step": 2,
+             "attempt": 0, "token": "deadbeefdeadbeef"}))
+        with pytest.raises(CollectiveTimeout):
+            ck0.save(2, {"w": np.zeros(2)})
+        # a live peer echoing THIS run's published token commits
+        (staging / "ack-rank1.json").write_text(json.dumps(
+            {"file": "shard-rank1.ckpt",
+             "crc32": fio.file_crc32(str(shard)),
+             "size": os.path.getsize(str(shard)), "step": 2,
+             "attempt": 0, "token": ck0._token}))
+        assert ck0.save(2, {"w": np.zeros(2)}) == 0
+
+    def test_barrier_timeout_raises_collective_timeout(self, tmp_path):
+        # world of 2 with only rank 1 present: the peer "died" mid-save
+        ck = ClusterCheckpoint(str(tmp_path), rank=1, world_size=2,
+                               barrier_timeout_s=0.3, poll_s=0.02,
+                               hang_exit=False)
+        with pytest.raises(CollectiveTimeout, match="dead or hung"):
+            ck.save(2, {"w": np.ones(2)})
+
+    def test_barrier_timeout_hang_exit_is_restartable_113(self, tmp_path):
+        # with hang_exit (the production default) the same stall becomes
+        # a restartable SystemExit(EXIT_WATCHDOG)
+        ck = ClusterCheckpoint(str(tmp_path), rank=1, world_size=2,
+                               barrier_timeout_s=0.2, poll_s=0.02)
+        with pytest.raises(SystemExit) as exc:
+            ck.save(2, {"w": np.ones(2)})
+        assert exc.value.code == EXIT_WATCHDOG
+
+
+# ---------------------------------------------------------------------------
+class TestCollectiveGuard:
+    def test_timeout_fires_callback_with_dump(self):
+        reports = []
+        with CollectiveGuard(0.15, name="test_allreduce", abort=False,
+                             on_timeout=reports.append) as g:
+            time.sleep(0.6)
+        assert g.fired
+        assert "test_allreduce" in reports[0]
+        assert "thread" in reports[0]  # carries the stack dump
+
+    def test_fast_collective_never_fires(self):
+        with CollectiveGuard(5.0, abort=False) as g:
+            pass
+        time.sleep(0.05)
+        assert not g.fired
+
+    def test_env_gate_off_by_default(self, monkeypatch):
+        from paddle_tpu.resilience.cluster import collective_guard
+
+        monkeypatch.delenv("PADDLE_TPU_COLLECTIVE_TIMEOUT_S", raising=False)
+        g = collective_guard("x")
+        assert not isinstance(g, CollectiveGuard)
+        monkeypatch.setenv("PADDLE_TPU_COLLECTIVE_TIMEOUT_S", "30")
+        g = collective_guard("x")
+        assert isinstance(g, CollectiveGuard) and g.timeout_s == 30.0
+
+
+# ---------------------------------------------------------------------------
+class TestInjectorPlans:
+    def test_spec_parses_cluster_kinds(self):
+        inj = FaultInjector.from_spec(
+            "kill_rank@4:1,hang_rank@2:0,corrupt_ckpt@1,nan@3")
+        assert inj.kill_rank_steps == {4: 1}
+        assert inj.hang_rank_steps == {2: 0}
+        assert inj.corrupt_ckpt_gens == {1}
+        assert inj.nan_steps == {3}
+
+    def test_rank_defaults_to_zero(self):
+        inj = FaultInjector.from_spec("kill_rank@5")
+        assert inj.kill_rank_steps == {5: 0}
+
+    def test_kill_rank_ignores_other_ranks(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        inj = FaultInjector(kill_rank_steps={3: 1})
+        assert inj.maybe_kill_rank(3) is False  # wrong rank: no fire
+        assert inj._fired == set()              # and no one-shot consumed
+
+    def test_hang_rank_one_shot_sleeps_once(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        inj = FaultInjector(hang_rank_steps={2: 1}, hang_seconds=0.05)
+        assert inj.maybe_hang_rank(2) == 0.05
+        assert inj.maybe_hang_rank(2) == 0.0  # one-shot
+
+    def test_corrupt_due_one_shot(self):
+        inj = FaultInjector(corrupt_ckpt_gens=[1])
+        assert inj.corrupt_ckpt_due(0) is False
+        assert inj.corrupt_ckpt_due(1) is True
+        assert inj.corrupt_ckpt_due(1) is False
+
+
+# ---------------------------------------------------------------------------
+class TestLaunchElastic:
+    def test_watchdog_exit_relaunches_under_budget(self, tmp_path):
+        script = tmp_path / "worker.py"
+        marker = tmp_path / "first_run_done"
+        script.write_text(textwrap.dedent(f"""
+            import os, sys
+            marker = {str(marker)!r}
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                sys.exit({EXIT_WATCHDOG})  # hung-and-self-killed
+            sys.exit(0)
+        """))
+        tel = get_telemetry()
+        before = tel.counter_value("resilience/job_restarts")
+        rc = launch(str(script), [], nproc_per_node=1,
+                    log_dir=str(tmp_path / "logs"), max_restarts=2,
+                    restart_backoff=0.01,
+                    extra_env={"JAX_PLATFORMS": "cpu"})
+        assert rc == 0
+        assert tel.counter_value("resilience/job_restarts") == before + 1
+
+    def test_sigkilled_rank_relaunches_and_counts_rank_failure(
+            self, tmp_path):
+        script = tmp_path / "worker.py"
+        marker = tmp_path / "first_run_done"
+        script.write_text(textwrap.dedent(f"""
+            import os, signal, sys
+            marker = {str(marker)!r}
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                os.kill(os.getpid(), signal.SIGKILL)
+            sys.exit(0)
+        """))
+        tel = get_telemetry()
+        before_jr = tel.counter_value("resilience/job_restarts")
+        before_rf = tel.counter_value("resilience/rank_failures")
+        rc = launch(str(script), [], nproc_per_node=1,
+                    log_dir=str(tmp_path / "logs"), max_restarts=2,
+                    restart_backoff=0.01,
+                    extra_env={"JAX_PLATFORMS": "cpu"})
+        assert rc == 0
+        assert tel.counter_value("resilience/job_restarts") == before_jr + 1
+        assert tel.counter_value("resilience/rank_failures") == before_rf + 1
+
+    def test_hung_rank_detected_by_stale_heartbeat(self, tmp_path):
+        # first run never beats and sleeps past the hang timeout; the
+        # supervisor tears it down (EXIT_WATCHDOG) and the relaunch
+        # finishes clean — the elastic path for alive-but-stuck ranks
+        script = tmp_path / "worker.py"
+        marker = tmp_path / "first_run_done"
+        script.write_text(textwrap.dedent(f"""
+            import os, sys, time
+            marker = {str(marker)!r}
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                time.sleep(60)  # no heartbeat file touches: "hung"
+            sys.exit(0)
+        """))
+        t0 = time.time()
+        rc = launch(str(script), [], nproc_per_node=1,
+                    log_dir=str(tmp_path / "logs"), max_restarts=1,
+                    restart_backoff=0.01, rank_hang_timeout=2.0,
+                    extra_env={"JAX_PLATFORMS": "cpu"})
+        assert rc == 0
+        assert time.time() - t0 < 45  # detected, not awaited
+
+    def test_budget_exhaustion_returns_the_failure(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(textwrap.dedent(f"""
+            import sys
+            sys.exit({EXIT_WATCHDOG})
+        """))
+        rc = launch(str(script), [], nproc_per_node=1,
+                    log_dir=str(tmp_path / "logs"), max_restarts=1,
+                    restart_backoff=0.01,
+                    extra_env={"JAX_PLATFORMS": "cpu"})
+        assert rc == EXIT_WATCHDOG  # relaunched once, then surfaced
+
+    def test_exhausted_sigkill_budget_surfaces_128_plus_signum(
+            self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(textwrap.dedent("""
+            import os, signal
+            os.kill(os.getpid(), signal.SIGKILL)
+        """))
+        rc = launch(str(script), [], nproc_per_node=1,
+                    log_dir=str(tmp_path / "logs"), max_restarts=0,
+                    restart_backoff=0.01,
+                    extra_env={"JAX_PLATFORMS": "cpu"})
+        assert rc == 128 + signal.SIGKILL  # shell convention, not -9
+
+    def test_plain_crash_still_fails_fast(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text("import sys; sys.exit(3)\n")
+        rc = launch(str(script), [], nproc_per_node=1,
+                    log_dir=str(tmp_path / "logs"), max_restarts=2,
+                    restart_backoff=0.01,
+                    extra_env={"JAX_PLATFORMS": "cpu"})
+        assert rc == 3  # a deterministic crash buys no relaunch
+
+
+# ---------------------------------------------------------------------------
+class TestHeartbeatFile:
+    def test_heartbeat_touches_exported_file(self, tmp_path, monkeypatch):
+        from paddle_tpu.resilience import watchdog as wd
+
+        hb = tmp_path / "heartbeat.rank0"
+        monkeypatch.setenv("PADDLE_TPU_HEARTBEAT_FILE", str(hb))
+        wd._reset_heartbeat_file_cache()
+        try:
+            wd.heartbeat(0)
+            assert hb.exists()
+            first = hb.stat().st_mtime_ns
+            time.sleep(0.6)  # past the touch rate limit
+            wd.heartbeat(1)
+            assert hb.stat().st_mtime_ns >= first
+        finally:
+            monkeypatch.delenv("PADDLE_TPU_HEARTBEAT_FILE")
+            wd._reset_heartbeat_file_cache()
+
+
+# ---------------------------------------------------------------------------
+_KILL_WORKER = textwrap.dedent("""
+    import json, os
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.resilience import RecoveryPolicy, StepGuard
+    from paddle_tpu.resilience.cluster import ClusterCheckpoint
+
+    STEPS = 10
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    step = TrainStep(net, lambda o, y: ((o - y) ** 2).mean(), opt,
+                     guard_updates=True)
+    guard = StepGuard(step, RecoveryPolicy(quarantine_dir=None))
+    ck = ClusterCheckpoint(os.environ["CK_ROOT"])
+    start = 0
+    restored = ck.restore()
+    if restored is not None:
+        step.restore_state(restored["state"])
+        start = int(restored["step"])
+    guard.step_count = start
+    with open(os.environ["START_LOG"] + f".rank{rank}", "a") as f:
+        f.write(f"{start}\\n")
+    rng = np.random.RandomState(0)
+    xs = rng.randn(STEPS, 8, 4).astype("float32")
+    ys = rng.randn(STEPS, 8, 2).astype("float32")
+    loss = None
+    for i in range(start, STEPS):
+        loss = guard((xs[i],), (ys[i],))
+        with open(os.environ["EXEC_LOG"] + f".rank{rank}", "a") as f:
+            f.write(f"{i}\\n")
+        if (i + 1) % 2 == 0 and (i + 1) < STEPS:
+            ck.save(i + 1, step.snapshot_state())
+    if rank == 0:
+        with open(os.environ["RESULT"], "w") as f:
+            json.dump({"final_step": guard.step_count,
+                       "loss": float(np.asarray(loss._value))}, f)
+""")
+
+
+class TestTwoProcessKillRankResume:
+    def test_kill_rank_resumes_from_committed_cursor_no_replay(
+            self, tmp_path):
+        """kill_rank@4:1 lands exactly at the committed cursor-4
+        boundary: the relaunched job must resume AT step 4 (the loader
+        cursor in the manifest), so no COMMITTED batch is ever replayed
+        — the killed rank executes every step exactly once. The
+        surviving rank races past the commit before the supervisor
+        tears it down (it executes 4..5 and then blocks on the dead
+        peer's cursor-6 ack); that uncommitted overrun is discarded by
+        the restore and re-run deterministically from the committed
+        state, which the exact single-process-reference loss proves
+        applies each batch once in the effective trajectory."""
+        script = tmp_path / "worker.py"
+        script.write_text(_KILL_WORKER)
+        result = tmp_path / "result.json"
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "",
+            "PYTHONPATH": _REPO + ":" + os.environ.get("PYTHONPATH", ""),
+            "CK_ROOT": str(tmp_path / "ckpt"),
+            "EXEC_LOG": str(tmp_path / "exec"),
+            "START_LOG": str(tmp_path / "starts"),
+            "RESULT": str(result),
+            "PADDLE_TPU_INJECT": "kill_rank@4:1",
+            "PADDLE_TPU_INJECT_STATE": str(tmp_path / "inject-state"),
+        }
+        rc = launch(str(script), [], nproc_per_node=2,
+                    log_dir=str(tmp_path / "logs"), backend="cpu",
+                    extra_env=env, max_restarts=2, restart_backoff=0.05)
+        assert rc == 0, self._logs(tmp_path)
+        # resume positions: both attempts logged their start step —
+        # fresh start 0, relaunch start 4 (the committed cursor)
+        for rank in (0, 1):
+            starts = [int(x) for x in
+                      (tmp_path / f"starts.rank{rank}").read_text().split()]
+            assert starts == [0, 4], starts
+        # the KILLED rank executed every step exactly once across both
+        # attempts: its committed progress (steps < cursor 4) was never
+        # replayed, its post-kill steps ran only in attempt 2
+        steps1 = [int(x) for x in
+                  (tmp_path / "exec.rank1").read_text().split()]
+        assert sorted(steps1) == list(range(10)), steps1
+        # the SURVIVOR never replays a committed batch either; only its
+        # uncommitted overrun past cursor 4 (discarded by the restore)
+        # re-runs, and every step is covered
+        steps0 = [int(x) for x in
+                  (tmp_path / "exec.rank0").read_text().split()]
+        committed = [s for s in steps0 if s < 4]
+        assert sorted(committed) == list(range(4)), steps0
+        assert sorted(set(steps0)) == list(range(10)), steps0
+        assert all(steps0.count(s) <= 2 for s in steps0), steps0
+        with open(result) as f:
+            final = json.load(f)
+        assert final["final_step"] == 10
+
+        # single-process reference on the identical schedule
+        step = _build_step(seed=0)
+        rng = np.random.RandomState(0)
+        xs = rng.randn(10, 8, 4).astype("float32")
+        ys = rng.randn(10, 8, 2).astype("float32")
+        ref = None
+        for i in range(10):
+            ref = step((xs[i],), (ys[i],))
+        np.testing.assert_allclose(final["loss"],
+                                   float(np.asarray(ref._value)),
+                                   rtol=1e-6, atol=1e-7)
+
+    @staticmethod
+    def _logs(tmp_path):
+        out = ""
+        logdir = tmp_path / "logs"
+        if logdir.is_dir():
+            for name in sorted(os.listdir(logdir)):
+                if name.startswith("workerlog"):
+                    out += f"--- {name} ---\n"
+                    out += (logdir / name).read_text()[-2000:]
+        return out
+
+
+# ---------------------------------------------------------------------------
+class TestTelemetryAggDeadRanks:
+    def _write_rank(self, path, step_ms):
+        rec = {"ts": 1.0, "step": 5, "tag": "t",
+               "scalars": {"hist/engine/step_ms/p50": step_ms,
+                           "counter/engine/steps": 5}}
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def test_aggregate_reports_missing_and_truncated_ranks(self, tmp_path):
+        from paddle_tpu.profiler import aggregate as agg
+
+        self._write_rank(tmp_path / "telemetry.rank0.jsonl", 10.0)
+        (tmp_path / "telemetry.rank2.jsonl").write_text("")  # truncated
+        paths = [str(tmp_path / "telemetry.rank0.jsonl"),
+                 str(tmp_path / "telemetry.rank2.jsonl")]
+        result = agg.aggregate(paths, expected_ranks=3)
+        dead = {d["rank"]: d for d in result["dead_ranks"]}
+        assert sorted(dead) == [1, 2]
+        assert "missing" in dead[1]["reason"]
+        assert "truncated" in dead[2]["reason"]
+        # the healthy rank still aggregates
+        assert result["ranks"] == [0]
+
+    def test_tag_filter_does_not_report_healthy_ranks_dead(self, tmp_path):
+        """Liveness is judged on unfiltered records: ranks whose records
+        all carry tag 't' must not be flagged dead when aggregating a
+        different --tag."""
+        from paddle_tpu.profiler import aggregate as agg
+
+        self._write_rank(tmp_path / "telemetry.rank0.jsonl", 10.0)
+        self._write_rank(tmp_path / "telemetry.rank1.jsonl", 11.0)
+        paths = [str(tmp_path / "telemetry.rank0.jsonl"),
+                 str(tmp_path / "telemetry.rank1.jsonl")]
+        result = agg.aggregate(paths, tag="launch", expected_ranks=2)
+        assert result["dead_ranks"] == []
+
+    def test_cli_expect_ranks_fails_on_dead_rank(self, tmp_path):
+        self._write_rank(tmp_path / "telemetry.rank0.jsonl", 10.0)
+        r = subprocess.run(
+            [sys.executable, os.path.join(_TOOLS, "telemetry_agg.py"),
+             str(tmp_path), "--expect-ranks", "2"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 1
+        assert "DEAD RANKS" in r.stdout
+        assert "rank 1" in r.stdout
+
+    def test_cli_expect_ranks_all_alive_passes(self, tmp_path):
+        self._write_rank(tmp_path / "telemetry.rank0.jsonl", 10.0)
+        self._write_rank(tmp_path / "telemetry.rank1.jsonl", 11.0)
+        r = subprocess.run(
+            [sys.executable, os.path.join(_TOOLS, "telemetry_agg.py"),
+             str(tmp_path), "--expect-ranks", "2"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "dead ranks: none" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+class TestSchemaClusterKeys:
+    def _file(self, tmp_path, scalars):
+        p = tmp_path / "t.jsonl"
+        p.write_text(json.dumps(
+            {"ts": 1.0, "step": 1, "tag": "t", "scalars": scalars}) + "\n")
+        return str(p)
+
+    def test_new_keys_validate(self, tmp_path):
+        p = self._file(tmp_path, {
+            "counter/resilience/job_restarts": 1,
+            "counter/resilience/rank_failures": 2,
+            "counter/resilience/rank_failures.rank1": 2,
+            "counter/ckpt/manifest_fallbacks": 1,
+            "hist/ckpt/commit_ms/p50": 12.5})
+        n, err = schema_gate.validate_file(
+            p, require=["counter/resilience/job_restarts"])
+        assert err is None and n == 1
+
+    def test_negative_totals_rejected(self, tmp_path):
+        for bad in ({"counter/resilience/job_restarts": -1},
+                    {"hist/ckpt/commit_ms/p50": -3.0},
+                    {"counter/ckpt/commits": -2}):
+            p = self._file(tmp_path, bad)
+            _n, err = schema_gate.validate_file(p)
+            assert err is not None and "monotone" in err
+
+
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+class TestClusterGateEndToEnd:
+    def test_gate_passes(self, tmp_path):
+        """The CI gate itself: SIGKILLed rank + corrupted checkpoint on a
+        2-process launch must recover to the clean run's final step AND
+        loss (acceptance criteria)."""
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(_TOOLS, "check_cluster_resilience.py"),
+             "--json", "--workdir", str(tmp_path / "demo")],
+            capture_output=True, text=True, timeout=580,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stdout + r.stderr
+        out = json.loads(r.stdout)
+        assert out["status"] == "OK"
+        assert out["counters"]["counter/resilience/job_restarts"] >= 1
+        assert out["counters"]["counter/ckpt/manifest_fallbacks"] >= 1
+        assert out["injected_loss"] == out["ref_loss"]

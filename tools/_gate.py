@@ -35,6 +35,22 @@ def add_gate_args(parser):
     return parser
 
 
+def read_counters(tel_path):
+    """Max observed value per ``counter/*`` scalar across all records of
+    a telemetry JSONL file — the folding both resilience gates use to
+    assert on cumulative counters across relaunches."""
+    out = {}
+    with open(tel_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            for k, v in json.loads(line).get("scalars", {}).items():
+                if k.startswith("counter/"):
+                    out[k] = max(out.get(k, 0), v)
+    return out
+
+
 def finish(gate, ok, detail, payload=None, json_mode=False,
            out=None, err=None):
     """Emit the uniform gate summary and return the exit code (0/1)."""

@@ -46,6 +46,13 @@ python tools/tpu_lint.py paddle_tpu --baseline tools/tpu_lint_baseline.json
 # quarantine a batch that replays non-finite in isolation.
 JAX_PLATFORMS=cpu python tools/check_resilience.py
 
+# cluster-resilience gate: the multi-process twin — a 2-rank run with a
+# SIGKILLed rank (supervisor detection + elastic relaunch) and a
+# bit-flipped committed checkpoint (manifest-verified fallback, one
+# generation back) must reach the clean run's final step AND loss, with
+# resilience/job_restarts and ckpt/manifest_fallbacks in the telemetry.
+JAX_PLATFORMS=cpu python tools/check_cluster_resilience.py
+
 if [ -f BENCH_extra.prev.json ]; then
   # LeNet rides per-step dispatch through the remote-TPU tunnel: the r5
   # variance study (tools/profiles/r5_lenet_variance.txt) measured CV 7.6%

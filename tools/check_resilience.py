@@ -34,7 +34,7 @@ _REPO = os.path.dirname(_TOOLS)
 sys.path.insert(0, _TOOLS)
 if _REPO not in sys.path:  # runnable from anywhere, not just the repo root
     sys.path.insert(1, _REPO)
-from _gate import add_gate_args, finish  # noqa: E402
+from _gate import add_gate_args, finish, read_counters  # noqa: E402
 
 # The demo worker: a guarded train loop over deterministic data. Step
 # position is the data cursor, so a preemption-resumed process continues
@@ -77,20 +77,6 @@ WORKER = textwrap.dedent("""
                    "loss": float(np.asarray(loss._value))}, f)
     tel.to_jsonl(TEL, step=guard.step_count, tag="resilience_demo")
 """)
-
-
-def _read_counters(tel_path):
-    """Max observed value per counter scalar across all records."""
-    out = {}
-    with open(tel_path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            for k, v in json.loads(line).get("scalars", {}).items():
-                if k.startswith("counter/"):
-                    out[k] = max(out.get(k, 0), v)
-    return out
 
 
 def _replay_quarantine(qdir):
@@ -187,7 +173,7 @@ def run_demo(workdir, steps=10, nan_step=3, sigterm_step=6):
                            require_prefix=["counter/resilience/"])
     if err:
         return False, f"telemetry: {err}", payload
-    counters = _read_counters(tel_path)
+    counters = read_counters(tel_path)
     payload["counters"] = {k: v for k, v in counters.items()
                            if k.startswith("counter/resilience/")}
     for need in ("counter/resilience/rollbacks",

@@ -22,6 +22,14 @@ demands that at least one scalar whose name starts with PREFIX appears
 in some record (e.g. ``--require-prefix counter/resilience/`` asserts a
 run left a resilience trace without naming each counter). Exit 0 on
 pass; exit 1 with the first violation's line number and reason on fail.
+
+Name contracts (beyond the generic shape): ``gauge/mfu*`` ∈ [0, 100];
+``gauge/compile/*`` ≥ 0; the resilience counters
+(``counter/resilience/*`` — incl. the cluster-level ``job_restarts``,
+``rank_failures``/``rank_failures.rank<i>``, ``collective_timeouts``)
+and the coordinated-checkpoint accounting (``counter/ckpt/*``,
+``hist/ckpt/commit_ms/*``) are ≥ 0 — a negative restart/commit count
+means a producer is writing deltas where totals belong.
 """
 from __future__ import annotations
 
@@ -70,6 +78,14 @@ def validate_record(rec, lineno):
         if name.startswith("gauge/compile/") and float(value) < 0:
             return (f"line {lineno}: scalar {name!r} = {value!r} "
                     f"is negative (flops/bytes accounting)")
+        # cluster-resilience name contracts: restart/rank-failure
+        # counters and checkpoint-commit accounting are monotone totals
+        if (name.startswith("counter/resilience/")
+                or name.startswith("counter/ckpt/")
+                or name.startswith("hist/ckpt/commit_ms")) \
+                and float(value) < 0:
+            return (f"line {lineno}: scalar {name!r} = {value!r} "
+                    f"is negative (resilience/ckpt totals are monotone)")
     return None
 
 

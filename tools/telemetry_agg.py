@@ -12,16 +12,23 @@ record at exit). This tool merges them (paddle_tpu.profiler.aggregate):
 - **straggler detection**: a rank whose ``hist/*step_ms/p50`` exceeds
   the cluster median by ``--threshold``x (default 1.25) is flagged —
   a data-parallel job runs at the speed of its slowest rank, so one
-  straggler silently taxes every chip in the ring.
+  straggler silently taxes every chip in the ring;
+- **dead-rank detection**: with ``--expect-ranks N``, a rank whose
+  telemetry log is missing or truncated (it died before the atexit
+  flush) is reported as a DEAD-RANK finding — not silently dropped from
+  the medians, which would make an N-1-rank cluster look healthy.
 
 Usage:
     python tools/telemetry_agg.py LOG_DIR              # telemetry.rank*.jsonl
     python tools/telemetry_agg.py rank0.jsonl rank1.jsonl ...
     python tools/telemetry_agg.py LOG_DIR --threshold 1.5 --json
     python tools/telemetry_agg.py LOG_DIR --fail-on-straggler   # gate mode
+    python tools/telemetry_agg.py LOG_DIR --expect-ranks 4      # dead ranks
 
-Exit code 0; with ``--fail-on-straggler``, 1 when any rank is flagged
-(CI cadence checks). ``--json`` emits the full aggregate object.
+Exit code 0; with ``--fail-on-straggler``, 1 when any rank is flagged;
+with ``--expect-ranks N``, 1 when any expected rank left no usable
+telemetry (asking for N ranks IS the check). ``--json`` emits the full
+aggregate object.
 """
 from __future__ import annotations
 
@@ -92,6 +99,16 @@ def format_report(result) -> str:
             lines.append(
                 f"{name:<{width}}  {cells}    "
                 f"{row['min']:.2f} / {row['median']:.2f} / {row['max']:.2f}")
+    dead = result.get("dead_ranks")
+    if dead:
+        lines.append(f"DEAD RANKS ({len(dead)} of "
+                     f"{result['expected_ranks']} expected):")
+        for d in dead:
+            where = f" [{d['path']}]" if "path" in d else ""
+            lines.append(f"  rank {d['rank']}: {d['reason']}{where}")
+    elif "expected_ranks" in result:
+        lines.append(f"dead ranks: none "
+                     f"({result['expected_ranks']} expected, all reported)")
     stragglers = result["stragglers"]
     if stragglers:
         lines.append(f"stragglers (> {result['threshold']:.2f}x cluster "
@@ -122,14 +139,27 @@ def main(argv=None):
                     help="emit the full aggregate object as JSON")
     ap.add_argument("--fail-on-straggler", action="store_true",
                     help="exit 1 when any rank is flagged (gate mode)")
+    ap.add_argument("--expect-ranks", type=int, default=None,
+                    help="ranks the job was launched with; any of them "
+                         "leaving no usable telemetry log is reported as "
+                         "a dead-rank finding and fails the check "
+                         "(exit 1)")
     args = ap.parse_args(argv)
     paths = _resolve_paths(args.paths)
     if not paths:
+        if args.expect_ranks:
+            # every expected rank is dead — that is a finding, not a
+            # usage error
+            result = agg.aggregate([], expected_ranks=args.expect_ranks)
+            print(json.dumps(result, indent=2, sort_keys=True) if args.json
+                  else format_report(result))
+            return 1
         print(f"telemetry aggregate: no JSONL files under {args.paths}",
               file=sys.stderr)
         return 1
-    result = agg.aggregate(paths, threshold=args.threshold, tag=args.tag)
-    if not result["n_ranks"]:
+    result = agg.aggregate(paths, threshold=args.threshold, tag=args.tag,
+                           expected_ranks=args.expect_ranks)
+    if not result["n_ranks"] and not result.get("dead_ranks"):
         print("telemetry aggregate: no parsable records in "
               + ", ".join(paths), file=sys.stderr)
         return 1
@@ -138,6 +168,8 @@ def main(argv=None):
     else:
         print(format_report(result))
     if args.fail_on_straggler and result["stragglers"]:
+        return 1
+    if result.get("dead_ranks"):
         return 1
     return 0
 
