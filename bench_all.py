@@ -15,8 +15,11 @@ Configs (BASELINE.md table):
  dryrun_multichip on the virtual mesh.)
   #6 input-pipeline: feed-bound MLP step, DevicePrefetcher on vs off
      -> samples/sec + speedup (net-new; any backend)
+  #7 serving: inference.serving closed-loop at N concurrent streams
+     -> tokens/sec + p50/p99 latency (net-new; any backend)
 
-Usage: python bench_all.py [--smoke] [lenet|resnet50|bert|longctx|pipeline]
+Usage: python bench_all.py [--smoke]
+         [lenet|resnet50|bert|longctx|pipeline|serving]
   (--smoke: tiny shapes, any backend; names select a subset)
 """
 from __future__ import annotations
@@ -364,12 +367,97 @@ def bench_input_pipeline():
             "speedup": round(on / off, 3)}
 
 
+def bench_serving():
+    """Serving runtime (inference.serving): closed-loop request latency
+    and throughput at N concurrent synchronous streams — the deployment
+    twin of the training configs. Each request carries L "tokens" (an
+    [L, d] activation through a 3-layer MLP), so tokens/s is comparable
+    across request sizes. ONE batch bucket sized to the concurrency
+    (every dispatch pads to it): a single compiled executable, and the
+    attribution headline (serve.step.b<N> + serve/batch_ms.b<N>) is the
+    bucket every batch actually hit — per-bucket MFU is the denominator,
+    occupancy the packing efficiency. The closed loop never sheds (no
+    deadline, capacity ≥ streams): any non-OK status here is a bug, and
+    the record carries the full serve/* telemetry for the schema gate.
+    The OVERLOAD side (2x offered load, injected stragglers, SIGTERM
+    drain) is tools/check_serving.py's job, not a latency bench's."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.inference.serving import (ServeConfig, ServingEngine,
+                                              run_streams)
+    from paddle_tpu.profiler import get_telemetry
+
+    paddle.seed(0)
+    L, d = (16, 64) if SMOKE else (128, 512)
+    streams = 2 if SMOKE else 16
+    per_stream = 4 if SMOKE else 40
+    net = nn.Sequential(nn.Linear(d, d), nn.ReLU(), nn.Linear(d, d),
+                        nn.ReLU(), nn.Linear(d, d))
+    net.eval()
+    cfg = Config()
+    cfg.set_layer(net, [paddle.jit.InputSpec([None, L, d], "float32", "x")])
+    engine = ServingEngine(create_predictor(cfg), ServeConfig(
+        capacity=4 * streams, buckets=(streams,)))
+    engine.start()  # warmup: the bucket compiles before the clock starts
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, L, d).astype(np.float32)
+    try:
+        run_streams(engine, streams, 2, lambda k: [xs[k % len(xs)]])  # warm
+        out = run_streams(engine, streams, per_stream,
+                          lambda k: [xs[k % len(xs)]])
+    finally:
+        acct = engine.shutdown()
+    n = streams * per_stream
+    if acct["unaccounted"] or acct["double_terminal"] \
+            or out["by_status"].get("ok", 0) != n:
+        raise AssertionError(
+            f"closed-loop serving shed or lost requests: {out['by_status']}, "
+            f"unaccounted={acct['unaccounted']}, "
+            f"double_terminal={acct['double_terminal']}")
+    occ = get_telemetry().hist_summary("serve/batch_occupancy") or {}
+    return {"metric": "serving_closed_loop_tokens_per_sec",
+            "value": round(out["ok_per_s"] * L, 1), "unit": "tokens/sec",
+            "streams": streams, "tokens_per_request": L,
+            "requests_per_sec": round(out["ok_per_s"], 2),
+            "p50_ms": round(out["p50_ms"], 3),
+            "p99_ms": round(out["p99_ms"], 3),
+            "batch_occupancy_p50": round(occ.get("p50", 0.0), 3),
+            "warmup_compile_ms": round(engine.warmup_ms[streams], 1)}
+
+
+def _merge_telemetry_record(tel, tag, extra, step):
+    """Replace THIS config's record in TELEMETRY.jsonl, keeping every
+    other config's — a subset run (`bench_all.py serving`) must not
+    truncate the other configs' recorded telemetry (twin of the
+    per-metric BENCH_extra.json merge in main)."""
+    kept = []
+    try:
+        with open("TELEMETRY.jsonl") as f:
+            for ln in f:
+                if not ln.strip():
+                    continue
+                try:
+                    if json.loads(ln).get("tag") == tag:
+                        continue
+                except Exception:
+                    pass  # drop ONLY the unparseable line (torn write)
+                else:
+                    kept.append(ln)
+    except OSError:
+        pass
+    with open("TELEMETRY.jsonl", "w") as f:
+        f.writelines(kept)
+    tel.to_jsonl("TELEMETRY.jsonl", step=step, tag=tag, extra=extra,
+                 append=True)
+
+
 def main():
     only = [a.lstrip("-") for a in sys.argv[1:] if a.lstrip("-") in
-            ("lenet", "resnet50", "bert", "longctx", "pipeline")]
+            ("lenet", "resnet50", "bert", "longctx", "pipeline", "serving")]
     table = {"lenet": bench_lenet, "resnet50": bench_resnet50,
              "bert": bench_bert_dp, "longctx": bench_gpt_long_context,
-             "pipeline": bench_input_pipeline}
+             "pipeline": bench_input_pipeline, "serving": bench_serving}
     from paddle_tpu.profiler import get_telemetry, xla_cost
 
     tel = get_telemetry()
@@ -410,9 +498,8 @@ def main():
         # config rather than re-validating the final snapshot N times
         extra = {k: v for k, v in r.items()
                  if isinstance(v, (int, float)) and not isinstance(v, bool)}
-        tel.to_jsonl("TELEMETRY.jsonl", step=len(results),
-                     tag=f"bench/{r['metric']}", extra=extra,
-                     append=bool(results))
+        _merge_telemetry_record(tel, f"bench/{r['metric']}", extra,
+                                step=len(results))
         results.append(r)
     if not SMOKE:
         # merge with any previously recorded configs (per-config runs)

@@ -17,11 +17,15 @@ from __future__ import annotations
 
 import os
 import pickle
-from typing import Dict, List, Optional
+import threading
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType"]
+
+# PrecisionType value -> (jnp cast dtype name, serving dtype bits)
+_PRECISION_CASTS = {"bfloat16": ("bfloat16", 16), "float16": ("float16", 16)}
 
 
 class PrecisionType:
@@ -43,6 +47,7 @@ class Config:
         self.params_path = params_path
         self._device = "tpu"
         self._precision = PrecisionType.Float32
+        self._precision_explicit = False  # set_precision called vs default
         self._memory_optim = True
         self._ir_optim = True
         self._cpu_threads = 1
@@ -71,6 +76,7 @@ class Config:
 
     def set_precision(self, p: str):
         self._precision = p
+        self._precision_explicit = True
 
     # --- model source ---
     def set_model(self, model_path: str, params_path: Optional[str] = None):
@@ -95,27 +101,43 @@ class Config:
 
 
 class _IOHandle:
-    """Zero-copy tensor handle (reference: ZeroCopyTensor / get_input_handle)."""
+    """Zero-copy tensor handle (reference: ZeroCopyTensor / get_input_handle).
+
+    Thread safety: writes land in BOTH a thread-local slot and a shared
+    slot; reads prefer the calling thread's slot. A thread driving the
+    canonical sequence (``copy_from_cpu`` → ``run()`` → ``copy_to_cpu``)
+    therefore always reads back ITS OWN outputs even with concurrent
+    callers on the same predictor, while single-threaded code and the
+    set-stable-inputs-once pattern (one thread writes an input, worker
+    threads ``run()``) still see the shared view."""
 
     def __init__(self, name: str):
         self.name = name
-        self._array: Optional[np.ndarray] = None
+        self._shared: Optional[np.ndarray] = None
+        self._tls = threading.local()
+
+    def _get(self) -> Optional[np.ndarray]:
+        return getattr(self._tls, "array", self._shared)
+
+    def _set(self, arr: np.ndarray):
+        self._tls.array = arr
+        self._shared = arr
 
     def copy_from_cpu(self, arr: np.ndarray):
-        self._array = np.asarray(arr)
+        self._set(np.asarray(arr))
 
     def reshape(self, shape):
-        if self._array is None:
-            self._array = np.zeros(shape, np.float32)
-        else:
-            self._array = self._array.reshape(shape)
+        cur = self._get()
+        self._set(np.zeros(shape, np.float32) if cur is None
+                  else cur.reshape(shape))
 
     def copy_to_cpu(self) -> np.ndarray:
-        return np.asarray(self._array)
+        return np.asarray(self._get())
 
     @property
     def shape(self):
-        return None if self._array is None else self._array.shape
+        arr = self._get()
+        return None if arr is None else arr.shape
 
 
 class Predictor:
@@ -126,12 +148,28 @@ class Predictor:
         self._output_names: List[str] = []
         self._inputs: Dict[str, _IOHandle] = {}
         self._outputs: Dict[str, _IOHandle] = {}
+        # run() is callable from many serving threads: the lock guards
+        # the SHARED input/output handles; the direct-inputs path stays
+        # lock-free through the (thread-safe) compiled call itself
+        self._lock = threading.Lock()
+        self._serving_raw = None   # jit-traceable fn(*batched) -> tuple
+        self._sample_specs_list = None  # [(per-sample shape, np dtype)]
+        self._pinned = False
+        self.serving_dtype = "float32"
         if config._layer is not None:
             self._init_from_layer(config._layer, config._input_spec)
         elif config.model_path:
             self._init_from_files(config.model_path)
         else:
             raise ValueError("Config needs set_model(path) or set_layer(layer)")
+        self.serving_dtype_bits = 16 if self.serving_dtype in (
+            "bfloat16", "float16") else 32
+        try:  # satellite: the serving dtype is an observable, not a secret
+            from ..profiler.telemetry import get_telemetry
+
+            get_telemetry().gauge("serve/dtype_bits", self.serving_dtype_bits)
+        except Exception:
+            pass  # telemetry must never block model load
 
     # -- loading ------------------------------------------------------------
     def _init_from_files(self, prefix: str):
@@ -159,7 +197,45 @@ class Predictor:
         self._input_names = blob["input_names"]
         self._output_names = blob["output_names"]
         pinned = blob.get("pinned_dynamic_dims", False)
+        self._pinned = pinned
+        # the artifact records the dtype its weights were BAKED in
+        # (jit.save(..., precision=...)); honoring Config._precision here
+        # means verifying against that record — a mismatch is an error,
+        # never a silent ignore (constants in an AOT artifact cannot be
+        # recast at load; set_layer mode can, and does)
+        artifact_dtype = blob.get("dtype", "float32")
+        want = self._config._precision
+        # the mismatch check fires BOTH ways: a reduced-precision request
+        # on an f32 artifact, AND an EXPLICIT Float32 request on a
+        # reduced-precision artifact (the default — no set_precision
+        # call — accepts whatever the artifact baked; Int8 stays the
+        # documented parity no-op)
+        explicit_f32 = (want == PrecisionType.Float32
+                        and getattr(self._config, "_precision_explicit",
+                                    False))
+        if (want in _PRECISION_CASTS or explicit_f32) \
+                and artifact_dtype != want:
+            raise ValueError(
+                f"Config requests {want} but {export_path} was exported "
+                f"with {artifact_dtype} weights baked in — re-export with "
+                f"jit.save(layer, prefix, input_spec, precision={want!r}) "
+                "or serve the live layer via Config.set_layer, which casts "
+                "at load")
+        self.serving_dtype = artifact_dtype
         expect = [tuple(a.shape) for a in exported.in_avals]
+
+        def raw(*arrays):
+            out = exported.call(*arrays)
+            return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+
+        self._serving_raw = raw
+        specs = []
+        for a in exported.in_avals:
+            dims = tuple(a.shape)[1:]  # axis 0 = batch (serving contract)
+            specs.append((dims, np.dtype(a.dtype))
+                         if all(isinstance(d, int) for d in dims) else None)
+        self._sample_specs_list = None if any(
+            s is None for s in specs) else specs
 
         def fn(*arrays):
             if pinned:
@@ -180,14 +256,40 @@ class Predictor:
 
     def _init_from_layer(self, layer, input_spec):
         import jax
+        import jax.numpy as jnp
 
         from ..jit import InputSpec
-        from ..jit.functionalize import functionalize, get_buffers, get_params
+        from ..jit.functionalize import (cast_floats, functionalize,
+                                         get_buffers, get_params)
 
         apply = functionalize(layer, training=False)
         params = get_params(layer)
         buffers = get_buffers(layer)
-        jitted = jax.jit(lambda *xs: apply(params, buffers, *xs)[0])
+
+        # honor Config precision here, where the weights are live: cast
+        # float params/buffers at load (the satellite — never silently
+        # ignore _precision), run compute in that dtype, hand results
+        # back in float32 so clients see a stable output contract
+        cast_name = _PRECISION_CASTS.get(self._config._precision,
+                                         (None, None))[0]
+        cast_dtype = jnp.dtype(cast_name) if cast_name else None
+
+        if cast_dtype is not None:
+            params = cast_floats(params, cast_dtype)
+            buffers = cast_floats(buffers, cast_dtype)
+            self.serving_dtype = cast_name
+
+        def raw(*xs):
+            if cast_dtype is not None:
+                xs = cast_floats(tuple(xs), cast_dtype)
+            out = apply(params, buffers, *xs)[0]
+            outs = out if isinstance(out, (list, tuple)) else (out,)
+            if cast_dtype is not None:
+                outs = cast_floats(tuple(outs), jnp.float32)
+            return tuple(outs)
+
+        self._serving_raw = raw
+        jitted = jax.jit(raw)
 
         n_inputs = len(input_spec) if input_spec else 1
         self._input_names = [
@@ -203,6 +305,19 @@ class Predictor:
             ]
             n_out = len(_jax.tree_util.tree_leaves(
                 _jax.eval_shape(jitted, *structs)))
+            from ..core import dtype as dtype_mod
+
+            specs = []
+            for s in input_spec:
+                shape = list(s.shape)
+                dt = (dtype_mod.convert_dtype(s.dtype)
+                      if isinstance(s, InputSpec) else np.dtype(s.dtype))
+                dims = tuple(shape)[1:]  # axis 0 = batch (serving contract)
+                specs.append((tuple(int(d) for d in dims), np.dtype(dt))
+                             if all(isinstance(d, int) and d >= 0
+                                    for d in dims) else None)
+            self._sample_specs_list = None if any(
+                s is None for s in specs) else specs
         else:
             n_out = 1
         self._output_names = [f"output{i}" for i in range(n_out)]
@@ -232,18 +347,7 @@ class Predictor:
     def get_output_handle(self, name: str) -> _IOHandle:
         return self._outputs[name]
 
-    def run(self, inputs: Optional[List[np.ndarray]] = None):
-        """ZeroCopyRun parity: consume input handles, fill output handles.
-        With ``inputs`` given, also returns outputs directly."""
-        if inputs is not None:
-            for n, a in zip(self._input_names, inputs):
-                self._inputs[n].copy_from_cpu(a)
-        arrays = []
-        for n in self._input_names:
-            h = self._inputs[n]
-            if h._array is None:
-                raise RuntimeError(f"input '{n}' not set (copy_from_cpu first)")
-            arrays.append(h._array)
+    def _execute(self, arrays: List[np.ndarray]) -> List[np.ndarray]:
         outs = self._fn(*arrays)
         outs = [np.asarray(o) for o in outs]
         if len(outs) != len(self._output_names):
@@ -252,9 +356,71 @@ class Predictor:
                 f"declares {self._output_names} — the export metadata is "
                 "out of sync with the serialized function"
             )
-        for n, o in zip(self._output_names, outs):
-            self._outputs[n].copy_from_cpu(o)
-        return outs if inputs is not None else True
+        return outs
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """ZeroCopyRun parity: consume input handles, fill output handles.
+        With ``inputs`` given, also returns outputs directly.
+
+        Thread safety: with a FULL ``inputs`` list, concurrent callers
+        share nothing on the way in (each call executes on its own
+        arrays; the compiled call is itself thread-safe) and only the
+        final output-handle refresh takes the predictor lock. The
+        handle paths (pure and PARTIAL ``inputs`` merged with pre-set
+        input handles) run under the lock, and handle writes are
+        thread-local-first (see ``_IOHandle``): a caller that does
+        ``copy_from_cpu`` → ``run()`` → ``copy_to_cpu`` reads back its
+        own outputs, never a concurrent caller's."""
+        if inputs is not None and len(inputs) == len(self._input_names):
+            arrays = [np.asarray(a) for a in inputs]
+            outs = self._execute(arrays)
+            with self._lock:
+                for n, a in zip(self._input_names, arrays):
+                    self._inputs[n].copy_from_cpu(a)
+                for n, o in zip(self._output_names, outs):
+                    self._outputs[n].copy_from_cpu(o)
+            return outs
+        with self._lock:
+            if inputs is not None:  # partial: merge into the handles
+                for n, a in zip(self._input_names, inputs):
+                    self._inputs[n].copy_from_cpu(a)
+            arrays = []
+            for n in self._input_names:
+                arr = self._inputs[n]._get()
+                if arr is None:
+                    raise RuntimeError(
+                        f"input '{n}' not set (copy_from_cpu first)")
+                arrays.append(arr)
+            outs = self._execute(arrays)
+            for n, o in zip(self._output_names, outs):
+                self._outputs[n].copy_from_cpu(o)
+            return outs if inputs is not None else True
+
+    # -- serving hooks (inference.serving.ServingEngine) -------------------
+    def serving_fn(self):
+        """The jit-traceable batched callable the serving scheduler
+        compiles per batch-size bucket: ``fn(*batched_arrays) -> tuple``
+        of batched outputs (jax arrays — no host sync inside)."""
+        if self._serving_raw is None:
+            raise RuntimeError("this predictor has no serving function")
+        if self._pinned:
+            raise RuntimeError(
+                "this artifact was exported with its dynamic dims PINNED "
+                "(symbolic-shape export failed at save time) — it accepts "
+                "exactly one shape and cannot be batch-bucketed; re-export "
+                "with static shapes or serve via Config.set_layer")
+        return self._serving_raw
+
+    def sample_specs(self) -> List[Tuple[tuple, np.dtype]]:
+        """Per-SAMPLE input specs ``[(shape-without-batch-axis, dtype)]``
+        — the serving contract is that axis 0 of every input is the
+        batch axis the scheduler packs."""
+        if self._sample_specs_list is None:
+            raise RuntimeError(
+                "per-sample input specs unavailable: the model was built "
+                "without an input_spec, or a non-batch dim is dynamic — "
+                "serving needs concrete per-sample shapes")
+        return list(self._sample_specs_list)
 
 
 def create_predictor(config: Config) -> Predictor:

@@ -69,7 +69,8 @@ def write_pdexport(path_prefix: str, exported, input_names: List[str],
                    output_names: List[str],
                    in_specs: List[Tuple[list, str]],
                    pinned_dynamic_dims: bool = False,
-                   encrypt_key: bytes | None = None):
+                   encrypt_key: bytes | None = None,
+                   dtype: str = "float32"):
     d = os.path.dirname(path_prefix)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -79,6 +80,11 @@ def write_pdexport(path_prefix: str, exported, input_names: List[str],
         "output_names": output_names,
         "in_specs": in_specs,
         "pinned_dynamic_dims": pinned_dynamic_dims,
+        # the dtype the weights were BAKED in (jit.save precision=...):
+        # loaders verify Config precision against this instead of
+        # silently ignoring it (constants in an AOT module can't be
+        # recast at load)
+        "dtype": dtype,
     }
     if encrypt_key is not None:
         # at-rest protection (reference framework/io/crypto/aes_cipher.cc);

@@ -1,0 +1,103 @@
+"""Bounded admission queue with explicit load shedding.
+
+The queue is the ONLY buffer between clients and the TPU: it has a hard
+capacity, and crossing it is an explicit ``REJECTED`` status returned to
+the caller at submit time — never an unbounded backlog that collapses
+into timeout soup under overload (the failure mode this subsystem
+exists to prevent). Deadlines are enforced twice here: at enqueue (a
+request that arrives already expired is refused a slot) and at take (an
+expired request is shed BEFORE it burns a TPU slot in a batch).
+
+Thread model: many submitter threads, one scheduler thread calling
+``take``. All transitions of the requests themselves happen outside
+this class (the engine owns statuses); the queue only sorts requests
+into accepted / shed-now buckets.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from .request import Request
+
+__all__ = ["AdmissionQueue", "ADMIT", "REJECT_CAPACITY", "REJECT_DRAINING",
+           "REJECT_EXPIRED"]
+
+# submit() verdicts — the engine maps them to terminal statuses
+ADMIT = "admit"
+REJECT_CAPACITY = "capacity"    # queue full: shed with REJECTED
+REJECT_DRAINING = "draining"    # drain started: admission stopped
+REJECT_EXPIRED = "expired"      # deadline already passed at enqueue
+
+
+class AdmissionQueue:
+    """FIFO with a hard bound, drain latch, and deadline-aware take."""
+
+    def __init__(self, capacity: int):
+        if int(capacity) < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._dq: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+        self._draining = False
+
+    # -- producer side -----------------------------------------------------
+    def submit(self, req: Request) -> str:
+        """Admit or shed ``req``; returns one of the verdict constants.
+        O(1), never blocks — backpressure here is a status, not a wait."""
+        now = time.monotonic()
+        with self._cond:
+            if self._draining:
+                return REJECT_DRAINING
+            if req.expired(now):
+                return REJECT_EXPIRED
+            if len(self._dq) >= self.capacity:
+                return REJECT_CAPACITY
+            self._dq.append(req)
+            self._cond.notify()
+            return ADMIT
+
+    # -- consumer side (scheduler thread) ----------------------------------
+    def take(self, max_n: int, timeout: float
+             ) -> Tuple[List[Request], List[Request]]:
+        """Up to ``max_n`` admitted requests for one batch, splitting out
+        those whose deadline expired while queued: ``(ready, expired)``.
+        Expired requests are popped (their slot frees immediately) but
+        never returned as batchable work. Returns ``([], [])`` after
+        ``timeout`` with nothing queued."""
+        with self._cond:
+            if not self._dq:
+                self._cond.wait(timeout)
+            now = time.monotonic()
+            ready: List[Request] = []
+            expired: List[Request] = []
+            while self._dq and len(ready) < max_n:
+                req = self._dq.popleft()
+                (expired if req.expired(now) else ready).append(req)
+            return ready, expired
+
+    # -- drain -------------------------------------------------------------
+    def start_drain(self) -> None:
+        """Latch: stop admitting. Queued work stays queued — the
+        scheduler keeps draining it through ``take``."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def pop_all(self) -> List[Request]:
+        """Empty the queue (drain-grace expiry: whatever is left gets a
+        DRAINED status from the engine)."""
+        with self._cond:
+            out = list(self._dq)
+            self._dq.clear()
+            return out
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._dq)

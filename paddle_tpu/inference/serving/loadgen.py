@@ -1,0 +1,122 @@
+"""Deterministic load generator — offered load as an experiment knob.
+
+Overload behavior is only provable against a CONTROLLED arrival process:
+``run_load`` paces submissions open-loop at a fixed offered rate (what a
+population of independent clients does — arrivals don't slow down
+because the server is struggling, which is precisely what makes
+overload), while ``run_streams`` runs N closed-loop streams
+(submit→wait→submit — what a fixed pool of synchronous clients does).
+The bench config uses streams for latency/throughput; the overload gate
+uses open-loop at 2x the calibrated sustainable rate.
+
+Both return an accounting-style summary (status counts + latency
+percentiles of the OK requests) built from the request objects
+themselves, independent of telemetry — the gate cross-checks the two.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .engine import ServingEngine
+from .request import Request, RequestStatus
+
+__all__ = ["run_load", "run_streams", "summarize"]
+
+
+def summarize(requests: Sequence[Request]) -> Dict:
+    """Status counts + OK-latency percentiles over finished requests
+    (latencies are exact: each request stamps its terminal time)."""
+    by_status: Dict[str, int] = {}
+    ok_lat: List[float] = []
+    for r in requests:
+        by_status[r.status] = by_status.get(r.status, 0) + 1
+        if r.status == RequestStatus.OK:
+            ok_lat.append(r.latency_ms())
+    out = {"submitted": len(requests), "by_status": by_status}
+    out.update(_percentiles(ok_lat))
+    return out
+
+
+def _percentiles(values: List[float]) -> Dict[str, float]:
+    if not values:
+        return {}
+    arr = np.sort(np.asarray(values, dtype=np.float64))
+
+    def pct(q):
+        idx = min(len(arr) - 1, max(0, int(round(q * (len(arr) - 1)))))
+        return float(arr[idx])
+
+    return {"p50_ms": pct(0.50), "p90_ms": pct(0.90), "p99_ms": pct(0.99),
+            "max_ms": float(arr[-1])}
+
+
+def run_load(engine: ServingEngine, n_requests: int, rate_per_s: float,
+             input_fn: Callable[[int], Sequence[np.ndarray]],
+             deadline_s: Optional[float] = None,
+             wait_timeout_s: float = 60.0,
+             return_requests: bool = False):
+    """Open-loop: submit ``n_requests`` paced at ``rate_per_s`` offered
+    load, then wait for every request to reach a terminal status.
+
+    Pacing is absolute-schedule based (request k targets ``t0 + k/rate``)
+    so a slow ``submit`` doesn't silently lower the offered rate — the
+    generator catches up, exactly like independent clients would. The
+    engine draining mid-run is expected (mid-load SIGTERM): submissions
+    continue and are REJECTED, which is part of the accounted outcome.
+
+    With ``return_requests`` the return is ``(summary, requests)`` so a
+    caller running several rounds (e.g. the overload gate offering load
+    until an injected fault fires) can ``summarize`` the union exactly
+    instead of merging per-round percentiles.
+    """
+    interval = 1.0 / float(rate_per_s)
+    t0 = time.monotonic()
+    reqs: List[Request] = []
+    for k in range(int(n_requests)):
+        target = t0 + k * interval
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        reqs.append(engine.submit(input_fn(k), deadline_s=deadline_s))
+    deadline = time.monotonic() + wait_timeout_s
+    for r in reqs:
+        r.wait(max(0.0, deadline - time.monotonic()))
+    out = summarize(reqs)
+    out["offered_rate_per_s"] = float(rate_per_s)
+    out["wall_s"] = time.monotonic() - t0
+    return (out, reqs) if return_requests else out
+
+
+def run_streams(engine: ServingEngine, n_streams: int, requests_per_stream: int,
+                input_fn: Callable[[int], Sequence[np.ndarray]],
+                deadline_s: Optional[float] = None) -> Dict:
+    """Closed-loop: ``n_streams`` threads each run submit→wait→submit.
+    Concurrency equals ``n_streams`` by construction — the serving bench
+    reports tokens/s and latency percentiles at this concurrency."""
+    all_reqs: List[List[Request]] = [[] for _ in range(n_streams)]
+
+    def stream(s: int):
+        for k in range(requests_per_stream):
+            req = engine.submit(input_fn(s * requests_per_stream + k),
+                                deadline_s=deadline_s)
+            all_reqs[s].append(req)
+            req.wait()
+
+    threads = [threading.Thread(target=stream, args=(s,), daemon=True)
+               for s in range(n_streams)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    out = summarize([r for rs in all_reqs for r in rs])
+    out["streams"] = n_streams
+    out["wall_s"] = wall
+    out["ok_per_s"] = out["by_status"].get(RequestStatus.OK, 0) \
+        / max(wall, 1e-9)
+    return out

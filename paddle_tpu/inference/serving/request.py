@@ -1,0 +1,119 @@
+"""Request model for the serving runtime — every request ends terminal.
+
+The overload-safety contract of ``paddle_tpu.inference.serving`` is an
+accounting identity: every submitted request reaches EXACTLY ONE terminal
+status, no matter what the load, the deadlines, or a mid-load SIGTERM do
+to the server. ``Request.finish`` is the single transition point — it is
+idempotent-by-refusal (the first terminal status wins, a second attempt
+returns False and is counted by the engine as ``serve/double_terminal``,
+expected to stay 0), so "executed AND rejected" is structurally
+impossible rather than merely untested.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["RequestStatus", "Request"]
+
+
+class RequestStatus:
+    """Terminal statuses (plus PENDING, the only non-terminal state).
+
+    - ``OK``: executed, result delivered within its deadline.
+    - ``REJECTED``: shed at admission — queue at capacity or the server
+      draining. The request never held a queue slot.
+    - ``DEADLINE_EXCEEDED``: accepted but its deadline passed — at the
+      queue (shed before burning a TPU slot), or at completion (the
+      batch finished too late; the result is discarded, never returned
+      stale).
+    - ``DRAINED``: accepted, still unfinished when the drain grace
+      expired at shutdown — the terminal status a preempted server owes
+      every request it accepted but could not finish.
+    - ``ERROR``: execution failed (model raised, result dropped).
+    """
+
+    PENDING = "pending"
+    OK = "ok"
+    REJECTED = "rejected"
+    DEADLINE_EXCEEDED = "deadline_exceeded"
+    DRAINED = "drained"
+    ERROR = "error"
+
+    TERMINAL = frozenset({OK, REJECTED, DEADLINE_EXCEEDED, DRAINED, ERROR})
+
+
+class Request:
+    """One inference request: per-sample inputs (no batch axis — the
+    scheduler owns batching) plus an optional deadline.
+
+    Timing fields (monotonic seconds): ``submitted_at`` stamps at
+    construction; ``deadline`` is absolute (``submitted_at +
+    deadline_s``), enforced at enqueue, batch formation, and completion.
+    """
+
+    __slots__ = ("id", "inputs", "submitted_at", "deadline", "status",
+                 "detail", "outputs", "error", "finished_at", "_done",
+                 "_lock")
+
+    def __init__(self, req_id: int, inputs: Sequence[np.ndarray],
+                 deadline_s: Optional[float] = None):
+        self.id = int(req_id)
+        self.inputs: Tuple[np.ndarray, ...] = tuple(
+            np.asarray(a) for a in inputs)
+        self.submitted_at = time.monotonic()
+        self.deadline = (None if deadline_s is None
+                         else self.submitted_at + float(deadline_s))
+        self.status = RequestStatus.PENDING
+        self.detail = ""
+        self.outputs: Optional[List[np.ndarray]] = None
+        self.error: Optional[BaseException] = None
+        self.finished_at: Optional[float] = None
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+
+    # -- terminal transition (single writer wins) --------------------------
+    def finish(self, status: str, outputs=None, detail: str = "",
+               error: Optional[BaseException] = None) -> bool:
+        """Transition to a terminal status. Returns True iff THIS call
+        performed the transition; a request that is already terminal is
+        left untouched and False is returned (the engine counts those —
+        a nonzero count means two code paths claimed the same request)."""
+        if status not in RequestStatus.TERMINAL:
+            raise ValueError(f"{status!r} is not a terminal status")
+        with self._lock:
+            if self.status != RequestStatus.PENDING:
+                return False
+            self.status = status
+            self.outputs = outputs
+            self.detail = detail
+            self.error = error
+            self.finished_at = time.monotonic()
+        self._done.set()
+        return True
+
+    # -- consumer side -----------------------------------------------------
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until terminal. Returns False on timeout."""
+        return self._done.wait(timeout)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) >= self.deadline
+
+    def latency_ms(self) -> float:
+        """Submit→terminal wall time (→now while still pending)."""
+        end = self.finished_at if self.finished_at is not None \
+            else time.monotonic()
+        return (end - self.submitted_at) * 1e3
+
+    def __repr__(self):
+        return (f"Request(id={self.id}, status={self.status!r}"
+                f"{', ' + self.detail if self.detail else ''})")

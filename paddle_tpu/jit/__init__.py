@@ -16,6 +16,7 @@ from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 from .functionalize import (
     TracedLayer,
+    cast_floats,
     functionalize,
     get_buffers,
     get_params,
@@ -149,6 +150,12 @@ def save(layer, path, input_spec=None, **configs):
         layer = layer._layer
     state = layer.state_dict()
     encrypt_key = configs.get("encrypt_key")
+    # validate OUTSIDE the best-effort export block: a typo'd precision
+    # must be a hard error, not a silently-f32 artifact + export_error
+    precision = configs.get("precision")
+    if precision and precision not in ("float32", "bfloat16", "float16"):
+        raise ValueError(f"unsupported export precision {precision!r} "
+                         "(float32, bfloat16, float16)")
     # with a key, EVERY artifact that reveals the model is protected:
     # weights (.pdiparams), compiled program (.pdexport), and the StableHLO
     # text is withheld from the plaintext metadata below
@@ -163,8 +170,24 @@ def save(layer, path, input_spec=None, **configs):
             params = get_params(layer)
             buffers = get_buffers(layer)
 
+            # precision="bfloat16"/"float16": bake CAST weights into the
+            # artifact (serving-dtype export — inference.PrecisionType).
+            # Compute runs in that dtype; outputs return as float32 so
+            # the client contract is precision-independent. The blob
+            # records the dtype so loaders can verify Config precision.
+            cast_dtype = None
+            if precision and precision != "float32":
+                cast_dtype = jnp.dtype(precision)
+                params = cast_floats(params, cast_dtype)
+                buffers = cast_floats(buffers, cast_dtype)
+
             def closed(*xs):
-                return apply(params, buffers, *xs)[0]
+                if cast_dtype is not None:
+                    xs = cast_floats(tuple(xs), cast_dtype)
+                out = apply(params, buffers, *xs)[0]
+                if cast_dtype is not None:
+                    out = cast_floats(out, jnp.float32)
+                return out
 
             shapes_dtypes = []
             for s in input_spec:
@@ -190,6 +213,7 @@ def save(layer, path, input_spec=None, **configs):
                 [f"output{i}" for i in range(n_out)], in_specs,
                 pinned_dynamic_dims=pinned,
                 encrypt_key=encrypt_key,
+                dtype=precision or "float32",
             )
             if encrypt_key is None:
                 meta["stablehlo"] = exported.mlir_module()

@@ -19,7 +19,21 @@ import jax.numpy as jnp
 from ..core.tensor import Parameter, Tensor, no_grad
 from ..nn.layer_base import Layer
 
-__all__ = ["functionalize", "get_params", "get_buffers", "set_params", "TracedLayer"]
+__all__ = ["functionalize", "get_params", "get_buffers", "set_params",
+           "cast_floats", "TracedLayer"]
+
+
+def cast_floats(tree, dtype):
+    """Cast the FLOAT leaves of a pytree to ``dtype`` (everything else
+    passes through untouched). The serving-precision primitive shared by
+    ``jit.save(precision=...)`` (bake cast weights into the artifact)
+    and ``inference.Predictor`` (cast a live layer at load, cast inputs
+    in / outputs back out) — one definition so the two paths cannot
+    silently diverge on what "cast the floats" means."""
+    dtype = jnp.dtype(dtype)
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else p, tree)
 
 
 def get_params(layer: Layer) -> Dict[str, Any]:

@@ -367,6 +367,12 @@ _STEP_HISTS = {
 
 
 def step_hist_for(entry: str) -> Optional[str]:
+    # serving buckets: each "serve.step.b<N>" entry owns the
+    # "serve/batch_ms.b<N>" histogram its scheduler records — per-bucket
+    # MFU denominators, same producer-owned-exact-name principle as the
+    # engine table above (the suffix IS the producer's suffix)
+    if entry.startswith("serve.step"):
+        return "serve/batch_ms" + entry[len("serve.step"):]
     return _STEP_HISTS.get(entry)
 
 

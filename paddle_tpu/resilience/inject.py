@@ -26,10 +26,28 @@ consulted by the runtime itself:
   in one committed shard AFTER the commit (``corrupt_ckpt@n``), so the
   manifest-verified restore path must catch it and fall back.
 
+Request-level faults (consulted by ``inference.serving``; indices are
+engine-assigned request ids / scheduler batch indices, so they replay
+deterministically against a deterministic load plan):
+
+- ``slow_req(req_id)`` — the batch CONTAINING request ``req_id`` stalls
+  (``slow_req@id:secs``): a straggler request that backs the queue up,
+  driving admission rejects and queued-deadline expiry downstream;
+- ``drop_req_due(req_id)`` — that request's result is lost
+  post-execution (``drop_req@id``): the accounting layer must still
+  terminate it (ERROR), proving no request can vanish silently;
+- ``storm_deadline(req_id)`` — ``deadline_storm@id:n`` gives the ``n``
+  requests starting at ``id`` a near-zero deadline (default 1 ms):
+  a burst of already-hopeless work the server must shed at every stage
+  without stalling live traffic;
+- the existing ``sigterm@n`` is also consulted by the serving scheduler
+  at batch-boundary ``n`` — a deterministic mid-load preemption.
+
 Env-driven for subprocess runs (the CI smoke gate, launch children):
 
     PADDLE_TPU_INJECT="nan@3,sigterm@7,slow@5:1.5,kill_worker@2"
     PADDLE_TPU_INJECT="kill_rank@4:1,hang_rank@2:0,corrupt_ckpt@1"
+    PADDLE_TPU_INJECT="slow_req@10:0.4,drop_req@12,deadline_storm@20:8"
 
 One-shot semantics: every injection fires at most once per injector.
 Cross-process one-shot (a relaunched job must not re-receive the same
@@ -85,6 +103,10 @@ class FaultInjector:
                  hang_rank_steps: Optional[Dict[int, int]] = None,
                  corrupt_ckpt_gens: Iterable[int] = (),
                  hang_seconds: float = 3600.0,
+                 slow_req_ids: Optional[Dict[int, float]] = None,
+                 drop_req_ids: Iterable[int] = (),
+                 deadline_storms: Optional[Dict[int, int]] = None,
+                 storm_deadline_s: float = 1e-3,
                  state_dir: Optional[str] = None):
         self.nan_steps = {int(s) for s in nan_steps}
         self.sigterm_steps = {int(s) for s in sigterm_steps}
@@ -97,6 +119,14 @@ class FaultInjector:
                                 for k, v in (hang_rank_steps or {}).items()}
         self.corrupt_ckpt_gens = {int(g) for g in corrupt_ckpt_gens}
         self.hang_seconds = float(hang_seconds)
+        self.slow_req_ids = {int(k): float(v)
+                             for k, v in (slow_req_ids or {}).items()}
+        self.drop_req_ids = {int(r) for r in drop_req_ids}
+        # deadline_storm@id:n expands to the n request ids it covers
+        self.storm_req_ids: Set[int] = set()
+        for start, n in (deadline_storms or {}).items():
+            self.storm_req_ids.update(range(int(start), int(start) + int(n)))
+        self.storm_deadline_s = float(storm_deadline_s)
         self.state_dir = state_dir
         self._fired: Set[str] = set()
 
@@ -105,11 +135,14 @@ class FaultInjector:
     def from_spec(cls, spec: str, state_dir: Optional[str] = None
                   ) -> "FaultInjector":
         """Parse ``"nan@3,sigterm@7,slow@5:1.5,kill_worker@2,
-        kill_rank@4:1,hang_rank@2:0,corrupt_ckpt@1"``."""
-        nan, sig, kill, corrupt = [], [], [], []
+        kill_rank@4:1,hang_rank@2:0,corrupt_ckpt@1,
+        slow_req@10:0.4,drop_req@12,deadline_storm@20:8"``."""
+        nan, sig, kill, corrupt, drop_req = [], [], [], [], []
         slow: Dict[int, float] = {}
         kill_rank: Dict[int, int] = {}
         hang_rank: Dict[int, int] = {}
+        slow_req: Dict[int, float] = {}
+        storms: Dict[int, int] = {}
         for part in spec.split(","):
             part = part.strip()
             if not part:
@@ -131,12 +164,21 @@ class FaultInjector:
                 target[int(step)] = int(r or 0)
             elif kind == "corrupt_ckpt":
                 corrupt.append(int(where))
+            elif kind == "slow_req":
+                rid, _, secs = where.partition(":")
+                slow_req[int(rid)] = float(secs or 1.0)
+            elif kind == "drop_req":
+                drop_req.append(int(where))
+            elif kind == "deadline_storm":
+                rid, _, n = where.partition(":")
+                storms[int(rid)] = int(n or 1)
             else:
                 raise ValueError(f"unknown fault kind {kind!r} in {spec!r}")
         return cls(nan_steps=nan, sigterm_steps=sig, slow_steps=slow,
                    kill_worker_batches=kill, kill_rank_steps=kill_rank,
                    hang_rank_steps=hang_rank, corrupt_ckpt_gens=corrupt,
-                   state_dir=state_dir)
+                   slow_req_ids=slow_req, drop_req_ids=drop_req,
+                   deadline_storms=storms, state_dir=state_dir)
 
     @classmethod
     def from_env(cls, env=None) -> Optional["FaultInjector"]:
@@ -243,6 +285,34 @@ class FaultInjector:
         self._count("hang_rank")
         time.sleep(self.hang_seconds)
         return self.hang_seconds
+
+    def slow_req(self, req_id: int) -> float:
+        """Stall the caller (the serving scheduler, about to dispatch
+        the batch containing request ``req_id``) — a deterministic
+        straggler. Returns the seconds slept (0.0 when not scheduled)."""
+        secs = self.slow_req_ids.get(int(req_id), 0.0)
+        if secs and self._once(f"slow_req@{req_id}"):
+            self._count("slow_req")
+            time.sleep(secs)
+            return secs
+        return 0.0
+
+    def drop_req_due(self, req_id: int) -> bool:
+        """True exactly once when request ``req_id``'s computed result
+        is scheduled to be lost post-execution (the drop itself lives in
+        the serving scheduler, which must still terminate the request)."""
+        return (int(req_id) in self.drop_req_ids
+                and self._once(f"drop_req@{req_id}"))
+
+    def storm_deadline(self, req_id: int) -> Optional[float]:
+        """The near-zero deadline (seconds) request ``req_id`` should be
+        submitted with when it falls inside an injected deadline storm;
+        None otherwise."""
+        if int(req_id) in self.storm_req_ids \
+                and self._once(f"deadline_storm@{req_id}"):
+            self._count("deadline_storm")
+            return self.storm_deadline_s
+        return None
 
     def corrupt_ckpt_due(self, generation: int) -> bool:
         """True exactly once when committed generation ``generation`` is

@@ -1,12 +1,14 @@
 """Inference path: jit.save → .pdexport → Config/create_predictor
 (reference: AnalysisPredictor API, analysis_predictor.cc:1140,846) and
 static save_inference_model/load_inference_model (fluid/io.py:1199,1412)."""
+import threading
+
 import numpy as np
 import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import nn
-from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.inference import Config, PrecisionType, create_predictor
 
 
 class SmallNet(nn.Layer):
@@ -150,3 +152,233 @@ class TestStaticInferenceModel:
                     str(tmp_path / "bad"), [x], [out], None, program=main)
         finally:
             paddle.disable_static()
+
+
+class TestConcurrentRun:
+    def test_threaded_run_with_inputs_is_correct(self):
+        """Predictor.run(inputs) from many threads: each caller must get
+        ITS OWN batch's outputs (the historical bug: all callers funneled
+        through the shared input/output handles, so concurrent runs
+        cross-delivered each other's results)."""
+        net = SmallNet()
+        net.eval()
+        config = Config()
+        config.set_layer(net, [paddle.jit.InputSpec([2, 8], "float32", "x")])
+        predictor = create_predictor(config)
+        xs = [np.random.RandomState(s).randn(2, 8).astype("float32")
+              for s in range(8)]
+        want = [net(paddle.to_tensor(x)).numpy() for x in xs]
+        results = [None] * len(xs)
+        errors = []
+        start = threading.Barrier(len(xs))
+
+        def worker(i):
+            try:
+                start.wait()
+                for _ in range(10):
+                    (out,) = predictor.run([xs[i]])
+                    np.testing.assert_allclose(out, want[i], atol=1e-5)
+                results[i] = True
+            except Exception as e:  # surfaced below, not swallowed
+                errors.append((i, e))
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(xs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors
+        assert all(results)
+
+    def test_threaded_handle_path_serializes(self, tmp_path):
+        """The handle-based path (copy_from_cpu → run() → copy_to_cpu)
+        IS shared state: the internal lock must keep concurrent use from
+        corrupting the handles (no torn reads / cross-thread arrays)."""
+        net = SmallNet()
+        net.eval()
+        prefix = str(tmp_path / "mt")
+        paddle.jit.save(net, prefix,
+                        input_spec=[paddle.jit.InputSpec([2, 8], "float32",
+                                                         "x")])
+        predictor = create_predictor(Config(prefix))
+        errors = []
+
+        def worker(seed):
+            try:
+                x = np.random.RandomState(seed).randn(2, 8).astype("float32")
+                want = net(paddle.to_tensor(x)).numpy()
+                for _ in range(5):
+                    (out,) = predictor.run([x])  # refreshes handles too
+                    np.testing.assert_allclose(out, want, atol=1e-5)
+            except Exception as e:
+                errors.append((seed, e))
+
+        threads = [threading.Thread(target=worker, args=(s,))
+                   for s in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors
+
+    def test_threaded_canonical_handle_sequence(self, tmp_path):
+        """copy_from_cpu → run() → copy_to_cpu as THREE separate calls
+        from many threads: handle writes are thread-local-first, so each
+        caller reads back its own outputs even when another thread's
+        run() lands between its run() and its copy_to_cpu()."""
+        net = SmallNet()
+        net.eval()
+        prefix = str(tmp_path / "seq")
+        paddle.jit.save(net, prefix,
+                        input_spec=[paddle.jit.InputSpec([2, 8], "float32",
+                                                         "x")])
+        predictor = create_predictor(Config(prefix))
+        in_name = predictor.get_input_names()[0]
+        out_name = predictor.get_output_names()[0]
+        errors = []
+        start = threading.Barrier(6)
+
+        def worker(seed):
+            try:
+                x = np.random.RandomState(seed).randn(2, 8).astype("float32")
+                want = net(paddle.to_tensor(x)).numpy()
+                inp = predictor.get_input_handle(in_name)
+                outh = predictor.get_output_handle(out_name)
+                start.wait()
+                for _ in range(10):
+                    inp.copy_from_cpu(x)
+                    predictor.run()
+                    np.testing.assert_allclose(outh.copy_to_cpu(), want,
+                                               atol=1e-5)
+            except Exception as e:
+                errors.append((seed, e))
+
+        threads = [threading.Thread(target=worker, args=(s,))
+                   for s in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors
+
+
+class TestPrecision:
+    def _net_and_x(self):
+        net = SmallNet()
+        net.eval()
+        x = np.random.RandomState(0).randn(4, 8).astype("float32")
+        return net, x
+
+    def test_set_layer_bfloat16_casts_weights(self):
+        """Config precision is honored, not silently ignored: live-layer
+        mode casts float params at load, computes in bf16, and returns
+        float32 outputs close to the f32 reference."""
+        net, x = self._net_and_x()
+        config = Config()
+        config.set_precision(PrecisionType.Bfloat16)
+        config.set_layer(net, [paddle.jit.InputSpec([None, 8], "float32")])
+        predictor = create_predictor(config)
+        assert predictor.serving_dtype == "bfloat16"
+        assert predictor.serving_dtype_bits == 16
+        (out,) = predictor.run([x])
+        assert out.dtype == np.float32  # output contract stays f32
+        want = net(paddle.to_tensor(x)).numpy()
+        # bf16 has ~3 decimal digits; atol sized to the mantissa loss
+        np.testing.assert_allclose(out, want, atol=0.15, rtol=0.05)
+        assert not np.allclose(out, 0)
+
+    def test_export_precision_bakes_and_loads(self, tmp_path):
+        """jit.save(precision='bfloat16') bakes cast weights into the
+        artifact; a loader requesting the same precision accepts it and
+        reports the serving dtype."""
+        net, x = self._net_and_x()
+        prefix = str(tmp_path / "bf16")
+        paddle.jit.save(net, prefix, precision="bfloat16",
+                        input_spec=[paddle.jit.InputSpec([None, 8],
+                                                         "float32")])
+        config = Config(prefix)
+        config.set_precision(PrecisionType.Bfloat16)
+        predictor = create_predictor(config)
+        assert predictor.serving_dtype == "bfloat16"
+        assert predictor.serving_dtype_bits == 16
+        (out,) = predictor.run([x])
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(
+            out, net(paddle.to_tensor(x)).numpy(), atol=0.15, rtol=0.05)
+
+    def test_precision_mismatch_on_artifact_raises(self, tmp_path):
+        """An AOT artifact's constants can't be recast at load: asking
+        for bf16 from an f32 export is an ERROR, never a silent ignore."""
+        net, _ = self._net_and_x()
+        prefix = str(tmp_path / "f32")
+        paddle.jit.save(net, prefix,
+                        input_spec=[paddle.jit.InputSpec([None, 8],
+                                                         "float32")])
+        config = Config(prefix)
+        config.set_precision(PrecisionType.Bfloat16)
+        with pytest.raises(ValueError, match="bfloat16"):
+            create_predictor(config)
+
+    def test_explicit_float32_on_bf16_artifact_raises(self, tmp_path):
+        """The mismatch check fires both ways: a client that EXPLICITLY
+        requests Float32 must not silently get bf16-rounded outputs from
+        a bf16-baked artifact — while the unset default keeps accepting
+        whatever the artifact baked."""
+        net, _ = self._net_and_x()
+        prefix = str(tmp_path / "bf16")
+        paddle.jit.save(net, prefix, precision="bfloat16",
+                        input_spec=[paddle.jit.InputSpec([None, 8],
+                                                         "float32")])
+        config = Config(prefix)
+        config.set_precision(PrecisionType.Float32)
+        with pytest.raises(ValueError, match="float32"):
+            create_predictor(config)
+        # no set_precision call: the artifact's own dtype is served
+        predictor = create_predictor(Config(prefix))
+        assert predictor.serving_dtype == "bfloat16"
+
+    def test_serving_dtype_recorded_in_telemetry(self):
+        from paddle_tpu.profiler.telemetry import get_telemetry
+
+        tel = get_telemetry()
+        tel.reset()
+        net, _ = self._net_and_x()
+        config = Config()
+        config.set_precision(PrecisionType.Bfloat16)
+        config.set_layer(net, [paddle.jit.InputSpec([None, 8], "float32")])
+        create_predictor(config)
+        assert tel.scalars().get("gauge/serve/dtype_bits") == 16
+
+    def test_unsupported_export_precision_raises(self, tmp_path):
+        net, _ = self._net_and_x()
+        with pytest.raises(ValueError, match="precision"):
+            paddle.jit.save(net, str(tmp_path / "bad"), precision="int4",
+                            input_spec=[paddle.jit.InputSpec([None, 8],
+                                                             "float32")])
+
+
+class TestServingHooks:
+    def test_sample_specs_strip_batch_axis(self):
+        net = SmallNet()
+        net.eval()
+        config = Config()
+        config.set_layer(net, [paddle.jit.InputSpec([None, 8], "float32")])
+        predictor = create_predictor(config)
+        specs = predictor.sample_specs()
+        assert specs == [((8,), np.dtype("float32"))]
+        fn = predictor.serving_fn()
+        out = fn(np.zeros((3, 8), "float32"))
+        assert isinstance(out, tuple) and np.asarray(out[0]).shape == (3, 4)
+
+    def test_exported_artifact_serving_hooks(self, tmp_path):
+        net = SmallNet()
+        net.eval()
+        prefix = str(tmp_path / "hooks")
+        paddle.jit.save(net, prefix,
+                        input_spec=[paddle.jit.InputSpec([None, 8],
+                                                         "float32")])
+        predictor = create_predictor(Config(prefix))
+        assert predictor.sample_specs() == [((8,), np.dtype("float32"))]
+        out = predictor.serving_fn()(np.zeros((2, 8), "float32"))
+        assert np.asarray(out[0]).shape == (2, 4)

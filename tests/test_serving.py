@@ -1,0 +1,652 @@
+"""Serving runtime (inference.serving): bounded admission with explicit
+shedding, deadlines enforced at enqueue/batch-formation/completion,
+bucketed continuous batching with compile counts bounded by len(buckets),
+drain-on-SIGTERM with every accepted request reaching EXACTLY ONE
+terminal status, and the exit-77 preemption path (ISSUE 7 acceptance)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.inference.serving import (AdmissionQueue, Request,
+                                          RequestStatus, ServeConfig,
+                                          ServingEngine, run_load,
+                                          run_streams, summarize)
+from paddle_tpu.inference.serving.admission import (ADMIT, REJECT_CAPACITY,
+                                                    REJECT_DRAINING,
+                                                    REJECT_EXPIRED)
+from paddle_tpu.profiler.telemetry import get_telemetry
+from paddle_tpu.resilience.inject import (FaultInjector, clear_injector,
+                                          install_injector)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_injector():
+    """Serving consults the process-wide injector: keep tests isolated."""
+    clear_injector()
+    yield
+    clear_injector()
+
+
+def make_engine(capacity=8, buckets=(1, 2, 4), in_dim=4, out_dim=3, **kw):
+    paddle.seed(0)
+    net = nn.Linear(in_dim, out_dim)
+    net.eval()
+    cfg = Config()
+    cfg.set_layer(net, [paddle.jit.InputSpec([None, in_dim], "float32", "x")])
+    eng = ServingEngine(create_predictor(cfg),
+                        ServeConfig(capacity=capacity, buckets=buckets, **kw))
+    return eng, net
+
+
+def sample(seed=0, in_dim=4):
+    return [np.random.RandomState(seed).randn(in_dim).astype("float32")]
+
+
+class TestRequest:
+    def test_terminal_exactly_once(self):
+        r = Request(0, sample())
+        assert r.status == RequestStatus.PENDING and not r.done()
+        assert r.finish(RequestStatus.OK, outputs=[np.zeros(3)]) is True
+        assert r.done() and r.status == RequestStatus.OK
+        # second transition refused — "executed AND rejected" impossible
+        assert r.finish(RequestStatus.REJECTED) is False
+        assert r.status == RequestStatus.OK
+        assert r.outputs is not None
+
+    def test_non_terminal_status_rejected(self):
+        r = Request(0, sample())
+        with pytest.raises(ValueError):
+            r.finish("pending")
+
+    def test_deadline_expiry(self):
+        r = Request(0, sample(), deadline_s=0.01)
+        assert not r.expired()
+        time.sleep(0.02)
+        assert r.expired()
+        assert Request(1, sample(), deadline_s=None).expired() is False
+
+    def test_wait_and_latency(self):
+        r = Request(0, sample(), deadline_s=5.0)
+        t = threading.Timer(0.05, r.finish, args=(RequestStatus.OK,))
+        t.start()
+        assert r.wait(2.0) is True
+        assert r.latency_ms() >= 40.0
+
+
+class TestAdmissionQueue:
+    def test_capacity_bound_is_hard(self):
+        q = AdmissionQueue(capacity=2)
+        rs = [Request(i, sample()) for i in range(3)]
+        assert q.submit(rs[0]) == ADMIT
+        assert q.submit(rs[1]) == ADMIT
+        assert q.submit(rs[2]) == REJECT_CAPACITY
+        assert len(q) == 2
+
+    def test_expired_refused_at_enqueue(self):
+        q = AdmissionQueue(capacity=4)
+        r = Request(0, sample(), deadline_s=0.0)
+        time.sleep(0.005)
+        assert q.submit(r) == REJECT_EXPIRED
+        assert len(q) == 0
+
+    def test_take_splits_expired(self):
+        q = AdmissionQueue(capacity=8)
+        live = Request(0, sample(), deadline_s=30.0)
+        dead = Request(1, sample(), deadline_s=0.01)
+        q.submit(live)
+        q.submit(dead)
+        time.sleep(0.03)
+        ready, expired = q.take(8, timeout=0.1)
+        assert ready == [live] and expired == [dead]
+        assert len(q) == 0  # expired slot freed immediately
+
+    def test_take_respects_max_n_and_fifo(self):
+        q = AdmissionQueue(capacity=8)
+        rs = [Request(i, sample()) for i in range(5)]
+        for r in rs:
+            q.submit(r)
+        ready, _ = q.take(3, timeout=0.1)
+        assert [r.id for r in ready] == [0, 1, 2]
+        assert len(q) == 2
+
+    def test_drain_latch_stops_admission(self):
+        q = AdmissionQueue(capacity=8)
+        q.submit(Request(0, sample()))
+        q.start_drain()
+        assert q.draining
+        assert q.submit(Request(1, sample())) == REJECT_DRAINING
+        assert len(q) == 1  # queued work stays queued
+        assert [r.id for r in q.pop_all()] == [0]
+        assert len(q) == 0
+
+    def test_bad_capacity_raises(self):
+        with pytest.raises(ValueError):
+            AdmissionQueue(capacity=0)
+
+
+class TestServeConfig:
+    def test_bucket_for_picks_smallest_fit(self):
+        cfg = ServeConfig(buckets=(1, 2, 4, 8))
+        assert cfg.bucket_for(1) == 1
+        assert cfg.bucket_for(3) == 4
+        assert cfg.bucket_for(8) == 8
+        with pytest.raises(ValueError):
+            cfg.bucket_for(9)
+
+    def test_max_batch_defaults_and_validates(self):
+        assert ServeConfig(buckets=(1, 4)).max_batch == 4
+        with pytest.raises(ValueError):
+            ServeConfig(buckets=(1, 4), max_batch=8)
+        with pytest.raises(ValueError):
+            ServeConfig(buckets=())
+
+
+class TestServingEngine:
+    def test_results_match_direct_predictor(self):
+        eng, net = make_engine()
+        eng.start()
+        try:
+            xs = [sample(seed=s)[0] for s in range(6)]
+            reqs = [eng.submit([x], deadline_s=30.0) for x in xs]
+            for r in reqs:
+                assert r.wait(30.0)
+            want = net(paddle.to_tensor(np.stack(xs))).numpy()
+            for r, w in zip(reqs, want):
+                assert r.status == RequestStatus.OK
+                np.testing.assert_allclose(r.outputs[0], w, atol=1e-5)
+        finally:
+            eng.shutdown()
+
+    def test_padding_rows_sliced_off(self):
+        """A lone request padded up to a bucket must come back per-sample
+        (bucket 2 or 4 padding never leaks into outputs)."""
+        eng, net = make_engine(buckets=(4,))
+        eng.start()
+        try:
+            x = sample(seed=3)[0]
+            r = eng.submit([x], deadline_s=30.0)
+            assert r.wait(30.0) and r.status == RequestStatus.OK
+            assert r.outputs[0].shape == (3,)
+            np.testing.assert_allclose(
+                r.outputs[0],
+                net(paddle.to_tensor(x[None])).numpy()[0], atol=1e-5)
+        finally:
+            eng.shutdown()
+
+    def test_compiles_bounded_by_buckets(self):
+        """Continuous batching never retraces: exactly len(buckets)
+        compiles no matter how request counts mix (warmup pre-pays all)."""
+        buckets = (1, 2, 4)
+        eng, _ = make_engine(buckets=buckets)
+        eng.start()  # warmup compiles every bucket
+        try:
+            compiles = sum(
+                fn.tracker.compiles
+                for fn in eng._scheduler._bucket_fns.values())
+            assert compiles == len(buckets)
+            for k in range(10):
+                eng.submit(sample(seed=k), deadline_s=30.0).wait(30.0)
+            compiles = sum(
+                fn.tracker.compiles
+                for fn in eng._scheduler._bucket_fns.values())
+            assert compiles == len(buckets)
+        finally:
+            eng.shutdown()
+
+    def test_submit_before_start_raises(self):
+        eng, _ = make_engine()
+        with pytest.raises(RuntimeError, match="start"):
+            eng.submit(sample())
+
+    def test_wrong_shape_and_arity_raise(self):
+        eng, _ = make_engine()
+        eng.start()
+        try:
+            with pytest.raises(ValueError, match="inputs"):
+                eng.submit([sample()[0], sample()[0]])
+            with pytest.raises(ValueError, match="batch axis"):
+                eng.submit([np.zeros((2, 4), "float32")])
+        finally:
+            eng.shutdown()
+
+    def test_capacity_rejects_are_explicit(self):
+        """Past capacity the submitter gets REJECTED immediately — the
+        rejected request never held a queue slot, never executed."""
+        eng, _ = make_engine(capacity=2, buckets=(1,))
+        # stall the scheduler inside the first batch so the queue backs up
+        install_injector(FaultInjector(slow_req_ids={0: 0.6}))
+        eng.start()
+        try:
+            first = eng.submit(sample(), deadline_s=30.0)
+            time.sleep(0.1)  # scheduler picked req 0 alone, now stalled
+            backlog = [eng.submit(sample(seed=k), deadline_s=30.0)
+                       for k in range(1, 6)]
+            rejected = [r for r in backlog
+                        if r.status == RequestStatus.REJECTED]
+            assert len(rejected) >= 1
+            for r in rejected:
+                assert r.done()  # terminal at submit-return, no waiting
+                assert "capacity" in r.detail
+                assert r.outputs is None
+            assert first.wait(30.0)
+        finally:
+            eng.shutdown()
+            acct = eng.accounting()
+            assert acct["unaccounted"] == []
+            assert acct["double_terminal"] == 0
+
+    def test_deadline_expired_in_queue_is_shed(self):
+        """A queued request whose deadline passes is shed at batch
+        formation — it never burns a TPU slot."""
+        eng, _ = make_engine(capacity=8, buckets=(1,))
+        install_injector(FaultInjector(slow_req_ids={0: 0.5}))
+        eng.start()
+        try:
+            eng.submit(sample(), deadline_s=30.0)  # stalls the scheduler
+            time.sleep(0.1)
+            doomed = eng.submit(sample(seed=1), deadline_s=0.05)
+            assert doomed.wait(30.0)
+            assert doomed.status == RequestStatus.DEADLINE_EXCEEDED
+            assert "queue" in doomed.detail
+        finally:
+            eng.shutdown()
+
+    def test_completed_past_deadline_never_delivers_stale(self):
+        """The batch straggled past the deadline: the result is discarded
+        and the request terminates DEADLINE_EXCEEDED, not stale-OK."""
+        eng, _ = make_engine(capacity=8, buckets=(1, 2))
+        eng.start()
+        install_injector(FaultInjector(slow_req_ids={0: 0.4}))
+        try:
+            r = eng.submit(sample(), deadline_s=0.1)  # in the stalled batch
+            assert r.wait(30.0)
+            assert r.status == RequestStatus.DEADLINE_EXCEEDED
+            assert "past deadline" in r.detail
+            assert r.outputs is None
+        finally:
+            eng.shutdown()
+
+    def test_expired_at_enqueue(self):
+        eng, _ = make_engine()
+        eng.start()
+        try:
+            r = eng.submit(sample(), deadline_s=0.0)
+            assert r.done()
+            assert r.status == RequestStatus.DEADLINE_EXCEEDED
+            assert "before enqueue" in r.detail
+        finally:
+            eng.shutdown()
+
+    def test_default_deadline_applies(self):
+        eng, _ = make_engine(default_deadline_s=0.0)
+        eng.start()
+        try:
+            r = eng.submit(sample())  # no explicit deadline -> default 0
+            assert r.status == RequestStatus.DEADLINE_EXCEEDED
+            r2 = eng.submit(sample(), deadline_s=30.0)  # explicit wins
+            assert r2.wait(30.0) and r2.status == RequestStatus.OK
+        finally:
+            eng.shutdown()
+
+
+class TestInjectionHooks:
+    def test_request_fault_spec_parsing(self):
+        inj = FaultInjector.from_spec(
+            "slow_req@10:0.4,drop_req@12,deadline_storm@20:3")
+        assert inj.slow_req_ids == {10: 0.4}
+        assert inj.drop_req_ids == {12}
+        assert inj.storm_req_ids == {20, 21, 22}
+
+    def test_slow_req_fires_once(self):
+        inj = FaultInjector(slow_req_ids={5: 0.01})
+        assert inj.slow_req(5) == 0.01
+        assert inj.slow_req(5) == 0.0  # one-shot
+        assert inj.slow_req(6) == 0.0
+
+    def test_drop_req_terminates_as_error(self):
+        """An injected post-execution result drop may not strand the
+        request: the accounting layer terminates it as ERROR."""
+        eng, _ = make_engine(buckets=(1,))
+        install_injector(FaultInjector(drop_req_ids=[0]))
+        eng.start()
+        try:
+            r = eng.submit(sample(), deadline_s=30.0)
+            assert r.wait(30.0)
+            assert r.status == RequestStatus.ERROR
+            assert "dropped" in r.detail
+            ok = eng.submit(sample(seed=1), deadline_s=30.0)
+            assert ok.wait(30.0) and ok.status == RequestStatus.OK
+        finally:
+            eng.shutdown()
+
+    def test_deadline_storm_sheds_without_stalling_live_traffic(self):
+        eng, _ = make_engine(capacity=16, buckets=(1, 2, 4))
+        install_injector(FaultInjector(deadline_storms={0: 4},
+                                       storm_deadline_s=1e-4))
+        eng.start()
+        try:
+            stormed = [eng.submit(sample(seed=k)) for k in range(4)]
+            live = eng.submit(sample(seed=9), deadline_s=30.0)
+            for r in stormed:
+                assert r.wait(30.0)
+                assert r.status == RequestStatus.DEADLINE_EXCEEDED
+            assert live.wait(30.0) and live.status == RequestStatus.OK
+        finally:
+            eng.shutdown()
+
+
+class TestDrain:
+    def test_drain_finishes_queued_work(self):
+        eng, _ = make_engine()
+        eng.start()
+        try:
+            reqs = [eng.submit(sample(seed=k), deadline_s=30.0)
+                    for k in range(5)]
+            acct = eng.drain(wait=True)
+            assert acct["unaccounted"] == []
+            assert acct["double_terminal"] == 0
+            for r in reqs:  # queued work finished, not dropped
+                assert r.status == RequestStatus.OK
+            late = eng.submit(sample(seed=9), deadline_s=30.0)
+            assert late.status == RequestStatus.REJECTED
+            assert "draining" in late.detail
+        finally:
+            eng.shutdown()
+
+    def test_drain_grace_expiry_marks_drained(self):
+        """Work still queued when the grace window closes gets the
+        DRAINED terminal status — never silently lost."""
+        eng, _ = make_engine(capacity=16, buckets=(1,), drain_grace_s=0.15)
+        install_injector(FaultInjector(slow_req_ids={0: 0.8}))
+        eng.start()
+        try:
+            eng.submit(sample(), deadline_s=30.0)  # stalls the scheduler
+            time.sleep(0.05)
+            backlog = [eng.submit(sample(seed=k), deadline_s=30.0)
+                       for k in range(1, 5)]
+            acct = eng.drain(wait=True)
+            assert acct["unaccounted"] == []
+            drained = [r for r in backlog
+                       if r.status == RequestStatus.DRAINED]
+            assert drained, "grace expiry should have DRAINED the backlog"
+        finally:
+            eng.shutdown()
+
+    def test_shutdown_without_start(self):
+        eng, _ = make_engine()
+        assert eng.drain(wait=True)["submitted"] == 0
+
+    def test_sigterm_racing_shutdown_still_exits_77(self):
+        """A SIGTERM landing while (or after) a normal shutdown drain
+        already latched never gets to set the drain REASON — the
+        relaunch exit must still fire off the preemption flag itself,
+        or the supervisor would treat the replica as done for good."""
+        from paddle_tpu.resilience.preemption import (
+            clear_preemption_request, install_preemption_handler,
+            preemption_requested, uninstall_preemption_handler)
+
+        eng, _ = make_engine(drain_grace_s=0.5)
+        eng.start()
+        install_preemption_handler()
+        try:
+            eng.drain(wait=True, reason="shutdown")
+            assert eng.drain_reason == "shutdown"
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.monotonic() + 5.0
+            while not preemption_requested():
+                assert time.monotonic() < deadline, "flag never set"
+                time.sleep(0.01)
+            with pytest.raises(SystemExit) as ei:
+                eng.exit_if_preempted(timeout=5.0)
+            assert ei.value.code == 77
+        finally:
+            clear_preemption_request()
+            uninstall_preemption_handler()
+            eng.shutdown()
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_scheduler_crash_latches_drain_and_sheds(self, monkeypatch):
+        """A scheduler crash must not leave the engine half-alive: the
+        admission queue latches draining, so submits racing or following
+        the crash are shed with a terminal REJECTED — never admitted
+        into a queue no thread serves (where wait() would hang and
+        accounting would grow unaccounted ids forever)."""
+        import paddle_tpu.inference.serving.scheduler as sched_mod
+
+        eng, _ = make_engine(default_deadline_s=10.0, drain_grace_s=0.2)
+        eng.start()
+        try:
+            ok = eng.submit(sample())
+            ok.wait(10.0)
+            assert ok.status == RequestStatus.OK
+
+            def boom():
+                raise RuntimeError("injected scheduler crash")
+
+            monkeypatch.setattr(sched_mod, "heartbeat", boom)
+            eng._scheduler.join(10.0)
+            assert not eng._scheduler.alive
+            assert eng.draining and eng.drain_reason == "scheduler crashed"
+            req = eng.submit(sample(1))
+            assert req.done() and req.status == RequestStatus.REJECTED
+            assert eng.wait_drained(10.0)
+            acct = eng.accounting()
+            assert acct["unaccounted"] == []
+            assert acct["double_terminal"] == 0
+        finally:
+            monkeypatch.undo()
+            eng.shutdown()
+
+
+class TestTelemetry:
+    def test_serve_counters_and_bounded_queue_depth(self, tmp_path):
+        tel = get_telemetry()
+        tel.reset()
+        eng, _ = make_engine(capacity=4)
+        eng.start()
+        try:
+            for k in range(6):
+                eng.submit(sample(seed=k), deadline_s=30.0).wait(30.0)
+        finally:
+            eng.shutdown()
+        assert tel.counter_value("serve/requests") == 6
+        assert tel.counter_value("serve/accepted") == 6
+        assert tel.counter_value("serve/completed") == 6
+        assert tel.counter_value("serve/batches") >= 1
+        assert tel.hist_summary("serve/latency_ms")["count"] == 6
+        scalars = tel.scalars()
+        assert scalars["gauge/serve/queue_capacity"] == 4
+        assert 0 <= scalars["gauge/serve/queue_depth"] <= 4
+        assert scalars["gauge/serve/dtype_bits"] == 32
+        # the emitted JSONL satisfies the documented serve/* contracts
+        path = str(tmp_path / "t.jsonl")
+        tel.to_jsonl(path, tag="serving_test")
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        try:
+            from check_telemetry_schema import validate_file
+        finally:
+            sys.path.pop(0)
+        n, err = validate_file(path, require=["counter/serve/requests"])
+        assert err is None and n == 1
+
+    def test_schema_rejects_depth_past_capacity(self, tmp_path):
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        try:
+            from check_telemetry_schema import validate_file
+        finally:
+            sys.path.pop(0)
+        bad = {"ts": 1.0, "step": None, "tag": "x", "scalars": {
+            "gauge/serve/queue_depth": 9.0,
+            "gauge/serve/queue_capacity": 4.0}}
+        p = tmp_path / "bad.jsonl"
+        p.write_text(json.dumps(bad) + "\n")
+        n, err = validate_file(str(p))
+        assert err is not None and "bounded" in err
+
+    def test_schema_rejects_negative_serve_counter(self, tmp_path):
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        try:
+            from check_telemetry_schema import validate_file
+        finally:
+            sys.path.pop(0)
+        bad = {"ts": 1.0, "step": None, "tag": "x", "scalars": {
+            "counter/serve/admission_rejects": -1.0}}
+        p = tmp_path / "bad2.jsonl"
+        p.write_text(json.dumps(bad) + "\n")
+        n, err = validate_file(str(p))
+        assert err is not None and "negative" in err
+
+
+class TestLoadgen:
+    def test_summarize_counts_and_percentiles(self):
+        reqs = []
+        for k in range(10):
+            r = Request(k, sample())
+            r.finish(RequestStatus.OK if k < 8 else RequestStatus.REJECTED)
+            reqs.append(r)
+        s = summarize(reqs)
+        assert s["submitted"] == 10
+        assert s["by_status"] == {"ok": 8, "rejected": 2}
+        assert 0 <= s["p50_ms"] <= s["p99_ms"] <= s["max_ms"]
+
+    def test_run_streams_closed_loop(self):
+        eng, _ = make_engine(capacity=16)
+        eng.start()
+        try:
+            out = run_streams(eng, n_streams=3, requests_per_stream=4,
+                              input_fn=lambda k: sample(seed=k),
+                              deadline_s=30.0)
+            assert out["submitted"] == 12
+            assert out["by_status"]["ok"] == 12  # closed loop never sheds
+            assert out["ok_per_s"] > 0
+        finally:
+            eng.shutdown()
+
+    def test_run_load_open_loop_overload_sheds(self):
+        """Open-loop at a rate far past sustainable must shed explicitly
+        (rejects and/or deadline expiry) yet account for every request."""
+        eng, _ = make_engine(capacity=2, buckets=(1,))
+        install_injector(FaultInjector(slow_req_ids={0: 0.3, 10: 0.3}))
+        eng.start()
+        try:
+            out = run_load(eng, n_requests=60, rate_per_s=400.0,
+                           input_fn=lambda k: sample(seed=k),
+                           deadline_s=0.2, wait_timeout_s=30.0)
+            assert out["submitted"] == 60
+            shed = (out["by_status"].get("rejected", 0)
+                    + out["by_status"].get("deadline_exceeded", 0))
+            assert shed > 0
+            assert sum(out["by_status"].values()) == 60
+        finally:
+            eng.shutdown()
+            acct = eng.accounting()
+            assert acct["unaccounted"] == []
+            assert acct["double_terminal"] == 0
+
+
+# The ISSUE 7 drain-on-SIGTERM acceptance, in-process observable pieces
+# subprocess-proven below: a real SIGTERM mid-load must drain (every
+# accepted request terminal, none double-claimed) and exit 77.
+_SIGTERM_WORKER = textwrap.dedent("""
+    import json, os, signal, sys, threading
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.inference.serving import ServeConfig, ServingEngine
+
+    paddle.seed(0)
+    net = nn.Linear(4, 3); net.eval()
+    cfg = Config()
+    cfg.set_layer(net, [paddle.jit.InputSpec([None, 4], "float32", "x")])
+    eng = ServingEngine(create_predictor(cfg), ServeConfig(
+        capacity=16, buckets=(1, 2, 4), default_deadline_s=5.0,
+        drain_grace_s=3.0))
+    eng.install_preemption().start()
+
+    rng = np.random.RandomState(0)
+    reqs = []
+    # SIGTERM ourselves mid-load from a side thread (a real signal, the
+    # real handler) while submissions continue — post-drain submissions
+    # must come back REJECTED, not hang
+    def fire():
+        os.kill(os.getpid(), signal.SIGTERM)
+    threading.Timer(0.15, fire).start()
+    import time
+    for k in range(400):
+        reqs.append(eng.submit([rng.randn(4).astype("float32")]))
+        time.sleep(0.001)
+    eng.wait_drained(20.0)
+    acct = eng.accounting()
+    statuses = {}
+    for r in reqs:
+        statuses[r.status] = statuses.get(r.status, 0) + 1
+    with open(os.environ["OUT"], "w") as f:
+        json.dump({"acct": acct, "statuses": statuses,
+                   "drain_reason": eng.drain_reason}, f)
+    eng.exit_if_preempted()
+    sys.exit(3)  # preemption drain never happened
+""")
+
+
+class TestDrainOnSigterm:
+    def test_sigterm_drains_and_exits_preempted(self, tmp_path):
+        """Mid-load SIGTERM: admission stops, accepted work finishes or
+        is DRAINED, every request is terminal exactly once, and the
+        process leaves via the PR 4 preemption path (exit 77)."""
+        out_path = str(tmp_path / "out.json")
+        worker = tmp_path / "worker.py"
+        worker.write_text(_SIGTERM_WORKER)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", "OUT": out_path,
+               "PYTHONPATH": _REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", "")}
+        env.pop("PADDLE_TPU_INJECT", None)
+        r = subprocess.run([sys.executable, str(worker)], env=env,
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 77, (r.returncode, r.stderr[-2000:])
+        with open(out_path) as f:
+            out = json.load(f)
+        acct = out["acct"]
+        assert out["drain_reason"] == "preempted"
+        assert acct["submitted"] == 400
+        assert acct["unaccounted"] == []
+        assert acct["double_terminal"] == 0
+        statuses = out["statuses"]
+        # the load ran long enough that some requests completed before
+        # the signal and some were shed after it
+        assert statuses.get("ok", 0) >= 1
+        assert statuses.get("rejected", 0) >= 1
+        assert set(statuses) <= RequestStatus.TERMINAL
+
+
+@pytest.mark.slow
+class TestServingGateEndToEnd:
+    def test_check_serving_gate_passes(self):
+        """The full overload acceptance: calibrated 2x offered load with
+        slow_req + deadline-storm + drop_req injection and a mid-load
+        SIGTERM must shed cleanly (gate OK, exit 0)."""
+        r = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "check_serving.py"),
+             "--requests", "1200", "--json"],
+            capture_output=True, text=True, timeout=580,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stdout + r.stderr
+        payload = json.loads(r.stdout)
+        assert payload["gate"] == "serving"
+        assert payload["status"] == "OK"
+        assert payload["by_status"].get("rejected", 0) >= 1
+        assert payload["by_status"].get("deadline_exceeded", 0) >= 1

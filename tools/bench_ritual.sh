@@ -53,6 +53,14 @@ JAX_PLATFORMS=cpu python tools/check_resilience.py
 # resilience/job_restarts and ckpt/manifest_fallbacks in the telemetry.
 JAX_PLATFORMS=cpu python tools/check_cluster_resilience.py
 
+# serving overload gate: the deployment-side acceptance — a calibrated
+# 2x-offered-load run with injected stragglers (slow_req), a deadline
+# storm, a dropped result, and a mid-load SIGTERM must shed via explicit
+# admission rejects + deadline expiry (bounded p99 for admitted work),
+# leave ZERO requests without a terminal status, and drain + exit 77
+# through the preemption relaunch path.
+JAX_PLATFORMS=cpu python tools/check_serving.py
+
 if [ -f BENCH_extra.prev.json ]; then
   # LeNet rides per-step dispatch through the remote-TPU tunnel: the r5
   # variance study (tools/profiles/r5_lenet_variance.txt) measured CV 7.6%

@@ -30,6 +30,15 @@ Name contracts (beyond the generic shape): ``gauge/mfu*`` ∈ [0, 100];
 and the coordinated-checkpoint accounting (``counter/ckpt/*``,
 ``hist/ckpt/commit_ms/*``) are ≥ 0 — a negative restart/commit count
 means a producer is writing deltas where totals belong.
+
+Serving contracts (``inference.serving``): ``counter/serve/*`` are
+monotone request totals ≥ 0; latency/batch histograms
+(``hist/serve/latency_ms*``, ``hist/serve/batch_ms*``) carry only
+non-negative fields; ``hist/serve/batch_occupancy*`` fields sit in
+[0, 1] except count/sum; and within one record
+``gauge/serve/queue_depth`` must sit in [0, ``gauge/serve/
+queue_capacity``] — a depth past the configured capacity means the
+bounded admission queue is not actually bounded.
 """
 from __future__ import annotations
 
@@ -86,6 +95,31 @@ def validate_record(rec, lineno):
                 and float(value) < 0:
             return (f"line {lineno}: scalar {name!r} = {value!r} "
                     f"is negative (resilience/ckpt totals are monotone)")
+        # serving contracts: request totals and latency/batch histograms
+        # can never go negative; occupancy is a fraction of the bucket
+        if (name.startswith("counter/serve/")
+                or name.startswith("hist/serve/latency_ms")
+                or name.startswith("hist/serve/batch_ms")) \
+                and float(value) < 0:
+            return (f"line {lineno}: scalar {name!r} = {value!r} "
+                    f"is negative (serve totals/latencies are >= 0)")
+        if name.startswith("hist/serve/batch_occupancy") \
+                and not name.endswith(("/count", "/sum")) \
+                and not (0 <= float(value) <= 1):
+            return (f"line {lineno}: scalar {name!r} = {value!r} "
+                    f"outside [0, 1] (occupancy = batch size / bucket)")
+    # cross-field: the admission queue is BOUNDED — its observed depth
+    # can never exceed the capacity the same record reports
+    depth = scalars.get("gauge/serve/queue_depth")
+    cap = scalars.get("gauge/serve/queue_capacity")
+    if depth is not None:
+        if float(depth) < 0:
+            return (f"line {lineno}: gauge/serve/queue_depth = {depth!r} "
+                    f"is negative")
+        if cap is not None and float(depth) > float(cap):
+            return (f"line {lineno}: gauge/serve/queue_depth = {depth!r} "
+                    f"exceeds gauge/serve/queue_capacity = {cap!r} "
+                    f"(the admission queue must be bounded)")
     return None
 
 
