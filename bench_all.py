@@ -240,13 +240,29 @@ def bench_gpt_long_context():
     kernels), so the XLA-level tier stands.
     MFU/vs_baseline framing follows bench.py's A100 methodology with the
     causal-attention term included (at L=8192 attention is ~38% of model
-    FLOPs)."""
+    FLOPs).
+
+    PR 8 additions: (1) the attention tier is now chosen by MEASUREMENT —
+    the config runs under ``PADDLE_TPU_ATTN_POLICY=bench`` (the TPU
+    default, forced here so CPU CI exercises the same path) with the
+    persistent tier cache wired, so the first trace micro-benches the
+    feasible tiers and every later run is a cache hit; (2) a
+    ``tokens_per_sec_forced_blockwise`` ablation column records what the
+    pre-policy streaming floor measures, so the tier win is a recorded
+    number, not a claim; (3) a remat control-loop probe pins the HBM
+    budget to 60% of the no-remat peak and records which checkpoint
+    policy ``remat='auto'`` escalates to and the peak it measured —
+    attribution-gauge proof that the ladder lowers peak HBM on THIS
+    config when capacity demands it."""
+    import tempfile
+
     import paddle_tpu as paddle
     from jax.sharding import Mesh
     from paddle_tpu.distributed.fleet.engine import ParallelTrainStep
+    from paddle_tpu.ops import remat_policy, tier_policy
+    from paddle_tpu.profiler import get_telemetry
     from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
 
-    paddle.seed(0)
     if SMOKE:
         config = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                            num_heads=4, max_position_embeddings=512,
@@ -257,32 +273,98 @@ def bench_gpt_long_context():
                            max_position_embeddings=8192,
                            hidden_dropout=0.0, attention_dropout=0.0)
         b, L, iters = 1, 8192, 10
-    model = GPTForCausalLM(config)
-    opt = paddle.optimizer.Adam(learning_rate=1e-4,
-                                parameters=model.parameters())
-    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
-    # no recompute: the chunked tier's exp-weight residuals (~10 GB, see
-    # docstring) fit HBM at this b=1 shape, and remat would trade ~25%
-    # throughput for capacity that isn't needed. Smoke keeps recompute ON
-    # deliberately — it is the only place the recompute × longctx-model
-    # compose is exercised off-TPU (the real config's recompute=False
-    # program is compiled by the full run itself).
-    step = ParallelTrainStep(model, loss_fn=model.loss_fn, optimizer=opt,
-                             mesh=mesh, recompute=bool(SMOKE),
-                             compute_dtype=None if SMOKE else jnp.bfloat16)
+
+    # no recompute on the real config: the chunked tier's exp-weight
+    # residuals (~10 GB, see docstring) fit HBM at this b=1 shape, and
+    # remat would trade ~25% throughput for capacity that isn't needed.
+    # Smoke keeps full remat ON deliberately — it is the only place the
+    # remat × longctx-model compose is exercised off-TPU (the real
+    # config's remat-off program is compiled by the full run itself).
+    def build_engine(remat=None):
+        paddle.seed(0)
+        model = GPTForCausalLM(config)
+        opt = paddle.optimizer.Adam(learning_rate=1e-4,
+                                    parameters=model.parameters())
+        mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+        return ParallelTrainStep(
+            model, loss_fn=model.loss_fn, optimizer=opt, mesh=mesh,
+            remat=("full" if SMOKE else "off") if remat is None else remat,
+            compute_dtype=None if SMOKE else jnp.bfloat16)
+
     rng = np.random.RandomState(0)
     ids = rng.randint(0, config.vocab_size, (b, L)).astype(np.int32)
     labels = np.roll(ids, -1, axis=1).astype(np.int32)
     ids = paddle.to_tensor(ids)
     labels = paddle.to_tensor(labels)
 
-    def one(i):
-        return step((ids,), (labels,))
+    def measure(engine, n_iter):
+        return _rate(lambda i: engine((ids,), (labels,)), 1, n_iter) * b * L
 
-    tps = _rate(one, 1, iters) * b * L
+    tel = get_telemetry()
+    saved_env = {k: os.environ.get(k) for k in
+                 ("PADDLE_TPU_ATTN_POLICY", "PADDLE_TPU_ATTN_TIER_CACHE",
+                  "PADDLE_TPU_DEVICE_HBM_BYTES")}
+    try:
+        # -- tier ablation leg: the forced streaming floor ---------------
+        os.environ["PADDLE_TPU_ATTN_POLICY"] = "blockwise"
+        engine = build_engine()
+        abl_tps = measure(engine, max(2, iters // 2))
+        del engine
+
+        # -- measured tier selection for the remaining legs --------------
+        if saved_env["PADDLE_TPU_ATTN_POLICY"] is None:
+            os.environ["PADDLE_TPU_ATTN_POLICY"] = "bench"
+        else:
+            os.environ["PADDLE_TPU_ATTN_POLICY"] = \
+                saved_env["PADDLE_TPU_ATTN_POLICY"]
+        if tier_policy.cache_path() is None:
+            # no compile-cache dir on this rig: still exercise the
+            # persistent verdict cache end-to-end via a scratch file
+            os.environ["PADDLE_TPU_ATTN_TIER_CACHE"] = os.path.join(
+                tempfile.mkdtemp(prefix="paddle_tpu_bench_"),
+                "attn_tiers.json")
+        tier_policy.reset()  # in-memory verdicts; the disk cache decides
+
+        # -- remat control-loop probe ------------------------------------
+        probe = build_engine(remat="auto")  # deferred build; probed by hand
+        remat_cols = {}
+        off = probe.lower_cost("off", (ids,), (labels,))
+        if off is not None:
+            os.environ["PADDLE_TPU_DEVICE_HBM_BYTES"] = str(
+                max(int(off["peak_hbm_bytes"] * 0.6), 1))
+            chosen = remat_policy.resolve(
+                "fleet.train_step",
+                lambda p: probe.lower_cost(p, (ids,), (labels,)))
+            auto_peak = tel.scalars().get(
+                "gauge/remat/peak_hbm/fleet.train_step")
+            remat_cols = {
+                "remat_off_peak_hbm_bytes": off["peak_hbm_bytes"],
+                "remat_auto_policy": chosen,
+                "remat_auto_peak_hbm_bytes": auto_peak,
+            }
+            del os.environ["PADDLE_TPU_DEVICE_HBM_BYTES"]
+        del probe
+
+        # -- the headline leg: measured tier selection, clean telemetry --
+        tel.reset()  # the record must carry ONLY this leg's attribution
+        engine = build_engine()
+        tps = measure(engine, iters)
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    tier_id = tel.scalars().get(
+        f"gauge/attn/tier.{tier_policy.gauge_key(L, config.hidden_size // config.num_heads, True)}")
+    id_to_name = {v: k for k, v in tier_policy.TIER_IDS.items()}
     out = {"metric": "gpt_small_L8192_longctx_train_tokens_per_sec",
            "value": round(tps, 1), "unit": "tokens/sec",
-           "seq_len": L}
+           "seq_len": L,
+           "tokens_per_sec_forced_blockwise": round(abl_tps, 1),
+           "tier_ablation_speedup": round(tps / abl_tps, 3),
+           "attn_tier_selected": id_to_name.get(tier_id, "unknown")}
+    out.update(remat_cols)
     if not SMOKE:
         # 6·N_matmul + causal attention 6·L·h·n_layers per token
         n_mat = (12 * config.num_layers * config.hidden_size ** 2

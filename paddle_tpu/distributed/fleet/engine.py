@@ -255,7 +255,7 @@ class ParallelTrainStep:
                  zero_stage=0, recompute=False, compute_dtype=None,
                  donate=True, extra_batch_axes=(), offload=False,
                  master_weights=None, check_finite=None,
-                 guard_updates=False):
+                 guard_updates=False, remat=None, sp_axis=None):
         self._layer = layer
         self._optimizer = optimizer
         self._loss_fn = loss_fn
@@ -327,9 +327,28 @@ class ParallelTrainStep:
             for n, d in self._opt_shardings.items()
         } if offload else None
         batch_axes = (dp_axis,) + tuple(extra_batch_axes)
-        self._batch_sharding = NamedSharding(
-            mesh, P(batch_axes if len(batch_axes) > 1 else dp_axis)
-        )
+        dim0 = batch_axes if len(batch_axes) > 1 else dp_axis
+        # sequence/context parallelism (``sp_axis``): batch leaves with a
+        # sequence dim land SHARDED over the ring axis (dim 1), so when
+        # 'auto' attention promotes onto ring_attention the Q/K/V shards
+        # are already rotated into place — the shard_map boundary inside
+        # the step reshards nothing. The ring mesh context is a
+        # trace-time global (like set_attention_impl): the most recently
+        # constructed engine owns it — an engine WITHOUT sp_axis clears
+        # it, so its traces can never promote onto a dead engine's mesh.
+        if sp_axis is not None and sp_axis not in mesh.axis_names:
+            raise ValueError(
+                f"sp_axis {sp_axis!r} is not an axis of this mesh "
+                f"{tuple(mesh.axis_names)}")
+        self._sp_axis = sp_axis
+        from paddle_tpu.ops.attention import set_ring_context
+
+        set_ring_context(mesh, sp_axis, batch_axis=dim0)
+        if self._sp_axis is not None:
+            self._batch_sharding = NamedSharding(
+                mesh, P(dim0, self._sp_axis))
+        else:
+            self._batch_sharding = NamedSharding(mesh, P(dim0))
         repl = NamedSharding(mesh, P())
         self._repl = repl
 
@@ -386,24 +405,19 @@ class ParallelTrainStep:
                 loss = loss._value
             return loss.astype(jnp.float32), new_b
 
-        if recompute:
-            # True → full activation checkpointing (reference recompute
-            # meta-strategy). A string names a selective jax rematerialization
-            # policy, e.g. 'dots': keep matmul outputs, recompute the
-            # elementwise/norm/softmax tissue in backward — trades a little
-            # VPU recompute for not storing (and re-reading) those residuals.
-            if recompute is True:
-                forward_loss = jax.checkpoint(forward_loss, static_argnums=())
-            else:
-                policies = {
-                    "dots": jax.checkpoint_policies.checkpoint_dots,
-                    "dots_no_batch":
-                        jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-                    "nothing": jax.checkpoint_policies.nothing_saveable,
-                }
-                forward_loss = jax.checkpoint(
-                    forward_loss, static_argnums=(),
-                    policy=policies[str(recompute)])
+        # ``remat`` supersedes the all-or-nothing ``recompute`` flag (whose
+        # legacy vocabulary — False/True/'dots'/'dots_no_batch'/'nothing' —
+        # still works and maps onto the same policies): 'off' | 'full' |
+        # an explicit jax.checkpoint policy | 'auto', which MEASURES the
+        # compiled step's peak HBM against the chip's capacity at the
+        # first call (ops.remat_policy, fed by the PR 5 attribution layer)
+        # and escalates dots→nothing→offload only as far as needed.
+        from paddle_tpu.ops import remat_policy as _remat_policy
+
+        if remat is None:
+            remat = recompute
+        self._remat = _remat_policy.normalize(remat)
+        self._forward_loss_base = forward_loss
 
         # grouped small-param updates conflict with dim-sharded opt state
         group_small = (zero_stage == 0
@@ -424,23 +438,26 @@ class ParallelTrainStep:
         self._nan_names: list = []
         self._last_flags = None
 
-        def step_fn(params, buffers, opt_state, lr, batch):
-            inputs, labels = batch
-            (loss, new_buffers), grads = jax.value_and_grad(
-                forward_loss, has_aux=True)(params, buffers, inputs, labels)
-            new_params, new_opt = apply_optimizer_update(
-                opt, named, params, grads, opt_state, lr,
-                group_small=group_small)
-            flags = (finite_flags(self._nan_names, loss=loss, grad=grads,
-                                  param=new_params)
-                     if self._check_nan else None)
-            if self._guard_updates and flags is not None:
-                new_params, new_buffers, new_opt = select_if_finite(
-                    flags, (new_params, new_buffers, new_opt),
-                    (params, buffers, opt_state))
-            return new_params, new_buffers, new_opt, loss, flags
+        def step_fn_of(fwd):
+            def step_fn(params, buffers, opt_state, lr, batch):
+                inputs, labels = batch
+                (loss, new_buffers), grads = jax.value_and_grad(
+                    fwd, has_aux=True)(params, buffers, inputs, labels)
+                new_params, new_opt = apply_optimizer_update(
+                    opt, named, params, grads, opt_state, lr,
+                    group_small=group_small)
+                flags = (finite_flags(self._nan_names, loss=loss, grad=grads,
+                                      param=new_params)
+                         if self._check_nan else None)
+                if self._guard_updates and flags is not None:
+                    new_params, new_buffers, new_opt = select_if_finite(
+                        flags, (new_params, new_buffers, new_opt),
+                        (params, buffers, opt_state))
+                return new_params, new_buffers, new_opt, loss, flags
 
-        self._step_fn = step_fn
+            return step_fn
+
+        self._step_fn_of = step_fn_of
 
         # input placement is handled by the explicit device_put in __call__
         # (batch arity varies per model, so a static in_shardings tuple
@@ -452,17 +469,92 @@ class ParallelTrainStep:
             repl,
             repl if self._check_nan else None,  # None output = empty subtree
         )
-        self._jitted = tracked_jit(
-            step_fn,
-            name="fleet.train_step",
-            sig_argnums=(3, 4),  # lr + batch drift; params/opt state are fixed
-            donate_argnums=(0, 2) if donate else (),
-            out_shardings=out_shardings,
-        )
         self._out_shardings = out_shardings
         self._donate = donate
+        if self._remat == "auto":
+            # resolved against the FIRST batch's avals (remat candidates
+            # are lowered+compiled and their measured peak HBM laddered
+            # against the chip budget), then built once — no per-step work
+            self._step_fn = None
+            self._jitted = None
+        else:
+            self._build_jitted(_remat_policy.apply_policy(
+                forward_loss, self._remat))
         self._jitted_multi = None
         self._last_step_t = None  # inter-call interval ⇒ steady-state step time
+
+    # ----------------------------------------------------------------------
+    def _build_jitted(self, fwd):
+        self._step_fn = self._step_fn_of(fwd)
+        self._jitted = tracked_jit(
+            self._step_fn,
+            name="fleet.train_step",
+            sig_argnums=(3, 4),  # lr + batch drift; params/opt state are fixed
+            donate_argnums=(0, 2) if self._donate else (),
+            out_shardings=self._out_shardings,
+        )
+
+    def _candidate_jit(self, policy):
+        """A plain-jit twin of the step under remat ``policy``, with the
+        real out-shardings and donation so XLA's memory accounting
+        matches the step that will actually run (never tracked — probe
+        compiles must not pollute the attribution registry)."""
+        from paddle_tpu.ops import remat_policy
+
+        fn = self._step_fn_of(
+            remat_policy.apply_policy(self._forward_loss_base, policy))
+        return jax.jit(fn, donate_argnums=(0, 2) if self._donate else (),
+                       out_shardings=self._out_shardings)
+
+    def lower_cost(self, policy, inputs, labels):
+        """XLA's own cost accounting — exact peak HBM, flops, bytes — for
+        THIS engine's step compiled under remat ``policy`` (the
+        measurement ``remat='auto'`` ladders on). Leaves the engine's
+        live jitted step untouched; None when the candidate is
+        infeasible on this backend."""
+        from paddle_tpu.ops import remat_policy
+
+        batch = (_raw_tuple(inputs), _raw_tuple(labels))
+        batch = jax.device_put(batch, self._batch_shardings(batch))
+        args = (self._params, self._buffers, self._opt_state,
+                self._optimizer.lr_device_scalar(), batch)
+        return remat_policy.program_cost(self._candidate_jit(policy), args)
+
+    def _resolve_remat(self, lr, batch):
+        """remat='auto': measure candidate policies' peak HBM on this
+        call's avals (ops.remat_policy ladder) and build the jitted
+        step with the winner. Runs once, before the first compile."""
+        from paddle_tpu.ops import remat_policy
+
+        args = (self._params, self._buffers, self._opt_state, lr, batch)
+        chosen = remat_policy.resolve(
+            "fleet.train_step",
+            lambda policy: remat_policy.program_cost(
+                self._candidate_jit(policy), args))
+        self._build_jitted(
+            remat_policy.apply_policy(self._forward_loss_base, chosen))
+
+    def _batch_shardings(self, tree):
+        """Per-leaf sharding tree for one batch: with ``sp_axis`` set,
+        leaves whose dim 1 can carry sequence shards (divides the ring
+        size) take the (dp, sp) layout while everything else — 1-D
+        per-sample leaves (e.g. NSP labels), broadcast-dim masks
+        [b, 1, L, L], ragged class dims — stays dp-only; one pytree
+        device_put either way. The landing layout is a placement hint
+        for GSPMD (the ring's shard_map boundary reshards whatever
+        arrives), so dp-only is always SAFE, just not pre-rotated."""
+        if self._sp_axis is None:
+            return self._batch_sharding
+        dp_only = NamedSharding(self._mesh, P(self._batch_sharding.spec[0]))
+        sp = self._mesh.shape[self._sp_axis]
+
+        def leaf_sharding(a):
+            shape = getattr(a, "shape", ())
+            if len(shape) >= 2 and shape[1] >= sp and shape[1] % sp == 0:
+                return self._batch_sharding
+            return dp_only
+
+        return jax.tree_util.tree_map(leaf_sharding, tree)
 
     # ----------------------------------------------------------------------
     def _record_step_metrics(self, t_enter, n_steps, n_tokens, loss,
@@ -524,17 +616,19 @@ class ParallelTrainStep:
     def __call__(self, inputs, labels):
         _watchdog_heartbeat()
         t_enter = time.perf_counter()
-        compiles_before = self._jitted.tracker.compiles
         with _spans.span("step", cat="step",
                          step=self._optimizer._global_step):
             with _spans.span("h2d", cat="h2d"):
                 # ONE pytree transfer for the whole batch (single
                 # dispatch; an already-sharded array — e.g. from
                 # ``prefetch`` — passes through without a copy)
+                batch = (_raw_tuple(inputs), _raw_tuple(labels))
                 raw_in, raw_lab = jax.device_put(
-                    (_raw_tuple(inputs), _raw_tuple(labels)),
-                    self._batch_sharding)
+                    batch, self._batch_shardings(batch))
             lr = self._optimizer.lr_device_scalar()
+            if self._jitted is None:  # remat='auto': first batch's avals
+                self._resolve_remat(lr, (raw_in, raw_lab))
+            compiles_before = self._jitted.tracker.compiles
             opt_state = self._opt_state
             if self._offload:
                 # stream host-resident optimizer state into HBM (async
@@ -617,12 +711,34 @@ class ParallelTrainStep:
             # for the whole stacked window (single dispatch instead of
             # one per array)
             spec = self._batch_sharding.spec
-            win_sharding = NamedSharding(
+            win_full = NamedSharding(
                 self._mesh, P(*((None,) + tuple(spec))))
-            raw_in, raw_lab = jax.device_put(
-                (_raw_tuple(inputs), _raw_tuple(labels)), win_sharding)
+            win_sharding = win_full
+            window = (_raw_tuple(inputs), _raw_tuple(labels))
+            if self._sp_axis is not None:
+                # per-leaf, mirroring _batch_shardings: only stacked
+                # leaves whose dim 2 can carry sequence shards take the
+                # (None, dp, sp) spec — 1-D label leaves, broadcast-dim
+                # masks, and ragged dims stay (None, dp)
+                dp_only = NamedSharding(self._mesh, P(None, spec[0]))
+                sp = self._mesh.shape[self._sp_axis]
+
+                def win_leaf_sharding(a):
+                    shape = getattr(a, "shape", ())
+                    if (len(shape) >= 3 and shape[2] >= sp
+                            and shape[2] % sp == 0):
+                        return win_full
+                    return dp_only
+
+                win_sharding = jax.tree_util.tree_map(
+                    win_leaf_sharding, window)
+            raw_in, raw_lab = jax.device_put(window, win_sharding)
         n_steps = raw_in[0].shape[0]
 
+        if self._step_fn is None:  # remat='auto' not yet resolved
+            self._resolve_remat(
+                self._optimizer.lr_device_scalar(),
+                jax.tree_util.tree_map(lambda a: a[0], (raw_in, raw_lab)))
         if self._jitted_multi is None:
             step_fn = self._step_fn
             repl = self._repl

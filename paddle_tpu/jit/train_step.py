@@ -39,7 +39,7 @@ class TrainStep:
     def __init__(self, layer: Layer, loss_fn: Callable, optimizer: Optimizer,
                  donate: bool = True, mesh=None, in_shardings=None,
                  check_finite: Optional[bool] = None,
-                 guard_updates: bool = False):
+                 guard_updates: bool = False, remat="off"):
         self._layer = layer
         self._optimizer = optimizer
         self._loss_fn = loss_fn
@@ -66,65 +66,140 @@ class TrainStep:
         self._nan_names: list = []
         self._last_flags = None
 
-        def step_fn(params, buffers, opt_state, lr, batch):
-            inputs, labels = batch
+        # ``remat``: 'off' (default) | 'auto' (roofline-driven selective
+        # rematerialization — ops.remat_policy measures the compiled
+        # step's peak HBM against the chip's capacity at the first call
+        # and escalates dots→nothing→offload only as needed) | an
+        # explicit jax.checkpoint policy ('full'/'dots'/'dots_no_batch'/
+        # 'nothing'/'offload').
+        from ..ops import remat_policy as _remat_policy
 
-            def loss_of(p):
-                out, new_b = self._apply(p, buffers, *inputs)
-                loss = self._loss_fn(out, *labels)
-                if isinstance(loss, Tensor):
-                    loss = loss._value
-                return loss, new_b
+        self._remat = _remat_policy.normalize(remat)
 
-            (loss, new_buffers), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
-            if opt._grad_clip is not None:
-                from ..nn.clip import ClipGradByGlobalNorm, clip_grads_global_norm_raw
+        def forward_loss(p, buffers, inputs, labels):
+            out, new_b = self._apply(p, buffers, *inputs)
+            loss = self._loss_fn(out, *labels)
+            if isinstance(loss, Tensor):
+                loss = loss._value
+            return loss, new_b
 
-                if isinstance(opt._grad_clip, ClipGradByGlobalNorm):
-                    grads = clip_grads_global_norm_raw(grads, opt._grad_clip.clip_norm)
-            new_params = {}
-            new_opt_state = {}
-            for name, p in params.items():
-                st = opt_state[name]
-                # multi_precision: all pre-update math (L2 fold, AdamW
-                # decay) runs on the f32 master, like apply_optimizer_update
-                master = (st.get("master")
-                          if isinstance(st, dict) else None)
-                p_eff = master if master is not None else p
-                g = grads[name].astype(p_eff.dtype)
-                wd = opt._decay_coeff(self._named_params[name])
-                if wd and type(opt).__name__ != "AdamW":
-                    g = g + wd * p_eff
-                if type(opt).__name__ == "AdamW" and getattr(opt, "_coeff", 0.0):
-                    decay = True
-                    if opt._apply_decay_param_fun is not None:
-                        decay = opt._apply_decay_param_fun(name)
-                    if decay:
-                        p_eff = p_eff * (1.0 - lr * opt._coeff)
-                if master is not None:
-                    sub = {k: v for k, v in st.items() if k != "master"}
-                    new_master, ns = opt._update(p_eff, g, sub, lr)
-                    ns["master"] = new_master
-                    np_ = new_master.astype(p.dtype)
-                else:
-                    np_, ns = opt._update(p_eff, g, st, lr)
-                new_params[name] = np_
-                new_opt_state[name] = ns
-            flags = (finite_flags(self._nan_names, loss=loss, grad=grads,
-                                  param=new_params)
-                     if self._check_nan else None)
-            if self._guard_updates and flags is not None:
-                from ..core.sanitizer import select_if_finite
+        self._forward_loss_base = forward_loss
 
-                new_params, new_buffers, new_opt_state = select_if_finite(
-                    flags, (new_params, new_buffers, new_opt_state),
-                    (params, buffers, opt_state))
-            return new_params, new_buffers, new_opt_state, loss, flags
+        def step_fn_of(fwd):
+            def step_fn(params, buffers, opt_state, lr, batch):
+                inputs, labels = batch
+                (loss, new_buffers), grads = jax.value_and_grad(
+                    fwd, has_aux=True)(params, buffers, inputs, labels)
+                return self._finish_step(params, buffers, opt_state, lr,
+                                         loss, new_buffers, grads)
 
-        self._jitted = tracked_jit(step_fn, name="jit.train_step",
-                                   sig_argnums=(3, 4),
-                                   donate_argnums=(0, 2) if donate else ())
+            return step_fn
+
+        self._step_fn_of = step_fn_of
+        self._donate = donate
+        if self._remat == "auto":
+            self._jitted = None  # resolved (and built) at the first call
+        else:
+            self._build_jitted(
+                _remat_policy.apply_policy(forward_loss, self._remat))
         self._last_step_t = None  # inter-call interval ⇒ steady-state step time
+
+    def _build_jitted(self, fwd):
+        self._jitted = tracked_jit(
+            self._step_fn_of(fwd), name="jit.train_step",
+            sig_argnums=(3, 4),
+            donate_argnums=(0, 2) if self._donate else ())
+
+    def _candidate_jit(self, policy):
+        """A plain-jit twin of the step under remat ``policy`` with the
+        real donation, so XLA's aliasing accounting matches the step that
+        will actually run (never tracked — probe compiles must not
+        pollute the attribution registry)."""
+        from ..ops import remat_policy
+
+        fn = self._step_fn_of(
+            remat_policy.apply_policy(self._forward_loss_base, policy))
+        return jax.jit(fn, donate_argnums=(0, 2) if self._donate else ())
+
+    def lower_cost(self, policy, inputs, labels):
+        """XLA's own cost accounting — exact peak HBM, flops, bytes — for
+        this step compiled under remat ``policy`` (the measurement
+        ``remat='auto'`` ladders on); None when infeasible."""
+        from ..ops import remat_policy
+
+        batch = jax.device_put((
+            tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                  for a in inputs),
+            tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                  for a in labels)))
+        args = (self._params, self._buffers, self._opt_state,
+                self._optimizer.lr_device_scalar(), batch)
+        return remat_policy.program_cost(self._candidate_jit(policy), args)
+
+    def _resolve_remat(self, lr, batch):
+        """remat='auto': measure candidate policies' peak HBM on this
+        call's avals (ops.remat_policy ladder) and build the jitted step
+        with the winner. Runs once, before the first compile."""
+        from ..ops import remat_policy
+
+        args = (self._params, self._buffers, self._opt_state, lr, batch)
+        chosen = remat_policy.resolve(
+            "jit.train_step",
+            lambda policy: remat_policy.program_cost(
+                self._candidate_jit(policy), args))
+        self._build_jitted(
+            remat_policy.apply_policy(self._forward_loss_base, chosen))
+
+    def _finish_step(self, params, buffers, opt_state, lr, loss,
+                     new_buffers, grads):
+        """Traced tail of the step: clip, optimizer update, finite sweep,
+        guarded select. Shared by every remat variant of the forward."""
+        from ..core.sanitizer import finite_flags
+
+        opt = self._optimizer
+        if opt._grad_clip is not None:
+            from ..nn.clip import ClipGradByGlobalNorm, clip_grads_global_norm_raw
+
+            if isinstance(opt._grad_clip, ClipGradByGlobalNorm):
+                grads = clip_grads_global_norm_raw(grads, opt._grad_clip.clip_norm)
+        new_params = {}
+        new_opt_state = {}
+        for name, p in params.items():
+            st = opt_state[name]
+            # multi_precision: all pre-update math (L2 fold, AdamW
+            # decay) runs on the f32 master, like apply_optimizer_update
+            master = (st.get("master")
+                      if isinstance(st, dict) else None)
+            p_eff = master if master is not None else p
+            g = grads[name].astype(p_eff.dtype)
+            wd = opt._decay_coeff(self._named_params[name])
+            if wd and type(opt).__name__ != "AdamW":
+                g = g + wd * p_eff
+            if type(opt).__name__ == "AdamW" and getattr(opt, "_coeff", 0.0):
+                decay = True
+                if opt._apply_decay_param_fun is not None:
+                    decay = opt._apply_decay_param_fun(name)
+                if decay:
+                    p_eff = p_eff * (1.0 - lr * opt._coeff)
+            if master is not None:
+                sub = {k: v for k, v in st.items() if k != "master"}
+                new_master, ns = opt._update(p_eff, g, sub, lr)
+                ns["master"] = new_master
+                np_ = new_master.astype(p.dtype)
+            else:
+                np_, ns = opt._update(p_eff, g, st, lr)
+            new_params[name] = np_
+            new_opt_state[name] = ns
+        flags = (finite_flags(self._nan_names, loss=loss, grad=grads,
+                              param=new_params)
+                 if self._check_nan else None)
+        if self._guard_updates and flags is not None:
+            from ..core.sanitizer import select_if_finite
+
+            new_params, new_buffers, new_opt_state = select_if_finite(
+                flags, (new_params, new_buffers, new_opt_state),
+                (params, buffers, opt_state))
+        return new_params, new_buffers, new_opt_state, loss, flags
 
     def prefetch(self, batches, depth=2, buckets=None):
         """Wrap a ``(inputs, labels)`` batch iterator in a background
@@ -137,7 +212,6 @@ class TrainStep:
 
     def __call__(self, inputs, labels):
         _watchdog_heartbeat()
-        compiles_before = self._jitted.tracker.compiles
         with contextlib.ExitStack() as _stk:
             if not _spans.in_category("step"):
                 # hapi fit (or another loop-level owner) may already hold
@@ -155,6 +229,9 @@ class TrainStep:
                           for a in labels),
                 ))
             lr = self._optimizer.lr_device_scalar()
+            if self._jitted is None:  # remat='auto': first batch's avals
+                self._resolve_remat(lr, (raw_inputs, raw_labels))
+            compiles_before = self._jitted.tracker.compiles
             with _spans.span("compute", cat="compute"):
                 self._params, self._buffers, self._opt_state, loss, flags = \
                     self._jitted(

@@ -5,5 +5,9 @@ from .attention import (  # noqa: F401
     dot_product_attention,
     flash_attention,
     ring_attention,
+    set_attention_impl,
+    set_ring_context,
+    xla_attention,
 )
 from .fused import fused_adam_step, fused_layer_norm, fused_softmax_bias  # noqa: F401
+from . import remat_policy, tier_policy  # noqa: F401

@@ -15,6 +15,7 @@ Three tiers, one API:
 from __future__ import annotations
 
 import functools
+import logging
 import math
 import os
 from typing import Optional
@@ -23,9 +24,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+logger = logging.getLogger("paddle_tpu.ops")
+
 __all__ = [
     "blockwise_attention", "flash_attention", "ring_attention",
     "xla_attention", "dot_product_attention", "set_attention_impl",
+    "set_ring_context",
 ]
 
 # Attention implementation selector. 'auto' (default) picks per context:
@@ -239,6 +243,14 @@ def _flash_attention_impl(q, k, v, causal, block_q, block_k):
             and k.shape[2] == L)
     if on_tpu and fits:
         return _flash_fwd_pallas(q, k, v, causal, block_q, block_k)
+    if on_tpu:
+        # the kernel was on the table (TPU) and the SHAPE knocked it off:
+        # that silent 8-10x drop must be counted and named (off-TPU the
+        # blockwise path is the documented behavior, not a fallback)
+        _count_fallback(
+            "flash", q.shape,
+            f"shape does not tile the Pallas forward (needs L % "
+            f"{block_q}/{block_k} == 0, d % 128 == 0, Lq == Lk)")
     return blockwise_attention(q, k, v, causal=causal, block_k=block_k)
 
 
@@ -256,6 +268,11 @@ def jax_flash_attention(q, k, v, causal=False, block_q=None, block_k=None):
     bq = min(block_q or 512, L)
     bk = min(block_k or 512, L)
     if L % bq != 0 or L % bk != 0 or k.shape[2] != L:
+        if jax.default_backend() == "tpu":
+            _count_fallback(
+                "pallas", q.shape,
+                f"shape does not tile the jax flash kernel "
+                f"(L % {bq}/{bk} != 0 or Lq != Lk)")
         return flash_attention(q, k, v, causal)
     bs = BlockSizes(
         block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
@@ -294,63 +311,252 @@ flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 # ---------------------------------------------------------------------------
 # Ring attention (sequence/context parallelism over a mesh axis)
 # ---------------------------------------------------------------------------
-def ring_attention(q, k, v, axis_name, causal=False, block_k=512):
-    """Attention where q/k/v are sequence-sharded over ``axis_name``.
+def _shard_map_fn():
+    """shard_map across jax versions: ``jax.shard_map`` (new API,
+    replication checking keyword ``check_vma``) or
+    ``jax.experimental.shard_map.shard_map`` (0.4.x, ``check_rep``).
+    Returns a ``fn(f, mesh, in_specs, out_specs)`` wrapper with
+    replication checking disabled (ring's psums confuse the checker), or
+    None when neither API exists (callers keep their single-device
+    path)."""
+    sm = getattr(jax, "shard_map", None)
+    kw = "check_vma"
+    if sm is None:
+        try:
+            from jax.experimental.shard_map import shard_map as sm
+            kw = "check_rep"
+        except Exception:
+            return None
 
-    Must be called inside shard_map/pjit with ``axis_name`` in scope. Each
-    step every device computes blockwise attention between its local Q shard
-    and the K/V shard currently resident, folds the result into running
-    online-softmax statistics, then rotates K/V one hop around the ring
-    (lax.ppermute → ICI neighbor copy, overlapping with the next compute).
-    Differentiable end-to-end: jax reverses the permutes in the backward.
-    """
+    def wrap(f, mesh, in_specs, out_specs):
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **{kw: False})
+
+    return wrap
+
+
+def _ring_pass(q, k, v, axis_name, causal, fn, init):
+    """One full rotation of K/V around ``axis_name``: ``fn(carry, kc, vc,
+    q_off, kv_off)`` folds the resident shard into the carry, then K/V
+    (plus any extra carried-with-K/V leaves ``fn`` returns) hop one
+    neighbor (lax.ppermute → ICI point-to-point, overlapping the next
+    step's compute). Shared by the forward and the recompute backward."""
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
-    b, h, L_local, d = q.shape
-    scale = 1.0 / math.sqrt(d)
-
+    L_local = q.shape[2]
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
-    def local_block(qh, kh, vh, q_off, kv_off):
-        # returns (unnormalized acc, m, l) for one head
-        Lq = qh.shape[0]
-        Lk = kh.shape[0]
-        s = (qh.astype(jnp.float32) @ kh.astype(jnp.float32).T) * scale
-        q_pos = q_off + jnp.arange(Lq)
-        k_pos = kv_off + jnp.arange(Lk)
-        if causal:
-            mask = k_pos[None, :] <= q_pos[:, None]
-            s = jnp.where(mask, s, _NEG_INF)
-        m = s.max(axis=-1)
-        p = jnp.exp(s - m[:, None])
-        l = p.sum(axis=-1)
-        acc = p @ vh.astype(jnp.float32)
-        return acc, m, l
-
-    vblock = jax.vmap(jax.vmap(local_block, in_axes=(0, 0, 0, None, None)),
-                      in_axes=(0, 0, 0, None, None))
-
     def step(carry, i):
-        acc, m, l, kc, vc = carry
+        state, kc, vc, rotating = carry
         src_idx = (my_idx - i) % axis_size  # whose shard we currently hold
-        a_i, m_i, l_i = vblock(q, kc, vc, my_idx * L_local, src_idx * L_local)
-        m_new = jnp.maximum(m, m_i)
-        c_old = jnp.exp(m - m_new)
-        c_new = jnp.exp(m_i - m_new)
-        acc = acc * c_old[..., None] + a_i * c_new[..., None]
-        l = l * c_old + l_i * c_new
+        state, rotating = fn(state, kc, vc, my_idx * L_local,
+                             src_idx * L_local, rotating)
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
-        return (acc, m_new, l, kc, vc), None
+        rotating = jax.tree_util.tree_map(
+            lambda t: jax.lax.ppermute(t, axis_name, perm), rotating)
+        return (state, kc, vc, rotating), None
+
+    (state, _, _, rotating), _ = jax.lax.scan(
+        step, (init[0], k, v, init[1]), jnp.arange(axis_size))
+    return state, rotating
+
+
+def _ring_fwd_impl(q, k, v, axis_name, causal):
+    """Forward ring pass; returns (out, lse) with lse = m + log l per row
+    ([b, h, L_local]) — the flash-style statistic the recompute backward
+    normalizes against."""
+    b, h, L_local, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32) * scale
+
+    def fold(state, kc, vc, q_off, kv_off, _):
+        acc, m, l = state
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32))
+        if causal:
+            q_pos = q_off + jnp.arange(L_local)
+            k_pos = kv_off + jnp.arange(kc.shape[2])
+            s = jnp.where(k_pos[None, None, None, :]
+                          <= q_pos[None, None, :, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+        l = l * corr + p.sum(axis=-1)
+        return (acc, m_new, l), _
 
     acc0 = jnp.zeros((b, h, L_local, d), jnp.float32)
     m0 = jnp.full((b, h, L_local), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, L_local), jnp.float32)
-    (acc, m, l, _, _), _ = jax.lax.scan(
-        step, (acc0, m0, l0, k, v), jnp.arange(axis_size)
-    )
-    out = acc / jnp.maximum(l, 1e-30)[..., None]
-    return out.astype(q.dtype)
+    (acc, m, l), _ = _ring_pass(q, k, v, axis_name, causal, fold,
+                                ((acc0, m0, l0), ()))
+    l = jnp.maximum(l, 1e-30)
+    out = (acc / l[..., None]).astype(q.dtype)
+    return out, m + jnp.log(l)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_attention(q, k, v, axis_name, causal=False, block_k=512):
+    """Attention where q/k/v ([b, h, L_local, d]) are sequence-sharded
+    over ``axis_name``.
+
+    Must be called inside shard_map/pjit with ``axis_name`` in scope. Each
+    step every device computes attention between its local Q shard and the
+    K/V shard currently resident, folds the result into running
+    online-softmax statistics, then rotates K/V one hop around the ring
+    (lax.ppermute → ICI neighbor copy, overlapping with the next compute).
+
+    The backward is a hand-written recompute pass (custom_vjp, like the
+    flash/chunked tiers): the forward saves only the [b, h, L_local]
+    logsumexp — never the O(L·L/ring) probability blocks autodiff-through-
+    scan would stack per rotation — and the backward re-runs the ring,
+    recomputing each block's probabilities from the saved statistic while
+    dK/dV partial sums travel around the ring WITH the K/V shards they
+    belong to (after the full rotation they land back home).
+    ``block_k`` is accepted for tier-API compatibility; the local shard is
+    one block."""
+    out, _ = _ring_fwd_impl(q, k, v, axis_name, causal)
+    return out
+
+
+def _ring_fwd_rule(q, k, v, axis_name, causal, block_k):
+    out, lse = _ring_fwd_impl(q, k, v, axis_name, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd_rule(axis_name, causal, block_k, res, g):
+    q, k, v, out, lse = res
+    b, h, L_local, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    # delta = rowsum(dO ⊙ O) — the softmax-backward row statistic,
+    # computed once on the [.., d] output instead of any [.., L] block
+    delta = jnp.einsum("bhqd,bhqd->bhq", gf, out.astype(jnp.float32))
+
+    def fold(dq, kc, vc, q_off, kv_off, rotating):
+        dk, dv = rotating
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf,
+                       kc.astype(jnp.float32)) * scale
+        if causal:
+            q_pos = q_off + jnp.arange(L_local)
+            k_pos = kv_off + jnp.arange(kc.shape[2])
+            s = jnp.where(k_pos[None, None, None, :]
+                          <= q_pos[None, None, :, None], s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])  # normalized probs, recomputed
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vc.astype(jnp.float32))
+        ds = p * (dp - delta[..., None])
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds,
+                             kc.astype(jnp.float32)) * scale
+        # dK/dV partials for the shard CURRENTLY resident: they rotate
+        # onward with it and are complete once it returns home
+        dk = dk + jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
+        dv = dv + jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+        return dq, (dk, dv)
+
+    z = jnp.zeros((b, h, L_local, d), jnp.float32)
+    dq, (dk, dv) = _ring_pass(q, k, v, axis_name, causal, fold,
+                              (z, (z, z)))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+ring_attention.defvjp(_ring_fwd_rule, _ring_bwd_rule)
+
+
+# -- ring auto-promotion (engine-provided mesh context) ---------------------
+def _ring_min_seq() -> int:
+    """Minimum GLOBAL sequence length for 'auto' to route through the ring
+    (below it the per-hop latency beats the sharded-compute win). Read per
+    dispatch — trace-time only, so tests and bench configs can flip it."""
+    try:
+        return int(os.environ.get("PADDLE_TPU_ATTN_RING_MIN_SEQ", "8192"))
+    except ValueError:
+        return 8192
+
+
+_ring_ctx = {"mesh": None, "axis": None, "batch": None}
+
+
+def set_ring_context(mesh, axis: Optional[str], batch_axis=None) -> None:
+    """Engine hook (``fleet.ParallelTrainStep(sp_axis=...)``): register a
+    mesh axis carrying sequence shards so 'auto' can promote long-context
+    causal attention onto the ring. ``batch_axis`` names the mesh axis (or
+    axis tuple) the BATCH dim is sharded over, so the ring's shard_map
+    region keeps the engine's data parallelism instead of gathering the
+    batch. Read at TRACE time, like ``set_attention_impl`` — call before
+    building the step. ``axis=None`` clears."""
+    _ring_ctx["mesh"] = mesh if axis else None
+    _ring_ctx["axis"] = axis
+    _ring_ctx["batch"] = batch_axis if axis else None
+
+
+def _ring_auto_ok(L: int, causal: bool, bias) -> bool:
+    from . import tier_policy
+
+    mesh, axis = _ring_ctx["mesh"], _ring_ctx["axis"]
+    if mesh is None or axis is None or not causal or bias is not None:
+        return False
+    if axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+        return False
+    # an EXPLICIT policy override outranks promotion: a forced tier or a
+    # pinned heuristic must measure exactly what it names (the bench
+    # ablation legs depend on this); the unset default and 'bench' leave
+    # the engine's sp_axis request in force
+    forced = tier_policy.forced_mode()
+    if forced in ("xla", "blockwise", "flash_tpu", "pallas", "heuristic"):
+        return False
+    size = mesh.shape[axis]
+    if L % size != 0 or (L < _ring_min_seq() and forced != "ring"):
+        return False
+    return _shard_map_fn() is not None
+
+
+def _ring_unavailable_reason(L: int, causal: bool, bias) -> str:
+    """Why ``_ring_auto_ok`` said no, for the forced-ring fallback
+    warning — the operator gets the ACTUAL blocker, not a generic hint
+    (the usual failure is not a missing context at all)."""
+    mesh, axis = _ring_ctx["mesh"], _ring_ctx["axis"]
+    if mesh is None or axis is None:
+        return ("no ring mesh context is registered "
+                "(fleet.ParallelTrainStep(sp_axis=) / "
+                "ops.attention.set_ring_context)")
+    if not causal:
+        return "the ring path only supports causal attention"
+    if bias is not None:
+        return "the ring path does not support an attention bias"
+    if axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+        return (f"registered axis {axis!r} is not a multi-device axis of "
+                f"the mesh {dict(mesh.shape)}")
+    if L % mesh.shape[axis] != 0:
+        return (f"sequence length {L} does not divide the ring size "
+                f"{mesh.shape[axis]}")
+    if _shard_map_fn() is None:
+        return "this jax has no shard_map API"
+    return "the ring context was cleared by a later engine"
+
+
+def _ring_sharded(q, k, v, causal, blhd):
+    """Manually-partitioned ring region nested inside the engine's jitted
+    GSPMD program: shard_map over the registered mesh with the sequence
+    dim sharded on the ring axis — Q/K/V enter pre-rotated (the engine's
+    batch sharding already lands them sequence-sharded, so no resharding
+    happens at this boundary)."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh, axis = _ring_ctx["mesh"], _ring_ctx["axis"]
+    ba = _ring_ctx["batch"]  # keep the engine's dp sharding on the batch dim
+    sm = _shard_map_fn()
+    spec = P(ba, axis, None, None) if blhd else P(ba, None, axis, None)
+
+    def local(q_, k_, v_):
+        if blhd:  # local transpose to the ring's [b, h, l, d] layout
+            tr = lambda t: t.transpose(0, 2, 1, 3)
+            return tr(ring_attention(tr(q_), tr(k_), tr(v_), axis,
+                                     causal, 512))
+        return ring_attention(q_, k_, v_, axis, causal, 512)
+
+    return sm(local, mesh, (spec, spec, spec), spec)(q, k, v)
 
 
 # ---------------------------------------------------------------------------
@@ -643,38 +849,84 @@ def xla_attention(q, k, v, causal=False, bias=None, layout="bhld"):
 # ---------------------------------------------------------------------------
 # Public dispatch
 # ---------------------------------------------------------------------------
+# one-shot fallback warnings, keyed (tier, shape, reason)
+_fallback_warned: set = set()
+
+
+def _count_fallback(tier: str, shape, reason: str) -> None:
+    """A dispatch decision silently rerouted off a fast tier: count it
+    (``counter/attn/tier_fallbacks`` — gated to ZERO over bench records
+    by tools/check_attribution.py) and warn once per (tier, shape). A
+    10x slowdown must never be invisible."""
+    from ..profiler.telemetry import get_telemetry
+
+    get_telemetry().counter("attn/tier_fallbacks")
+    key = (tier, tuple(shape), reason)
+    if key not in _fallback_warned:
+        _fallback_warned.add(key)
+        logger.warning(
+            "attention: %s tier fell back for shape %s — %s (counted in "
+            "counter/attn/tier_fallbacks; warned once per shape)",
+            tier, tuple(shape), reason)
+
+
+# impl-name → tier-policy name (the kernel impls split per backend)
+_TIER_OF_IMPL = {"jax_flash": "pallas", "flash": "pallas"}
+
+
 def dot_product_attention(q, k, v, causal=False, bias=None, sp_axis=None,
                           use_flash=True, layout="bhld"):
-    """Attention dispatch by context and ``set_attention_impl``:
-    ring (sp sharded) > selected impl > blockwise fallback.
+    """Attention dispatch by context, measurement, and
+    ``set_attention_impl``: ring (sp sharded, or auto-promoted when an
+    engine registered a ring mesh via ``set_ring_context`` and the
+    sequence is long enough) > the benchmarked tier policy
+    (``ops.tier_policy``, consulted by ``impl='auto'``) > the threshold
+    heuristic > blockwise fallback.
 
     ``layout='blhd'`` passes [b, l, h, d] operands straight into the XLA
-    path (no transpose copies); impls that need [b, h, l, d] get a
-    transposed view and transpose back."""
-    if layout == "blhd":
-        if sp_axis is None and bias is None and not _FORCE_BHLD:
-            impl = _resolve_impl(q.shape[1], bias, use_flash, causal)
-            if impl == "flash_tpu" and not _flash_tpu_fits(q, k, blhd=True):
-                # auto picked the kernel but the shape doesn't tile: keep
-                # the MEMORY-SAFE streaming path (the kernel's own fallback
-                # is the materialized O(L²) form — wrong for long L)
-                impl = "blockwise"
+    and flash_tpu paths (no transpose copies); impls that need
+    [b, h, l, d] get a transposed view and transpose back. All selection
+    happens at TRACE time: the chosen tier is baked into the compiled
+    program (zero per-step work, zero extra retraces)."""
+    from ..profiler.telemetry import get_telemetry
+    from . import tier_policy
+
+    blhd = layout == "blhd"
+    # trace-time fact: how many attention dispatches the compiled entry
+    # contains (marks a bench record "attention-bearing" for the tier
+    # gate); in eager mode it counts calls, which is equally true
+    get_telemetry().counter("attn/calls")
+    tr = lambda t: t.transpose(0, 2, 1, 3)
+    L = q.shape[1] if blhd else q.shape[2]
+    d = q.shape[-1]
+    if sp_axis is not None:
+        # explicit sequence-sharded call (L here is the LOCAL shard):
+        # the verdict gauge must still land — the tier gate requires one
+        # on every attention-bearing record
+        tier_policy.publish_tier(L, d, causal, "ring")
+        if blhd:
+            return tr(ring_attention(tr(q), tr(k), tr(v), sp_axis,
+                                     causal, 512))
+        return ring_attention(q, k, v, sp_axis, causal, 512)
+    if _IMPL == "auto" and _ring_auto_ok(L, causal, bias):
+        tier_policy.publish_tier(L, d, causal, "ring")
+        return _ring_sharded(q, k, v, causal, blhd)
+    impl = _select_impl(q, k, bias, use_flash, causal, blhd)
+    tier_policy.publish_tier(L, d, causal, _TIER_OF_IMPL.get(impl, impl))
+    if blhd:
+        if not _FORCE_BHLD:
             if impl == "flash_tpu":
                 from .flash_tpu import flash_attention_blhd
 
                 return flash_attention_blhd(q, k, v, causal)
-            if impl == "xla":
+            if impl == "xla" and bias is None:
                 return xla_attention(q, k, v, causal=causal, layout="blhd")
-        tr = lambda t: t.transpose(0, 2, 1, 3)
-        out = dot_product_attention(tr(q), tr(k), tr(v), causal=causal,
-                                    bias=bias, sp_axis=sp_axis,
-                                    use_flash=use_flash)
-        return tr(out)
-    if sp_axis is not None:
-        return ring_attention(q, k, v, sp_axis, causal=causal)
-    impl = _resolve_impl(q.shape[2], bias, use_flash, causal)
-    if impl == "flash_tpu" and not _flash_tpu_fits(q, k, blhd=False):
-        impl = "blockwise"
+        return tr(_apply_impl(impl, tr(q), tr(k), tr(v), causal, bias))
+    return _apply_impl(impl, q, k, v, causal, bias)
+
+
+def _apply_impl(impl, q, k, v, causal, bias):
+    """Run one resolved impl on [b, h, l, d] operands."""
     if impl == "flash_tpu":
         from .flash_tpu import flash_attention_blhd
 
@@ -687,6 +939,89 @@ def dot_product_attention(q, k, v, causal=False, bias=None, sp_axis=None,
     if impl == "xla":
         return xla_attention(q, k, v, causal=causal, bias=bias)
     return blockwise_attention(q, k, v, causal=causal, bias=bias)
+
+
+def _select_impl(q, k, bias, use_flash, causal, blhd):
+    """The impl this dispatch will take, both layouts agreeing: the
+    benchmarked tier policy when it has jurisdiction (``impl='auto'``,
+    unbiased, ``use_flash``), the measured-threshold heuristic
+    (``_resolve_impl``) otherwise."""
+    from . import tier_policy
+
+    L = q.shape[1] if blhd else q.shape[2]
+    if _IMPL == "auto" and bias is None and use_flash:
+        mode = tier_policy.policy_mode()
+        choice = None
+        if mode in ("xla", "blockwise", "flash_tpu", "pallas"):
+            choice = mode  # PADDLE_TPU_ATTN_POLICY forced tier wins
+        elif mode == "ring":
+            _count_fallback(
+                "ring", q.shape,
+                "PADDLE_TPU_ATTN_POLICY=ring but "
+                + _ring_unavailable_reason(L, causal, bias))
+        elif mode == "bench":
+            h = q.shape[2] if blhd else q.shape[1]
+            choice = tier_policy.select(
+                h, L, q.shape[-1], q.dtype, causal,
+                _tier_candidates(q, k, causal, blhd))
+        if choice is not None:
+            return _impl_of_tier(choice, q, k, causal, blhd)
+    impl = _resolve_impl(L, bias, use_flash, causal)
+    if impl == "flash_tpu" and not _flash_tpu_fits(q, k, blhd=blhd):
+        # the heuristic picked the kernel but the shape doesn't tile: keep
+        # the MEMORY-SAFE streaming path (the kernel's own fallback is the
+        # materialized O(L²) form — wrong for long L)
+        _count_fallback(
+            "flash_tpu", q.shape,
+            "shape does not tile onto the flash_tpu kernel (needs "
+            "Lq == Lk, L % 256 == 0, heads*dim % 128 == 0) — streaming "
+            "via blockwise instead, ~8-10x slower at long L")
+        impl = "blockwise"
+    return impl
+
+
+def _impl_of_tier(tier, q, k, causal, blhd):
+    """Map a tier-policy verdict onto a dispatchable impl name, with the
+    same shape safety net the heuristic path has."""
+    if tier == "flash_tpu":
+        if _flash_tpu_fits(q, k, blhd=blhd) and causal:
+            return "flash_tpu"
+        _count_fallback("flash_tpu", q.shape,
+                        "cached tier verdict no longer tiles this call — "
+                        "streaming via blockwise")
+        return "blockwise"
+    if tier == "pallas":
+        return "jax_flash" if jax.default_backend() == "tpu" else "flash"
+    return tier  # xla | blockwise
+
+
+def _tier_candidates(q, k, causal, blhd):
+    """Feasible tiers for the micro-bench: shape/backend gates only —
+    never preferences (preference is exactly what gets measured). The
+    xla candidate is capped at 2x its heuristic threshold so the bench
+    itself cannot OOM materializing scores for extreme L."""
+    if blhd:
+        L, H = q.shape[1], q.shape[2]
+        Lk = k.shape[1]
+    else:
+        H, L = q.shape[1], q.shape[2]
+        Lk = k.shape[2]
+    on_tpu = jax.default_backend() == "tpu"
+    cands = []
+    xla_cap = 2 * (_XLA_MAX_SEQ_CAUSAL if causal else _XLA_MAX_SEQ)
+    if Lk == L and L <= xla_cap:
+        cands.append("xla")
+    if (on_tpu and causal and not _NO_MOSAIC
+            and _flash_tpu_fits(q, k, blhd=blhd)):
+        cands.append("flash_tpu")
+    # mirror jax_flash_attention's own dispatch gate (L must tile its
+    # min(512, L) default blocks) — a candidate the kernel would bounce
+    # back off would time the FALLBACK under the 'pallas' label and could
+    # persist that mislabel to the verdict cache
+    if on_tpu and Lk == L and L % min(512, L) == 0:
+        cands.append("pallas")
+    cands.append("blockwise")
+    return cands
 
 
 def _flash_tpu_fits(q, k, blhd):

@@ -204,8 +204,18 @@ def flash_attention_blhd(q, k, v, causal=True, block=256):
 def _flash_fwd(q, k, v, causal, block):
     b, L, H, d = q.shape
     if not causal or not _fits(b, L, H, d, block):
-        from .attention import xla_attention
+        from .attention import _count_fallback, xla_attention
 
+        if jax.default_backend() == "tpu":
+            # reaching this on TPU means the kernel was called with a
+            # shape the dispatch gates should have filtered (or a direct
+            # caller bypassed them): count it so the reroute is never
+            # invisible (off-TPU the XLA path is documented behavior)
+            _count_fallback(
+                "flash_tpu", q.shape,
+                f"flash_attention_blhd cannot tile this shape (needs "
+                f"causal, L % {block} == 0, H*d % 128 == 0) — "
+                f"materializing via the XLA tier")
         return xla_attention(q, k, v, causal=causal, layout="blhd"), None
     scale = 1.0 / math.sqrt(d)
     q3 = q.reshape(b, L, H * d)

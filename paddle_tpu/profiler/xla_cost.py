@@ -58,6 +58,7 @@ __all__ = [
     "CostRecord", "CostRegistry", "cost_registry", "capture",
     "record_compile", "set_steps_per_call", "chip_peaks", "publish_mfu",
     "roofline_verdict", "reset", "cost_analysis_mode",
+    "hbm_capacity_bytes",
 ]
 
 logger = logging.getLogger("paddle_tpu.profiler")
@@ -81,8 +82,51 @@ _CHIP_PEAKS = (
 )
 _FALLBACK_PEAKS = (1e12, 100e9)
 
+# device_kind substring (lowercased) -> HBM capacity in bytes. Same
+# first-match-wins ordering as _CHIP_PEAKS. The CPU entry is a nominal
+# host budget so remat='auto' resolves to "fits, no remat" on test rigs
+# unless a test pins PADDLE_TPU_DEVICE_HBM_BYTES down to force the
+# escalation ladder.
+_CHIP_HBM = (
+    ("v5 lite", 16e9), ("v5litepod", 16e9), ("v5e", 16e9),
+    ("v5p", 95e9),
+    ("v6 lite", 32e9), ("v6e", 32e9),
+    ("v4", 32e9), ("v3", 32e9), ("v2", 16e9),
+    ("cpu", 64e9),
+)
+_FALLBACK_HBM = 32e9
+
 _peaks_cache = None
 _peaks_lock = threading.Lock()
+
+
+def hbm_capacity_bytes() -> float:
+    """Per-device HBM capacity in bytes — the budget ``ops.remat_policy``
+    sizes checkpoint policies against. ``PADDLE_TPU_DEVICE_HBM_BYTES``
+    overrides; else the device's own ``memory_stats()['bytes_limit']``
+    when the backend reports one; else the device-kind registry."""
+    try:
+        ov = float(os.environ.get("PADDLE_TPU_DEVICE_HBM_BYTES") or 0)
+        if ov > 0:
+            return ov
+    except ValueError:
+        pass
+    kind = "unknown"
+    try:
+        import jax
+
+        dev = jax.local_devices()[0]
+        kind = str(dev.device_kind).lower()
+        stats = dev.memory_stats()
+        limit = (stats or {}).get("bytes_limit", 0)
+        if limit and limit > 0:
+            return float(limit)
+    except Exception:
+        pass
+    for sub, cap in _CHIP_HBM:
+        if sub in kind:
+            return cap
+    return _FALLBACK_HBM
 
 
 def cost_analysis_mode() -> str:

@@ -29,6 +29,10 @@ python tools/check_retrace_budget.py TELEMETRY.jsonl --budget 6
 # non-zero compile/flops and compile/peak_hbm_bytes from the XLA cost
 # model plus a live gauge/mfu. Perf numbers without a denominator are
 # how a rig quietly settles at 8% MFU; this keeps the denominator wired.
+# Also the TIER gate: attention-bearing records must carry the selected
+# gauge/attn/tier.* verdict and ZERO counter/attn/tier_fallbacks — a
+# shape silently streaming through blockwise is a ~10x cliff that fails
+# the ritual instead of hiding in a log line.
 python tools/check_attribution.py TELEMETRY.jsonl
 
 # tpu-lint gate: the STATIC twin of the retrace-budget gate — AST

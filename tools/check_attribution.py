@@ -14,6 +14,17 @@ MFU columns quietly vanish — this gate makes that loud: every
 - ``gauge/mfu`` in (0, 100]          (the step-latency histograms and
                                       per-chip peak registry connected).
 
+Tier gate (PR 8): a record whose compiled entries dispatched attention
+(``counter/attn/calls`` > 0) must additionally carry
+
+- at least one ``gauge/attn/tier.<shape>`` >= 0 (the tier-selection
+  policy published a verdict for every attention shape — a dispatch
+  path bypassing ``ops.tier_policy`` would silently lose the kernel
+  choice the bench is supposed to prove), and
+- ``counter/attn/tier_fallbacks`` == 0 (no dispatch silently rerouted
+  off a fast tier mid-bench; a fallback is a ~10x cliff that must fail
+  the ritual, not hide in a log line).
+
 Usage:
     python tools/check_attribution.py TELEMETRY.jsonl \
         [--tag-prefix bench/] [--json]
@@ -70,7 +81,30 @@ def check_file(path, tag_prefix="bench/"):
                     violations.append(
                         f"line {lineno} ({tag}): {name} = {v!r}, "
                         f"want {want}")
+            violations.extend(
+                f"line {lineno} ({tag}): {msg}"
+                for msg in _tier_violations(scalars))
     return n, violations
+
+
+def _tier_violations(scalars):
+    """Tier-gate checks for one attention-bearing record's scalars."""
+    calls = scalars.get("counter/attn/calls") or 0
+    if not isinstance(calls, (int, float)) or calls <= 0:
+        return  # no attention in this config's compiled entries
+    tiers = {k: v for k, v in scalars.items()
+             if k.startswith("gauge/attn/tier.")}
+    if not tiers:
+        yield (f"counter/attn/calls = {calls:g} but no gauge/attn/tier.* "
+               f"— the dispatch bypassed ops.tier_policy's verdict")
+    for k, v in sorted(tiers.items()):
+        if not isinstance(v, (int, float)) or v < 0:
+            yield f"{k} = {v!r}, want a tier id >= 0"
+    fb = scalars.get("counter/attn/tier_fallbacks", 0)
+    if not isinstance(fb, (int, float)) or fb != 0:
+        yield (f"counter/attn/tier_fallbacks = {fb!r}, want 0 — a "
+               f"dispatch silently rerouted off its fast tier (the "
+               f"one-shot warning in the run log names the shape)")
 
 
 def main(argv=None):
@@ -105,7 +139,8 @@ def main(argv=None):
                       json_mode=args.json)
     return finish(GATE, True,
                   f"{n} bench record(s) carry compile/flops, "
-                  f"compile/peak_hbm_bytes, and mfu",
+                  f"compile/peak_hbm_bytes, and mfu; attention-bearing "
+                  f"ones carry tier verdicts with zero fallbacks",
                   payload=payload, json_mode=args.json)
 
 
