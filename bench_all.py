@@ -186,27 +186,67 @@ def bench_bert_dp():
     config = bert_tiny() if SMOKE else bert_base(hidden_dropout=0.0,
                                                  attention_dropout=0.0)
     b, L = (4, 64) if SMOKE else (32, 128)  # phase-1 pretrain shape
-    model = BertForPretraining(config)
-    opt = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
-                                 parameters=model.parameters())
     # fleet DP engine; one chip here = dp world of 1, the same compiled
     # path the 8-device CPU-mesh parity tests exercise with dp=8
     mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
-    step = ParallelTrainStep(
-        model, loss_fn=model.loss_fn, optimizer=opt, mesh=mesh,
-        compute_dtype=None if SMOKE else jnp.bfloat16)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, config.vocab_size, (b, L)).astype(np.int32)
     mlm = np.where(rng.rand(b, L) < 0.15, ids, -100).astype(np.int32)
     nsp = rng.randint(0, 2, b).astype(np.int64)
 
+    # silent-corruption defense cost (resilience.integrity): the same
+    # config built with in-jit state fingerprinting, measured with the
+    # fold firing on EVERY timed step (fingerprint_every=1) — at the
+    # production interval of 100 the due step would land inside _rate's
+    # warmup and the timed window (<100 steps) would price only the
+    # cond-false branch, never the tree reduction the column exists to
+    # bound. The per-fold cost divided by the production interval is the
+    # amortized overhead the "<1% step time at fingerprint_every=100"
+    # acceptance bar is judged on. Measured BEFORE the headline leg so
+    # (a) the fp engine pays any process cold-start tax (conservative
+    # bias) and (b) a telemetry reset leaves the headline record
+    # carrying ONLY the main engine's attribution. FRESH model +
+    # optimizer per engine: the jitted step donates the arrays the
+    # layer handed it, so a second engine over the same objects would
+    # read deleted buffers.
+    _FP_PRODUCTION_EVERY = 100
+    paddle.seed(0)
+    model_fp = BertForPretraining(config)
+    opt_fp = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                                    parameters=model_fp.parameters())
+    step_fp = ParallelTrainStep(
+        model_fp, loss_fn=model_fp.loss_fn, optimizer=opt_fp, mesh=mesh,
+        compute_dtype=None if SMOKE else jnp.bfloat16,
+        fingerprint_every=1)
+    # 20 smoke iters (not the usual 3): this column is a RATIO of two
+    # rates, so per-leg noise doubles — 3-iter CPU rates swing ±11%
+    sps_fp = _rate(lambda i: step_fp((ids,), (mlm, nsp)),
+                   2, 20 if SMOKE else 30) * b
+    del step_fp, model_fp, opt_fp
+    from paddle_tpu.profiler import get_telemetry
+
+    get_telemetry().reset()
+
+    paddle.seed(0)
+    model = BertForPretraining(config)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                                 parameters=model.parameters())
+    step = ParallelTrainStep(
+        model, loss_fn=model.loss_fn, optimizer=opt, mesh=mesh,
+        compute_dtype=None if SMOKE else jnp.bfloat16)
+
     def one(i):
         return step((ids,), (mlm, nsp))
 
-    sps = _rate(one, 2, 3 if SMOKE else 30) * b
+    sps = _rate(one, 2, 20 if SMOKE else 30) * b
+    fold_pct = (sps / sps_fp - 1.0) * 100  # fold cost as % of a step
     out = {"metric": "bert_base_dp_pretrain_samples_per_sec_per_chip",
            "value": round(sps, 2), "unit": "samples/sec",
-           "tokens_per_sec": round(sps * L, 2)}
+           "tokens_per_sec": round(sps * L, 2),
+           "fingerprint_samples_per_sec": round(sps_fp, 2),
+           "fingerprint_fold_overhead_pct": round(fold_pct, 3),
+           "fingerprint_overhead_pct": round(
+               fold_pct / _FP_PRODUCTION_EVERY, 4)}
     if not SMOKE:
         # 6·N FLOP/token with N = transformer params (BERT-base ~86M
         # non-embedding) + MLM head matmul 2·h·V fwd ·3

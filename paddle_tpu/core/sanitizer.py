@@ -22,7 +22,8 @@ import numpy as np
 from .flags import flag_value
 
 __all__ = ["jit_check_enabled", "finite_flags", "finite_report",
-           "raise_if_nonfinite", "select_if_finite"]
+           "raise_if_nonfinite", "select_if_finite",
+           "tree_fingerprint", "zero_fingerprint"]
 
 
 def jit_check_enabled() -> bool:
@@ -63,6 +64,72 @@ def select_if_finite(flags, new_tree, old_tree):
     ok = jnp.all(flags)
     return jax.tree_util.tree_map(lambda a, b: jnp.where(ok, a, b),
                                   new_tree, old_tree)
+
+
+def _xor_fold_leaf(leaf):
+    """XOR-fold one array leaf to a single uint32, bit-exactly: every
+    flipped bit in the leaf flips the result. The bitcast preserves the
+    leaf's raw representation (no value rounding), so two states that
+    differ by ONE mantissa bit — the silent-corruption case a float
+    tolerance would wave through — fold to different words."""
+    x = leaf
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        x = jnp.concatenate([jnp.real(x).ravel(), jnp.imag(x).ravel()])
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.uint8)
+    size = jnp.dtype(x.dtype).itemsize
+    if size == 1:
+        u = jax.lax.bitcast_convert_type(x, jnp.uint8).astype(jnp.uint32)
+    elif size == 2:
+        u = jax.lax.bitcast_convert_type(x, jnp.uint16).astype(jnp.uint32)
+    else:
+        # 4-byte dtypes map 1:1; 8-byte dtypes gain a trailing dim of 2
+        # 32-bit words — folded like any other axis
+        u = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    return jax.lax.reduce(u, np.uint32(0), jax.lax.bitwise_xor,
+                          tuple(range(u.ndim)))
+
+
+def zero_fingerprint():
+    """The fingerprint aval twin ``tree_fingerprint`` returns — the
+    not-computed branch of the in-jit ``lax.cond`` gate must produce the
+    same structure and dtypes."""
+    return {"sum": jnp.zeros((), jnp.float32),
+            "abs_sum": jnp.zeros((), jnp.float32),
+            "xor": jnp.zeros((), jnp.uint32)}
+
+
+def tree_fingerprint(*trees):
+    """Trace-time state fingerprint: fold every leaf of the given
+    pytrees into three scalars — a float32 sum, a float32 abs-sum, and a
+    bit-exact uint32 XOR word (``_xor_fold_leaf`` per leaf, rotated into
+    the accumulator so leaf order matters).
+
+    Runs INSIDE a compiled step: a handful of fused reduces over state
+    already resident in HBM, returning scalars the host can fetch
+    without materializing anything large. Deterministic for a fixed
+    compiled program, so two DP replicas executing the same program on
+    the same values produce bit-identical fingerprints — any
+    disagreement is divergence (see ``resilience.integrity``). Float
+    leaves contribute to all three folds; integer/bool leaves contribute
+    to the XOR word only (their sum has no shared float carrier).
+    """
+    total = jnp.zeros((), jnp.float32)
+    abs_total = jnp.zeros((), jnp.float32)
+    xor_total = jnp.zeros((), jnp.uint32)
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if not hasattr(leaf, "dtype"):
+                leaf = jnp.asarray(leaf)
+            if _float_leaf(leaf):
+                f = leaf.astype(jnp.float32)
+                total = total + jnp.sum(f)
+                abs_total = abs_total + jnp.sum(jnp.abs(f))
+            # rotate-then-xor: identical twin leaves at different tree
+            # positions cannot cancel to 0 the way a plain XOR chain would
+            xor_total = ((xor_total << 1) | (xor_total >> 31)) \
+                ^ _xor_fold_leaf(leaf)
+    return {"sum": total, "abs_sum": abs_total, "xor": xor_total}
 
 
 def finite_report(names, flags):

@@ -24,6 +24,9 @@ wrapped.)
 """
 from __future__ import annotations
 
+import json
+import os
+import time
 from typing import Optional
 
 import jax
@@ -34,9 +37,10 @@ from ..core.tensor import Tensor, apply_op, to_tensor, wrap_raw
 from .parallel import get_world_size
 
 __all__ = [
-    "ReduceOp", "all_reduce", "all_gather", "broadcast", "reduce", "scatter",
-    "alltoall", "reduce_scatter", "barrier", "send", "recv", "wait",
-    "new_group", "get_group", "split_group",
+    "ReduceOp", "all_reduce", "all_gather", "all_gather_object", "broadcast",
+    "reduce", "scatter", "alltoall", "reduce_scatter", "barrier", "send",
+    "recv", "wait", "new_group", "get_group", "split_group",
+    "launch_world_rank",
 ]
 
 
@@ -170,6 +174,137 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         tensor_list.extend(wrap_raw(p) for p in parts)
         return tensor_list
     return [wrap_raw(p) for p in parts]
+
+
+# fixed frame for the process-collective object path: process_allgather
+# needs identical shapes on every rank, and a fingerprint/ack record is
+# tiny — 4 KiB with a length prefix covers it with room to spare
+_OBJ_FRAME = 4096
+
+
+def launch_world_rank():
+    """(world, rank) from the launcher env contract — the source of
+    truth when jax process collectives are NOT initialized (the
+    single-host multi-process CPU topology the resilience gates run).
+    Shared by ``all_gather_object`` and ``resilience.integrity``; the
+    fault injector keeps its own no-jax-import twin
+    (``FaultInjector._rank``) because it must work before device init,
+    and this module imports jax at the top."""
+    try:
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1)
+    except ValueError:
+        world = 1
+    try:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    except ValueError:
+        rank = 0
+    return world, rank
+
+
+def all_gather_object(obj, key, rendezvous_dir=None, timeout_s=120.0,
+                      poll_s=0.05, rank=None, world_size=None,
+                      cleanup_prev=False):
+    """Eager host-side all-gather of ONE small JSON-serializable object
+    per rank; returns the ``world_size`` objects ordered by rank.
+
+    Transports, in preference order:
+
+    - **process collectives** (jax-distributed world matching
+      ``world_size``): the object rides a fixed-size length-prefixed
+      uint8 frame through ``multihost_utils.process_allgather``, under
+      the same :class:`resilience.cluster.CollectiveGuard` hang
+      conversion every eager collective here gets;
+    - **shared-filesystem rendezvous** (``rendezvous_dir``): each rank
+      atomically writes ``<key>.rank<r>.json`` and polls-with-deadline
+      for all peers, raising ``CollectiveTimeout`` past ``timeout_s`` —
+      the no-sockets topology ``ClusterCheckpoint`` already relies on.
+      ``key`` must be unique per logical collective (callers key on the
+      step). ``cleanup_prev=True`` unlinks this rank's PREVIOUS key's
+      file once the current gather completes: completing gather *k*
+      proves every rank finished gather *k-1* (it wrote *k* only after
+      reading all of *k-1*), so the *k-1* file is dead weight.
+
+    The fingerprint-divergence monitor (``resilience.integrity``) is the
+    primary consumer; anything needing a tiny cross-rank consensus
+    (config checks, cursor agreement) can reuse it.
+    """
+    world, env_rank = launch_world_rank()
+    if world_size is not None:
+        world = int(world_size)
+    r = env_rank if rank is None else int(rank)
+    if world <= 1:
+        return [obj]
+    try:
+        jax_world = jax.process_count()
+    except RuntimeError:
+        jax_world = 1
+    if jax_world == world:
+        from jax.experimental import multihost_utils
+
+        data = json.dumps(obj).encode()
+        if len(data) > _OBJ_FRAME - 8:
+            raise ValueError(
+                f"all_gather_object payload {len(data)}B exceeds the "
+                f"{_OBJ_FRAME - 8}B frame — this is a small-object "
+                f"consensus primitive, not a data channel")
+        frame = np.zeros(_OBJ_FRAME, np.uint8)
+        frame[:8] = np.frombuffer(
+            np.uint64(len(data)).tobytes(), np.uint8)
+        frame[8:8 + len(data)] = np.frombuffer(data, np.uint8)
+        with _hang_guard("all_gather_object"):
+            stacked = multihost_utils.process_allgather(frame)
+        out = []
+        for row in np.asarray(stacked):
+            n = int(np.frombuffer(row[:8].tobytes(), np.uint64)[0])
+            out.append(json.loads(row[8:8 + n].tobytes().decode()))
+        return out
+    if rendezvous_dir is None:
+        rendezvous_dir = os.environ.get("PADDLE_TPU_INTEGRITY_DIR")
+    if not rendezvous_dir:
+        raise RuntimeError(
+            f"all_gather_object: world size {world} but jax process "
+            f"collectives are not initialized and no rendezvous_dir "
+            f"(PADDLE_TPU_INTEGRITY_DIR) is set — no transport can carry "
+            f"the gather")
+    from ..framework.io import atomic_replace
+    from ..resilience.cluster import CollectiveTimeout
+
+    os.makedirs(rendezvous_dir, exist_ok=True)
+    mine = os.path.join(rendezvous_dir, f"{key}.rank{r}.json")
+
+    def _write(tmp):
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+
+    atomic_replace(mine, _write)
+    paths = [os.path.join(rendezvous_dir, f"{key}.rank{i}.json")
+             for i in range(world)]
+    deadline = time.monotonic() + float(timeout_s)
+    while not all(os.path.exists(p) for p in paths):
+        if time.monotonic() > deadline:
+            missing = [i for i, p in enumerate(paths)
+                       if not os.path.exists(p)]
+            raise CollectiveTimeout(
+                f"rank {r}: all_gather_object({key!r}) gave up waiting "
+                f"for rank(s) {missing} after {timeout_s:.1f}s — a peer "
+                f"rank is dead or hung")
+        time.sleep(float(poll_s))
+    out = []
+    for p in paths:
+        with open(p) as f:
+            out.append(json.load(f))
+    if cleanup_prev:
+        prev = _prev_gather_file.get((rendezvous_dir, r))
+        if prev and prev != mine:
+            try:
+                os.unlink(prev)
+            except OSError:
+                pass
+        _prev_gather_file[(rendezvous_dir, r)] = mine
+    return out
+
+
+_prev_gather_file: dict = {}
 
 
 def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,

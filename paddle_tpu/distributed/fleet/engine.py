@@ -255,7 +255,8 @@ class ParallelTrainStep:
                  zero_stage=0, recompute=False, compute_dtype=None,
                  donate=True, extra_batch_axes=(), offload=False,
                  master_weights=None, check_finite=None,
-                 guard_updates=False, remat=None, sp_axis=None):
+                 guard_updates=False, remat=None, sp_axis=None,
+                 fingerprint_every=None):
         self._layer = layer
         self._optimizer = optimizer
         self._loss_fn = loss_fn
@@ -438,8 +439,40 @@ class ParallelTrainStep:
         self._nan_names: list = []
         self._last_flags = None
 
+        # in-jit state fingerprints (resilience.integrity contract) —
+        # same trace-time gate as jit.TrainStep: the fingerprint code is
+        # compiled in at build time, due-ness per step rides a TRACED
+        # bool, so the retrace budget is untouched
+        from paddle_tpu.resilience.integrity import fingerprint_every_from_env
+
+        if fingerprint_every is None:
+            fingerprint_every = fingerprint_every_from_env()
+        self._fp_every = max(0, int(fingerprint_every))
+        import collections as _collections
+        import os as _os
+
+        self._fp_history: _collections.deque = _collections.deque(
+            maxlen=int(_os.environ.get("PADDLE_TPU_FP_HISTORY", "64") or 64))
+
+        def _with_fingerprint(new_params, new_buffers, new_opt, fp_due):
+            from ...core.sanitizer import tree_fingerprint, zero_fingerprint
+
+            # the state the program RETURNS (post-update, post-guarded-
+            # select) — reductions over sharded leaves are global, so
+            # every rank of a jax-distributed mesh computes the SAME
+            # scalars by construction and divergence detection targets
+            # replica worlds (independent processes, DP replicas)
+            return jax.lax.cond(
+                fp_due,
+                lambda: tree_fingerprint(new_params, new_opt, new_buffers),
+                zero_fingerprint)
+
         def step_fn_of(fwd):
-            def step_fn(params, buffers, opt_state, lr, batch):
+            """The 5-arg CORE step (scan body for run_steps). The
+            per-step jitted entry wraps it with the traced
+            fingerprint-due argument when fingerprinting is on
+            (``_wrap_fp``)."""
+            def step_core(params, buffers, opt_state, lr, batch):
                 inputs, labels = batch
                 (loss, new_buffers), grads = jax.value_and_grad(
                     fwd, has_aux=True)(params, buffers, inputs, labels)
@@ -455,7 +488,9 @@ class ParallelTrainStep:
                         (params, buffers, opt_state))
                 return new_params, new_buffers, new_opt, loss, flags
 
-            return step_fn
+            return step_core
+
+        self._with_fingerprint = _with_fingerprint
 
         self._step_fn_of = step_fn_of
 
@@ -468,7 +503,7 @@ class ParallelTrainStep:
             self._opt_shardings,
             repl,
             repl if self._check_nan else None,  # None output = empty subtree
-        )
+        ) + ((repl,) if self._fp_every else ())  # fingerprint scalars
         self._out_shardings = out_shardings
         self._donate = donate
         if self._remat == "auto":
@@ -484,10 +519,31 @@ class ParallelTrainStep:
         self._last_step_t = None  # inter-call interval ⇒ steady-state step time
 
     # ----------------------------------------------------------------------
+    def _wrap_fp(self, step_core):
+        """Per-step jit entry: the core plus the traced fingerprint-due
+        bool when fingerprinting is on (run_steps scans the CORE and
+        fingerprints the final carry instead)."""
+        if not self._fp_every:
+            return step_core
+
+        def step_fn(params, buffers, opt_state, lr, batch, fp_due):
+            new_params, new_buffers, new_opt, loss, flags = step_core(
+                params, buffers, opt_state, lr, batch)
+            fp = self._with_fingerprint(new_params, new_buffers, new_opt,
+                                        fp_due)
+            return new_params, new_buffers, new_opt, loss, flags, fp
+
+        return step_fn
+
+    def _fp_args(self):
+        """The trailing traced fingerprint-due argument (probe compiles
+        pass False — due-ness never changes the program signature)."""
+        return (jnp.asarray(False),) if self._fp_every else ()
+
     def _build_jitted(self, fwd):
         self._step_fn = self._step_fn_of(fwd)
         self._jitted = tracked_jit(
-            self._step_fn,
+            self._wrap_fp(self._step_fn),
             name="fleet.train_step",
             sig_argnums=(3, 4),  # lr + batch drift; params/opt state are fixed
             donate_argnums=(0, 2) if self._donate else (),
@@ -501,8 +557,8 @@ class ParallelTrainStep:
         compiles must not pollute the attribution registry)."""
         from paddle_tpu.ops import remat_policy
 
-        fn = self._step_fn_of(
-            remat_policy.apply_policy(self._forward_loss_base, policy))
+        fn = self._wrap_fp(self._step_fn_of(
+            remat_policy.apply_policy(self._forward_loss_base, policy)))
         return jax.jit(fn, donate_argnums=(0, 2) if self._donate else (),
                        out_shardings=self._out_shardings)
 
@@ -517,7 +573,7 @@ class ParallelTrainStep:
         batch = (_raw_tuple(inputs), _raw_tuple(labels))
         batch = jax.device_put(batch, self._batch_shardings(batch))
         args = (self._params, self._buffers, self._opt_state,
-                self._optimizer.lr_device_scalar(), batch)
+                self._optimizer.lr_device_scalar(), batch) + self._fp_args()
         return remat_policy.program_cost(self._candidate_jit(policy), args)
 
     def _resolve_remat(self, lr, batch):
@@ -526,7 +582,8 @@ class ParallelTrainStep:
         step with the winner. Runs once, before the first compile."""
         from paddle_tpu.ops import remat_policy
 
-        args = (self._params, self._buffers, self._opt_state, lr, batch)
+        args = (self._params, self._buffers, self._opt_state, lr, batch) \
+            + self._fp_args()
         chosen = remat_policy.resolve(
             "fleet.train_step",
             lambda policy: remat_policy.program_cost(
@@ -637,10 +694,24 @@ class ParallelTrainStep:
                     lambda s, sh: jax.device_put(s, sh)
                     if hasattr(s, "shape") else s,
                     opt_state, self._opt_shardings)
+            fp_due = bool(self._fp_every) and \
+                self._optimizer._global_step % self._fp_every == 0
             with _spans.span("compute", cat="compute"):
-                self._params, self._buffers, new_opt, loss, flags = \
-                    self._jitted(self._params, self._buffers, opt_state, lr,
-                                 (raw_in, raw_lab))
+                if self._fp_every:
+                    (self._params, self._buffers, new_opt, loss, flags,
+                     fp) = self._jitted(self._params, self._buffers,
+                                        opt_state, lr, (raw_in, raw_lab),
+                                        jnp.asarray(fp_due))
+                else:
+                    self._params, self._buffers, new_opt, loss, flags = \
+                        self._jitted(self._params, self._buffers, opt_state,
+                                     lr, (raw_in, raw_lab))
+        if self._fp_every and fp_due:
+            from paddle_tpu.resilience.integrity import publish_fingerprint
+
+            publish_fingerprint(self._fp_history,
+                                self._optimizer._global_step, fp,
+                                self._fp_every)
         if self._offload:
             # evacuate the updated state back to host DRAM, freeing HBM
             new_opt = jax.tree_util.tree_map(
@@ -742,8 +813,9 @@ class ParallelTrainStep:
         if self._jitted_multi is None:
             step_fn = self._step_fn
             repl = self._repl
+            with_fp = self._with_fingerprint
 
-            def multi_fn(params, buffers, opt_state, lrs, batches):
+            def multi_core(params, buffers, opt_state, lrs, batches):
                 def body(carry, step_in):
                     lr, batch = step_in[0], (step_in[1], step_in[2])
                     params, buffers, opt_state = carry
@@ -755,6 +827,19 @@ class ParallelTrainStep:
                     body, (params, buffers, opt_state),
                     (lrs, batches[0], batches[1]))
                 return params, buffers, opt_state, losses, flags
+
+            if self._fp_every:
+                # windows fingerprint the WINDOW-FINAL carry (one cond
+                # after the scan, not one per scanned step) when any
+                # step inside the window crossed the interval boundary
+                def multi_fn(params, buffers, opt_state, lrs, batches,
+                             fp_due):
+                    params, buffers, opt_state, losses, flags = multi_core(
+                        params, buffers, opt_state, lrs, batches)
+                    fp = with_fp(params, buffers, opt_state, fp_due)
+                    return params, buffers, opt_state, losses, flags, fp
+            else:
+                multi_fn = multi_core
 
             self._jitted_multi = tracked_jit(
                 multi_fn,
@@ -790,10 +875,24 @@ class ParallelTrainStep:
                 lambda s, sh: jax.device_put(s, sh)
                 if hasattr(s, "shape") else s,
                 opt_state, self._opt_shardings)
+        gs = self._optimizer._global_step
+        fp_due = bool(self._fp_every) and any(
+            (gs + k) % self._fp_every == 0 for k in range(int(n_steps)))
         with _spans.span("compute", cat="compute"):
-            self._params, self._buffers, new_opt, losses, flags = \
-                self._jitted_multi(self._params, self._buffers,
-                                   opt_state, lrs, (raw_in, raw_lab))
+            if self._fp_every:
+                (self._params, self._buffers, new_opt, losses, flags,
+                 fp) = self._jitted_multi(
+                    self._params, self._buffers, opt_state, lrs,
+                    (raw_in, raw_lab), jnp.asarray(fp_due))
+            else:
+                self._params, self._buffers, new_opt, losses, flags = \
+                    self._jitted_multi(self._params, self._buffers,
+                                       opt_state, lrs, (raw_in, raw_lab))
+        if self._fp_every and fp_due:
+            from paddle_tpu.resilience.integrity import publish_fingerprint
+
+            publish_fingerprint(self._fp_history,
+                                gs + int(n_steps) - 1, fp, self._fp_every)
         if self._offload:
             # evacuate once per window, freeing HBM between windows
             new_opt = jax.tree_util.tree_map(
@@ -825,6 +924,25 @@ class ParallelTrainStep:
         from paddle_tpu.resilience.guard import finite_report
 
         return finite_report(self._nan_names, self._last_flags)
+
+    @property
+    def fingerprint_every(self) -> int:
+        """The in-jit fingerprint interval (0 = off)."""
+        return self._fp_every
+
+    def last_fingerprint(self):
+        """The newest in-jit state fingerprint as ``(step, {"sum",
+        "abs_sum", "xor"})`` with host-fetched scalars, or None before
+        the first one (see jit.TrainStep.last_fingerprint)."""
+        if not self._fp_history:
+            return None
+        step, fp = self._fp_history[-1]
+        return step, {k: np.asarray(v) for k, v in fp.items()}
+
+    def fingerprint_history(self):
+        """Bounded per-rank history of (step, fingerprint) pairs, oldest
+        first (device scalars — fetch lazily)."""
+        return list(self._fp_history)
 
     def snapshot_state(self):
         """Deep sharding-preserving copy of the on-device train state —

@@ -19,6 +19,7 @@ import pickle
 import queue
 import signal
 import threading
+import time
 import traceback
 from typing import Callable, Optional
 
@@ -250,22 +251,36 @@ class _MultiProcessIter:
         self._reorder[batch_id] = (err, data)
         return True
 
+    # receive-poll quantum: short enough that dead-worker detection and
+    # deadline checks run promptly (a 2 s quantum made respawn latency —
+    # and tests exercising it — hostage to queue-timeout alignment under
+    # load), long enough to stay off the hot path (a record that IS
+    # coming returns immediately, the quantum only prices the idle poll)
+    _POLL_S = 0.25
+
     def _drain_outstanding(self):
         """Receive (and discard) every dispatched-but-unread record so the
         transport is empty before an epoch reset. Stops early if workers
-        died — the caller respawns in that case."""
-        deadline = 0.0
+        died — the caller respawns in that case. The deadline is a
+        monotonic-clock budget re-anchored on every received record, not
+        an accumulation of poll quanta (which under-counts time spent
+        inside successful receives under load)."""
+        budget = self._loader.timeout or 120.0
+        deadline = time.monotonic() + budget
         while self._rcvd_idx < self._send_idx:
             if self._rcvd_idx in self._reorder:
                 self._reorder.pop(self._rcvd_idx)
                 self._rcvd_idx += 1
                 continue
-            if not self._recv_one(timeout_s=2.0):
-                deadline += 2.0
-                if (any(not w.is_alive() for w in self._workers)
-                        or deadline >= (self._loader.timeout or 120.0)):
-                    self._shutdown()
-                    return
+            if self._recv_one(timeout_s=self._POLL_S):
+                deadline = time.monotonic() + budget
+                continue
+            # only a SILENT quantum consults liveness/deadline — records
+            # already in the transport always drain first
+            if any(not w.is_alive() for w in self._workers) \
+                    or time.monotonic() >= deadline:
+                self._shutdown()
+                return
         self._reorder.clear()
 
     def _reset(self):
@@ -297,38 +312,52 @@ class _MultiProcessIter:
             if not self._persistent:
                 self._shutdown()
             raise StopIteration
-        waited = 0.0
+        budget = self._loader.timeout or 120.0
+        deadline = time.monotonic() + budget
         while self._rcvd_idx not in self._reorder:
-            if not self._recv_one(timeout_s=2.0):
-                waited += 2.0
-                dead_slots = [w for w, p in enumerate(self._workers)
-                              if not p.is_alive()]
-                if dead_slots:
-                    # resilience retry layer: respawn each dead worker
-                    # ONCE and re-enqueue its unanswered batches; a
-                    # second death of the same slot (or any death under
-                    # an iterable dataset, whose stream position is
-                    # unrecoverable) propagates as before
-                    if (not self._iterable
-                            and not any(w in self._respawned
-                                        for w in dead_slots)):
-                        for w in dead_slots:
-                            self._respawn(w)
-                        # the respawned worker pays spawn + re-import +
-                        # recompute of re-enqueued batches — that must
-                        # not count against the receive timeout
-                        waited = 0.0
-                        continue
-                    self._shutdown()
-                    raise RuntimeError(
-                        f"DataLoader worker slot(s) {dead_slots} exited "
-                        "unexpectedly (respawn budget exhausted). Note: "
-                        "workers start via spawn — datasets must be "
-                        "importable (defined in a module, not __main__/REPL)."
-                    )
-                if waited >= (self._loader.timeout or 120.0):
-                    self._shutdown()
-                    raise RuntimeError("DataLoader worker timed out")
+            if self._recv_one(timeout_s=self._POLL_S):
+                # progress re-anchors the deadline: the budget bounds
+                # SILENCE, not total epoch time. Receive comes FIRST so
+                # a dead worker's already-computed, already-sent results
+                # are drained and delivered before its death is acted
+                # on — acting on liveness while deliverable records sit
+                # in the transport would discard them (and, on the
+                # respawn path, recompute them).
+                deadline = time.monotonic() + budget
+                continue
+            # nothing arrived this quantum: consult liveness. The short
+            # quantum (vs the old 2 s receive timeout) is the deflake —
+            # dead-worker detection latency no longer depends on a long
+            # queue timeout lining up with the death under load.
+            dead_slots = [w for w, p in enumerate(self._workers)
+                          if not p.is_alive()]
+            if dead_slots:
+                # resilience retry layer: respawn each dead worker
+                # ONCE and re-enqueue its unanswered batches; a
+                # second death of the same slot (or any death under
+                # an iterable dataset, whose stream position is
+                # unrecoverable) propagates as before
+                if (not self._iterable
+                        and not any(w in self._respawned
+                                    for w in dead_slots)):
+                    for w in dead_slots:
+                        self._respawn(w)
+                    # the respawned worker pays spawn + re-import +
+                    # recompute of re-enqueued batches — a fresh
+                    # monotonic budget, not an accumulation reset, so
+                    # a loaded machine still gets the full window
+                    deadline = time.monotonic() + budget
+                    continue
+                self._shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker slot(s) {dead_slots} exited "
+                    "unexpectedly (respawn budget exhausted). Note: "
+                    "workers start via spawn — datasets must be "
+                    "importable (defined in a module, not __main__/REPL)."
+                )
+            if time.monotonic() >= deadline:
+                self._shutdown()
+                raise RuntimeError("DataLoader worker timed out")
         err, data = self._reorder.pop(self._rcvd_idx)
         batch_id = self._rcvd_idx
         self._rcvd_idx += 1

@@ -8,12 +8,14 @@ hapi.Model, the fleet data-parallel engine, and bench.py all build on this.
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from functools import partial
 from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
@@ -39,7 +41,8 @@ class TrainStep:
     def __init__(self, layer: Layer, loss_fn: Callable, optimizer: Optimizer,
                  donate: bool = True, mesh=None, in_shardings=None,
                  check_finite: Optional[bool] = None,
-                 guard_updates: bool = False, remat="off"):
+                 guard_updates: bool = False, remat="off",
+                 fingerprint_every: Optional[int] = None):
         self._layer = layer
         self._optimizer = optimizer
         self._loss_fn = loss_fn
@@ -66,6 +69,22 @@ class TrainStep:
         self._nan_names: list = []
         self._last_flags = None
 
+        # ``fingerprint_every`` (resilience.integrity contract): every N
+        # steps the compiled step folds params+opt-state+buffers into 3
+        # scalars (sum / abs-sum / bit-exact XOR) INSIDE the jit, gated
+        # by a TRACED bool argument — the gate is decided at build time,
+        # the due-ness per step at run time, so the retrace budget is
+        # untouched and off-interval steps skip the reduces at runtime.
+        from ..resilience.integrity import fingerprint_every_from_env
+
+        if fingerprint_every is None:
+            fingerprint_every = fingerprint_every_from_env()
+        self._fp_every = max(0, int(fingerprint_every))
+        import collections
+
+        self._fp_history: collections.deque = collections.deque(
+            maxlen=int(os.environ.get("PADDLE_TPU_FP_HISTORY", "64") or 64))
+
         # ``remat``: 'off' (default) | 'auto' (roofline-driven selective
         # rematerialization — ops.remat_policy measures the compiled
         # step's peak HBM against the chip's capacity at the first call
@@ -86,12 +105,21 @@ class TrainStep:
         self._forward_loss_base = forward_loss
 
         def step_fn_of(fwd):
-            def step_fn(params, buffers, opt_state, lr, batch):
-                inputs, labels = batch
-                (loss, new_buffers), grads = jax.value_and_grad(
-                    fwd, has_aux=True)(params, buffers, inputs, labels)
-                return self._finish_step(params, buffers, opt_state, lr,
-                                         loss, new_buffers, grads)
+            if self._fp_every:
+                def step_fn(params, buffers, opt_state, lr, batch, fp_due):
+                    inputs, labels = batch
+                    (loss, new_buffers), grads = jax.value_and_grad(
+                        fwd, has_aux=True)(params, buffers, inputs, labels)
+                    return self._finish_step(params, buffers, opt_state, lr,
+                                             loss, new_buffers, grads,
+                                             fp_due=fp_due)
+            else:
+                def step_fn(params, buffers, opt_state, lr, batch):
+                    inputs, labels = batch
+                    (loss, new_buffers), grads = jax.value_and_grad(
+                        fwd, has_aux=True)(params, buffers, inputs, labels)
+                    return self._finish_step(params, buffers, opt_state, lr,
+                                             loss, new_buffers, grads)
 
             return step_fn
 
@@ -133,8 +161,14 @@ class TrainStep:
             tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a)
                   for a in labels)))
         args = (self._params, self._buffers, self._opt_state,
-                self._optimizer.lr_device_scalar(), batch)
+                self._optimizer.lr_device_scalar(), batch) \
+            + self._fp_args()
         return remat_policy.program_cost(self._candidate_jit(policy), args)
+
+    def _fp_args(self):
+        """The trailing traced fingerprint-due argument (probe compiles
+        pass False — due-ness never changes the program signature)."""
+        return (jnp.asarray(False),) if self._fp_every else ()
 
     def _resolve_remat(self, lr, batch):
         """remat='auto': measure candidate policies' peak HBM on this
@@ -142,7 +176,8 @@ class TrainStep:
         with the winner. Runs once, before the first compile."""
         from ..ops import remat_policy
 
-        args = (self._params, self._buffers, self._opt_state, lr, batch)
+        args = (self._params, self._buffers, self._opt_state, lr, batch) \
+            + self._fp_args()
         chosen = remat_policy.resolve(
             "jit.train_step",
             lambda policy: remat_policy.program_cost(
@@ -151,9 +186,10 @@ class TrainStep:
             remat_policy.apply_policy(self._forward_loss_base, chosen))
 
     def _finish_step(self, params, buffers, opt_state, lr, loss,
-                     new_buffers, grads):
+                     new_buffers, grads, fp_due=None):
         """Traced tail of the step: clip, optimizer update, finite sweep,
-        guarded select. Shared by every remat variant of the forward."""
+        guarded select, optional state fingerprint. Shared by every
+        remat variant of the forward."""
         from ..core.sanitizer import finite_flags
 
         opt = self._optimizer
@@ -199,6 +235,18 @@ class TrainStep:
             new_params, new_buffers, new_opt_state = select_if_finite(
                 flags, (new_params, new_buffers, new_opt_state),
                 (params, buffers, opt_state))
+        if self._fp_every:
+            from ..core.sanitizer import tree_fingerprint, zero_fingerprint
+
+            # fingerprint the state the step RETURNS (post-update,
+            # post-guarded-select — what the next step will carry); the
+            # runtime cond skips the reduces on off-interval steps
+            fp = jax.lax.cond(
+                fp_due,
+                lambda: tree_fingerprint(new_params, new_opt_state,
+                                         new_buffers),
+                zero_fingerprint)
+            return new_params, new_buffers, new_opt_state, loss, flags, fp
         return new_params, new_buffers, new_opt_state, loss, flags
 
     def prefetch(self, batches, depth=2, buckets=None):
@@ -232,12 +280,26 @@ class TrainStep:
             if self._jitted is None:  # remat='auto': first batch's avals
                 self._resolve_remat(lr, (raw_inputs, raw_labels))
             compiles_before = self._jitted.tracker.compiles
+            fp_due = bool(self._fp_every) and \
+                self._optimizer._global_step % self._fp_every == 0
             with _spans.span("compute", cat="compute"):
-                self._params, self._buffers, self._opt_state, loss, flags = \
-                    self._jitted(
+                if self._fp_every:
+                    (self._params, self._buffers, self._opt_state, loss,
+                     flags, fp) = self._jitted(
+                        self._params, self._buffers, self._opt_state, lr,
+                        (raw_inputs, raw_labels), jnp.asarray(fp_due))
+                else:
+                    (self._params, self._buffers, self._opt_state, loss,
+                     flags) = self._jitted(
                         self._params, self._buffers, self._opt_state, lr,
                         (raw_inputs, raw_labels),
                     )
+        if self._fp_every and fp_due:
+            from ..resilience.integrity import publish_fingerprint
+
+            publish_fingerprint(self._fp_history,
+                                self._optimizer._global_step, fp,
+                                self._fp_every)
         if self._check_nan:
             self._last_flags = flags
             if not self._guard_updates:
@@ -267,6 +329,26 @@ class TrainStep:
         from ..resilience.guard import finite_report
 
         return finite_report(self._nan_names, self._last_flags)
+
+    @property
+    def fingerprint_every(self) -> int:
+        """The in-jit fingerprint interval (0 = off)."""
+        return self._fp_every
+
+    def last_fingerprint(self):
+        """The newest in-jit state fingerprint as ``(step, {"sum",
+        "abs_sum", "xor"})`` with host-fetched scalars (bit-preserving
+        ``np.asarray`` — this is the sync point the divergence monitor
+        pays once per interval), or None before the first one."""
+        if not self._fp_history:
+            return None
+        step, fp = self._fp_history[-1]
+        return step, {k: np.asarray(v) for k, v in fp.items()}
+
+    def fingerprint_history(self):
+        """Bounded per-rank history of (step, fingerprint) pairs, oldest
+        first (device scalars — fetch lazily)."""
+        return list(self._fp_history)
 
     def snapshot_state(self):
         """Deep on-device copy of params/buffers/opt-state. A copy, not a

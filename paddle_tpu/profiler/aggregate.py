@@ -34,13 +34,20 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "read_jsonl", "rank_of_path", "final_scalars", "load_rank_scalars",
-    "cluster_view", "detect_stragglers", "detect_dead_ranks", "aggregate",
-    "STEP_HIST_PATTERN",
+    "cluster_view", "detect_stragglers", "detect_dead_ranks",
+    "detect_suspect_chips", "aggregate",
+    "STEP_HIST_PATTERN", "SDC_REPAIR_PATTERN",
 ]
 
 # any per-rank step-latency p50 qualifies for straggler comparison
 # (engine/, executor/, jit/, hapi/ producers all end in step_ms)
 STEP_HIST_PATTERN = re.compile(r"^hist/.*step_ms/p50$")
+
+# per-repaired-rank silent-corruption repair counter
+# (resilience.integrity bumps it on EVERY rank, naming the repaired one,
+# so any surviving rank's log carries the evidence)
+SDC_REPAIR_PATTERN = re.compile(
+    r"^counter/resilience/sdc_repaired\.rank(\d+)$")
 
 _RANK_RE = re.compile(r"rank[._-]?(\d+)")
 
@@ -152,6 +159,29 @@ def detect_stragglers(rank_scalars: Dict[int, Dict[str, float]],
     return findings
 
 
+def detect_suspect_chips(rank_scalars: Dict[int, Dict[str, float]],
+                         max_repairs: float = 1) -> List[dict]:
+    """Flag ranks whose silent-corruption repair count exceeds
+    ``max_repairs`` — one repair is a cosmic ray, repeated repairs of
+    the SAME rank are a marginal chip that will keep poisoning the
+    replica set until the hardware is replaced. The per-rank counters
+    (``counter/resilience/sdc_repaired.rank<i>``) are folded by max
+    across every reporting rank's log (all ranks record each repair
+    event, naming the repaired rank), so one surviving log is enough
+    evidence. Sorted worst-first."""
+    repairs: Dict[int, float] = {}
+    for scalars in rank_scalars.values():
+        for name, value in scalars.items():
+            m = SDC_REPAIR_PATTERN.match(name)
+            if m:
+                j = int(m.group(1))
+                repairs[j] = max(repairs.get(j, 0.0), float(value))
+    findings = [{"rank": j, "repairs": v, "max_repairs": float(max_repairs)}
+                for j, v in sorted(repairs.items()) if v > float(max_repairs)]
+    findings.sort(key=lambda f: -f["repairs"])
+    return findings
+
+
 def detect_dead_ranks(paths: Sequence[str],
                       rank_scalars: Dict[int, Dict[str, float]],
                       expected_ranks: int) -> List[dict]:
@@ -181,7 +211,8 @@ def detect_dead_ranks(paths: Sequence[str],
 
 def aggregate(paths: Sequence[str], threshold: float = 1.25,
               tag: Optional[str] = None,
-              expected_ranks: Optional[int] = None) -> dict:
+              expected_ranks: Optional[int] = None,
+              suspect_repairs: float = 1) -> dict:
     """One-call cluster report over per-rank JSONL paths. Each file is
     parsed exactly once; with a ``tag`` filter the records are folded
     twice — tag-filtered for the view, unfiltered for liveness — rather
@@ -208,6 +239,9 @@ def aggregate(paths: Sequence[str], threshold: float = 1.25,
         "view": cluster_view(rank_scalars),
         "stragglers": detect_stragglers(rank_scalars, threshold=threshold),
         "threshold": threshold,
+        "suspect_chips": detect_suspect_chips(rank_scalars,
+                                              max_repairs=suspect_repairs),
+        "suspect_repairs": float(suspect_repairs),
     }
     if expected_ranks is not None:
         # liveness is judged on UNFILTERED records: a healthy rank whose

@@ -25,13 +25,20 @@ saves, ``distributed.launch`` fail-fast watching):
   — coordinated manifest-verified checkpointing across ranks, with
   barrier/collective hangs converted into the restartable
   ``EXIT_WATCHDOG`` exit the ``distributed.launch`` supervisor
-  relaunches (README "Fault tolerance → Distributed recovery").
+  relaunches (README "Fault tolerance → Distributed recovery");
+- :class:`IntegrityMonitor` / :func:`selftest` (``integrity.py``) —
+  silent-corruption defense: in-jit state fingerprints (engines built
+  with ``fingerprint_every=N``), cross-rank divergence detection with
+  healthy-replica repair, logical checkpoint fingerprints, and the
+  golden-step self-test (README "Fault tolerance → Silent corruption").
 
 Telemetry: ``resilience/{nonfinite_steps,rollbacks,quarantined_batches,
 worker_respawns,restarts,job_restarts,rank_failures,watchdog_dumps,
-collective_timeouts,io_retries,spills,resumes,preempt_exits}`` counters
+collective_timeouts,io_retries,spills,resumes,preempt_exits,
+sdc_detected,sdc_repaired,selftest_runs,selftest_failures}`` counters
 plus ``ckpt/{commits,commit_ms,restores,manifest_verified,
-manifest_fallbacks}`` (README "Fault tolerance").
+manifest_fallbacks,fingerprint_mismatches}`` and
+``gauge/integrity/fingerprint.*`` (README "Fault tolerance").
 """
 from __future__ import annotations
 
@@ -56,6 +63,17 @@ from .inject import (  # noqa: F401
     active_injector,
     clear_injector,
     install_injector,
+)
+from .integrity import (  # noqa: F401
+    IntegrityError,
+    IntegrityMonitor,
+    IntegrityPolicy,
+    corrupt_param_bit,
+    fingerprint_digest,
+    golden_step_digest,
+    host_state_fingerprint,
+    pick_healthy,
+    selftest,
 )
 from .preemption import (  # noqa: F401
     EXIT_PREEMPTED,
@@ -82,6 +100,9 @@ __all__ = [
     "RecoveryPolicy", "StepGuard", "finite_report", "quarantine_batch",
     "load_quarantine", "replay_quarantine",
     "FaultInjector", "install_injector", "active_injector", "clear_injector",
+    "IntegrityError", "IntegrityMonitor", "IntegrityPolicy",
+    "corrupt_param_bit", "fingerprint_digest", "golden_step_digest",
+    "host_state_fingerprint", "pick_healthy", "selftest",
     "EXIT_PREEMPTED", "PreemptionHandler", "install_preemption_handler",
     "uninstall_preemption_handler", "preemption_requested",
     "clear_preemption_request", "exit_for_relaunch",

@@ -383,6 +383,14 @@ class ClusterCheckpoint:
         shard_path = os.path.join(tmp, shard)
         payload = {"state": _to_host(state), "step": int(step),
                    "rank": self.rank, "meta": meta}
+        # logical state fingerprint (resilience.integrity): a CRC over
+        # the state's VALUES, computed before serialization and
+        # recomputed after restore's load — catches device→disk→device
+        # corruption even when the per-file CRC (which hashes whatever
+        # bytes were written, corrupt or not) passes
+        from .integrity import host_state_fingerprint
+
+        state_fp = host_state_fingerprint(payload["state"])
         _io.save(payload, shard_path)  # atomic within the staging dir
         if self.rank == 0:
             def _write_token(tmp_path):
@@ -392,6 +400,7 @@ class ClusterCheckpoint:
             _io.atomic_replace(os.path.join(tmp, _TOKEN_NAME), _write_token)
         ack = {"file": shard, "crc32": _io.file_crc32(shard_path),
                "size": os.path.getsize(shard_path), "step": int(step),
+               "state_fp": int(state_fp["crc32"]),
                "attempt": _launch_attempt(),
                "token": self._token if self.rank == 0
                else _read_token(tmp)}
@@ -491,7 +500,10 @@ class ClusterCheckpoint:
             "format": 1, "generation": int(g), "step": int(step),
             "world_size": self.world_size, "ts": time.time(),
             "files": {a["file"]: {"crc32": int(a["crc32"]),
-                                  "size": int(a["size"])}
+                                  "size": int(a["size"]),
+                                  **({"state_fp": int(a["state_fp"])}
+                                     if a.get("state_fp") is not None
+                                     else {})}
                       for a in verified.values()},
             "meta": meta,
         }
@@ -555,10 +567,30 @@ class ClusterCheckpoint:
                     raise _io.CheckpointIntegrityError(
                         f"{gen_dir}: committed by a {manifest.get('world_size')}"
                         f"-rank job, this job has {self.world_size} ranks")
-                shard = os.path.join(gen_dir, f"shard-rank{self.rank}.ckpt")
+                shard_name = f"shard-rank{self.rank}.ckpt"
+                shard = os.path.join(gen_dir, shard_name)
                 # verify_generation just hashed every listed file, this
                 # shard included — skip load's second full read
                 payload = _io.load(shard, verify=False)
+                want_fp = (manifest.get("files", {}).get(shard_name, {})
+                           or {}).get("state_fp")
+                if want_fp is not None:
+                    # end-to-end logical verification: recompute the
+                    # state fingerprint from the DESERIALIZED values and
+                    # compare to what the committing rank computed from
+                    # its in-memory state — per-file CRCs only prove the
+                    # disk returned the bytes that were written, not
+                    # that those bytes were the state
+                    from .integrity import host_state_fingerprint
+
+                    got = host_state_fingerprint(payload["state"])["crc32"]
+                    if int(want_fp) != int(got):
+                        tel.counter("ckpt/fingerprint_mismatches")
+                        raise _io.CheckpointIntegrityError(
+                            f"{shard}: logical state fingerprint "
+                            f"{got:#010x} != committed {int(want_fp):#010x}"
+                            f" — bytes verified but values diverged "
+                            f"(serialization-path corruption)")
                 tel.counter("ckpt/manifest_verified")
             except _io.CheckpointIntegrityError as e:
                 tel.counter("ckpt/manifest_fallbacks")

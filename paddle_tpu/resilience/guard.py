@@ -23,7 +23,10 @@ continue, in layers:
 
 The guard is also the step-boundary host for the other resilience
 layers: it feeds the Watchdog heartbeat, checks the preemption flag
-(emergency checkpoint → ``EXIT_PREEMPTED``), and consults the active
+(emergency checkpoint → ``EXIT_PREEMPTED``), drives the silent-
+corruption ``IntegrityMonitor`` (``integrity=`` ctor arg — fingerprint
+exchange + healthy-replica repair, with this guard's rolling snapshot
+as the repair ladder's second rung), and consults the active
 ``FaultInjector`` so every one of these paths is testable
 deterministically.
 """
@@ -188,7 +191,8 @@ class StepGuard:
 
     def __init__(self, step, policy: Optional[RecoveryPolicy] = None,
                  scaler=None, injector=None,
-                 on_preempt: Optional[Callable[[], None]] = None):
+                 on_preempt: Optional[Callable[[], None]] = None,
+                 integrity=None):
         if not getattr(step, "_guard_updates", False):
             raise ValueError(
                 "StepGuard needs an engine built with guard_updates=True "
@@ -200,6 +204,14 @@ class StepGuard:
         self._scaler = scaler
         self._injector = injector
         self._on_preempt = on_preempt
+        # silent-corruption defense (resilience.integrity): the monitor
+        # consumes the engine's in-jit fingerprints at step boundaries,
+        # exchanges them across ranks, and repairs divergence from a
+        # healthy replica — with this guard's rolling snapshot as its
+        # second rung on the repair ladder
+        self._integrity = integrity
+        if integrity is not None and integrity._snapshot_restore is None:
+            integrity._snapshot_restore = self._restore_snapshot
         self.step_count = 0
         self._snap = None
         self._snap_meta = None
@@ -251,6 +263,13 @@ class StepGuard:
             self._check_preemption()  # same boundary sees the injected signal
             inj.maybe_kill_rank(step_i)   # SIGKILL: never returns if due
             inj.maybe_hang_rank(step_i)   # heartbeat starvation if due
+            if inj.bitflip_param_due(step_i):
+                # silent in-device corruption: finite, tiny, invisible
+                # to the NaN sweep — only the fingerprint divergence
+                # path (resilience.integrity) can catch it
+                from .integrity import corrupt_param_bit
+
+                corrupt_param_bit(self._engine)
             inputs = inj.corrupt_batch(step_i, inputs)
             inj.maybe_slow(step_i)
         if self._snap is None:
@@ -272,9 +291,35 @@ class StepGuard:
                 self._take_snapshot(self.step_count)
         else:
             self._handle_bad(step_i, inputs, labels, bad)
+        if self._integrity is not None:
+            # divergence check rides the SAME boundary on every rank
+            # (ranks run the loop in lockstep, so the exchange cannot
+            # deadlock against a peer that skipped it); on bad steps the
+            # fingerprint covers the KEPT state — the in-jit select ran
+            # before the fingerprint fold
+            self._integrity.after_step(self.step_count)
         return loss
 
     # -- internals ---------------------------------------------------------
+    def _restore_snapshot(self) -> bool:
+        """Integrity-monitor fallback rung: reinstall the rolling
+        last-good snapshot's ARRAYS (False when none exists yet).
+
+        Deliberately does NOT roll back the optimizer's global-step/LR
+        cursor the way the NaN rollback does: the surviving ranks are
+        still at the current loop position, and the fingerprint schedule
+        and exchange keys are derived from the step counter — a minority
+        rank that rewinds its cursor would fingerprint at different step
+        labels than its peers and deadlock every later exchange. Keeping
+        the cursor means this rung restores older-but-clean arrays at
+        the current position; the next interval's exchange then repairs
+        the remaining delta from the healthy replica (or re-detects)."""
+        if self._snap is None:
+            return False
+        self._engine.restore_state(self._snap)
+        get_telemetry().counter("resilience/rollbacks")
+        return True
+
     def _opt_meta(self):
         """Scalar optimizer state the array snapshot misses: the global
         step and the LR scheduler position. Without these, a resumed (or

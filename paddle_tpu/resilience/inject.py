@@ -24,7 +24,13 @@ consulted by the runtime itself:
   detects a hung rank;
 - ``corrupt_ckpt_due(generation)`` — ``ClusterCheckpoint`` flips a byte
   in one committed shard AFTER the commit (``corrupt_ckpt@n``), so the
-  manifest-verified restore path must catch it and fall back.
+  manifest-verified restore path must catch it and fall back;
+- ``bitflip_param_due(step)`` — StepGuard flips ONE low-mantissa bit of
+  one resident parameter at the step boundary when this rank matches
+  (``bitflip_param@step:r``, via ``resilience.integrity
+  .corrupt_param_bit``): silent in-device corruption — finite, tiny,
+  invisible to the NaN/Inf sweep — that only the bit-exact fingerprint
+  divergence path (``resilience.integrity``) can catch.
 
 Request-level faults (consulted by ``inference.serving``; indices are
 engine-assigned request ids / scheduler batch indices, so they replay
@@ -47,6 +53,7 @@ Env-driven for subprocess runs (the CI smoke gate, launch children):
 
     PADDLE_TPU_INJECT="nan@3,sigterm@7,slow@5:1.5,kill_worker@2"
     PADDLE_TPU_INJECT="kill_rank@4:1,hang_rank@2:0,corrupt_ckpt@1"
+    PADDLE_TPU_INJECT="bitflip_param@3:1"
     PADDLE_TPU_INJECT="slow_req@10:0.4,drop_req@12,deadline_storm@20:8"
 
 One-shot semantics: every injection fires at most once per injector.
@@ -101,6 +108,7 @@ class FaultInjector:
                  kill_worker_batches: Iterable[int] = (),
                  kill_rank_steps: Optional[Dict[int, int]] = None,
                  hang_rank_steps: Optional[Dict[int, int]] = None,
+                 bitflip_param_steps: Optional[Dict[int, int]] = None,
                  corrupt_ckpt_gens: Iterable[int] = (),
                  hang_seconds: float = 3600.0,
                  slow_req_ids: Optional[Dict[int, float]] = None,
@@ -117,6 +125,8 @@ class FaultInjector:
                                 for k, v in (kill_rank_steps or {}).items()}
         self.hang_rank_steps = {int(k): int(v)
                                 for k, v in (hang_rank_steps or {}).items()}
+        self.bitflip_param_steps = {
+            int(k): int(v) for k, v in (bitflip_param_steps or {}).items()}
         self.corrupt_ckpt_gens = {int(g) for g in corrupt_ckpt_gens}
         self.hang_seconds = float(hang_seconds)
         self.slow_req_ids = {int(k): float(v)
@@ -141,6 +151,7 @@ class FaultInjector:
         slow: Dict[int, float] = {}
         kill_rank: Dict[int, int] = {}
         hang_rank: Dict[int, int] = {}
+        bitflip: Dict[int, int] = {}
         slow_req: Dict[int, float] = {}
         storms: Dict[int, int] = {}
         for part in spec.split(","):
@@ -158,9 +169,10 @@ class FaultInjector:
                 sig.append(int(where))
             elif kind == "kill_worker":
                 kill.append(int(where))
-            elif kind in ("kill_rank", "hang_rank"):
+            elif kind in ("kill_rank", "hang_rank", "bitflip_param"):
                 step, _, r = where.partition(":")
-                target = kill_rank if kind == "kill_rank" else hang_rank
+                target = {"kill_rank": kill_rank, "hang_rank": hang_rank,
+                          "bitflip_param": bitflip}[kind]
                 target[int(step)] = int(r or 0)
             elif kind == "corrupt_ckpt":
                 corrupt.append(int(where))
@@ -176,7 +188,8 @@ class FaultInjector:
                 raise ValueError(f"unknown fault kind {kind!r} in {spec!r}")
         return cls(nan_steps=nan, sigterm_steps=sig, slow_steps=slow,
                    kill_worker_batches=kill, kill_rank_steps=kill_rank,
-                   hang_rank_steps=hang_rank, corrupt_ckpt_gens=corrupt,
+                   hang_rank_steps=hang_rank, bitflip_param_steps=bitflip,
+                   corrupt_ckpt_gens=corrupt,
                    slow_req_ids=slow_req, drop_req_ids=drop_req,
                    deadline_storms=storms, state_dir=state_dir)
 
@@ -285,6 +298,20 @@ class FaultInjector:
         self._count("hang_rank")
         time.sleep(self.hang_seconds)
         return self.hang_seconds
+
+    def bitflip_param_due(self, step: int) -> bool:
+        """True exactly once at a scheduled (step, rank) boundary when
+        THIS rank's resident state is due for a silent bit flip (the
+        flip itself lives in ``resilience.integrity.corrupt_param_bit``,
+        applied by StepGuard, which owns the engine). One-shot across
+        relaunches via the state-dir marker, like ``kill_rank``."""
+        r = self.bitflip_param_steps.get(int(step))
+        if r is None or r != self._rank():
+            return False
+        if not self._once(f"bitflip_param@{step}:{r}"):
+            return False
+        self._count("bitflip_param")
+        return True
 
     def slow_req(self, req_id: int) -> float:
         """Stall the caller (the serving scheduler, about to dispatch

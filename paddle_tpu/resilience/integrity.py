@@ -1,0 +1,584 @@
+"""Silent-corruption defense — detect-and-repair for finite-but-wrong.
+
+The rest of the resilience stack catches *loud* failures: NaN/Inf
+(StepGuard), crashed/hung ranks (launch supervisor, CollectiveGuard),
+torn or bit-rotted checkpoint files (manifest CRCs). The dominant
+residual failure at fleet scale is *silent* data corruption: an HBM bit
+flip or a marginal chip produces finite-but-wrong numbers, DP replicas
+quietly diverge, and the poison is committed to checkpoints as truth.
+This module closes that class, in four layers:
+
+1. **In-jit state fingerprints** — engines built with
+   ``fingerprint_every=N`` fold params + optimizer state + buffers into
+   three scalars *inside* the compiled step
+   (``core.sanitizer.tree_fingerprint``: f32 sum, f32 abs-sum, and a
+   bit-exact uint32 XOR word), gated by a **traced** bool so the
+   off-interval steps skip the reduces at runtime without a retrace.
+   Fingerprints are published as ``gauge/integrity/fingerprint.*``
+   (deferred device scalars — no step sync) and recorded into a bounded
+   per-rank history.
+
+2. **Cross-rank divergence detection + repair**
+   (:class:`IntegrityMonitor`) — DP replicas executing the same program
+   on the same data must agree *bit for bit*. Every fingerprint interval
+   the monitor exchanges fingerprint digests across ranks
+   (``distributed.communication.all_gather_object`` — process
+   collectives under ``CollectiveGuard`` on a jax-distributed world, a
+   shared-filesystem rendezvous elsewhere) and majority-votes on
+   mismatch: the minority rank(s) are repaired by re-publishing state
+   from a healthy rank (ties trust the lowest rank — run >= 3 replicas
+   for a true majority). If the healthy-replica repair cannot complete,
+   the ladder falls back to the StepGuard snapshot
+   (``snapshot_restore``) and then to ``ClusterCheckpoint.restore()``.
+   Counted in ``resilience/sdc_detected`` / ``resilience/sdc_repaired``
+   (+ ``sdc_repaired.rank<i>`` naming the repaired rank, the
+   SUSPECT-CHIP signal ``tools/telemetry_agg.py`` reports on).
+
+3. **End-to-end checkpoint integrity** — ``ClusterCheckpoint`` records
+   :func:`host_state_fingerprint` (a *logical* fingerprint over the
+   state's values, not the file's bytes) in its manifest at commit and
+   recomputes it after ``restore()`` load, so device→disk→device
+   corruption is caught even when every per-file CRC passes.
+
+4. **Golden-step self-test** (:func:`selftest`) — a canned
+   deterministic train-step compared bit-exactly against a stored golden
+   digest at startup/relaunch, flagging a bad chip or a miscompiling
+   toolchain before it eats real work. Goldens are keyed by
+   (jax version, backend, device kind) so a legitimate toolchain change
+   re-records instead of false-alarming.
+
+Proven end-to-end by ``tools/check_sdc.py`` (bench_ritual.sh): a
+2-process run with an injected ``bitflip_param@step:rank`` must detect
+the divergence within one fingerprint interval, repair from the healthy
+rank, and reach the clean run's bit-identical final loss.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import sys
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..profiler.telemetry import get_telemetry
+from .watchdog import EXIT_WATCHDOG
+
+__all__ = [
+    "IntegrityError", "IntegrityPolicy", "IntegrityMonitor",
+    "fingerprint_digest", "publish_fingerprint", "host_state_fingerprint",
+    "pick_healthy", "corrupt_param_bit", "selftest", "golden_step_digest",
+]
+
+_ENV_GOLDEN = "PADDLE_TPU_GOLDEN_STEP"
+_ENV_RENDEZVOUS = "PADDLE_TPU_INTEGRITY_DIR"
+_ENV_FP_EVERY = "PADDLE_TPU_FINGERPRINT_EVERY"
+
+
+class IntegrityError(RuntimeError):
+    """This process computed provably wrong numbers: the golden-step
+    self-test disagreed with its stored digest, or a divergence repair
+    could not complete. Continuing would train on (or serve) corrupt
+    state."""
+
+
+def fingerprint_every_from_env(default: int = 0) -> int:
+    try:
+        return int(os.environ.get(_ENV_FP_EVERY, str(default)) or default)
+    except ValueError:
+        return default
+
+
+# -- fingerprint plumbing (engine side) -------------------------------------
+
+def publish_fingerprint(history, step: int, fp: Dict[str, Any],
+                        every: int) -> None:
+    """Engine hook after a fingerprinting step: publish the three
+    scalars as deferred gauges (device scalars — coerced only when a
+    snapshot/JSONL export reads them, never a step sync) plus the
+    interval gates reason about detection latency with, and append to
+    the engine's bounded history deque."""
+    tel = get_telemetry()
+    tel.gauge("integrity/fingerprint_every", int(every))
+    tel.gauge("integrity/fingerprint.sum", fp["sum"])
+    tel.gauge("integrity/fingerprint.abs_sum", fp["abs_sum"])
+    tel.gauge("integrity/fingerprint.xor", fp["xor"])
+    history.append((int(step), fp))
+
+
+def fingerprint_digest(fp: Dict[str, Any]) -> str:
+    """Canonical bit-exact wire form of one fingerprint: the raw bytes
+    of sum (f32) + abs_sum (f32) + xor (u32), hex-encoded. String
+    equality == bit-for-bit state agreement; a float tolerance here
+    would re-admit exactly the silent class this defends against."""
+    return (np.asarray(fp["sum"], np.float32).tobytes()
+            + np.asarray(fp["abs_sum"], np.float32).tobytes()
+            + np.asarray(fp["xor"], np.uint32).tobytes()).hex()
+
+
+# -- logical (host-side) state fingerprint ----------------------------------
+
+def host_state_fingerprint(tree) -> Dict[str, int]:
+    """Deterministic CRC32 over a state pytree's *values* (leaf paths,
+    dtypes, shapes, raw bytes — in flatten order). Unlike the per-file
+    CRCs a checkpoint manifest records, this is computed from the
+    in-memory state BEFORE serialization and recomputed from the
+    deserialized state after load — so corruption anywhere on the
+    device→pickle→disk→unpickle→device path is caught even when the
+    bytes-on-disk hash matches what was (already corrupt) written."""
+    import jax
+
+    crc = 0
+    leaves = 0
+    nbytes = 0
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        a = np.asarray(leaf)
+        crc = zlib.crc32(jax.tree_util.keystr(path).encode(), crc)
+        crc = zlib.crc32(f"{a.dtype}|{a.shape}".encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(a).tobytes(), crc)
+        leaves += 1
+        nbytes += a.nbytes
+    return {"crc32": crc & 0xFFFFFFFF, "leaves": leaves, "bytes": nbytes}
+
+
+# -- majority vote -----------------------------------------------------------
+
+def pick_healthy(entries: Sequence[Tuple[int, str]]
+                 ) -> Tuple[List[int], List[int]]:
+    """Majority vote over ``(rank, digest)`` pairs: the largest group of
+    bit-identical fingerprints is presumed healthy, everyone else is the
+    corrupt minority. Ties (e.g. a 2-replica world, 1 vs 1) trust the
+    group containing the LOWEST rank — a documented presumption, not
+    knowledge; deployments that need a true majority run >= 3 replicas.
+    Returns ``(healthy_ranks, minority_ranks)``, both sorted."""
+    groups: Dict[str, List[int]] = {}
+    for rank, digest in entries:
+        groups.setdefault(digest, []).append(int(rank))
+    best = max(groups.values(), key=lambda rs: (len(rs), -min(rs)))
+    healthy = sorted(best)
+    minority = sorted(r for rs in groups.values() for r in rs
+                      if rs is not best)
+    return healthy, minority
+
+
+# -- deterministic in-device corruption (fault injection) --------------------
+
+def corrupt_param_bit(engine, name: Optional[str] = None, index: int = 0,
+                      bit: int = 1) -> str:
+    """The ``bitflip_param@step:rank`` fault: flip ONE low-mantissa bit
+    of one element of one parameter, in place in the engine's device
+    state. The damage is deliberately *silent* — a tiny, finite value
+    change the NaN/Inf sweep can never see — so only the bit-exact
+    fingerprint divergence path can catch it. Returns the parameter
+    name. Re-lays the leaf out onto the engine's sharding when the
+    engine declares one (fleet)."""
+    import jax
+    import jax.numpy as jnp
+
+    params = engine._params
+    if name is None:
+        floats = sorted(n for n, v in params.items()
+                        if hasattr(v, "dtype")
+                        and jnp.issubdtype(v.dtype, jnp.floating))
+        if not floats:
+            raise ValueError("engine has no floating parameter to corrupt")
+        name = floats[0]
+    a = np.asarray(params[name]).copy()
+    itemsize = a.dtype.itemsize
+    view = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}[itemsize]
+    raw = a.view(view).ravel()
+    raw[int(index) % raw.size] ^= np.array(1 << int(bit), view)
+    shardings = getattr(engine, "_param_shardings", None)
+    if shardings is not None and name in shardings:
+        params[name] = jax.device_put(a, shardings[name])
+    else:
+        params[name] = jax.device_put(a)
+    return name
+
+
+# -- golden-step self-test ---------------------------------------------------
+
+def _golden_key() -> str:
+    import jax
+
+    try:
+        kind = getattr(jax.devices()[0], "device_kind", "unknown")
+    except Exception:
+        kind = "unknown"
+    return f"jax-{jax.__version__}|{jax.default_backend()}|{kind}"
+
+
+def golden_step_digest() -> str:
+    """Run the canned deterministic step — a tiny fixed-weight MLP
+    forward + backward in one jitted program, inputs/params from integer
+    ramps (no RNG, no environment dependence) — and digest every output
+    bit. Same toolchain + same healthy chip ⇒ same digest, always; a
+    different digest inside one environment key means the hardware or
+    the compiler is producing wrong numbers."""
+    import jax
+    import jax.numpy as jnp
+
+    def canned():
+        w1 = ((jnp.arange(64 * 32, dtype=jnp.float32) % 13) - 6.0) \
+            .reshape(64, 32) * 0.05
+        w2 = ((jnp.arange(32 * 8, dtype=jnp.float32) % 11) - 5.0) \
+            .reshape(32, 8) * 0.07
+        x = jnp.sin(jnp.arange(16 * 64, dtype=jnp.float32) * 0.01) \
+            .reshape(16, 64)
+        y = jnp.cos(jnp.arange(16 * 8, dtype=jnp.float32) * 0.02) \
+            .reshape(16, 8)
+
+        def loss_fn(w1, w2):
+            h = jnp.tanh(x @ w1)
+            out = h @ w2
+            return jnp.mean((out - y) ** 2)
+
+        loss, (g1, g2) = jax.value_and_grad(loss_fn, argnums=(0, 1))(w1, w2)
+        return loss, g1, g2
+
+    loss, g1, g2 = jax.jit(canned)()
+    h = hashlib.sha256()
+    for out in (loss, g1, g2):
+        h.update(np.asarray(out, np.float32).tobytes())
+    return h.hexdigest()
+
+
+def selftest(path: Optional[str] = None, record: bool = True,
+             raise_on_mismatch: bool = True) -> Dict[str, Any]:
+    """Golden-step self-test: compare this process's canned-step digest
+    against the golden stored at ``path`` (default
+    ``$PADDLE_TPU_GOLDEN_STEP``) for this environment key. No entry yet
+    and ``record=True`` ⇒ record it (the startup run establishes the
+    golden; every relaunch re-verifies). Mismatch ⇒ the chip or the
+    toolchain is computing wrong numbers: ``resilience/selftest_failures``
+    is bumped and :class:`IntegrityError` raised (or the result returned
+    with ``ok=False`` when ``raise_on_mismatch=False``).
+
+    Returns ``{"ok", "recorded", "key", "digest", "golden", "path"}``.
+    """
+    tel = get_telemetry()
+    tel.counter("resilience/selftest_runs")
+    path = path or os.environ.get(_ENV_GOLDEN)
+    key = _golden_key()
+    digest = golden_step_digest()
+    result = {"ok": True, "recorded": False, "key": key, "digest": digest,
+              "golden": None, "path": path}
+    if not path:
+        return result  # nowhere to compare against: a smoke run
+    goldens: Dict[str, str] = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                goldens = json.load(f)
+        except (OSError, ValueError):
+            goldens = {}  # unreadable golden: re-record below
+    golden = goldens.get(key)
+    result["golden"] = golden
+    if golden is None:
+        if record:
+            from ..framework.io import atomic_replace
+
+            goldens[key] = digest
+
+            def _write(tmp):
+                with open(tmp, "w") as f:
+                    json.dump(goldens, f, indent=1, sort_keys=True)
+
+            atomic_replace(path, _write)
+            result["recorded"] = True
+        return result
+    if golden != digest:
+        tel.counter("resilience/selftest_failures")
+        result["ok"] = False
+        if raise_on_mismatch:
+            raise IntegrityError(
+                f"golden-step self-test FAILED for {key}: canned step "
+                f"digest {digest[:16]}… != stored golden {golden[:16]}… "
+                f"({path}). This chip or toolchain is computing wrong "
+                f"numbers — do not train through it. (A legitimate "
+                f"toolchain upgrade changes the environment key and "
+                f"re-records instead of landing here.)")
+    return result
+
+
+# -- the cross-rank monitor --------------------------------------------------
+
+@dataclasses.dataclass
+class IntegrityPolicy:
+    """Knobs for :class:`IntegrityMonitor`.
+
+    ``rendezvous_dir``: shared filesystem directory for the fingerprint
+    exchange + repair payloads when jax process collectives are not
+    initialized (defaults to ``$PADDLE_TPU_INTEGRITY_DIR``). ``timeout_s``
+    bounds every cross-rank wait (a dead peer must become a restartable
+    exit, not a forever-block); ``hang_exit=False`` raises
+    ``CollectiveTimeout`` instead (tests, embedders). ``golden_path``
+    runs :func:`selftest` at monitor construction."""
+
+    rendezvous_dir: Optional[str] = None
+    timeout_s: float = 120.0
+    poll_s: float = 0.05
+    hang_exit: bool = True
+    golden_path: Optional[str] = None
+    # give up (IntegrityError) when any ONE rank is repaired more than
+    # this many times — one cosmic ray per chip is tolerable, repetition
+    # on the same chip is hardware to replace
+    max_repairs: int = 8
+
+
+class IntegrityMonitor:
+    """Cross-rank divergence detection + healthy-replica repair over an
+    engine built with ``fingerprint_every=N``.
+
+    Drive it from :class:`StepGuard` (``StepGuard(step, policy,
+    integrity=monitor)``) or call :meth:`after_step` at step boundaries
+    yourself. Each new engine fingerprint is exchanged across ranks
+    (``communication.all_gather_object`` — CollectiveGuard-wrapped
+    process collectives on a jax-distributed world, shared-filesystem
+    rendezvous otherwise); on mismatch the majority (ties: lowest rank)
+    is presumed healthy and the minority restores the healthy source's
+    full state (params + buffers + optimizer state), falling back to the
+    local StepGuard snapshot and then the cluster checkpoint when the
+    healthy payload cannot be read. ``last_event`` keeps the most recent
+    detection for gates: ``{"step", "healthy", "minority", "source",
+    "repaired", "via"}``.
+    """
+
+    def __init__(self, engine, rank: Optional[int] = None,
+                 world_size: Optional[int] = None,
+                 policy: Optional[IntegrityPolicy] = None,
+                 snapshot_restore: Optional[Callable[[], bool]] = None,
+                 checkpoint=None):
+        from ..distributed.communication import launch_world_rank
+
+        self._engine = engine
+        self.policy = policy or IntegrityPolicy()
+        env_world, env_rank = launch_world_rank()
+        self.rank = env_rank if rank is None else int(rank)
+        self.world_size = env_world if world_size is None else int(world_size)
+        self._snapshot_restore = snapshot_restore
+        self._checkpoint = checkpoint
+        self._last_seen_step: Optional[int] = None
+        self._repairs_by_rank: Dict[int, int] = {}
+        self.last_event: Optional[Dict[str, Any]] = None
+        if self.policy.rendezvous_dir is None:
+            self.policy.rendezvous_dir = os.environ.get(_ENV_RENDEZVOUS)
+        if self.policy.golden_path or os.environ.get(_ENV_GOLDEN):
+            selftest(self.policy.golden_path)
+        if not getattr(engine, "fingerprint_every", 0):
+            raise ValueError(
+                "IntegrityMonitor needs an engine built with "
+                "fingerprint_every > 0 (TrainStep/ParallelTrainStep ctor "
+                "arg) — without in-jit fingerprints there is nothing to "
+                "compare across ranks")
+
+    # -- step-boundary hook -------------------------------------------------
+    def after_step(self, step_count: Optional[int] = None) -> bool:
+        """Consume the engine's newest fingerprint, if any; exchange +
+        compare across ranks on a new one. Returns True when a
+        divergence was detected at this boundary. Newness is judged from
+        the history's step label alone — the scalar D2H fetch
+        (``last_fingerprint``) is paid only once per interval, never on
+        the 99 off-interval boundaries."""
+        hist = self._engine.fingerprint_history()
+        if not hist or hist[-1][0] == self._last_seen_step:
+            return False  # no new fingerprint since the last boundary
+        rec = self._engine.last_fingerprint()
+        step, fp = rec
+        self._last_seen_step = step
+        if self.world_size <= 1:
+            return False
+        from .cluster import CollectiveTimeout
+
+        try:
+            return self._check(step, fp)
+        except CollectiveTimeout as e:
+            if not self.policy.hang_exit:
+                raise
+            from .cluster import _report_timeout
+
+            report = _report_timeout(
+                extra=f"{e}; exiting {EXIT_WATCHDOG} for relaunch",
+                tag="integrity_timeout")
+            sys.stderr.write(report + "\n")
+            sys.exit(EXIT_WATCHDOG)
+
+    # -- internals ----------------------------------------------------------
+    def _check(self, step: int, fp) -> bool:
+        from ..distributed.communication import all_gather_object
+        from .cluster import _launch_attempt
+
+        digest = fingerprint_digest(fp)
+        # keys carry the launch attempt: a relaunched job (restartable
+        # exit mid-repair) re-reaches the same step numbers, and a stale
+        # attempt's fp/repair files satisfying the new attempt's waits
+        # would compare live state against a dead run — the same
+        # staging-staleness class ClusterCheckpoint's commit token closes
+        attempt = _launch_attempt()
+        gathered = all_gather_object(
+            {"rank": self.rank, "step": int(step), "fp": digest},
+            key=f"integrity-fp-a{attempt}-{int(step)}",
+            rendezvous_dir=self.policy.rendezvous_dir,
+            timeout_s=self.policy.timeout_s, poll_s=self.policy.poll_s,
+            rank=self.rank, world_size=self.world_size,
+            cleanup_prev=True)
+        entries = [(int(g["rank"]), str(g["fp"])) for g in gathered]
+        if len({d for _, d in entries}) <= 1:
+            return False  # bit-for-bit agreement — the common case
+        tel = get_telemetry()
+        tel.counter("resilience/sdc_detected")
+        healthy, minority = pick_healthy(entries)
+        source = healthy[0]
+        event = {"step": int(step), "healthy": healthy,
+                 "minority": minority, "source": source,
+                 "repaired": False, "via": None}
+        self.last_event = event
+        sys.stderr.write(
+            f"[integrity] rank {self.rank}: state fingerprints DIVERGED at "
+            f"step {step}: minority rank(s) {minority} vs healthy "
+            f"{healthy} — repairing from rank {source}\n")
+        self._repair(step, source, minority, event)
+        if event["repaired"]:
+            # counted only for repairs that actually happened — a
+            # healthy rank whose publish failed must not fabricate
+            # sdc_repaired (and phantom SUSPECT-CHIP findings) for a
+            # minority peer it never reached
+            tel.counter("resilience/sdc_repaired")
+            for m in minority:
+                tel.counter(f"resilience/sdc_repaired.rank{m}")
+            # give-up is per REPAIRED RANK (the documented contract):
+            # one cosmic ray each on N different chips is fine; the
+            # same chip repaired past the budget is hardware to replace.
+            # Only actual repairs count — a failed publish must not
+            # charge the budget of a rank that was never touched.
+            for m in minority:
+                n = self._repairs_by_rank[m] = \
+                    self._repairs_by_rank.get(m, 0) + 1
+                if n > self.policy.max_repairs:
+                    raise IntegrityError(
+                        f"rank {self.rank}: rank {m} needed {n} "
+                        f"silent-corruption repairs in one run — that "
+                        f"replica has a persistently bad chip; replace "
+                        f"the hardware instead of laundering its state")
+        return True
+
+    def _repair(self, step: int, source: int, minority: List[int],
+                event: Dict[str, Any]) -> None:
+        """Repair ladder: healthy-replica state publish → local StepGuard
+        snapshot → cluster checkpoint. Every rank participates (the
+        publish is collective-shaped); only minority ranks install."""
+        try:
+            self._repair_from_source(step, source, minority)
+            event["repaired"] = True
+            event["via"] = "healthy_replica"
+            return
+        except Exception as e:  # noqa: BLE001 — ladder, not a crash
+            sys.stderr.write(
+                f"[integrity] rank {self.rank}: healthy-replica repair "
+                f"failed ({e}); falling back\n")
+        if self.rank not in minority:
+            # a healthy rank has nothing to restore, but its publish
+            # FAILED — it must not claim a repair it cannot know
+            # happened (the minority may have died mid-restore); it
+            # carries correct state and continues, leaving the peer's
+            # fate to the supervisor/timeout machinery
+            event["via"] = "publish_failed"
+            return
+        if self._snapshot_restore is not None:
+            try:
+                if self._snapshot_restore() is not False:
+                    event["repaired"] = True
+                    event["via"] = "snapshot"
+                    return
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(
+                    f"[integrity] rank {self.rank}: snapshot restore "
+                    f"failed ({e}); falling back to checkpoint\n")
+        if self._checkpoint is not None:
+            restored = self._checkpoint.restore()
+            if restored is not None:
+                self._engine.restore_state(restored["state"])
+                event["repaired"] = True
+                event["via"] = "checkpoint"
+                return
+        raise IntegrityError(
+            f"rank {self.rank}: state diverged at step {step} and no "
+            f"repair source succeeded (healthy replica, snapshot, "
+            f"checkpoint) — refusing to continue on corrupt state")
+
+    def _repair_from_source(self, step: int, source: int,
+                            minority: List[int]) -> None:
+        """Publish the healthy source's full engine state to the corrupt
+        minority. jax-distributed worlds broadcast leaves over DCN;
+        otherwise the shared filesystem carries an atomic, CRC-verified
+        payload (``framework.io.save``) + per-minority done-acks so
+        every rank leaves this interval in lockstep."""
+        import jax
+
+        jax_world = 1
+        try:
+            jax_world = jax.process_count()
+        except RuntimeError:
+            pass
+        if jax_world == self.world_size and self.world_size > 1:
+            from ..distributed import communication as comm
+
+            state = self._engine.snapshot_state()
+            host = jax.tree_util.tree_map(np.asarray, state)
+            repaired = jax.tree_util.tree_map(
+                lambda a: comm.broadcast(a, src=source), host)
+            if self.rank in minority:
+                self._engine.restore_state(repaired)
+            return
+        root = self.policy.rendezvous_dir
+        if not root:
+            raise IntegrityError(
+                "no repair transport: jax process collectives are not "
+                "initialized and IntegrityPolicy.rendezvous_dir "
+                "(PADDLE_TPU_INTEGRITY_DIR) is unset")
+        from ..framework import io as _io
+        from .cluster import _launch_attempt
+
+        # attempt-scoped like the fp exchange: a relaunched attempt
+        # re-reaching this step must never restore the dead attempt's
+        # payload on presence alone
+        payload_path = os.path.join(
+            root, f"repair-a{_launch_attempt()}-step{int(step)}.ckpt")
+        if self.rank == source:
+            state = self._engine.snapshot_state()
+            host = {"state": jax.tree_util.tree_map(np.asarray, state),
+                    "step": int(step), "source": int(source)}
+            _io.save(host, payload_path)  # atomic: presence == complete
+        if self.rank in minority:
+            self._wait_for(lambda: os.path.exists(payload_path),
+                           f"healthy rank {source}'s repair payload for "
+                           f"step {step}")
+            payload = _io.load(payload_path)
+            self._engine.restore_state(payload["state"])
+            done = payload_path + f".done.rank{self.rank}"
+            _io.atomic_replace(done, lambda tmp: open(tmp, "w").close())
+
+        def _all_done() -> bool:
+            return all(os.path.exists(payload_path + f".done.rank{m}")
+                       for m in minority)
+
+        self._wait_for(_all_done,
+                       f"minority rank(s) {minority} to ack the step-{step} "
+                       f"repair")
+
+    def _wait_for(self, predicate, what: str) -> None:
+        from .cluster import CollectiveTimeout
+
+        deadline = time.monotonic() + self.policy.timeout_s
+        while not predicate():
+            if time.monotonic() > deadline:
+                raise CollectiveTimeout(
+                    f"rank {self.rank}: gave up waiting for {what} after "
+                    f"{self.policy.timeout_s:.1f}s — a peer rank is dead "
+                    f"or hung")
+            time.sleep(self.policy.poll_s)

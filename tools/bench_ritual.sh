@@ -57,6 +57,14 @@ JAX_PLATFORMS=cpu python tools/check_resilience.py
 # resilience/job_restarts and ckpt/manifest_fallbacks in the telemetry.
 JAX_PLATFORMS=cpu python tools/check_cluster_resilience.py
 
+# silent-corruption gate: a 2-process run with an injected in-device
+# bit flip (bitflip_param@3:1 — finite, tiny, invisible to the NaN/Inf
+# sweep) must be DETECTED by the cross-rank fingerprint exchange within
+# one fingerprint interval, repaired from the healthy rank, and reach
+# the clean run's final loss bit-identically, with
+# resilience/sdc_detected and sdc_repaired in the telemetry.
+JAX_PLATFORMS=cpu python tools/check_sdc.py
+
 # serving overload gate: the deployment-side acceptance — a calibrated
 # 2x-offered-load run with injected stragglers (slow_req), a deadline
 # storm, a dropped result, and a mid-load SIGTERM must shed via explicit

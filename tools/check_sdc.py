@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""Silent-data-corruption smoke gate — detect-and-repair is exercised,
+not claimed.
+
+End-to-end on the CPU backend against the REAL runtime (in-jit
+fingerprinting engines + ``IntegrityMonitor`` cross-rank exchange +
+``distributed.launch`` + fault injection, no mocks):
+
+1. the gate itself records the golden-step digest
+   (``resilience.selftest``) that every worker then re-verifies at
+   startup — the bad-chip/miscompiling-toolchain floor;
+2. run a tiny seeded 2-process training job uninjected → per-rank final
+   losses (and prove the fingerprint exchange raises NO false
+   divergence on bit-identical replicas);
+3. run the same job with ``PADDLE_TPU_INJECT="bitflip_param@3:1"``:
+   one low-mantissa bit of a resident parameter on rank 1 silently
+   flips at the step-3 boundary — finite, tiny, invisible to the
+   NaN/Inf sweep. The fingerprint exchange must DETECT the divergence
+   within one fingerprint interval, majority-vote rank 1 into the
+   minority, repair it from healthy rank 0's state, and finish;
+4. assert both ranks reach the clean run's final loss **bit-identically**
+   (``float.hex()`` equality — a tolerance here would re-admit exactly
+   the silent class this defends), detection latency
+   ``detected_at - flip_step <= fingerprint_every``, and that
+   TELEMETRY.jsonl carries ``resilience/sdc_detected >= 1``,
+   ``resilience/sdc_repaired >= 1`` plus the
+   ``gauge/integrity/fingerprint.*`` schema contract.
+
+Gate conventions per tools/_gate.py (``sdc defense: OK|FAIL — ...``,
+exit 0/1, ``--json``). Wired into tools/bench_ritual.sh after
+check_cluster_resilience.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import textwrap
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_TOOLS)
+sys.path.insert(0, _TOOLS)
+if _REPO not in sys.path:  # runnable from anywhere, not just the repo root
+    sys.path.insert(1, _REPO)
+from _gate import add_gate_args, finish, read_counters  # noqa: E402
+
+# The demo worker: every rank trains the same deterministic data through
+# a fingerprinting guarded step with the divergence monitor riding the
+# step boundaries. Each rank verifies the golden step at startup and
+# writes its own result file (final loss as float.hex() so the gate's
+# equality check is bit-exact, plus the monitor's detection event).
+WORKER = textwrap.dedent("""
+    import json, os
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.profiler.telemetry import get_telemetry
+    from paddle_tpu.resilience import (IntegrityMonitor, IntegrityPolicy,
+                                       RecoveryPolicy, StepGuard, selftest)
+
+    STEPS = int(os.environ["DEMO_STEPS"])
+    EVERY = int(os.environ["DEMO_FP_EVERY"])
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    # golden-step self-test against the digest the gate recorded — a
+    # worker on a bad chip/toolchain dies HERE, before training
+    selftest(os.environ["DEMO_GOLDEN"], record=False)
+
+    paddle.seed(0)
+    net = nn.Linear(8, 4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    step = TrainStep(net, lambda out, y: ((out - y) ** 2).mean(), opt,
+                     guard_updates=True, fingerprint_every=EVERY)
+    monitor = IntegrityMonitor(step, policy=IntegrityPolicy(
+        rendezvous_dir=os.environ["DEMO_INTEGRITY"], timeout_s=60.0))
+    guard = StepGuard(step, RecoveryPolicy(quarantine_dir=None),
+                      integrity=monitor)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(STEPS, 16, 8).astype("float32")
+    ys = rng.randn(STEPS, 16, 4).astype("float32")
+    loss = None
+    for i in range(STEPS):
+        loss = guard((xs[i],), (ys[i],))
+    ev = monitor.last_event
+    with open(os.environ["DEMO_RESULT"] + f".rank{rank}", "w") as f:
+        json.dump({"final_step": guard.step_count,
+                   "loss_hex": float(np.asarray(loss._value)).hex(),
+                   "detected_at": ev["step"] if ev else None,
+                   "repaired": bool(ev and ev["repaired"]),
+                   "via": ev["via"] if ev else None,
+                   "minority": ev["minority"] if ev else None}, f)
+    if rank == 0:
+        # one writer per file: every rank bumps every sdc counter (incl.
+        # the .rank<i>-suffixed ones), so rank 0's record carries all
+        # the evidence and concurrent multi-KB appends can't tear lines
+        get_telemetry().to_jsonl(os.environ["DEMO_TELEMETRY"],
+                                 step=guard.step_count, tag="sdc_demo")
+""")
+
+
+def _run(workdir, tag, steps, fp_every, golden, inject=None, tel_path=None):
+    """One 2-process launch; returns (rc, {rank: result})."""
+    from paddle_tpu.distributed.launch import launch
+
+    worker = os.path.join(workdir, "worker.py")
+    with open(worker, "w") as f:
+        f.write(WORKER)
+    sub = os.path.join(workdir, tag)
+    os.makedirs(sub, exist_ok=True)
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",  # one CPU device per rank, not the test 8-dev host
+        "PYTHONPATH": _REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "PADDLE_TPU_TELEMETRY": "1",
+        "DEMO_STEPS": str(steps),
+        "DEMO_FP_EVERY": str(fp_every),
+        "DEMO_GOLDEN": golden,
+        "DEMO_INTEGRITY": os.path.join(sub, "integrity"),
+        "DEMO_RESULT": os.path.join(sub, "result.json"),
+        "DEMO_TELEMETRY": tel_path or os.path.join(sub, "telemetry.jsonl"),
+    }
+    if inject:
+        env["PADDLE_TPU_INJECT"] = inject
+        env["PADDLE_TPU_INJECT_STATE"] = os.path.join(sub, "inject-state")
+    rc = launch(worker, [], nproc_per_node=2,
+                log_dir=os.path.join(sub, "logs"), backend="cpu",
+                extra_env=env, telemetry_jsonl=tel_path)
+    results = {}
+    for r in (0, 1):
+        p = env["DEMO_RESULT"] + f".rank{r}"
+        if os.path.exists(p):
+            with open(p) as f:
+                results[r] = json.load(f)
+    return rc, results
+
+
+def run_demo(workdir, steps=8, fp_every=2, flip_step=3):
+    """Returns (ok, detail, payload)."""
+    from paddle_tpu.resilience import selftest
+
+    tel_path = os.path.join(workdir, "TELEMETRY.jsonl")
+    golden = os.path.join(workdir, "golden-step.json")
+    rec = selftest(golden)  # the gate records; workers verify
+    if not rec["ok"]:
+        return False, "gate-side golden-step self-test failed", {}
+
+    # 1. uninjected 2-process reference: bit-identical replicas, the
+    # exchange must stay silent
+    rc, ref = _run(workdir, "clean", steps, fp_every, golden)
+    if rc != 0 or len(ref) != 2:
+        return False, f"uninjected run failed rc={rc}", {}
+    if any(r["detected_at"] is not None for r in ref.values()):
+        return False, ("FALSE POSITIVE: clean bit-identical replicas "
+                       "reported divergence"), {"ref": ref}
+    if ref[0]["loss_hex"] != ref[1]["loss_hex"]:
+        return False, "clean replicas disagree — demo is not deterministic", \
+            {"ref": ref}
+
+    # 2. silent bit flip on rank 1
+    rc, inj = _run(workdir, "injected", steps, fp_every, golden,
+                   inject=f"bitflip_param@{flip_step}:1", tel_path=tel_path)
+    if rc != 0 or len(inj) != 2:
+        return False, f"injected run failed rc={rc}", {}
+
+    payload = {"ref": ref, "injected": inj, "flip_step": flip_step,
+               "fingerprint_every": fp_every}
+    ev = inj[0]
+    if ev["detected_at"] is None:
+        return False, ("silent corruption was NEVER detected — the "
+                       "injected replica trained (and would checkpoint) "
+                       "poisoned state"), payload
+    if ev["detected_at"] - flip_step > fp_every:
+        return False, (f"detection latency {ev['detected_at'] - flip_step} "
+                       f"steps exceeds one fingerprint interval "
+                       f"({fp_every})"), payload
+    if ev["minority"] != [1] or not ev["repaired"]:
+        return False, (f"wrong verdict: minority={ev['minority']} "
+                       f"repaired={ev['repaired']} (injected rank was 1)"), \
+            payload
+    for r in (0, 1):
+        if inj[r]["loss_hex"] != ref[r]["loss_hex"]:
+            return False, (f"rank {r} final loss NOT bit-identical to the "
+                           f"clean run after repair: {inj[r]['loss_hex']} "
+                           f"vs {ref[r]['loss_hex']}"), payload
+
+    from check_telemetry_schema import validate_file
+
+    n, err = validate_file(
+        tel_path,
+        require=["counter/resilience/sdc_detected",
+                 "counter/resilience/sdc_repaired",
+                 "counter/resilience/sdc_repaired.rank1",
+                 "gauge/integrity/fingerprint_every"],
+        require_prefix=["gauge/integrity/fingerprint."])
+    if err:
+        return False, f"telemetry: {err}", payload
+    counters = read_counters(tel_path)
+    payload["counters"] = {k: v for k, v in counters.items()
+                           if k.startswith("counter/resilience/sdc")}
+    for need in ("counter/resilience/sdc_detected",
+                 "counter/resilience/sdc_repaired"):
+        if counters.get(need, 0) < 1:
+            return False, f"{need} = {counters.get(need, 0)}, expected >= 1", \
+                payload
+    return True, (f"bitflip_param@{flip_step}:1 detected at step "
+                  f"{ev['detected_at']} (<= {flip_step}+{fp_every}), "
+                  f"repaired via {ev['via']} from rank 0; both ranks' "
+                  f"final loss bit-identical to clean "
+                  f"({inj[0]['loss_hex']}); sdc_detected="
+                  f"{counters['counter/resilience/sdc_detected']:.0f} "
+                  f"sdc_repaired="
+                  f"{counters['counter/resilience/sdc_repaired']:.0f}"), \
+        payload
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="End-to-end silent-corruption smoke gate (injected "
+                    "in-device bit flip on a tiny 2-process CPU run must "
+                    "be detected within one fingerprint interval and "
+                    "repaired from the healthy rank)")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--fp-every", type=int, default=2)
+    ap.add_argument("--flip-step", type=int, default=3)
+    ap.add_argument("--workdir", default=None,
+                    help="keep artifacts here instead of a temp dir")
+    add_gate_args(ap)
+    args = ap.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.workdir:
+        os.makedirs(args.workdir, exist_ok=True)
+        ok, detail, payload = run_demo(args.workdir, args.steps,
+                                       args.fp_every, args.flip_step)
+    else:
+        with tempfile.TemporaryDirectory(prefix="sdc-gate-") as d:
+            ok, detail, payload = run_demo(d, args.steps, args.fp_every,
+                                           args.flip_step)
+    return finish("sdc defense", ok, detail, payload=payload,
+                  json_mode=args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,10 +26,21 @@ pass; exit 1 with the first violation's line number and reason on fail.
 Name contracts (beyond the generic shape): ``gauge/mfu*`` ∈ [0, 100];
 ``gauge/compile/*`` ≥ 0; the resilience counters
 (``counter/resilience/*`` — incl. the cluster-level ``job_restarts``,
-``rank_failures``/``rank_failures.rank<i>``, ``collective_timeouts``)
-and the coordinated-checkpoint accounting (``counter/ckpt/*``,
-``hist/ckpt/commit_ms/*``) are ≥ 0 — a negative restart/commit count
-means a producer is writing deltas where totals belong.
+``rank_failures``/``rank_failures.rank<i>``, ``collective_timeouts``,
+and the silent-corruption ``sdc_detected``/``sdc_repaired``/
+``sdc_repaired.rank<i>``) and the coordinated-checkpoint accounting
+(``counter/ckpt/*``, ``hist/ckpt/commit_ms/*``) are ≥ 0 — a negative
+restart/commit count means a producer is writing deltas where totals
+belong.
+
+Integrity contracts (``resilience.integrity``): a record carrying
+``gauge/integrity/fingerprint_every`` (the interval — recorded so gates
+can reason about detection latency) must carry it ≥ 1 AND carry all
+three ``gauge/integrity/fingerprint.{sum,abs_sum,xor}`` scalars — an
+interval without fingerprints means the engine claims fingerprinting it
+never published; ``fingerprint.xor`` is a uint32 word, so ∈ [0, 2^32);
+and within one record ``counter/resilience/sdc_repaired`` ≤
+``sdc_detected`` (every repair is preceded by its detection).
 
 Serving contracts (``inference.serving``): ``counter/serve/*`` are
 monotone request totals ≥ 0; latency/batch histograms
@@ -108,6 +119,34 @@ def validate_record(rec, lineno):
                 and not (0 <= float(value) <= 1):
             return (f"line {lineno}: scalar {name!r} = {value!r} "
                     f"outside [0, 1] (occupancy = batch size / bucket)")
+        # integrity contracts: the fingerprint interval is a count of
+        # steps (>= 1 when fingerprinting is on — 0/off publishes no
+        # gauge at all); the XOR fold is a uint32 word
+        if name == "gauge/integrity/fingerprint_every" and float(value) < 1:
+            return (f"line {lineno}: scalar {name!r} = {value!r} "
+                    f"< 1 (the interval is only published when "
+                    f"fingerprinting is enabled)")
+        if name == "gauge/integrity/fingerprint.xor" \
+                and not (0 <= float(value) < 2 ** 32):
+            return (f"line {lineno}: scalar {name!r} = {value!r} "
+                    f"outside [0, 2^32) (uint32 XOR fold)")
+    # cross-field: fingerprinting enabled (interval present) must come
+    # with the fingerprints themselves — detection latency can only be
+    # reasoned about when both are in the record
+    if "gauge/integrity/fingerprint_every" in scalars:
+        for part in ("sum", "abs_sum", "xor"):
+            if f"gauge/integrity/fingerprint.{part}" not in scalars:
+                return (f"line {lineno}: gauge/integrity/fingerprint_every "
+                        f"present but gauge/integrity/fingerprint.{part} "
+                        f"missing — fingerprinting claimed but not "
+                        f"published")
+    # cross-field: a repair can only follow a detection
+    det = scalars.get("counter/resilience/sdc_detected")
+    rep = scalars.get("counter/resilience/sdc_repaired")
+    if rep is not None and float(rep) > float(det or 0):
+        return (f"line {lineno}: counter/resilience/sdc_repaired = {rep!r} "
+                f"exceeds sdc_detected = {det!r} (every repair is "
+                f"preceded by its detection)")
     # cross-field: the admission queue is BOUNDED — its observed depth
     # can never exceed the capacity the same record reports
     depth = scalars.get("gauge/serve/queue_depth")

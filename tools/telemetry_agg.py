@@ -16,7 +16,13 @@ record at exit). This tool merges them (paddle_tpu.profiler.aggregate):
 - **dead-rank detection**: with ``--expect-ranks N``, a rank whose
   telemetry log is missing or truncated (it died before the atexit
   flush) is reported as a DEAD-RANK finding — not silently dropped from
-  the medians, which would make an N-1-rank cluster look healthy.
+  the medians, which would make an N-1-rank cluster look healthy;
+- **suspect-chip detection**: a rank whose silent-corruption repair
+  count (``counter/resilience/sdc_repaired.rank<i>``, bumped by every
+  rank naming the repaired one) exceeds ``--suspect-repairs`` is
+  reported as a SUSPECT-CHIP finding — one repair is a cosmic ray,
+  repeated repairs of the same rank are a marginal chip the repair loop
+  is laundering; replace the hardware.
 
 Usage:
     python tools/telemetry_agg.py LOG_DIR              # telemetry.rank*.jsonl
@@ -24,11 +30,13 @@ Usage:
     python tools/telemetry_agg.py LOG_DIR --threshold 1.5 --json
     python tools/telemetry_agg.py LOG_DIR --fail-on-straggler   # gate mode
     python tools/telemetry_agg.py LOG_DIR --expect-ranks 4      # dead ranks
+    python tools/telemetry_agg.py LOG_DIR --fail-on-suspect     # bad chips
 
 Exit code 0; with ``--fail-on-straggler``, 1 when any rank is flagged;
 with ``--expect-ranks N``, 1 when any expected rank left no usable
-telemetry (asking for N ranks IS the check). ``--json`` emits the full
-aggregate object.
+telemetry (asking for N ranks IS the check); with ``--fail-on-suspect``,
+1 when any rank's repair count exceeds the threshold. ``--json`` emits
+the full aggregate object.
 """
 from __future__ import annotations
 
@@ -63,6 +71,7 @@ _HEADLINE = (
     "hist/jit/step_ms/p50", "hist/hapi/step_ms/p50",
     "gauge/mfu", "counter/engine/steps", "counter/executor/runs",
     "gauge/engine/tokens_per_s",
+    "counter/resilience/sdc_detected", "counter/resilience/sdc_repaired",
 )
 
 
@@ -109,6 +118,17 @@ def format_report(result) -> str:
     elif "expected_ranks" in result:
         lines.append(f"dead ranks: none "
                      f"({result['expected_ranks']} expected, all reported)")
+    suspects = result.get("suspect_chips")
+    if suspects:
+        lines.append(f"SUSPECT CHIPS (> {result['suspect_repairs']:.0f} "
+                     f"silent-corruption repair(s)):")
+        for s in suspects:
+            lines.append(
+                f"  rank {s['rank']}: repaired {s['repairs']:.0f} times — "
+                f"repeated SDC repairs of one rank mean a marginal chip, "
+                f"not bad luck; replace the hardware")
+    else:
+        lines.append("suspect chips: none")
     stragglers = result["stragglers"]
     if stragglers:
         lines.append(f"stragglers (> {result['threshold']:.2f}x cluster "
@@ -144,6 +164,13 @@ def main(argv=None):
                          "leaving no usable telemetry log is reported as "
                          "a dead-rank finding and fails the check "
                          "(exit 1)")
+    ap.add_argument("--suspect-repairs", type=float, default=1,
+                    help="SDC repairs of one rank above which it is a "
+                         "SUSPECT-CHIP finding (default 1: a single "
+                         "repair is tolerated, repetition is not)")
+    ap.add_argument("--fail-on-suspect", action="store_true",
+                    help="exit 1 when any rank exceeds --suspect-repairs "
+                         "(gate mode)")
     args = ap.parse_args(argv)
     paths = _resolve_paths(args.paths)
     if not paths:
@@ -158,7 +185,8 @@ def main(argv=None):
               file=sys.stderr)
         return 1
     result = agg.aggregate(paths, threshold=args.threshold, tag=args.tag,
-                           expected_ranks=args.expect_ranks)
+                           expected_ranks=args.expect_ranks,
+                           suspect_repairs=args.suspect_repairs)
     if not result["n_ranks"] and not result.get("dead_ranks"):
         print("telemetry aggregate: no parsable records in "
               + ", ".join(paths), file=sys.stderr)
@@ -168,6 +196,8 @@ def main(argv=None):
     else:
         print(format_report(result))
     if args.fail_on_straggler and result["stragglers"]:
+        return 1
+    if args.fail_on_suspect and result.get("suspect_chips"):
         return 1
     if result.get("dead_ranks"):
         return 1
