@@ -17,9 +17,13 @@ Configs (BASELINE.md table):
      -> samples/sec + speedup (net-new; any backend)
   #7 serving: inference.serving closed-loop at N concurrent streams
      -> tokens/sec + p50/p99 latency (net-new; any backend)
+  #8 decode: token-level LLM serving (paged KV + continuous batching +
+     speculative ablation) vs the one-shot recompute-the-prefix
+     Predictor baseline at N=8 streams -> tokens/sec + TTFT/TPOT
+     p50/p99 (net-new; any backend)
 
 Usage: python bench_all.py [--smoke]
-         [lenet|resnet50|bert|longctx|pipeline|serving]
+         [lenet|resnet50|bert|longctx|pipeline|serving|decode]
   (--smoke: tiny shapes, any backend; names select a subset)
 """
 from __future__ import annotations
@@ -548,6 +552,146 @@ def bench_serving():
             "warmup_compile_ms": round(engine.warmup_ms[streams], 1)}
 
 
+def bench_decode():
+    """Token-level LLM serving (inference.serving.decode): greedy
+    generation at N=8 concurrent streams through decode-step continuous
+    batching over the paged KV cache, against the ONE-SHOT baseline the
+    runtime replaces — a Predictor recomputing the full prefix every
+    token (PR 7's serving shape). Same workload both legs (8 streams x
+    identical prompts x same token budget), tokens/s = generated tokens
+    / wall.
+
+    Ablation columns: the one-shot baseline (`oneshot_tokens_per_sec`,
+    `continuous_batching_speedup`) and speculative decoding
+    (`spec_tokens_per_sec`, `spec_accept_rate` — a tiny draft model
+    proposing k=3). TTFT/TPOT p50/p99 come from the request objects'
+    own stamps; decode-step MFU attribution comes from the per-entry
+    cost records (serve.decode.b<N> entries own serve/decode_ms.b<N>).
+    The spec and baseline legs run FIRST so the headline record's
+    last-compiled entry is the main leg's decode executable."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn  # noqa: F401  (predictor path imports)
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.inference.serving import (TokenServeConfig,
+                                              TokenServingEngine,
+                                              run_generation_streams)
+    from paddle_tpu.profiler import get_telemetry
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    streams = 8
+    if SMOKE:
+        P, T, per_stream = 48, 16, 2
+        mcfg = dict(vocab_size=512, hidden_size=128, num_layers=2,
+                    num_heads=4)
+    else:
+        P, T, per_stream = 256, 64, 4
+        mcfg = dict(vocab_size=2048, hidden_size=256, num_layers=4,
+                    num_heads=8)
+    Lmax = P + T
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig(
+        max_position_embeddings=Lmax, hidden_dropout=0.0,
+        attention_dropout=0.0, **mcfg))
+    model.eval()
+    paddle.seed(3)
+    draft = GPTForCausalLM(GPTConfig(
+        vocab_size=mcfg["vocab_size"], hidden_size=mcfg["hidden_size"] // 2,
+        num_layers=1, num_heads=2, max_position_embeddings=Lmax,
+        hidden_dropout=0.0, attention_dropout=0.0))
+    draft.eval()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, mcfg["vocab_size"], P).astype(np.int32)
+               for _ in range(streams)]
+    bs = 16
+    kv_blocks = streams * (Lmax // bs + 1) + 8
+
+    def serve_cfg(spec_k=0):
+        return TokenServeConfig(
+            capacity=4 * streams, decode_buckets=(1, 2, 4, 8),
+            max_running=streams, prefill_chunk=min(P, 32),
+            kv_blocks=kv_blocks, kv_block_size=bs, max_seq_len=Lmax,
+            spec_k=spec_k)
+
+    def run_leg(engine):
+        engine.start()
+        try:
+            run_generation_streams(  # warm: every entry compiled
+                engine, streams, 1,
+                lambda k: prompts[k % streams], max_new_tokens=4)
+            out = run_generation_streams(
+                engine, streams, per_stream,
+                lambda k: prompts[k % streams], max_new_tokens=T)
+        finally:
+            acct = engine.shutdown()
+        want_ok = streams * (per_stream + 1)  # warm + timed rounds
+        if acct["unaccounted"] or acct["double_terminal"] \
+                or engine.kv_accounting()["leaked_blocks"] \
+                or acct["by_status"].get("ok", 0) != want_ok:
+            raise AssertionError(f"decode bench lost requests or blocks: "
+                                 f"{acct}, {engine.kv_accounting()}")
+        return out
+
+    tel = get_telemetry()
+
+    # leg 1 (first — its compiles must not be the headline entry):
+    # speculative ablation
+    spec = run_leg(TokenServingEngine(model, serve_cfg(spec_k=3),
+                                      draft_model=draft))
+    accept = tel.snapshot()["gauges"].get("serve/spec_accept_rate", 0.0)
+
+    # leg 2: one-shot baseline — a Predictor over the full padded
+    # context, recomputing the whole prefix for every generated token
+    # (all 8 streams batched per step, which FAVORS the baseline: it
+    # gets perfect batching for free)
+    cfg = Config()
+    cfg.set_layer(model, [paddle.jit.InputSpec([None, Lmax], "int64",
+                                               "ids")])
+    predictor = create_predictor(cfg)
+    raw_fn = predictor.serving_fn()
+
+    def serving_logits(arr):  # serving_fn returns a tuple of outputs
+        out = raw_fn(jnp.asarray(arr))
+        return np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+
+    ids = np.zeros((streams, Lmax), np.int64)
+    for s in range(streams):
+        ids[s, :P] = prompts[s]
+    serving_logits(ids)  # warm the compile off the clock
+    t0 = time.perf_counter()
+    n_base_tokens = 0
+    for rep in range(per_stream):
+        cur = ids.copy()
+        ln = P
+        for _ in range(T):
+            logits = serving_logits(cur)
+            nxt = logits[:, ln - 1].argmax(-1)
+            cur[:, ln] = nxt
+            ln += 1
+            n_base_tokens += streams
+    oneshot_tps = n_base_tokens / (time.perf_counter() - t0)
+
+    # leg 3 (last — the headline attribution entry): plain continuous
+    # batching. kv_evictions is reported as THIS leg's delta — counters
+    # are process-cumulative and the spec leg's double pool pressure
+    # must not masquerade as headline-config thrash
+    ev0 = tel.counter_value("serve/kv_evictions")
+    out = run_leg(TokenServingEngine(model, serve_cfg()))
+    evictions = tel.counter_value("serve/kv_evictions") - ev0
+    return {"metric": "decode_serving_tokens_per_sec",
+            "value": round(out["tokens_per_s"], 1), "unit": "tokens/sec",
+            "streams": streams, "prompt_len": P, "max_new_tokens": T,
+            "oneshot_tokens_per_sec": round(oneshot_tps, 1),
+            "continuous_batching_speedup":
+                round(out["tokens_per_s"] / max(oneshot_tps, 1e-9), 3),
+            "spec_tokens_per_sec": round(spec["tokens_per_s"], 1),
+            "spec_accept_rate": round(float(accept), 4),
+            "ttft_p50_ms": round(out.get("ttft_p50_ms", 0.0), 3),
+            "ttft_p99_ms": round(out.get("ttft_p99_ms", 0.0), 3),
+            "tpot_p50_ms": round(out.get("tpot_p50_ms", 0.0), 3),
+            "tpot_p99_ms": round(out.get("tpot_p99_ms", 0.0), 3),
+            "kv_evictions": int(evictions)}
+
+
 def _merge_telemetry_record(tel, tag, extra, step):
     """Replace THIS config's record in TELEMETRY.jsonl, keeping every
     other config's — a subset run (`bench_all.py serving`) must not
@@ -576,10 +720,12 @@ def _merge_telemetry_record(tel, tag, extra, step):
 
 def main():
     only = [a.lstrip("-") for a in sys.argv[1:] if a.lstrip("-") in
-            ("lenet", "resnet50", "bert", "longctx", "pipeline", "serving")]
+            ("lenet", "resnet50", "bert", "longctx", "pipeline", "serving",
+             "decode")]
     table = {"lenet": bench_lenet, "resnet50": bench_resnet50,
              "bert": bench_bert_dp, "longctx": bench_gpt_long_context,
-             "pipeline": bench_input_pipeline, "serving": bench_serving}
+             "pipeline": bench_input_pipeline, "serving": bench_serving,
+             "decode": bench_decode}
     from paddle_tpu.profiler import get_telemetry, xla_cost
 
     tel = get_telemetry()

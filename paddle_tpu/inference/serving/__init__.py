@@ -23,12 +23,20 @@ and request-level fault injection (``slow_req@`` / ``drop_req@`` /
         y = req.outputs[0]
 """
 from .admission import AdmissionQueue
+from .decode import (DecodeScheduler, GenRequest, TokenServeConfig,
+                     TokenServingEngine, dense_greedy_reference)
 from .engine import ServeConfig, ServingEngine
-from .loadgen import run_load, run_streams, summarize
+from .kv_cache import KVCacheConfig, KVCachePool
+from .loadgen import (run_generation_streams, run_load, run_streams,
+                      summarize, summarize_generation)
 from .request import Request, RequestStatus
 from .scheduler import BatchScheduler
 
 __all__ = [
-    "AdmissionQueue", "BatchScheduler", "Request", "RequestStatus",
-    "ServeConfig", "ServingEngine", "run_load", "run_streams", "summarize",
+    "AdmissionQueue", "BatchScheduler", "DecodeScheduler", "GenRequest",
+    "KVCacheConfig", "KVCachePool", "Request", "RequestStatus",
+    "ServeConfig", "ServingEngine", "TokenServeConfig",
+    "TokenServingEngine", "dense_greedy_reference",
+    "run_generation_streams", "run_load", "run_streams", "summarize",
+    "summarize_generation",
 ]

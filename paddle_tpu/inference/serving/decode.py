@@ -1,0 +1,866 @@
+"""Token-level LLM serving: decode-step continuous batching over a paged
+KV cache, chunked-prefill admission, and speculative decoding.
+
+PR 7's runtime batches ONE-SHOT predictor calls — the dominant real
+traffic shape (long prompt + streamed decode) would recompute its whole
+prefix every token. This module serves generation natively:
+
+- **Continuous batching at token granularity**: every scheduler
+  iteration advances ALL running sequences by one decode step (packed
+  into the smallest decode bucket — one compiled executable per bucket,
+  same bounded-compile scheme as the PR 7 scheduler) and at most ONE
+  prefill chunk, so a newly admitted 10k-token prompt costs running
+  decodes at most one chunk of latency, never a full prefill stall.
+- **Paged KV cache** (``kv_cache.KVCachePool``): per-sequence block
+  tables over a fixed pool; blocks allocate as sequences grow and free
+  at EVERY terminal transition (the engine's ``_finish`` funnel owns the
+  release, so no status path can leak). Pool pressure evicts the
+  youngest running sequence back to re-prefill (recompute-style
+  preemption, counted in ``serve/kv_evictions``).
+- **Speculative decoding**: a draft model proposes ``spec_k`` greedy
+  tokens (k cheap sequential steps), the target verifies all of them in
+  ONE batched (k+1)-token step; the accepted prefix plus the target's
+  correction advance the sequence 1..k+1 tokens per round.
+  ``gauge/serve/spec_accept_rate`` tracks the cumulative acceptance.
+- **PR 7 lifecycle unchanged**: admission queue, deadline enforcement
+  (queue / mid-generation), drain semantics, the exactly-one-terminal
+  accounting ledger, and the SIGTERM → drain → exit-77 relaunch path are
+  inherited verbatim from ``ServingEngine`` — a preempted replica
+  terminates every request exactly once (OK with full text, DRAINED with
+  partial text) and releases every KV block.
+
+Telemetry (schema-gated): counters ``serve/kv_blocks_{alloc,free}``,
+``serve/decode_steps``, ``serve/prefill_chunks``, ``serve/kv_evictions``,
+``serve/tokens_generated``, ``serve/spec_{proposed,accepted}``; gauges
+``serve/kv_occupancy`` ∈ [0,1], ``serve/kv_blocks_{total,used}``,
+``serve/spec_accept_rate`` ∈ [0,1], ``serve/running``; histograms
+``serve/ttft_ms``, ``serve/tpot_ms``, ``serve/decode_ms[.b<N>]``,
+``serve/prefill_ms[.c<N>]``, ``serve/verify_ms[.b<N>]``,
+``serve/draft_ms``. Each compiled entry (``serve.decode.b<N>``,
+``serve.prefill.c<N>``, ``serve.verify.b<N>``, ``serve.draft.b<N>``) is
+cost-analyzed by the PR 5 attribution layer and mapped to its own
+histogram, so decode-step MFU is a first-class column.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...profiler.retrace import tracked_jit
+from ...profiler.telemetry import get_telemetry
+from ...resilience.inject import active_injector
+from ...resilience.preemption import preemption_requested
+from ...resilience.watchdog import heartbeat
+from .engine import ServeConfig, ServingEngine
+from .kv_cache import KVCacheConfig, KVCachePool
+from .request import Request, RequestStatus
+
+__all__ = ["TokenServeConfig", "GenRequest", "TokenServingEngine",
+           "DecodeScheduler", "dense_greedy_reference"]
+
+
+class TokenServeConfig(ServeConfig):
+    """Knobs of the token-level runtime. The PR 7 knobs (admission
+    ``capacity``, ``default_deadline_s``, ``drain_grace_s``,
+    ``idle_poll_s``) plus bucket handling are INHERITED from
+    ``ServeConfig`` — ``decode_buckets`` are its ``buckets`` and
+    ``max_running`` its ``max_batch``, so bucket validation/selection
+    cannot drift between the two engines.
+
+    Args:
+        decode_buckets: ascending batch sizes for the decode/verify
+            steps; one executable per bucket (per T). ``max_running``
+            (default: largest bucket) bounds concurrent sequences.
+        prefill_chunk: tokens per prefill chunk — the admission quantum.
+            Long prompts enter in chunks of this size, one chunk per
+            scheduler iteration, so running decodes never stall longer
+            than one chunk.
+        max_new_tokens: default generation budget per request.
+        kv_blocks / kv_block_size / kv_dtype: pool geometry + storage
+            ('float32' | 'bfloat16' | 'int8' — int8 stores per-token-head
+            scales via ``quant.quantize_kv``).
+        max_seq_len: hard per-sequence cap (prompt + generation);
+            defaults to the model's position table, clamped to what the
+            pool can hold for one sequence.
+        spec_k: speculative tokens proposed per round (0 = off; needs a
+            draft model on the engine).
+    """
+
+    def __init__(self, capacity: int = 64,
+                 decode_buckets: Sequence[int] = (1, 2, 4, 8),
+                 max_running: Optional[int] = None,
+                 prefill_chunk: int = 32,
+                 max_new_tokens: int = 64,
+                 default_deadline_s: Optional[float] = None,
+                 drain_grace_s: float = 5.0,
+                 idle_poll_s: float = 0.01,
+                 kv_blocks: int = 64,
+                 kv_block_size: int = 16,
+                 kv_dtype: str = "float32",
+                 max_seq_len: Optional[int] = None,
+                 spec_k: int = 0):
+        super().__init__(capacity=capacity, buckets=decode_buckets,
+                         max_batch=max_running,
+                         default_deadline_s=default_deadline_s,
+                         drain_grace_s=drain_grace_s,
+                         idle_poll_s=idle_poll_s)
+        self.prefill_chunk = int(prefill_chunk)
+        self.max_new_tokens = int(max_new_tokens)
+        self.kv_blocks = int(kv_blocks)
+        self.kv_block_size = int(kv_block_size)
+        self.kv_dtype = kv_dtype
+        self.max_seq_len = max_seq_len
+        self.spec_k = int(spec_k)
+
+    @property
+    def decode_buckets(self):
+        return self.buckets
+
+    @property
+    def max_running(self) -> int:
+        return self.max_batch
+
+
+class GenRequest(Request):
+    """One generation request. ``inputs`` holds the prompt (ledger/parity
+    with the PR 7 request); the generation state lives on the request so
+    the scheduler, the terminal funnel, and the accounting ledger all see
+    one object.
+
+    Timing stamps beyond the PR 7 pair: ``first_token_at`` (TTFT) and
+    ``last_token_at`` — TPOT is derived at the terminal transition.
+    """
+
+    def __init__(self, req_id: int, prompt: np.ndarray,
+                 max_new_tokens: int, deadline_s: Optional[float] = None,
+                 eos_id: Optional[int] = None):
+        super().__init__(req_id, [prompt], deadline_s)
+        self.prompt = np.asarray(prompt, np.int32)
+        self.max_new = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.toks: List[int] = [int(t) for t in self.prompt]
+        self.n_prompt = len(self.toks)
+        self.generated: List[int] = []
+        self.ncache = 0          # tokens whose K/V are in the target cache
+        self.draft_ncache = 0    # ditto, draft cache (speculative mode)
+        self.evictions = 0
+        self.first_token_at: Optional[float] = None
+        self.last_token_at: Optional[float] = None
+
+    @property
+    def pending(self) -> int:
+        """Known tokens not yet in cache — 1 means decode-eligible
+        (exactly the next token to feed), >1 means (re)prefilling."""
+        return len(self.toks) - self.ncache
+
+    def ttft_ms(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return (self.first_token_at - self.submitted_at) * 1e3
+
+    def tpot_ms(self) -> Optional[float]:
+        if (self.first_token_at is None or self.last_token_at is None
+                or len(self.generated) < 2):
+            return None
+        return ((self.last_token_at - self.first_token_at)
+                / (len(self.generated) - 1)) * 1e3
+
+
+class DecodeScheduler:
+    """The decode loop — one thread owns the device and the pool.
+
+    Each iteration: heartbeat → drain/preemption check → admission (pop
+    waiting prompts into the running set while slots exist) → deadline
+    shedding → ONE prefill chunk for the oldest prefilling sequence →
+    ONE decode (or speculative) round for every decode-eligible
+    sequence → retire finished sequences. Work per iteration is bounded
+    (≤ 1 chunk + ≤ 1 decode round), which is what makes admission unable
+    to starve decodes.
+    """
+
+    def __init__(self, engine: "TokenServingEngine"):
+        self._engine = engine
+        self._thread = threading.Thread(
+            target=self._run, name="DecodeScheduler", daemon=True)
+        self._stopped = threading.Event()
+        self.batch_index = 0
+        self._running: List[GenRequest] = []
+        self._decode_fns: Dict[int, object] = {}
+        self._verify_fns: Dict[int, object] = {}
+        self._draft_fns: Dict[int, object] = {}
+        self._prefill_fn = None
+        self._draft_prefill_fn = None
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self._thread.start()
+        return self
+
+    def join(self, timeout=None):
+        self._thread.join(timeout)
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    # -- compiled executables ----------------------------------------------
+    def _make_step(self, fwd, name: str):
+        """One compiled entry: forward a chunk through the cache, return
+        the greedy token per position (argmax stays on device — the D2H
+        per step is [B, T] int32, not [B, T, V] logits). Pages (arg 3)
+        are donated: the pool is the largest serving buffer and must
+        never exist twice on device."""
+
+        def step(params, tokens, qpos, pages, tables, kv_lens):
+            logits, pages = fwd(params, tokens, qpos, pages, tables,
+                                kv_lens)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), pages
+
+        # sig_argnums: hash only the drift-capable inputs — flattening
+        # the full params pytree per decode step would put O(leaves)
+        # host work on the token hot path
+        return tracked_jit(step, name=name, sig_argnums=(1, 2, 4, 5),
+                           donate_argnums=(3,))
+
+    def _decode_fn(self, bucket: int):
+        fn = self._decode_fns.get(bucket)
+        if fn is None:
+            fn = self._make_step(self._engine._fwd, f"serve.decode.b{bucket}")
+            self._decode_fns[bucket] = fn
+        return fn
+
+    def _verify_fn(self, bucket: int):
+        fn = self._verify_fns.get(bucket)
+        if fn is None:
+            fn = self._make_step(self._engine._fwd, f"serve.verify.b{bucket}")
+            self._verify_fns[bucket] = fn
+        return fn
+
+    def _draft_fn(self, bucket: int):
+        fn = self._draft_fns.get(bucket)
+        if fn is None:
+            fn = self._make_step(self._engine._draft_fwd,
+                                 f"serve.draft.b{bucket}")
+            self._draft_fns[bucket] = fn
+        return fn
+
+    def _get_prefill_fn(self, draft: bool = False):
+        if draft:
+            if self._draft_prefill_fn is None:
+                self._draft_prefill_fn = self._make_step(
+                    self._engine._draft_fwd,
+                    f"serve.draft_prefill.c{self._engine.config.prefill_chunk}")
+            return self._draft_prefill_fn
+        if self._prefill_fn is None:
+            self._prefill_fn = self._make_step(
+                self._engine._fwd,
+                f"serve.prefill.c{self._engine.config.prefill_chunk}")
+        return self._prefill_fn
+
+    def warmup(self) -> Dict[str, float]:
+        """Compile every entry with a zero batch (all writes land on the
+        scratch page, all attention is masked) before the first request;
+        with the persistent compile cache set, a relaunched replica
+        replays these in milliseconds."""
+        eng = self._engine
+        cfg = eng.config
+        out: Dict[str, float] = {}
+
+        def run(label, fn, pool, B, T, fwd_params):
+            toks = jnp.zeros((B, T), jnp.int32)
+            qpos = jnp.zeros((B, T), jnp.int32)
+            tables = jnp.zeros((B, eng._table_width), jnp.int32)
+            lens = jnp.zeros((B,), jnp.int32)
+            t0 = time.perf_counter()
+            g, pages = fn(fwd_params, toks, qpos, pool.pages, tables, lens)
+            np.asarray(g)  # block: measure compile+run
+            pool.pages = pages
+            out[label] = (time.perf_counter() - t0) * 1e3
+
+        for b in cfg.decode_buckets:
+            run(f"decode.b{b}", self._decode_fn(b), eng._pool, b, 1,
+                eng._params)
+        run(f"prefill.c{cfg.prefill_chunk}", self._get_prefill_fn(),
+            eng._pool, 1, cfg.prefill_chunk, eng._params)
+        if eng.spec_enabled:
+            for b in cfg.decode_buckets:
+                run(f"verify.b{b}", self._verify_fn(b), eng._pool, b,
+                    cfg.spec_k + 1, eng._params)
+                run(f"draft.b{b}", self._draft_fn(b), eng._draft_pool, b, 1,
+                    eng._draft_params)
+            run(f"draft_prefill.c{cfg.prefill_chunk}",
+                self._get_prefill_fn(draft=True), eng._draft_pool, 1,
+                cfg.prefill_chunk, eng._draft_params)
+        return out
+
+    # -- the loop ----------------------------------------------------------
+    def _run(self):
+        eng = self._engine
+        cfg = eng.config
+        tel = get_telemetry()
+        running = self._running
+        drain_deadline = None
+        try:
+            while True:
+                heartbeat()  # a hung decode step -> watchdog 113
+                if preemption_requested() and not eng.draining:
+                    eng._begin_drain(reason="preempted")
+                if eng.draining:
+                    if drain_deadline is None:
+                        drain_deadline = (time.monotonic()
+                                          + cfg.drain_grace_s)
+                    # in-flight generation may keep decoding inside the
+                    # grace window (short generations finish with full
+                    # text); at expiry — or once nothing is running —
+                    # everything left goes DRAINED with partial text and
+                    # every block returns to the pool
+                    if not running or time.monotonic() >= drain_deadline:
+                        for r in running:
+                            self._retire(r, RequestStatus.DRAINED,
+                                         detail="drained mid-generation")
+                        running.clear()
+                        for r in eng._queue.pop_all():
+                            eng._finish(r, RequestStatus.DRAINED,
+                                        detail="drained before prefill")
+                        return
+                # admission: fill free slots from the queue (drain stops
+                # this — a prompt admitted mid-drain could never finish)
+                while not eng.draining and len(running) < cfg.max_running:
+                    ready, expired = eng._queue.take(
+                        1, timeout=0.0 if running else cfg.idle_poll_s)
+                    for r in expired:
+                        eng._finish(r, RequestStatus.DEADLINE_EXCEEDED,
+                                    detail="deadline expired in queue")
+                    if not ready:
+                        break
+                    running.append(ready[0])
+                if tel.enabled:
+                    tel.gauge("serve/queue_depth", len(eng._queue))
+                    tel.gauge("serve/running", len(running))
+                if not running:
+                    continue
+                # mid-generation deadline shedding: the slot frees and
+                # the partial text is discarded (stale results are never
+                # delivered as success)
+                now = time.monotonic()
+                for r in list(running):
+                    if r.deadline is not None and now >= r.deadline:
+                        self._retire(r, RequestStatus.DEADLINE_EXCEEDED,
+                                     detail="deadline expired "
+                                            "mid-generation")
+                        running.remove(r)
+                if not running:
+                    continue
+                inj = active_injector()
+                if inj is not None:
+                    for r in running:  # injected straggler stalls the round
+                        inj.slow_req(r.id)
+                prefilling = [r for r in running if r.pending > 1]
+                decoding = [r for r in running if r.pending == 1]
+                if prefilling:
+                    self._prefill_chunk(prefilling[0])
+                if decoding:
+                    if eng.spec_enabled:
+                        self._spec_round(decoding)
+                    else:
+                        self._decode_round(decoding)
+                for r in list(running):
+                    if self._done_generating(r):
+                        self._retire(r, RequestStatus.OK)
+                        running.remove(r)
+                self.batch_index += 1
+                if inj is not None:
+                    inj.maybe_sigterm(self.batch_index)
+        except BaseException:
+            # same contract as the PR 7 scheduler: a crash must not
+            # strand accepted requests — latch drain first (post-crash
+            # submits shed REJECTED), then fail everything in flight;
+            # the engine's finish funnel releases their KV blocks
+            tb = traceback.format_exc()
+            eng._begin_drain(reason="scheduler crashed")
+            for r in running + eng._queue.pop_all():
+                if not r.done():
+                    eng._finish(r, RequestStatus.ERROR,
+                                detail=f"scheduler crashed:\n{tb}")
+            running.clear()
+            raise
+        finally:
+            self._stopped.set()
+
+    # -- helpers -----------------------------------------------------------
+    def _done_generating(self, r: GenRequest) -> bool:
+        if r.done():
+            return False  # already terminal via another path
+        if len(r.generated) >= r.max_new:
+            return True
+        return (r.eos_id is not None and r.generated
+                and r.generated[-1] == r.eos_id)
+
+    def _retire(self, r: GenRequest, status: str, detail: str = "") -> None:
+        tel = get_telemetry()
+        if tel.enabled:
+            t = r.ttft_ms()
+            if t is not None:
+                tel.observe("serve/ttft_ms", t)
+            t = r.tpot_ms()
+            if t is not None:
+                tel.observe("serve/tpot_ms", t)
+        self._engine._finish(
+            r, status, outputs=[np.asarray(r.generated, np.int32)],
+            detail=detail)
+
+    def _append_token(self, r: GenRequest, tok: int) -> bool:
+        """Record one sampled token. Returns False when the request had
+        already hit its budget/EOS (speculative rounds may over-produce)."""
+        if len(r.generated) >= r.max_new or \
+                (r.eos_id is not None and r.generated
+                 and r.generated[-1] == r.eos_id):
+            return False
+        now = time.monotonic()
+        if r.first_token_at is None:
+            r.first_token_at = now
+        r.last_token_at = now
+        r.generated.append(int(tok))
+        r.toks.append(int(tok))
+        get_telemetry().counter("serve/tokens_generated")
+        return True
+
+    def _evict(self, victim: GenRequest) -> None:
+        """Recompute-style preemption: free the victim's blocks; it
+        re-enters chunked prefill over its full known token sequence
+        (prompt + generated so far) when capacity returns."""
+        eng = self._engine
+        eng._pool.release(victim.id)
+        victim.ncache = 0
+        if eng.spec_enabled:
+            eng._draft_pool.release(victim.id)
+            victim.draft_ncache = 0
+        victim.evictions += 1
+        get_telemetry().counter("serve/kv_evictions")
+
+    def _ensure_blocks(self, r: GenRequest, n_tokens: int,
+                       draft: bool = False, exclude=()) -> bool:
+        """Grow ``r``'s allocation, evicting the YOUNGEST other running
+        sequence under pool pressure. ``exclude`` protects sequences
+        already accepted into the round's batch — evicting one of those
+        would zero its cache cursor AFTER its feed was decided, feeding
+        the step a sequence whose blocks are gone. False = no capacity
+        even after evictions (r waits a round)."""
+        eng = self._engine
+        pool = eng._draft_pool if draft else eng._pool
+        while not pool.ensure(r.id, n_tokens):
+            victim = next((v for v in reversed(self._running)
+                           if v is not r and v not in exclude
+                           and v.ncache > 0), None)
+            if victim is None:
+                return False
+            self._evict(victim)
+        return True
+
+    def _batch_arrays(self, reqs: List[GenRequest], bucket: int, T: int,
+                      tokens: List[List[int]], draft: bool = False):
+        """Stack per-sequence feeds, padding rows to ``bucket``: padded
+        rows carry kv_len 0, so every write they scatter is redirected to
+        the scratch page and every attention row is fully masked."""
+        eng = self._engine
+        pool = eng._draft_pool if draft else eng._pool
+        nc = [(r.draft_ncache if draft else r.ncache) for r in reqs]
+        toks = np.zeros((bucket, T), np.int32)
+        qpos = np.zeros((bucket, T), np.int32)
+        lens = np.zeros((bucket,), np.int32)
+        tables = np.zeros((bucket, eng._table_width), np.int32)
+        for i, r in enumerate(reqs):
+            toks[i] = tokens[i]
+            qpos[i] = nc[i] + np.arange(T, dtype=np.int32)
+            lens[i] = nc[i] + T
+            tables[i] = pool.block_table(r.id, eng._table_width)
+        return (jnp.asarray(toks), jnp.asarray(qpos), jnp.asarray(tables),
+                jnp.asarray(lens))
+
+    # -- prefill -----------------------------------------------------------
+    def _prefill_chunk(self, r: GenRequest) -> None:
+        eng = self._engine
+        cfg = eng.config
+        tel = get_telemetry()
+        C = cfg.prefill_chunk
+        real = min(C, r.pending)
+        if not self._ensure_blocks(r, r.ncache + real):
+            return  # pool exhausted even after evictions; retry next round
+        if eng.spec_enabled and not self._ensure_blocks(
+                r, r.draft_ncache + real, draft=True):
+            return
+        chunk = r.toks[r.ncache:r.ncache + real] + [0] * (C - real)
+        toks = np.asarray(chunk, np.int32)[None]
+        qpos = (r.ncache + np.arange(C, dtype=np.int32))[None]
+        lens = np.asarray([r.ncache + real], np.int32)
+        table = eng._pool.block_table(r.id, eng._table_width)[None]
+        t0 = time.perf_counter()
+        g, pages = self._get_prefill_fn()(
+            eng._params, jnp.asarray(toks), jnp.asarray(qpos),
+            eng._pool.pages, jnp.asarray(table), jnp.asarray(lens))
+        eng._pool.pages = pages
+        g_np = np.asarray(g)
+        ms = (time.perf_counter() - t0) * 1e3
+        if tel.enabled:
+            tel.counter("serve/prefill_chunks")
+            tel.observe("serve/prefill_ms", ms)
+            tel.observe(f"serve/prefill_ms.c{C}", ms)
+        if eng.spec_enabled:
+            # the draft cache follows the target's chunk schedule so
+            # proposing never needs a separate prompt pass
+            dtable = eng._draft_pool.block_table(r.id, eng._table_width)[None]
+            dlens = np.asarray([r.draft_ncache + real], np.int32)
+            t0 = time.perf_counter()
+            dg, dpages = self._get_prefill_fn(draft=True)(
+                eng._draft_params, jnp.asarray(toks), jnp.asarray(qpos),
+                eng._draft_pool.pages, jnp.asarray(dtable),
+                jnp.asarray(dlens))
+            eng._draft_pool.pages = dpages
+            np.asarray(dg)
+            if tel.enabled:
+                tel.observe(f"serve/draft_prefill_ms.c{C}",
+                            (time.perf_counter() - t0) * 1e3)
+            r.draft_ncache += real
+        r.ncache += real
+        if r.pending == 0:
+            # the chunk covered every known token: the last position's
+            # greedy output IS the first generated token (TTFT stamps
+            # here)
+            self._append_token(r, int(g_np[0, real - 1]))
+
+    # -- plain decode ------------------------------------------------------
+    def _decode_round(self, decoding: List[GenRequest],
+                      protect=()) -> None:
+        """One decode step for every decode-eligible sequence.
+        ``protect`` extends the eviction-exclusion set beyond this
+        round's own batch — the speculative path passes its
+        already-ensured group, whose members must not lose their blocks
+        to the tail's allocations after their feeds were decided."""
+        eng = self._engine
+        tel = get_telemetry()
+        group = []
+        for r in decoding:
+            if r.pending != 1:
+                continue  # evicted by a neighbor's allocation this round
+            if len(group) >= eng.config.max_running:
+                break
+            if self._ensure_blocks(r, r.ncache + 1,
+                                   exclude=group + list(protect)):
+                group.append(r)
+        if not group:
+            return
+        bucket = eng.config.bucket_for(len(group))
+        arrays = self._batch_arrays(group, bucket, 1,
+                                    [[r.toks[-1]] for r in group])
+        t0 = time.perf_counter()
+        g, pages = self._decode_fn(bucket)(eng._params, arrays[0],
+                                           arrays[1], eng._pool.pages,
+                                           arrays[2], arrays[3])
+        eng._pool.pages = pages
+        g_np = np.asarray(g)
+        ms = (time.perf_counter() - t0) * 1e3
+        if tel.enabled:
+            tel.counter("serve/decode_steps")
+            tel.observe("serve/decode_ms", ms)
+            tel.observe(f"serve/decode_ms.b{bucket}", ms)
+            tel.observe("serve/batch_occupancy", len(group) / bucket)
+        for i, r in enumerate(group):
+            r.ncache += 1
+            self._append_token(r, int(g_np[i, 0]))
+
+    # -- speculative decode ------------------------------------------------
+    def _spec_round(self, decoding: List[GenRequest]) -> None:
+        """Draft proposes k tokens per sequence (k cheap steps), target
+        verifies the pending token + all k proposals in ONE (k+1)-token
+        step; the longest proposal prefix matching the target's greedy
+        choice is accepted, plus the target's own next token."""
+        eng = self._engine
+        cfg = eng.config
+        tel = get_telemetry()
+        k = cfg.spec_k
+        group = []
+        tail = []  # too close to max_seq_len for k-ahead writes
+        for r in decoding:
+            if r.pending != 1:
+                continue
+            if len(group) >= cfg.max_running:
+                break
+            # the verify step writes positions ncache..ncache+k: a
+            # sequence within k tokens of max_seq_len cannot take a spec
+            # round (the writes would overflow its block table / position
+            # range) — it finishes its last tokens on the plain decode
+            # path instead
+            if r.ncache + 1 + k > eng.max_seq_len:
+                tail.append(r)
+                continue
+            # target writes k+1 entries; draft catches up + writes k
+            if not self._ensure_blocks(r, r.ncache + 1 + k,
+                                       exclude=group):
+                continue
+            if not self._ensure_blocks(r, len(r.toks) - 1 + k, draft=True,
+                                       exclude=group):
+                continue
+            group.append(r)
+        if tail:
+            # the tail's allocations must not evict spec-group members
+            # whose feeds were already decided from their ensured blocks
+            self._decode_round(tail, protect=group)
+        if not group:
+            return
+        # draft catch-up, gap == 1 (the steady state after a fully
+        # accepted round): ONE batched T=1 draft step for all of them —
+        # not a chunk-padded per-sequence prefill on the hot path
+        gap1 = [r for r in group if len(r.toks) - 1 - r.draft_ncache == 1]
+        if gap1:
+            b1 = cfg.bucket_for(len(gap1))
+            arrays = self._batch_arrays(
+                gap1, b1, 1, [[r.toks[r.draft_ncache]] for r in gap1],
+                draft=True)
+            t0 = time.perf_counter()
+            dg, dpages = self._draft_fn(b1)(
+                eng._draft_params, arrays[0], arrays[1],
+                eng._draft_pool.pages, arrays[2], arrays[3])
+            eng._draft_pool.pages = dpages
+            np.asarray(dg)  # catch-up: only the cache write matters
+            if tel.enabled:
+                ms = (time.perf_counter() - t0) * 1e3
+                tel.observe("serve/draft_ms", ms)
+                tel.observe(f"serve/draft_ms.b{b1}", ms)
+            for r in gap1:
+                r.draft_ncache += 1
+        # chunked catch-up for larger gaps (post-eviction re-prefill)
+        for r in group:
+            while len(r.toks) - 1 - r.draft_ncache > 0:
+                gap = len(r.toks) - 1 - r.draft_ncache
+                real = min(cfg.prefill_chunk, gap)
+                chunk = r.toks[r.draft_ncache:r.draft_ncache + real] \
+                    + [0] * (cfg.prefill_chunk - real)
+                qpos = (r.draft_ncache
+                        + np.arange(cfg.prefill_chunk, dtype=np.int32))[None]
+                dtable = eng._draft_pool.block_table(
+                    r.id, eng._table_width)[None]
+                dlens = np.asarray([r.draft_ncache + real], np.int32)
+                t0 = time.perf_counter()
+                dg, dpages = self._get_prefill_fn(draft=True)(
+                    eng._draft_params,
+                    jnp.asarray(np.asarray(chunk, np.int32)[None]),
+                    jnp.asarray(qpos), eng._draft_pool.pages,
+                    jnp.asarray(dtable), jnp.asarray(dlens))
+                eng._draft_pool.pages = dpages
+                np.asarray(dg)
+                if tel.enabled:
+                    tel.observe(
+                        f"serve/draft_prefill_ms.c{cfg.prefill_chunk}",
+                        (time.perf_counter() - t0) * 1e3)
+                r.draft_ncache += real
+        bucket = cfg.bucket_for(len(group))
+        # phase 1: k sequential draft steps propose greedily (each step
+        # timed into the serve/draft_ms.b<N> hist its serve.draft.b<N>
+        # entry owns, so the draft's decode-step MFU is attributed like
+        # the target's)
+        proposals = [[] for _ in group]
+        feed = [[r.toks[-1]] for r in group]
+        for _ in range(k):
+            arrays = self._batch_arrays(group, bucket, 1, feed, draft=True)
+            t0 = time.perf_counter()
+            dg, dpages = self._draft_fn(bucket)(
+                eng._draft_params, arrays[0], arrays[1],
+                eng._draft_pool.pages, arrays[2], arrays[3])
+            eng._draft_pool.pages = dpages
+            dg_np = np.asarray(dg)
+            if tel.enabled:
+                ms = (time.perf_counter() - t0) * 1e3
+                tel.observe("serve/draft_ms", ms)
+                tel.observe(f"serve/draft_ms.b{bucket}", ms)
+            for i, r in enumerate(group):
+                r.draft_ncache += 1
+                proposals[i].append(int(dg_np[i, 0]))
+            feed = [[p[-1]] for p in proposals]
+        # phase 2: one batched (k+1)-token target verification
+        arrays = self._batch_arrays(
+            group, bucket, k + 1,
+            [[r.toks[-1]] + proposals[i] for i, r in enumerate(group)])
+        t0 = time.perf_counter()
+        g, pages = self._verify_fn(bucket)(eng._params, arrays[0],
+                                           arrays[1], eng._pool.pages,
+                                           arrays[2], arrays[3])
+        eng._pool.pages = pages
+        g_np = np.asarray(g)
+        ms = (time.perf_counter() - t0) * 1e3
+        if tel.enabled:
+            tel.counter("serve/decode_steps")
+            tel.observe("serve/verify_ms", ms)
+            tel.observe(f"serve/verify_ms.b{bucket}", ms)
+            tel.observe("serve/batch_occupancy", len(group) / bucket)
+        # phase 3: accept the longest matching prefix + the correction
+        round_accepted = 0
+        for i, r in enumerate(group):
+            len_old = len(r.toks)
+            a = 0
+            while a < k and proposals[i][a] == int(g_np[i, a]):
+                a += 1
+            new_toks = proposals[i][:a] + [int(g_np[i, a])]
+            for t in new_toks:
+                if not self._append_token(r, t):
+                    break
+            # target cache advanced over the pending token + a accepted
+            # proposals; rejected entries are overwritten when their
+            # positions are legitimately re-fed (and masked until then)
+            r.ncache = min(r.ncache + 1 + a, len(r.toks) - 1)
+            # draft entries beyond the accepted prefix are rolled back
+            # the same way (a == k leaves the draft one token behind —
+            # next round's catch-up chunk covers it)
+            r.draft_ncache = min(len_old + min(a, k - 1), r.draft_ncache)
+            self._spec_proposed += k
+            self._spec_accepted += a
+            round_accepted += a
+        if tel.enabled:
+            tel.counter("serve/spec_proposed", k * len(group))
+            tel.counter("serve/spec_accepted", round_accepted)
+            tel.gauge("serve/spec_accept_rate",
+                      self._spec_accepted / max(self._spec_proposed, 1))
+
+
+def dense_greedy_reference(model, prompt: Sequence[int], max_new: int,
+                           eos_id: Optional[int] = None) -> List[int]:
+    """Greedy decode by FULL-PREFIX recompute through the eval-mode
+    Layer model — the one-shot-predictor-era reference the paged decode
+    path is parity-gated against (and the baseline the decode bench must
+    beat). O(L) recompute per token by construction."""
+    import paddle_tpu
+
+    toks = [int(t) for t in prompt]
+    out: List[int] = []
+    for _ in range(int(max_new)):
+        ids = np.asarray(toks, np.int64)[None]
+        logits = np.asarray(model(paddle_tpu.Tensor(ids)).numpy())
+        t = int(logits[0, -1].argmax())
+        toks.append(t)
+        out.append(t)
+        if eos_id is not None and t == eos_id:
+            break
+    return out
+
+
+class TokenServingEngine(ServingEngine):
+    """Token-level serving over a ``GPTForCausalLM`` — the decode twin of
+    the PR 7 one-shot engine, sharing its whole request lifecycle
+    (admission, deadlines, drain, accounting, preemption exit) and
+    substituting the decode scheduler + paged KV pool for the one-shot
+    batch loop.
+
+    ::
+
+        eng = TokenServingEngine(model, TokenServeConfig(
+            decode_buckets=(1, 2, 4, 8), prefill_chunk=32,
+            kv_blocks=128, kv_dtype="int8"))
+        eng.install_preemption().start()
+        req = eng.submit(prompt_ids, max_new_tokens=64)
+        req.wait()
+        req.outputs[0]          # generated token ids (possibly partial
+                                # when status == 'drained')
+    """
+
+    def __init__(self, model, config: Optional[TokenServeConfig] = None,
+                 draft_model=None):
+        from ...jit.functionalize import get_params
+        from ...text.models.gpt import gpt_decode_fns
+
+        self.config = config or TokenServeConfig()
+        cfg = self.config
+        mcfg = model.config
+        head_dim = mcfg.hidden_size // mcfg.num_heads
+        self._params = get_params(model)
+        self._fwd = gpt_decode_fns(mcfg, cfg.kv_dtype)
+        pool_cfg = KVCacheConfig(
+            mcfg.num_layers, mcfg.num_heads, head_dim,
+            num_blocks=cfg.kv_blocks, block_size=cfg.kv_block_size,
+            dtype=cfg.kv_dtype)
+        max_seq = cfg.max_seq_len or mcfg.max_position_embeddings
+        max_seq = min(max_seq, mcfg.max_position_embeddings)
+        if pool_cfg.blocks_for(max_seq) > pool_cfg.usable_blocks:
+            raise ValueError(
+                f"KV pool ({pool_cfg.usable_blocks} usable blocks of "
+                f"{cfg.kv_block_size}) cannot hold ONE max-length sequence "
+                f"({max_seq} tokens) — raise kv_blocks or lower max_seq_len")
+        self.max_seq_len = max_seq
+        self._pool = KVCachePool(pool_cfg)
+        self._table_width = pool_cfg.blocks_for(max_seq)
+        self.spec_enabled = draft_model is not None and cfg.spec_k > 0
+        if cfg.spec_k > 0 and draft_model is None:
+            raise ValueError("spec_k > 0 needs a draft_model")
+        if self.spec_enabled:
+            dcfg = draft_model.config
+            self._draft_params = get_params(draft_model)
+            self._draft_fwd = gpt_decode_fns(dcfg, cfg.kv_dtype)
+            self._draft_pool = KVCachePool(KVCacheConfig(
+                dcfg.num_layers, dcfg.num_heads,
+                dcfg.hidden_size // dcfg.num_heads,
+                num_blocks=cfg.kv_blocks, block_size=cfg.kv_block_size,
+                dtype=cfg.kv_dtype))
+        else:
+            self._draft_params = self._draft_fwd = self._draft_pool = None
+        self._init_runtime()
+
+    def _make_scheduler(self):
+        return DecodeScheduler(self)
+
+    @property
+    def pool(self) -> KVCachePool:
+        return self._pool
+
+    def _publish_start_gauges(self) -> None:
+        pass  # no predictor, no serving dtype gauge — base start() shared
+
+    def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               eos_id: Optional[int] = None) -> GenRequest:
+        """Admit or shed one generation request. Same contract as the
+        PR 7 submit: ALWAYS returns a request; a shed one is already
+        terminal."""
+        if not self._started:
+            raise RuntimeError("TokenServingEngine.start() first")
+        prompt = np.asarray(prompt_ids)
+        if prompt.ndim != 1 or prompt.size < 1 \
+                or not np.issubdtype(prompt.dtype, np.integer):
+            raise ValueError("prompt_ids must be a non-empty 1-D integer "
+                             f"array, got shape {prompt.shape} "
+                             f"{prompt.dtype}")
+        max_new = (self.config.max_new_tokens if max_new_tokens is None
+                   else int(max_new_tokens))
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        if len(prompt) + max_new > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
+                f"exceeds max_seq_len {self.max_seq_len}")
+        req_id = self._allocate_request_id()
+        req = GenRequest(req_id, prompt.astype(np.int32), max_new,
+                         self._resolve_deadline(req_id, deadline_s),
+                         eos_id=eos_id)
+        return self._admit(req)
+
+    def _finish(self, req, status, outputs=None, detail="", error=None):
+        # the single terminal funnel also owns KV release: whatever path
+        # terminates a request (OK, deadline, drain, crash, reject), its
+        # blocks return to the pool here — leaks are structurally
+        # impossible rather than per-call-site discipline (release is
+        # idempotent and a no-op for requests that never held cache)
+        self._pool.release(req.id)
+        if self.spec_enabled:
+            self._draft_pool.release(req.id)
+        super()._finish(req, status, outputs=outputs, detail=detail,
+                        error=error)
+
+    def kv_accounting(self) -> dict:
+        out = self._pool.accounting()
+        if self.spec_enabled:
+            out["draft"] = self._draft_pool.accounting()
+        return out

@@ -97,8 +97,21 @@ class ServingEngine:
         self._predictor = predictor
         self._serving_fn = predictor.serving_fn()
         self._sample_specs = predictor.sample_specs()
+        self._init_runtime()
+
+    def _make_scheduler(self):
+        """The device-loop this engine runs (the token-level decode
+        engine substitutes its own scheduler; everything else — ledger,
+        admission, drain, preemption — is shared verbatim)."""
+        return BatchScheduler(self)
+
+    def _init_runtime(self) -> None:
+        """Queue + scheduler + the terminal-accounting ledger + drain
+        state — the request-lifecycle core both engine variants share.
+        Requires ``self.config`` to carry at least ``capacity``,
+        ``drain_grace_s`` and ``idle_poll_s``."""
         self._queue = AdmissionQueue(self.config.capacity)
-        self._scheduler = BatchScheduler(self)
+        self._scheduler = self._make_scheduler()
         self._tel = get_telemetry()
         self._id_lock = threading.Lock()
         self._next_id = 0
@@ -133,12 +146,17 @@ class ServingEngine:
         if self._tel.enabled:
             self._tel.gauge("serve/queue_capacity", self.config.capacity)
             self._tel.gauge("serve/draining", 0)
-            self._tel.gauge("serve/dtype_bits",
-                            getattr(self._predictor, "serving_dtype_bits", 32))
+            self._publish_start_gauges()
         self.warmup_ms = self._scheduler.warmup() if warmup else {}
         self._started = True
         self._scheduler.start()
         return self
+
+    def _publish_start_gauges(self) -> None:
+        """Engine-variant start-time gauges (the decode engine has no
+        predictor and overrides this to a no-op)."""
+        self._tel.gauge("serve/dtype_bits",
+                        getattr(self._predictor, "serving_dtype_bits", 32))
 
     # -- client side -------------------------------------------------------
     def submit(self, inputs: Sequence[np.ndarray],
@@ -164,20 +182,36 @@ class ServingEngine:
                     f"request input shape {tuple(a.shape)} != per-sample "
                     f"spec {tuple(shape)} (submit WITHOUT the batch axis)")
             arrays.append(a)
+        req_id = self._allocate_request_id()
+        req = Request(req_id, arrays,
+                      self._resolve_deadline(req_id, deadline_s))
+        return self._admit(req)
+
+    # -- admission funnel (shared by both engine variants) ------------------
+    def _allocate_request_id(self) -> int:
         with self._id_lock:
             req_id = self._next_id
             self._next_id += 1
             self._submitted_total += 1
+        return req_id
+
+    def _resolve_deadline(self, req_id: int,
+                          deadline_s: Optional[float]) -> Optional[float]:
         inj = active_injector()
         if inj is not None:
             storm = inj.storm_deadline(req_id)
             if storm is not None:  # injected deadline storm
-                deadline_s = storm
-        if deadline_s is None:
-            deadline_s = self.config.default_deadline_s
-        req = Request(req_id, arrays, deadline_s)
+                return storm
+        return (self.config.default_deadline_s if deadline_s is None
+                else deadline_s)
+
+    def _admit(self, req: Request) -> Request:
+        """Register + enqueue-or-shed one constructed request — the ONE
+        verdict dispatch both engine variants share, so the
+        exactly-one-terminal ledger semantics cannot drift between
+        them."""
         with self._id_lock:
-            self._pending[req_id] = req
+            self._pending[req.id] = req
         if self._tel.enabled:
             self._tel.counter("serve/requests")
         verdict = self._queue.submit(req)

@@ -1,0 +1,202 @@
+"""Blocked / paged KV-cache pool — the memory system of token-level serving.
+
+The decode traffic shape (long prompt, streamed decode) keeps per-sequence
+state: every generated token attends to every previous token's K/V. A
+naive cache reserves ``max_len`` per sequence up front and wastes most of
+it (sequences finish early, prompts vary 10-100x); this pool instead
+carves one device allocation into fixed-size **blocks** and hands them to
+sequences on demand, vLLM-style:
+
+- device side: ``pages['k'] / pages['v']`` are
+  ``[num_layers, num_blocks, block_size, heads, head_dim]`` arrays; a
+  token at logical position ``p`` of a sequence lives in page
+  ``block_table[p // block_size]`` at slot ``p % block_size``. The pages
+  pytree flows through the jitted decode step (donated — the pool is the
+  single largest serving buffer, it must never exist twice).
+- host side: a free list plus an owner map. ``allocate``/``release`` are
+  O(blocks moved) and run on the scheduler thread; accounting is exact —
+  ``used_blocks`` must return to 0 after a drain, and the decode gate
+  fails on a single leaked block.
+- **int8 storage** (``dtype='int8'``): K/V quantize on write through
+  ``quant.quantize_kv`` (one float32 scale per token-head, stored in
+  ``pages['k_scale']/['v_scale']``) and dequantize per page inside the
+  attention gather — halving (vs bf16) or quartering (vs f32) the cache's
+  HBM so twice the sequences fit before eviction. Accuracy is gated by a
+  bf16-reference parity test (tests/test_decode_serving.py).
+
+Page 0 is a reserved **scratch page**: it is never allocated, and every
+masked-out write (padding rows of a bucketed batch, padded tail of a
+prefill chunk) is redirected into it, so a scatter never needs a
+data-dependent guard inside the compiled step.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...profiler.telemetry import get_telemetry
+
+__all__ = ["KVCacheConfig", "KVCachePool", "SCRATCH_PAGE"]
+
+# page 0: the write target for masked-out tokens (see module docstring)
+SCRATCH_PAGE = 0
+
+_STORE_DTYPES = ("float32", "bfloat16", "int8")
+
+
+class KVCacheConfig:
+    """Geometry + storage dtype of one pool.
+
+    Args:
+        num_layers/num_heads/head_dim: the served model's KV shape.
+        num_blocks: pool capacity in blocks (one is reserved as scratch).
+        block_size: tokens per block — small enough that a finishing
+            sequence strands < block_size slots, large enough that the
+            per-block gather indices stay cheap (16 is the default
+            compromise; vLLM ships the same).
+        dtype: 'float32' | 'bfloat16' | 'int8' storage. int8 adds the
+            per-token-head scale planes.
+        compute_dtype: dtype K/V are dequantized to for the attention
+            dot (defaults to float32 off-int8 storage dtype).
+    """
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 num_blocks: int = 64, block_size: int = 16,
+                 dtype: str = "float32",
+                 compute_dtype: Optional[str] = None):
+        if dtype not in _STORE_DTYPES:
+            raise ValueError(f"kv dtype {dtype!r} not in {_STORE_DTYPES}")
+        if num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (page 0 is scratch)")
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.dtype = dtype
+        self.compute_dtype = compute_dtype or (
+            "float32" if dtype == "int8" else dtype)
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1  # minus the scratch page
+
+    def max_tokens(self) -> int:
+        return self.usable_blocks * self.block_size
+
+    def blocks_for(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.block_size)
+
+
+class KVCachePool:
+    """One device pool + its host-side block accounting."""
+
+    def __init__(self, config: KVCacheConfig):
+        self.config = config
+        c = config
+        shape = (c.num_layers, c.num_blocks, c.block_size, c.num_heads,
+                 c.head_dim)
+        store = jnp.int8 if c.dtype == "int8" else jnp.dtype(c.dtype)
+        self.pages: Dict[str, jnp.ndarray] = {
+            "k": jnp.zeros(shape, store),
+            "v": jnp.zeros(shape, store),
+        }
+        if c.dtype == "int8":
+            sshape = shape[:-1]  # [L, N, bs, H] — one scale per token-head
+            self.pages["k_scale"] = jnp.zeros(sshape, jnp.float32)
+            self.pages["v_scale"] = jnp.zeros(sshape, jnp.float32)
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(1, c.num_blocks))
+        self._owned: Dict[int, List[int]] = {}  # request id -> block ids
+        self._tel = get_telemetry()
+        if self._tel.enabled:
+            self._tel.gauge("serve/kv_blocks_total", c.usable_blocks)
+            self._publish_locked()
+
+    # -- accounting (host, scheduler thread + the engine's finish funnel) --
+    def _publish_locked(self) -> None:
+        if not self._tel.enabled:
+            return
+        used = self.config.usable_blocks - len(self._free)
+        self._tel.gauge("serve/kv_blocks_used", used)
+        self._tel.gauge("serve/kv_occupancy",
+                        used / max(self.config.usable_blocks, 1))
+
+    def ensure(self, owner: int, n_tokens: int) -> bool:
+        """Grow ``owner``'s block list to cover ``n_tokens`` positions.
+        Returns False (allocating NOTHING — no partial grabs to unwind)
+        when the free list cannot cover the growth; the scheduler then
+        evicts or defers."""
+        need = self.config.blocks_for(n_tokens)
+        with self._lock:
+            have = self._owned.setdefault(owner, [])
+            grow = need - len(have)
+            if grow <= 0:
+                return True
+            if grow > len(self._free):
+                return False
+            taken = [self._free.pop() for _ in range(grow)]
+            have.extend(taken)
+            if self._tel.enabled:
+                self._tel.counter("serve/kv_blocks_alloc", len(taken))
+            self._publish_locked()
+            return True
+
+    def release(self, owner: int) -> int:
+        """Return every block of ``owner`` to the free list (idempotent —
+        the engine's terminal funnel calls it for every request, whether
+        or not it ever owned cache). Returns the number freed."""
+        with self._lock:
+            blocks = self._owned.pop(owner, None)
+            if not blocks:
+                return 0
+            self._free.extend(blocks)
+            if self._tel.enabled:
+                self._tel.counter("serve/kv_blocks_free", len(blocks))
+            self._publish_locked()
+            return len(blocks)
+
+    def owned(self, owner: int) -> List[int]:
+        with self._lock:
+            return list(self._owned.get(owner, ()))
+
+    @property
+    def used_blocks(self) -> int:
+        with self._lock:
+            return self.config.usable_blocks - len(self._free)
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def occupancy(self) -> float:
+        return self.used_blocks / max(self.config.usable_blocks, 1)
+
+    def accounting(self) -> dict:
+        """The leak ledger: after a drain, ``leaked_blocks`` must be 0 and
+        ``owners`` empty — the decode gate and the drain test assert it."""
+        with self._lock:
+            used = self.config.usable_blocks - len(self._free)
+            return {"total_blocks": self.config.usable_blocks,
+                    "used_blocks": used,
+                    "leaked_blocks": used,
+                    "owners": sorted(self._owned)}
+
+    # -- device-facing helpers ---------------------------------------------
+    def block_table(self, owner: int, width: int) -> np.ndarray:
+        """``owner``'s page ids padded to ``width`` with the scratch page
+        (padding is never dereferenced — masked by kv_lens/q_positions)."""
+        blocks = self.owned(owner)
+        if len(blocks) > width:
+            raise ValueError(f"owner {owner} holds {len(blocks)} blocks, "
+                             f"table width is {width}")
+        out = np.full(width, SCRATCH_PAGE, np.int32)
+        out[:len(blocks)] = blocks
+        return out
+
+    def table_width(self, max_tokens: int) -> int:
+        return self.config.blocks_for(max_tokens)

@@ -24,7 +24,8 @@ import numpy as np
 from .engine import ServingEngine
 from .request import Request, RequestStatus
 
-__all__ = ["run_load", "run_streams", "summarize"]
+__all__ = ["run_load", "run_streams", "summarize",
+           "run_generation_streams", "summarize_generation"]
 
 
 def summarize(requests: Sequence[Request]) -> Dict:
@@ -52,6 +53,67 @@ def _percentiles(values: List[float]) -> Dict[str, float]:
 
     return {"p50_ms": pct(0.50), "p90_ms": pct(0.90), "p99_ms": pct(0.99),
             "max_ms": float(arr[-1])}
+
+
+def summarize_generation(requests: Sequence["Request"]) -> Dict:
+    """Token-level summary: status counts, generated-token totals, and
+    the two latency distributions that actually describe streamed decode
+    — TTFT (submit → first token) and TPOT (steady-state inter-token
+    time) — each as p50/p90/p99. Built from the request objects' own
+    stamps, independent of telemetry (gates cross-check the two)."""
+    by_status: Dict[str, int] = {}
+    ttft: List[float] = []
+    tpot: List[float] = []
+    n_tokens = 0
+    for r in requests:
+        by_status[r.status] = by_status.get(r.status, 0) + 1
+        n_tokens += len(getattr(r, "generated", ()) or ())
+        t = r.ttft_ms() if hasattr(r, "ttft_ms") else None
+        if t is not None:
+            ttft.append(t)
+        t = r.tpot_ms() if hasattr(r, "tpot_ms") else None
+        if t is not None:
+            tpot.append(t)
+    out = {"submitted": len(requests), "by_status": by_status,
+           "tokens_generated": n_tokens}
+    out.update({f"ttft_{k}": v for k, v in _percentiles(ttft).items()})
+    out.update({f"tpot_{k}": v for k, v in _percentiles(tpot).items()})
+    return out
+
+
+def run_generation_streams(engine, n_streams: int,
+                           requests_per_stream: int,
+                           prompt_fn: Callable[[int], Sequence[int]],
+                           max_new_tokens: Optional[int] = None,
+                           deadline_s: Optional[float] = None) -> Dict:
+    """Closed-loop generation load: ``n_streams`` threads each running
+    submit → wait-for-full-generation → submit against a
+    ``TokenServingEngine``. The headline is ``tokens_per_s`` (generated
+    tokens / wall) at concurrency == n_streams, plus the TTFT/TPOT
+    percentiles of ``summarize_generation``."""
+    all_reqs: List[List] = [[] for _ in range(n_streams)]
+
+    def stream(s: int):
+        for k in range(requests_per_stream):
+            req = engine.submit(prompt_fn(s * requests_per_stream + k),
+                                max_new_tokens=max_new_tokens,
+                                deadline_s=deadline_s)
+            all_reqs[s].append(req)
+            req.wait()
+
+    threads = [threading.Thread(target=stream, args=(s,), daemon=True)
+               for s in range(n_streams)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    out = summarize_generation([r for rs in all_reqs for r in rs])
+    out["streams"] = n_streams
+    out["wall_s"] = wall
+    out["tokens_per_s"] = out["tokens_generated"] / max(wall, 1e-9)
+    return out
 
 
 def run_load(engine: ServingEngine, n_requests: int, rate_per_s: float,

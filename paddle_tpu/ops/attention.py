@@ -29,7 +29,7 @@ logger = logging.getLogger("paddle_tpu.ops")
 __all__ = [
     "blockwise_attention", "flash_attention", "ring_attention",
     "xla_attention", "dot_product_attention", "set_attention_impl",
-    "set_ring_context",
+    "set_ring_context", "paged_attention",
 ]
 
 # Attention implementation selector. 'auto' (default) picks per context:
@@ -557,6 +557,147 @@ def _ring_sharded(q, k, v, causal, blhd):
         return ring_attention(q_, k_, v_, axis, causal, 512)
 
     return sm(local, mesh, (spec, spec, spec), spec)(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Paged attention (decode over the serving KV-cache pool)
+# ---------------------------------------------------------------------------
+# The token-level serving runtime (inference.serving.decode) keeps K/V in
+# a blocked pool: pages [N, block_size, H, D] plus per-sequence block
+# tables. Decode-time attention gathers a sequence's pages by table and
+# attends the query chunk (T=1 for plain decode, T=k+1 for speculative
+# verify, T=chunk for prefill) against them. Two XLA-level tiers with
+# genuinely different memory/compute profiles, selected by
+# tier_policy.select_paged (micro-benched + verdict-cached like every
+# training tier):
+# - 'paged_gather': one gather of the whole context then one fused
+#   masked softmax — fastest while the context is score-tensor-small;
+# - 'paged_scan': lax.scan over pages with online softmax — O(block)
+#   live memory, int8 pages dequantize one page at a time (the actual
+#   HBM win of int8 storage).
+# Positions are logical: token p of a sequence lives in table slot
+# p // block_size at offset p % block_size, so slot index IS position.
+
+
+def _paged_widen(x, scale, compute_dtype):
+    """Pages (possibly int8 + scales) -> compute dtype."""
+    if scale is None:
+        return x.astype(compute_dtype)
+    from ..quant import dequantize_kv
+
+    return dequantize_kv(x, scale, compute_dtype)
+
+
+def _paged_mask(k_pos, q_positions, kv_lens):
+    """[B, T, K] bool: causal (k_pos <= q_pos) AND within the written
+    prefix (k_pos < kv_len) — the second clause keeps padded table slots
+    and stale post-eviction entries unreadable."""
+    return ((k_pos[None, None, :] <= q_positions[:, :, None])
+            & (k_pos[None, None, :] < kv_lens[:, None, None]))
+
+
+def _paged_gather_impl(q, k_pages, v_pages, block_tables, q_positions,
+                       kv_lens, k_scale=None, v_scale=None):
+    """q: [B, T, H, D]; k_pages/v_pages: [N, bs, H, D] (+ [N, bs, H]
+    scales for int8 pools); block_tables: [B, M] int32; q_positions:
+    [B, T] int32 global positions; kv_lens: [B] int32 valid prefix."""
+    B, T, H, D = q.shape
+    bs = k_pages.shape[1]
+    M = block_tables.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    k = _paged_widen(k_pages[block_tables],
+                     None if k_scale is None else k_scale[block_tables],
+                     jnp.float32).reshape(B, M * bs, H, D)
+    v = _paged_widen(v_pages[block_tables],
+                     None if v_scale is None else v_scale[block_tables],
+                     jnp.float32).reshape(B, M * bs, H, D)
+    s = jnp.einsum("bthd,bkhd->bhtk", q.astype(jnp.float32) * scale, k)
+    k_pos = jnp.arange(M * bs, dtype=jnp.int32)
+    mask = _paged_mask(k_pos, q_positions, kv_lens)
+    s = jnp.where(mask[:, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    p = e / jnp.maximum(e.sum(axis=-1, keepdims=True), 1e-30)
+    out = jnp.einsum("bhtk,bkhd->bthd", p, v)
+    return out.astype(q.dtype)
+
+
+def _paged_scan_impl(q, k_pages, v_pages, block_tables, q_positions,
+                     kv_lens, k_scale=None, v_scale=None):
+    """Online-softmax scan over table slots — the flash recurrence over
+    pages. Only one [B, bs, H, D] page pair is live (and, for int8
+    pools, dequantized) per step."""
+    B, T, H, D = q.shape
+    bs = k_pages.shape[1]
+    M = block_tables.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    qf = q.astype(jnp.float32) * scale
+
+    def body(carry, i):
+        acc, m, l = carry
+        pids = block_tables[:, i]  # [B]
+        kc = _paged_widen(k_pages[pids],
+                          None if k_scale is None else k_scale[pids],
+                          jnp.float32)  # [B, bs, H, D]
+        vc = _paged_widen(v_pages[pids],
+                          None if v_scale is None else v_scale[pids],
+                          jnp.float32)
+        s = jnp.einsum("bthd,bshd->bhts", qf, kc)
+        k_pos = i * bs + jnp.arange(bs, dtype=jnp.int32)
+        mask = _paged_mask(k_pos, q_positions, kv_lens)
+        s = jnp.where(mask[:, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        acc = acc * corr[..., None] + jnp.einsum("bhts,bshd->bhtd", p, vc)
+        l = l * corr + p.sum(axis=-1)
+        return (acc, m_new, l), None
+
+    acc0 = jnp.zeros((B, H, T, D), jnp.float32)
+    m0 = jnp.full((B, H, T), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    (acc, m, l), _ = jax.lax.scan(
+        body, (acc0, m0, l0), jnp.arange(M, dtype=jnp.int32))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, q_positions,
+                    kv_lens, k_scale=None, v_scale=None):
+    """Attention of a query chunk against a paged KV cache.
+
+    Args:
+        q: [B, T, H, D] query chunk (T=1 plain decode; T=k+1 speculative
+            verify; T=chunk_size chunked prefill).
+        k_pages/v_pages: one layer's pool pages [N, bs, H, D] (int8 or
+            float storage).
+        block_tables: [B, M] int32 page ids (scratch-padded).
+        q_positions: [B, T] int32 global position of each query token.
+        kv_lens: [B] int32 — number of valid cache positions (tokens of
+            the sequence INCLUDING this chunk's writes).
+        k_scale/v_scale: [N, bs, H] float32 per-token-head scales when
+            the pool stores int8 (``quant.quantize_kv``), else None.
+
+    Tier selection happens at TRACE time via
+    ``tier_policy.select_paged`` — micro-benched on TPU, verdict-cached,
+    zero per-step work — and every dispatch publishes its verdict to
+    ``gauge/attn/tier.paged.*`` (the attribution tier gate covers decode
+    records like every other attention-bearing record)."""
+    from ..profiler.telemetry import get_telemetry
+    from . import tier_policy
+
+    get_telemetry().counter("attn/calls")
+    B, T, H, D = q.shape
+    bs = k_pages.shape[1]
+    M = block_tables.shape[1]
+    tier = tier_policy.select_paged(T, H, D, M, bs, q.dtype,
+                                    k_scale is not None)
+    get_telemetry().gauge(f"attn/tier.paged.t{T}.d{D}",
+                          tier_policy.TIER_IDS.get(tier, -1))
+    impl = (_paged_gather_impl if tier == "paged_gather"
+            else _paged_scan_impl)
+    return impl(q, k_pages, v_pages, block_tables, q_positions, kv_lens,
+                k_scale, v_scale)
 
 
 # ---------------------------------------------------------------------------

@@ -50,14 +50,23 @@ from typing import Dict, List, Optional
 logger = logging.getLogger("paddle_tpu.ops")
 
 __all__ = [
-    "TIER_IDS", "policy_mode", "forced_mode", "cache_path", "select",
-    "publish_tier", "registry", "TierRegistry", "reset",
+    "TIER_IDS", "PAGED_TIERS", "policy_mode", "forced_mode", "cache_path",
+    "select", "select_paged", "publish_tier", "registry", "TierRegistry",
+    "reset",
 ]
 
-# stable numeric ids for the gauge/attn/tier.* telemetry (schema: >= 0)
-TIER_IDS = {"xla": 0, "flash_tpu": 1, "pallas": 2, "blockwise": 3, "ring": 4}
+# stable numeric ids for the gauge/attn/tier.* telemetry (schema: >= 0).
+# paged_gather / paged_scan are the DECODE tiers (attention over the
+# serving KV-cache pool — ops.attention.paged_attention); they join the
+# same id space so one gauge family covers train and serve dispatch.
+TIER_IDS = {"xla": 0, "flash_tpu": 1, "pallas": 2, "blockwise": 3, "ring": 4,
+            "paged_gather": 5, "paged_scan": 6}
 
 _FORCIBLE = ("xla", "flash_tpu", "pallas", "blockwise", "ring")
+
+# decode-path tiers: both are always feasible (pure-XLA gather/scan), so
+# selection is purely a measurement or heuristic question, never a gate
+PAGED_TIERS = ("paged_gather", "paged_scan")
 
 # micro-bench shape: batch is pinned to 1 (every tier scales ~linearly in
 # batch, so the ranking is batch-invariant and the bench stays cheap);
@@ -391,3 +400,146 @@ def select(h: int, L: int, d: int, dtype, causal: bool,
     if verdict is None:
         return None
     return verdict["tier"]
+
+
+# -- paged (decode) tier selection -----------------------------------------
+# The KV-cache decode path has its own pair of tiers
+# (ops.attention.paged_attention): 'paged_gather' materializes the whole
+# gathered context per step (one big fused softmax — wins while the
+# context fits comfortably), 'paged_scan' streams page-by-page with
+# online softmax (O(block) live memory — wins for long contexts and is
+# the only safe choice near HBM capacity). Their crossover depends on
+# rig and shape exactly like the training tiers, so the same machinery
+# applies: measure once per shape key, persist the verdict, zero
+# per-step cost (selection happens at trace time of the decode step).
+
+def paged_policy_mode() -> str:
+    """'bench' | 'heuristic' | a forced paged tier.
+
+    ``PADDLE_TPU_ATTN_PAGED_POLICY`` wins (``paged_gather`` /
+    ``paged_scan`` / ``bench`` / ``heuristic``); unset follows the same
+    default as the training tiers — measure on TPU, heuristic off-TPU
+    (host timings never poison the shared verdict cache)."""
+    v = os.environ.get("PADDLE_TPU_ATTN_PAGED_POLICY", "").strip().lower()
+    if v in PAGED_TIERS or v in ("bench", "heuristic"):
+        return v
+    if v:
+        global _warned_unknown_policy
+        if v != _warned_unknown_policy:
+            _warned_unknown_policy = v
+            logger.warning("tier_policy: unknown "
+                           "PADDLE_TPU_ATTN_PAGED_POLICY=%r — falling back "
+                           "to the heuristic (warned once per value)", v)
+        return "heuristic"
+    import jax
+
+    return "bench" if jax.default_backend() == "tpu" else "heuristic"
+
+
+def make_paged_key(t: int, h: int, d: int, m: int, bs: int, dtype,
+                   quantized: bool) -> str:
+    """Decode-shape verdict key: query chunk length, heads, head_dim,
+    table width x block size (the gathered-context geometry), storage
+    dtype. Batch is deliberately absent — like the training bench's
+    pinned batch, both tiers scale ~linearly in B, so the ranking is
+    batch-invariant and one verdict covers every decode bucket."""
+    q = "int8" if quantized else str(dtype)
+    return f"{_backend_key()}:paged:t{t}:h{h}:d{d}:m{m}x{bs}:{q}"
+
+
+def _paged_heuristic(m: int, bs: int) -> str:
+    # materialized gather is profitable while the gathered context is
+    # score-tensor-small; past that the page-streaming scan bounds live
+    # memory (same 4096 knee the xla/blockwise training split uses)
+    return "paged_gather" if m * bs <= 4096 else "paged_scan"
+
+
+def bench_paged(key: str, t: int, h: int, d: int, m: int, bs: int, dtype,
+                quantized: bool, persist: bool = True) -> Optional[dict]:
+    """Time both paged tiers at [1, t, h, d] queries over an [m*bs]-token
+    paged context and record the winner — forward only (decode is
+    inference; there is no backward to weigh in)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..profiler.telemetry import get_telemetry
+    from . import attention as att
+
+    rng = np.random.RandomState(0)
+    with jax.ensure_compile_time_eval():
+        q = jnp.asarray(rng.randn(1, t, h, d).astype(np.float32), dtype)
+        if quantized:
+            k_pages = jnp.asarray(
+                rng.randint(-127, 127, (m + 1, bs, h, d)), jnp.int8)
+            v_pages = jnp.asarray(
+                rng.randint(-127, 127, (m + 1, bs, h, d)), jnp.int8)
+            k_scale = jnp.asarray(
+                rng.rand(m + 1, bs, h).astype(np.float32)) * 0.01
+            v_scale = jnp.asarray(
+                rng.rand(m + 1, bs, h).astype(np.float32)) * 0.01
+        else:
+            k_pages = jnp.asarray(
+                rng.randn(m + 1, bs, h, d).astype(np.float32), dtype)
+            v_pages = jnp.asarray(
+                rng.randn(m + 1, bs, h, d).astype(np.float32), dtype)
+            k_scale = v_scale = None
+        tables = jnp.asarray(np.arange(1, m + 1, dtype=np.int32)[None, :])
+        q_pos = jnp.asarray(
+            np.arange(m * bs - t, m * bs, dtype=np.int32)[None, :])
+        kv_lens = jnp.asarray(np.asarray([m * bs], np.int32))
+    timings = {}
+    for tier in PAGED_TIERS:
+        impl = (att._paged_gather_impl if tier == "paged_gather"
+                else att._paged_scan_impl)
+
+        def fn(q_, kp, vp, bt, qp, kl, ks=k_scale, vs=v_scale, impl=impl):
+            return impl(q_, kp, vp, bt, qp, kl, ks, vs)
+
+        try:
+            compiled = jax.jit(fn).lower(
+                q, k_pages, v_pages, tables, q_pos, kv_lens).compile()
+            out = compiled(q, k_pages, v_pages, tables, q_pos, kv_lens)
+            np.asarray(out)  # drain before the clock
+            times = []
+            for _ in range(_BENCH_REPS):
+                t0 = time.perf_counter()
+                out = compiled(q, k_pages, v_pages, tables, q_pos, kv_lens)
+                np.asarray(out)
+                times.append(time.perf_counter() - t0)
+            timings[tier] = min(times)  # min: host noise only adds time
+        except Exception as e:
+            logger.info("tier_policy: paged tier %r infeasible (%s: %s)",
+                        tier, type(e).__name__, e)
+    if not timings:
+        return None
+    best = min(timings, key=timings.get)
+    verdict = {"tier": best,
+               "timings_ms": {k2: round(s * 1e3, 3)
+                              for k2, s in timings.items()},
+               "ts": time.time()}
+    _registry.record(key, verdict, persist=persist)
+    get_telemetry().counter("attn/tier_bench")
+    logger.info("tier_policy: %s -> %s (%s)", key, best,
+                ", ".join(f"{k2}={ms:.2f}ms"
+                          for k2, ms in verdict["timings_ms"].items()))
+    return verdict
+
+
+def select_paged(t: int, h: int, d: int, m: int, bs: int, dtype,
+                 quantized: bool) -> str:
+    """The paged tier for this decode shape. Forced > cached verdict >
+    fresh micro-bench (bench mode) > heuristic. Like ``select``, a pure
+    cache hit is one dict lookup at trace time — the verdict bakes into
+    the compiled decode step."""
+    mode = paged_policy_mode()
+    if mode in PAGED_TIERS:
+        return mode
+    if mode == "bench":
+        key = make_paged_key(t, h, d, m, bs, dtype, quantized)
+        verdict = _registry.verdict(key)
+        if verdict is None or verdict.get("tier") not in PAGED_TIERS:
+            verdict = bench_paged(key, t, h, d, m, bs, dtype, quantized)
+        if verdict is not None:
+            return verdict["tier"]
+    return _paged_heuristic(m, bs)

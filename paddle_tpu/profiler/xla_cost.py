@@ -417,6 +417,19 @@ def step_hist_for(entry: str) -> Optional[str]:
     # engine table above (the suffix IS the producer's suffix)
     if entry.startswith("serve.step"):
         return "serve/batch_ms" + entry[len("serve.step"):]
+    # token-level serving (inference.serving.decode): every compiled
+    # decode/prefill/verify entry owns the wall-time histogram the
+    # decode scheduler records under the same bucket suffix, so
+    # decode-STEP MFU is attributed per executable (the decode bench's
+    # headline column)
+    # draft_prefill must match before draft (shared prefix)
+    for stem, hist in (("serve.decode", "serve/decode_ms"),
+                       ("serve.prefill", "serve/prefill_ms"),
+                       ("serve.verify", "serve/verify_ms"),
+                       ("serve.draft_prefill", "serve/draft_prefill_ms"),
+                       ("serve.draft", "serve/draft_ms")):
+        if entry.startswith(stem):
+            return hist + entry[len(stem):]
     return _STEP_HISTS.get(entry)
 
 

@@ -29,6 +29,7 @@ __all__ = [
     "QuantConfig", "FakeQuantDequant", "QuantedLinear", "QuantedConv2D",
     "quant_aware", "convert", "Int8Linear", "Int8Conv2D",
     "PostTrainingQuantization", "quant_dequant",
+    "quantize_kv", "dequantize_kv",
 ]
 
 
@@ -51,6 +52,32 @@ def _weight_scale(w, quant_type, channel_axis, bits):
         shape[channel_axis] = s.shape[0]
         return s.reshape(shape)
     return _absmax_scale(w, bits)
+
+
+def quantize_kv(x, bits=8):
+    """Symmetric int8 quantization of a K/V slab for the paged KV cache
+    (``inference.serving.kv_cache``): one scale per (*leading, head) row,
+    reduced over the trailing head_dim only — the finest granularity that
+    adds no extra reduction pass at decode time (the dequant multiply
+    broadcasts along the dim the attention dot contracts).
+
+    x: [..., H, D] → (int8 [..., H, D], float32 scales [..., H]).
+    The scale formula is the same absmax/qmax rule every other int8 path
+    in this module uses, with the 1e-8 floor so an all-zero page cannot
+    write a zero scale (div-by-zero on a later requantize)."""
+    qmax = 2 ** (bits - 1) - 1
+    scale = jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1),
+                        1e-8) / qmax
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -qmax - 1, qmax).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_kv(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv` — [..., H, D] int8 + [..., H] scales
+    back to ``dtype``. Called per-page inside the paged-attention gather,
+    so only the pages a decode step actually touches are ever widened."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 def quant_dequant(x, scale, bits=8):

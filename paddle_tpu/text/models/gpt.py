@@ -22,7 +22,7 @@ from paddle_tpu.nn import initializer as I
 from paddle_tpu.ops.attention import dot_product_attention
 
 __all__ = ["GPTConfig", "GPT", "GPTForCausalLM", "gpt2_small", "gpt2_medium",
-           "gpt2_tiny"]
+           "gpt2_tiny", "gpt_decode_fns"]
 
 
 @dataclass
@@ -281,6 +281,105 @@ def gpt_functional_fns(config: GPTConfig, sp_axis=None, mp_axis=None):
         return loss.astype(jnp.float32)
 
     return embed_fn, block_fn, head_loss_fn
+
+
+def gpt_decode_fns(config: GPTConfig, kv_dtype: str = "float32"):
+    """Pure KV-cached forward for token-level serving
+    (``inference.serving.decode``): ONE function covers chunked prefill,
+    single-token decode, and speculative verification — they are all
+    "advance the cache by a T-token chunk and return the chunk's logits",
+    differing only in T.
+
+    Returns ``forward_chunk(params, tokens, q_positions, pages,
+    block_tables, kv_lens) -> (logits [B, T, V], pages)`` where
+    ``params`` is the flat ``jit.functionalize.get_params`` dict of a
+    ``GPTForCausalLM`` and ``pages`` is a ``KVCachePool.pages`` pytree
+    (paged layout + scratch-page convention documented in
+    inference/serving/kv_cache.py). Each layer writes the chunk's K/V
+    into its pages (int8 pools quantize on write via
+    ``quant.quantize_kv``), then attends through
+    ``ops.attention.paged_attention`` — so the tier policy measures and
+    selects the decode attention path exactly like the training tiers.
+
+    Numerics match the eval-mode Layer forward (dropout-free, gelu
+    approximate, tied lm_head) up to the attention tier's accumulation
+    order — the paged-vs-dense parity test pins the tolerance.
+    """
+    from paddle_tpu.ops.attention import paged_attention
+
+    nh = config.num_heads
+    hd = config.hidden_size // nh
+    eps = config.layer_norm_epsilon
+    nl = config.num_layers
+    max_pos = config.max_position_embeddings
+    quantized = kv_dtype == "int8"
+    if quantized:
+        from paddle_tpu.quant import quantize_kv
+    store = jnp.int8 if quantized else jnp.dtype(kv_dtype)
+
+    def ln(x, w, b):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mean) * jax.lax.rsqrt(var + eps) * w + b
+
+    def forward_chunk(params, tokens, q_positions, pages, block_tables,
+                      kv_lens):
+        B, T = tokens.shape
+        bs = pages["k"].shape[2]
+        # scatter targets: token t of row b lands in table slot
+        # pos // bs at offset pos % bs; masked-out tokens (padded rows,
+        # padded chunk tails — q_position >= kv_len) are redirected to
+        # the reserved scratch page 0, so the scatter needs no guard
+        valid = q_positions < kv_lens[:, None]
+        width = block_tables.shape[1]
+        page_idx = jnp.take_along_axis(
+            block_tables, jnp.clip(q_positions // bs, 0, width - 1), axis=1)
+        page_idx = jnp.where(valid, page_idx, 0)
+        slot = q_positions % bs
+        pos = jnp.clip(q_positions, 0, max_pos - 1)
+        x = params["gpt.wte.weight"][tokens] + params["gpt.wpe.weight"][pos]
+        for i in range(nl):
+            p = {n: params[f"gpt.h.{i}.{n}"] for n in (
+                "ln_1.weight", "ln_1.bias", "attn.qkv.weight",
+                "attn.qkv.bias", "attn.proj.weight", "attn.proj.bias",
+                "ln_2.weight", "ln_2.bias", "mlp.fc.weight", "mlp.fc.bias",
+                "mlp.proj.weight", "mlp.proj.bias")}
+            h = ln(x, p["ln_1.weight"], p["ln_1.bias"])
+            qkv = h @ p["attn.qkv.weight"] + p["attn.qkv.bias"]
+            q3, k3, v3 = jnp.split(qkv, 3, axis=-1)
+            q3 = q3.reshape(B, T, nh, hd)
+            k3 = k3.reshape(B, T, nh, hd)
+            v3 = v3.reshape(B, T, nh, hd)
+            if quantized:
+                kq, ks = quantize_kv(k3)
+                vq, vs = quantize_kv(v3)
+                pages["k"] = pages["k"].at[i, page_idx, slot].set(kq)
+                pages["v"] = pages["v"].at[i, page_idx, slot].set(vq)
+                pages["k_scale"] = \
+                    pages["k_scale"].at[i, page_idx, slot].set(ks)
+                pages["v_scale"] = \
+                    pages["v_scale"].at[i, page_idx, slot].set(vs)
+                k_sc, v_sc = pages["k_scale"][i], pages["v_scale"][i]
+            else:
+                pages["k"] = pages["k"].at[i, page_idx, slot].set(
+                    k3.astype(store))
+                pages["v"] = pages["v"].at[i, page_idx, slot].set(
+                    v3.astype(store))
+                k_sc = v_sc = None
+            o = paged_attention(q3, pages["k"][i], pages["v"][i],
+                                block_tables, q_positions, kv_lens,
+                                k_sc, v_sc)
+            x = x + o.reshape(B, T, nh * hd) @ p["attn.proj.weight"] \
+                + p["attn.proj.bias"]
+            h2 = ln(x, p["ln_2.weight"], p["ln_2.bias"])
+            h2 = jax.nn.gelu(h2 @ p["mlp.fc.weight"] + p["mlp.fc.bias"],
+                             approximate=True)
+            x = x + h2 @ p["mlp.proj.weight"] + p["mlp.proj.bias"]
+        x = ln(x, params["gpt.ln_f.weight"], params["gpt.ln_f.bias"])
+        logits = x @ params["gpt.wte.weight"].T
+        return logits, pages
+
+    return forward_chunk
 
 
 def _gpt_mp_fns(config: GPTConfig, ln, sp_axis, mp_axis):

@@ -73,6 +73,15 @@ JAX_PLATFORMS=cpu python tools/check_sdc.py
 # through the preemption relaunch path.
 JAX_PLATFORMS=cpu python tools/check_serving.py
 
+# decode gate: the token-level twin — paged-KV greedy decode must be
+# token-identical to the dense recompute-the-prefix reference (logits
+# within tolerance), and a mixed prefill+decode load with injected
+# stragglers plus a mid-generation SIGTERM must drain with every request
+# terminal exactly once, bounded TTFT p99, zero leaked KV blocks
+# (alloc == free across the whole run), and zero attention-tier
+# fallbacks.
+JAX_PLATFORMS=cpu python tools/check_decode.py
+
 if [ -f BENCH_extra.prev.json ]; then
   # LeNet rides per-step dispatch through the remote-TPU tunnel: the r5
   # variance study (tools/profiles/r5_lenet_variance.txt) measured CV 7.6%

@@ -43,13 +43,25 @@ and within one record ``counter/resilience/sdc_repaired`` ≤
 ``sdc_detected`` (every repair is preceded by its detection).
 
 Serving contracts (``inference.serving``): ``counter/serve/*`` are
-monotone request totals ≥ 0; latency/batch histograms
-(``hist/serve/latency_ms*``, ``hist/serve/batch_ms*``) carry only
-non-negative fields; ``hist/serve/batch_occupancy*`` fields sit in
-[0, 1] except count/sum; and within one record
-``gauge/serve/queue_depth`` must sit in [0, ``gauge/serve/
-queue_capacity``] — a depth past the configured capacity means the
-bounded admission queue is not actually bounded.
+monotone request totals ≥ 0 (this covers the KV-cache block accounting
+``counter/serve/kv_blocks_{alloc,free}`` too); latency/batch/token
+histograms (``hist/serve/latency_ms*``, ``hist/serve/batch_ms*``, and
+the token-level ``hist/serve/{ttft_ms,tpot_ms,decode_ms,prefill_ms,
+verify_ms,draft_ms}*``) carry only non-negative fields;
+``hist/serve/batch_occupancy*`` fields sit in [0, 1] except count/sum;
+and within one record ``gauge/serve/queue_depth`` must sit in
+[0, ``gauge/serve/queue_capacity``] — a depth past the configured
+capacity means the bounded admission queue is not actually bounded.
+
+Token-level serving contracts (``inference.serving.decode``):
+``gauge/serve/kv_occupancy`` ∈ [0, 1] and
+``gauge/serve/spec_accept_rate`` ∈ [0, 1] (both are fractions by
+definition); ``gauge/serve/kv_blocks_{total,used}`` ≥ 0; and within one
+record ``kv_blocks_used`` ≤ ``kv_blocks_total`` AND ``kv_occupancy``
+must equal ``used/total`` (small tolerance) — an occupancy gauge that
+disagrees with the block ledger it summarizes means the pool's
+accounting and its telemetry have split, which is exactly how a block
+leak hides.
 """
 from __future__ import annotations
 
@@ -110,10 +122,26 @@ def validate_record(rec, lineno):
         # can never go negative; occupancy is a fraction of the bucket
         if (name.startswith("counter/serve/")
                 or name.startswith("hist/serve/latency_ms")
-                or name.startswith("hist/serve/batch_ms")) \
+                or name.startswith("hist/serve/batch_ms")
+                or name.startswith("hist/serve/ttft_ms")
+                or name.startswith("hist/serve/tpot_ms")
+                or name.startswith("hist/serve/decode_ms")
+                or name.startswith("hist/serve/prefill_ms")
+                or name.startswith("hist/serve/verify_ms")
+                or name.startswith("hist/serve/draft_ms")
+                or name.startswith("hist/serve/draft_prefill_ms")
+                or name in ("gauge/serve/kv_blocks_total",
+                            "gauge/serve/kv_blocks_used")) \
                 and float(value) < 0:
             return (f"line {lineno}: scalar {name!r} = {value!r} "
                     f"is negative (serve totals/latencies are >= 0)")
+        # token-serving fractions: occupancy of the KV pool and the
+        # speculative acceptance rate are [0, 1] by definition
+        if name in ("gauge/serve/kv_occupancy",
+                    "gauge/serve/spec_accept_rate") \
+                and not (0 <= float(value) <= 1):
+            return (f"line {lineno}: scalar {name!r} = {value!r} "
+                    f"outside [0, 1]")
         if name.startswith("hist/serve/batch_occupancy") \
                 and not name.endswith(("/count", "/sum")) \
                 and not (0 <= float(value) <= 1):
@@ -147,6 +175,21 @@ def validate_record(rec, lineno):
         return (f"line {lineno}: counter/resilience/sdc_repaired = {rep!r} "
                 f"exceeds sdc_detected = {det!r} (every repair is "
                 f"preceded by its detection)")
+    # cross-field: the KV pool's occupancy gauge must agree with the
+    # block ledger it summarizes — a drifting pair is how a leak hides
+    used = scalars.get("gauge/serve/kv_blocks_used")
+    total = scalars.get("gauge/serve/kv_blocks_total")
+    occ = scalars.get("gauge/serve/kv_occupancy")
+    if used is not None and total is not None:
+        if float(used) > float(total):
+            return (f"line {lineno}: gauge/serve/kv_blocks_used = {used!r} "
+                    f"exceeds gauge/serve/kv_blocks_total = {total!r} "
+                    f"(the pool is a fixed allocation)")
+        if occ is not None and float(total) > 0 \
+                and abs(float(occ) - float(used) / float(total)) > 1e-6:
+            return (f"line {lineno}: gauge/serve/kv_occupancy = {occ!r} "
+                    f"inconsistent with kv_blocks_used/total = "
+                    f"{used!r}/{total!r}")
     # cross-field: the admission queue is BOUNDED — its observed depth
     # can never exceed the capacity the same record reports
     depth = scalars.get("gauge/serve/queue_depth")
