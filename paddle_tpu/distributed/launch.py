@@ -244,6 +244,19 @@ def _run_job_once(training_script, script_args, envs, log_dir, backend,
         hb = heartbeat_path(log_dir, rank)
         hb_files.append(hb)
         full_env["PADDLE_TPU_HEARTBEAT_FILE"] = hb
+        # ops plane: one HTTP port per rank — a shared PADDLE_TPU_OPS_PORT
+        # would have every local rank racing one bind (first wins, the
+        # rest invisible to the scrape config), so the launcher offsets
+        # the base port by the GLOBAL rank: rank i serves on base + i
+        ops_base = full_env.get("PADDLE_TPU_OPS_PORT", "").strip()
+        if ops_base:
+            try:
+                base_ops_port = int(ops_base)
+            except ValueError:
+                base_ops_port = 0
+            if base_ops_port > 0:
+                full_env["PADDLE_TPU_OPS_PORT"] = str(
+                    base_ops_port + int(rank))
         log_f = open(os.path.join(log_dir, f"workerlog.{rank}"), log_mode)
         logs.append(log_f)
         p = subprocess.Popen(

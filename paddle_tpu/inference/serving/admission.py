@@ -55,6 +55,12 @@ class AdmissionQueue:
                 return REJECT_EXPIRED
             if len(self._dq) >= self.capacity:
                 return REJECT_CAPACITY
+            # sampled-trace stamp UNDER the condition lock: the scheduler
+            # cannot take() this request until the lock releases, so
+            # 'admit' is ordered before every scheduler-side event — a
+            # post-submit stamp on the engine side would race a hot
+            # scheduler all the way past the terminal publication
+            req.trace_event("admit")
             self._dq.append(req)
             self._cond.notify()
             return ADMIT

@@ -170,6 +170,28 @@ class GenRequest(Request):
         return ((self.last_token_at - self.first_token_at)
                 / (len(self.generated) - 1)) * 1e3
 
+    # -- observability (ops plane) ----------------------------------------
+    def phase(self) -> str:
+        """Token-level lifecycle phase: queued (nothing cached yet),
+        prefill (known tokens still entering the cache), or decode."""
+        if self.status != RequestStatus.PENDING:
+            return self.status
+        if self.ncache == 0 and not self.generated:
+            return "queued"
+        return "prefill" if self.pending > 1 else "decode"
+
+    def debug_state(self, now=None) -> dict:
+        out = super().debug_state(now)
+        out.update({
+            "prompt_tokens": self.n_prompt,
+            "tokens_generated": len(self.generated),
+            "max_new_tokens": self.max_new,
+            "kv_cached_tokens": self.ncache,
+            "evictions": self.evictions,
+            "ttft_ms": self.ttft_ms(),
+        })
+        return out
+
 
 class DecodeScheduler:
     """The decode loop — one thread owns the device and the pool.
@@ -340,6 +362,9 @@ class DecodeScheduler:
                                     detail="deadline expired in queue")
                     if not ready:
                         break
+                    ready[0].trace_event(  # sampled: queue wait ends here
+                        "queue",
+                        dur_s=time.monotonic() - ready[0].submitted_at)
                     running.append(ready[0])
                 if tel.enabled:
                     tel.gauge("serve/queue_depth", len(eng._queue))
@@ -508,6 +533,7 @@ class DecodeScheduler:
         eng._pool.pages = pages
         g_np = np.asarray(g)
         ms = (time.perf_counter() - t0) * 1e3
+        r.trace_event(f"prefill.c{C}", dur_s=ms / 1e3)
         if tel.enabled:
             tel.counter("serve/prefill_chunks")
             tel.observe("serve/prefill_ms", ms)
@@ -572,6 +598,7 @@ class DecodeScheduler:
             tel.observe(f"serve/decode_ms.b{bucket}", ms)
             tel.observe("serve/batch_occupancy", len(group) / bucket)
         for i, r in enumerate(group):
+            r.trace_event(f"decode.b{bucket}", dur_s=ms / 1e3)
             r.ncache += 1
             self._append_token(r, int(g_np[i, 0]))
 
@@ -694,6 +721,8 @@ class DecodeScheduler:
         eng._pool.pages = pages
         g_np = np.asarray(g)
         ms = (time.perf_counter() - t0) * 1e3
+        for r in group:  # sampled traces: one spec round = one decode slice
+            r.trace_event(f"decode.spec.b{bucket}", dur_s=ms / 1e3)
         if tel.enabled:
             tel.counter("serve/decode_steps")
             tel.observe("serve/verify_ms", ms)

@@ -32,6 +32,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ...profiler import spans as _spans
 from ...profiler.telemetry import get_telemetry
 from ...resilience.inject import active_injector
 from .admission import (ADMIT, REJECT_CAPACITY, REJECT_DRAINING,
@@ -150,6 +151,17 @@ class ServingEngine:
         self.warmup_ms = self._scheduler.warmup() if warmup else {}
         self._started = True
         self._scheduler.start()
+        # ops plane: register this engine as the rank's live serving
+        # state (drain latch, queue saturation, in-flight ledger) and
+        # arm the env-gated per-rank HTTP server — both no-ops without
+        # PADDLE_TPU_OPS_PORT, and neither may block serving startup
+        try:
+            from ...profiler import ops_server
+
+            ops_server.set_serving_engine(self)
+            ops_server.maybe_start_from_env(telemetry=self._tel)
+        except Exception:
+            pass
         return self
 
     def _publish_start_gauges(self) -> None:
@@ -209,12 +221,17 @@ class ServingEngine:
         """Register + enqueue-or-shed one constructed request — the ONE
         verdict dispatch both engine variants share, so the
         exactly-one-terminal ledger semantics cannot drift between
-        them."""
+        them. Also the ONE place request-scoped traces are minted: a
+        sampled request (PADDLE_TPU_TRACE_SAMPLE, deterministic on id)
+        carries its timeline from here to its terminal transition."""
+        if _spans.should_trace(req.id):
+            req.trace = _spans.ReqTrace(req.id)
+            req.trace_event("submit")
         with self._id_lock:
             self._pending[req.id] = req
         if self._tel.enabled:
             self._tel.counter("serve/requests")
-        verdict = self._queue.submit(req)
+        verdict = self._queue.submit(req)  # stamps 'admit' on admission
         if verdict == ADMIT:
             if self._tel.enabled:
                 self._tel.counter("serve/accepted")
@@ -240,6 +257,13 @@ class ServingEngine:
             if self._tel.enabled:
                 self._tel.counter("serve/double_terminal")
             return
+        if req.trace is not None:
+            # terminal stamp closes the sampled timeline; publishing to
+            # the trace store is what /debug/requests and the chrome
+            # export read — only the WINNING transition publishes, so a
+            # trace appears exactly once
+            req.trace_event(f"terminal:{status}")
+            _spans.trace_store().add(req.trace)
         with self._id_lock:
             self._pending.pop(req.id, None)
             self._status_counts[status] = \
@@ -274,6 +298,18 @@ class ServingEngine:
                     "by_status": dict(self._status_counts),
                     "unaccounted": unaccounted,
                     "double_terminal": self._double_terminal}
+
+    def debug_requests(self, limit: int = 256) -> list:
+        """The in-flight ledger for the ops plane's ``/debug/requests``:
+        one row per PENDING request (age, phase, deadline remaining,
+        generation progress), oldest first, capped at ``limit`` — an
+        overloaded replica must not build an unbounded JSON body."""
+        with self._id_lock:
+            reqs = sorted(self._pending.values(),
+                          key=lambda r: r.submitted_at)
+        now = time.monotonic()
+        return [r.debug_state(now) for r in reqs
+                if r.status == RequestStatus.PENDING][:int(limit)]
 
     # -- batch-formation helpers (scheduler-facing) -------------------------
     def _stack_batch(self, reqs: List[Request], bucket: int

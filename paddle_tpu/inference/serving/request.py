@@ -57,7 +57,7 @@ class Request:
 
     __slots__ = ("id", "inputs", "submitted_at", "deadline", "status",
                  "detail", "outputs", "error", "finished_at", "_done",
-                 "_lock")
+                 "_lock", "trace")
 
     def __init__(self, req_id: int, inputs: Sequence[np.ndarray],
                  deadline_s: Optional[float] = None):
@@ -74,6 +74,10 @@ class Request:
         self.finished_at: Optional[float] = None
         self._done = threading.Event()
         self._lock = threading.Lock()
+        # request-scoped trace (profiler.spans.ReqTrace) — attached at
+        # submit for sampled requests (PADDLE_TPU_TRACE_SAMPLE), None
+        # otherwise; every lifecycle stage stamps through trace_event
+        self.trace = None
 
     # -- terminal transition (single writer wins) --------------------------
     def finish(self, status: str, outputs=None, detail: str = "",
@@ -113,6 +117,40 @@ class Request:
         end = self.finished_at if self.finished_at is not None \
             else time.monotonic()
         return (end - self.submitted_at) * 1e3
+
+    # -- observability (ops plane) ----------------------------------------
+    def trace_event(self, name: str, dur_s: float = 0.0) -> None:
+        """Stamp one lifecycle event onto the request's trace — a no-op
+        for unsampled requests, so call sites never branch."""
+        t = self.trace
+        if t is not None:
+            t.event(name, dur_s)
+
+    def phase(self) -> str:
+        """Coarse lifecycle phase for ``/debug/requests`` (terminal
+        statuses report themselves; a pending one-shot request is either
+        queued or packed into a running batch — the engine does not
+        track which, and 'inflight' is what an operator needs)."""
+        if self.status != RequestStatus.PENDING:
+            return self.status
+        return "inflight"
+
+    def debug_state(self, now: Optional[float] = None) -> dict:
+        """One ``/debug/requests`` row: who is this request, how old is
+        it, how much deadline is left, what is it doing."""
+        now = time.monotonic() if now is None else now
+        out = {
+            "id": self.id,
+            "status": self.status,
+            "phase": self.phase(),
+            "age_ms": (now - self.submitted_at) * 1e3,
+            "deadline_remaining_ms": (
+                None if self.deadline is None
+                else (self.deadline - now) * 1e3),
+        }
+        if self.trace is not None:
+            out["trace_id"] = self.trace.trace_id
+        return out
 
     def __repr__(self):
         return (f"Request(id={self.id}, status={self.status!r}"

@@ -112,6 +112,9 @@ class BatchScheduler:
                     eng._begin_drain(reason="preempted")
                 ready, expired = eng._queue.take(
                     eng.config.max_batch, timeout=eng.config.idle_poll_s)
+                now = time.monotonic()
+                for r in ready:  # sampled traces: queue wait ends here
+                    r.trace_event("queue", dur_s=now - r.submitted_at)
                 for r in expired:
                     eng._finish(r, RequestStatus.DEADLINE_EXCEEDED,
                                 detail="deadline expired in queue")
@@ -165,6 +168,8 @@ class BatchScheduler:
                 eng._finish(r, RequestStatus.ERROR, detail=detail, error=e)
             return
         batch_ms = (time.perf_counter() - t0) * 1e3
+        for r in reqs:  # sampled traces: the compiled step this rode in
+            r.trace_event(f"batch.b{bucket}", dur_s=batch_ms / 1e3)
         if tel.enabled:
             tel.counter("serve/batches")
             tel.observe("serve/batch_ms", batch_ms)

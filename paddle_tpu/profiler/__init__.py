@@ -44,18 +44,30 @@ from ..utils.profiler import (  # noqa: F401
     start_profiler,
     stop_profiler,
 )
+from . import ops_server, slo  # noqa: F401
+from .ops_server import (  # noqa: F401
+    OpsServer,
+    prometheus_text,
+    start_ops_server,
+    stop_ops_server,
+)
 from .retrace import RetraceTracker, reset_trackers, tracked_jit  # noqa: F401
+from .slo import SLOMonitor, SLOObjective, parse_slos  # noqa: F401
 from .spans import (  # noqa: F401
     FlightRecorder,
+    ReqTrace,
     Span,
     flight_recorder,
     span,
+    trace_store,
 )
 from .telemetry import (  # noqa: F401
     Histogram,
     Telemetry,
     get_telemetry,
     sample_device_memory,
+    start_device_memory_sampler,
+    start_periodic_flush,
 )
 from .xla_cost import (  # noqa: F401
     CostRecord,
@@ -68,11 +80,15 @@ from .xla_cost import (  # noqa: F401
 
 __all__ = [
     "Telemetry", "Histogram", "get_telemetry", "sample_device_memory",
+    "start_periodic_flush", "start_device_memory_sampler",
     "tracked_jit", "RetraceTracker", "reset_trackers",
     "Span", "span", "FlightRecorder", "flight_recorder",
+    "ReqTrace", "trace_store",
+    "OpsServer", "start_ops_server", "stop_ops_server", "prometheus_text",
+    "SLOMonitor", "SLOObjective", "parse_slos",
     "CostRecord", "cost_registry", "chip_peaks", "publish_mfu",
     "set_steps_per_call", "capture_compile_cost",
     "Profiler", "RecordEvent", "record_event", "start_profiler",
     "stop_profiler", "export_chrome_tracing",
-    "spans", "xla_cost", "aggregate",
+    "spans", "xla_cost", "aggregate", "ops_server", "slo",
 ]

@@ -35,8 +35,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 __all__ = [
     "read_jsonl", "rank_of_path", "final_scalars", "load_rank_scalars",
     "cluster_view", "detect_stragglers", "detect_dead_ranks",
-    "detect_suspect_chips", "aggregate",
-    "STEP_HIST_PATTERN", "SDC_REPAIR_PATTERN",
+    "detect_suspect_chips", "detect_slo_burns", "aggregate",
+    "STEP_HIST_PATTERN", "SDC_REPAIR_PATTERN", "ALERT_PATTERN",
 ]
 
 # any per-rank step-latency p50 qualifies for straggler comparison
@@ -48,6 +48,10 @@ STEP_HIST_PATTERN = re.compile(r"^hist/.*step_ms/p50$")
 # so any surviving rank's log carries the evidence)
 SDC_REPAIR_PATTERN = re.compile(
     r"^counter/resilience/sdc_repaired\.rank(\d+)$")
+
+# SLO burn-rate alert episodes (profiler.slo bumps counter/alert/<name>
+# on every rising edge of a multi-window burn alert)
+ALERT_PATTERN = re.compile(r"^counter/alert/(.+)$")
 
 _RANK_RE = re.compile(r"rank[._-]?(\d+)")
 
@@ -182,6 +186,30 @@ def detect_suspect_chips(rank_scalars: Dict[int, Dict[str, float]],
     return findings
 
 
+def detect_slo_burns(rank_scalars: Dict[int, Dict[str, float]]) -> List[dict]:
+    """One finding per (rank, objective) whose log carries a fired SLO
+    burn-rate alert (``counter/alert/<objective>`` > 0). An alert is an
+    SLO budget actually burning while the replica served traffic — a
+    run that looks "green" on throughput medians but carries alerts
+    shipped a user-visible degradation. The rank's final burn gauges
+    ride along when present. Sorted most-episodes-first."""
+    findings: List[dict] = []
+    for rank, scalars in sorted(rank_scalars.items()):
+        for name, value in sorted(scalars.items()):
+            m = ALERT_PATTERN.match(name)
+            if not m or float(value) <= 0:
+                continue
+            obj = m.group(1)
+            findings.append({
+                "rank": rank, "objective": obj,
+                "episodes": float(value),
+                "burn_fast": scalars.get(f"gauge/slo/{obj}/burn_fast"),
+                "burn_slow": scalars.get(f"gauge/slo/{obj}/burn_slow"),
+            })
+    findings.sort(key=lambda f: -f["episodes"])
+    return findings
+
+
 def detect_dead_ranks(paths: Sequence[str],
                       rank_scalars: Dict[int, Dict[str, float]],
                       expected_ranks: int) -> List[dict]:
@@ -242,6 +270,7 @@ def aggregate(paths: Sequence[str], threshold: float = 1.25,
         "suspect_chips": detect_suspect_chips(rank_scalars,
                                               max_repairs=suspect_repairs),
         "suspect_repairs": float(suspect_repairs),
+        "slo_burns": detect_slo_burns(rank_scalars),
     }
     if expected_ranks is not None:
         # liveness is judged on UNFILTERED records: a healthy rank whose

@@ -1,0 +1,508 @@
+"""Per-rank HTTP ops plane — live /metrics, health, and debug endpoints.
+
+The telemetry subsystem (PR 1) and the attribution layer (PR 5) are
+post-hoc: counters flush to JSONL at exit, spans export to chrome traces
+after the run. The north-star workload is a serving fleet under live
+traffic, where the operator's questions — "is this replica healthy?",
+"what is it doing right now?", "why is this one request slow?" — must be
+answerable WHILE the process runs. This is the reference framework's
+operability generation (VisualDL scalar streaming + fleet metric
+collection) rebuilt over our richer signal:
+
+- ``GET /metrics`` — Prometheus text exposition (one scrape target per
+  rank) built live from the ``Telemetry`` registry: counters as
+  ``paddle_tpu_<name>_total``, gauges as ``paddle_tpu_<name>``,
+  histograms as summaries (p50/p95/p99 quantile labels + ``_count`` /
+  ``_sum``). Every sample carries a ``rank`` label; the repo's
+  structured suffixes (``.b<N>`` batch buckets, ``.c<N>`` prefill
+  chunks, ``.d<i>`` devices, ``.rank<i>``) become an ``entry`` label so
+  one family aggregates across buckets instead of exploding the
+  namespace.
+- ``GET /healthz`` — is this process trustworthy? Wired to REAL runtime
+  state: watchdog heartbeat freshness (``resilience.watchdog``
+  last-beat age), the serving drain latch, golden-step selftest
+  failures and unrepaired silent corruption
+  (``resilience/selftest_failures``, ``sdc_detected`` vs
+  ``sdc_repaired``), and active SLO burn alerts. 503 + per-source JSON
+  on any failure, so a load balancer ejects a draining or suspect
+  replica before users feel it.
+- ``GET /readyz`` — should this process receive NEW traffic? Healthz
+  plus admission-queue saturation (a full queue sheds; routing new
+  work there just manufactures rejects).
+- ``GET /debug/requests`` — the serving ledger's in-flight requests
+  (age, phase, deadline remaining, tokens generated) plus recently
+  completed sampled request traces.
+- ``GET /debug/spans`` — the always-on flight recorder's event tail
+  (``?n=`` limits), i.e. "what was this process doing just now".
+- ``GET /debug/telemetry`` — the raw flat scalar view (the JSONL
+  payload), for humans with curl and no Prometheus.
+
+Env contract: ``PADDLE_TPU_OPS_PORT`` arms the server
+(``distributed.launch`` auto-offsets it per rank, so rank *i* serves on
+``base + i``); port 0 binds an ephemeral port (tests/gates read
+``server.port``). The server is a stdlib ``ThreadingHTTPServer`` on a
+daemon thread: zero cost on the step/decode hot path beyond the request
+handling itself, and it can never hold a dying process open.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from .telemetry import Telemetry, env_float, get_telemetry
+
+__all__ = [
+    "OpsServer", "start_ops_server", "stop_ops_server", "current_ops_server",
+    "maybe_start_from_env", "prometheus_text", "parse_prometheus_text",
+    "register_health_source", "unregister_health_source", "health_report",
+    "set_serving_engine", "current_serving_engine", "rank",
+]
+
+
+def rank() -> int:
+    """This process's global trainer rank (the ``rank`` label on every
+    exposed sample), from the launcher's env contract; 0 standalone."""
+    for var in ("PADDLE_TRAINER_ID", "PROCESS_ID"):
+        raw = os.environ.get(var)
+        if raw:
+            try:
+                return int(raw)
+            except ValueError:
+                pass
+    return 0
+
+
+# -- Prometheus text exposition ----------------------------------------------
+
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
+# the repo's structured metric suffixes: batch buckets (.b4), prefill
+# chunks (.c32), local devices (.d0), ranks (.rank1) — label material,
+# not name material
+_ENTRY_SUFFIX = re.compile(r"^(.*)\.((?:b|c|d)\d+|rank\d+)$")
+
+
+def _split_entry(name: str):
+    m = _ENTRY_SUFFIX.match(name)
+    return (m.group(1), m.group(2)) if m else (name, None)
+
+
+def _metric_name(name: str, suffix: str = "") -> str:
+    return "paddle_tpu_" + _NAME_SANITIZE.sub("_", name) + suffix
+
+
+def _labels(rank_no: int, entry: Optional[str] = None,
+            quantile: Optional[str] = None) -> str:
+    parts = [f'rank="{rank_no}"']
+    if entry is not None:
+        parts.append(f'entry="{entry}"')
+    if quantile is not None:
+        parts.append(f'quantile="{quantile}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def prometheus_text(telemetry: Optional[Telemetry] = None,
+                    rank_no: Optional[int] = None) -> str:
+    """The live registry as Prometheus text exposition format 0.0.4.
+    Pure function of one ``Telemetry.snapshot()`` — scrapes see a
+    consistent cut, and tests validate without HTTP."""
+    tel = telemetry or get_telemetry()
+    r = rank() if rank_no is None else int(rank_no)
+    snap = tel.snapshot()
+    lines: List[str] = []
+    typed: set = set()
+
+    def emit_type(metric: str, kind: str) -> None:
+        if metric not in typed:
+            typed.add(metric)
+            lines.append(f"# TYPE {metric} {kind}")
+
+    for name in sorted(snap["counters"]):
+        base, entry = _split_entry(name)
+        metric = _metric_name(base, "_total")
+        emit_type(metric, "counter")
+        lines.append(f"{metric}{_labels(r, entry)} "
+                     f"{int(snap['counters'][name])}")
+    for name in sorted(snap["gauges"]):
+        base, entry = _split_entry(name)
+        metric = _metric_name(base)
+        emit_type(metric, "gauge")
+        lines.append(f"{metric}{_labels(r, entry)} "
+                     f"{float(snap['gauges'][name]):.10g}")
+    for name in sorted(snap["histograms"]):
+        s = snap["histograms"][name]
+        base, entry = _split_entry(name)
+        metric = _metric_name(base)
+        emit_type(metric, "summary")
+        for q, field in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            if field in s and s[field] is not None:
+                lines.append(f"{metric}{_labels(r, entry, q)} "
+                             f"{float(s[field]):.10g}")
+        lines.append(f"{metric}_sum{_labels(r, entry)} "
+                     f"{float(s.get('sum', 0.0)):.10g}")
+        lines.append(f"{metric}_count{_labels(r, entry)} "
+                     f"{int(s.get('count', 0))}")
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus_text(text: str) -> Dict[str, List[dict]]:
+    """Strict-enough parser of the exposition this module emits:
+    ``{metric_name: [{labels: {...}, value: float}, ...]}``. Raises
+    ``ValueError`` on any malformed line — the ops gate uses it to
+    assert the exposition actually parses, not merely that bytes came
+    back."""
+    import math
+
+    out: Dict[str, List[dict]] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            if not re.match(r"^# (TYPE|HELP) [a-zA-Z_:][a-zA-Z0-9_:]* ",
+                            line):
+                raise ValueError(f"line {lineno}: malformed comment: "
+                                 f"{line!r}")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: malformed sample: {line!r}")
+        name, labelstr, value = m.groups()
+        try:
+            v = float(value)
+        except ValueError:
+            raise ValueError(f"line {lineno}: non-numeric value {value!r}")
+        if math.isnan(v):
+            raise ValueError(f"line {lineno}: NaN sample for {name}")
+        labels = dict(_LABEL_RE.findall(labelstr or ""))
+        out.setdefault(name, []).append({"labels": labels, "value": v})
+    return out
+
+
+# -- health sources -----------------------------------------------------------
+# A health source is a callable -> {"ok": bool, "ready": bool, "detail":
+# str}. "ok" feeds /healthz (is this process trustworthy), "ready" feeds
+# /readyz (should it receive NEW traffic) — a saturated admission queue
+# is not-ready but perfectly healthy. Built-ins below; subsystems may
+# register more.
+
+_health_lock = threading.Lock()
+_health_sources: Dict[str, Callable[[], dict]] = {}
+_serving_engine = None  # the live ServingEngine this rank runs, if any
+
+
+def register_health_source(name: str, fn: Callable[[], dict]) -> None:
+    with _health_lock:
+        _health_sources[str(name)] = fn
+
+
+def unregister_health_source(name: str) -> None:
+    with _health_lock:
+        _health_sources.pop(str(name), None)
+
+
+def set_serving_engine(engine) -> None:
+    """Called by ``ServingEngine.start()`` so the ops plane can see the
+    drain latch, queue saturation, and the in-flight ledger. Pass None
+    to detach (tests)."""
+    global _serving_engine
+    _serving_engine = engine
+
+
+def current_serving_engine():
+    return _serving_engine
+
+
+def _watchdog_health() -> dict:
+    from ..resilience import watchdog
+
+    age = watchdog.last_beat_age_s()
+    wd = watchdog.current_watchdog()
+    # staleness: explicit env override, else the armed watchdog's own
+    # deadline (the process already declared what "too long" means),
+    # else 60 s once any beat has been seen
+    stale_s = env_float("PADDLE_TPU_OPS_STALE_HEARTBEAT_S",
+                         wd.deadline_s if wd is not None else 60.0)
+    if age is None:
+        return {"ok": True, "ready": True,
+                "detail": "no heartbeat emitted yet (no step/serve loop)"}
+    ok = stale_s <= 0 or age <= stale_s
+    return {"ok": ok, "ready": ok,
+            "detail": f"last heartbeat {age:.1f}s ago"
+                      + ("" if ok else f" (stale > {stale_s:.1f}s)")}
+
+
+def _integrity_health() -> dict:
+    tel = get_telemetry()
+    selftest_fail = tel.counter_value("resilience/selftest_failures")
+    detected = tel.counter_value("resilience/sdc_detected")
+    repaired = tel.counter_value("resilience/sdc_repaired")
+    if selftest_fail > 0:
+        return {"ok": False, "ready": False,
+                "detail": f"golden-step selftest failed {selftest_fail}x "
+                          f"— this chip computes wrong numbers"}
+    if detected > repaired:
+        return {"ok": False, "ready": False,
+                "detail": f"unrepaired silent corruption: detected "
+                          f"{detected}, repaired {repaired}"}
+    return {"ok": True, "ready": True,
+            "detail": f"selftest clean, sdc {detected}/{repaired} "
+                      f"detected/repaired"}
+
+
+def _serving_health() -> dict:
+    eng = _serving_engine
+    if eng is None:
+        return {"ok": True, "ready": True, "detail": "no serving engine"}
+    if eng.draining:
+        return {"ok": False, "ready": False,
+                "detail": f"draining ({eng.drain_reason}) — replica is "
+                          f"going away, eject it"}
+    depth = len(eng._queue)
+    cap = eng.config.capacity
+    sat = depth / cap if cap else 0.0
+    threshold = env_float("PADDLE_TPU_OPS_QUEUE_SAT", 0.95)
+    if sat >= threshold:
+        return {"ok": True, "ready": False,
+                "detail": f"admission queue saturated: {depth}/{cap} — "
+                          f"healthy but shedding, route new work away"}
+    return {"ok": True, "ready": True, "detail": f"queue {depth}/{cap}"}
+
+
+def _slo_health() -> dict:
+    from .slo import get_slo_monitor
+
+    mon = get_slo_monitor()
+    if mon is None:
+        return {"ok": True, "ready": True, "detail": "no SLO monitor"}
+    alerts = mon.active_alerts()
+    if alerts:
+        return {"ok": False, "ready": False,
+                "detail": "SLO budget burning: " + ", ".join(alerts)}
+    return {"ok": True, "ready": True,
+            "detail": f"{len(mon.objectives)} objective(s), no alert"}
+
+
+_BUILTIN_SOURCES = (("watchdog", _watchdog_health),
+                    ("integrity", _integrity_health),
+                    ("serving", _serving_health),
+                    ("slo", _slo_health))
+
+
+def health_report() -> dict:
+    """Evaluate every source. ``{"ok", "ready", "sources": {...}}`` — a
+    source that RAISES reports unhealthy (an ops plane that says "fine"
+    because its checker crashed is worse than none)."""
+    sources: Dict[str, dict] = {}
+    with _health_lock:
+        extra = list(_health_sources.items())
+    for name, fn in list(_BUILTIN_SOURCES) + extra:
+        try:
+            res = dict(fn())
+            res.setdefault("ok", False)
+            res.setdefault("ready", bool(res["ok"]))
+        except Exception as e:  # noqa: BLE001 — any checker crash
+            res = {"ok": False, "ready": False,
+                   "detail": f"health source crashed: {e!r}"}
+        sources[name] = res
+    return {"ok": all(s["ok"] for s in sources.values()),
+            "ready": all(s["ready"] for s in sources.values()),
+            "rank": rank(),
+            "sources": sources}
+
+
+def _debug_requests(limit: int = 256) -> dict:
+    eng = _serving_engine
+    from .spans import trace_store
+
+    inflight: List[dict] = []
+    if eng is not None:
+        try:
+            inflight = eng.debug_requests(limit=limit)
+        except Exception:
+            inflight = []
+    completed = [t.to_dict() for t in trace_store().snapshot(limit)]
+    return {"rank": rank(), "in_flight": inflight,
+            "completed_traces": completed}
+
+
+# -- the HTTP server ----------------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-ops/1"
+
+    def log_message(self, fmt, *args):  # noqa: D102 — silence stderr
+        pass
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        try:
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # scraper went away mid-write; its problem
+
+    def _send_json(self, code: int, obj) -> None:
+        self._send(code, json.dumps(obj, indent=1, sort_keys=True,
+                                    default=str),
+                   "application/json; charset=utf-8")
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        tel = self.server.telemetry  # type: ignore[attr-defined]
+        try:
+            if url.path == "/metrics":
+                tel.counter("ops/scrapes")
+                self._send(200, prometheus_text(tel),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif url.path == "/healthz":
+                rep = health_report()
+                self._send_json(200 if rep["ok"] else 503, rep)
+            elif url.path == "/readyz":
+                rep = health_report()
+                self._send_json(200 if rep["ready"] else 503, rep)
+            elif url.path == "/debug/requests":
+                limit = int(q.get("n", ["256"])[0])
+                self._send_json(200, _debug_requests(limit))
+            elif url.path == "/debug/spans":
+                from .spans import flight_recorder
+
+                n = q.get("n", [None])[0]
+                self._send_json(200, {
+                    "rank": rank(),
+                    "events": flight_recorder().dump(
+                        int(n) if n else None)})
+            elif url.path == "/debug/telemetry":
+                self._send_json(200, tel.scalars())
+            else:
+                self._send_json(404, {"error": f"no route {url.path}",
+                                      "routes": ["/metrics", "/healthz",
+                                                 "/readyz",
+                                                 "/debug/requests",
+                                                 "/debug/spans",
+                                                 "/debug/telemetry"]})
+        except Exception as e:  # noqa: BLE001 — handler must not die
+            try:
+                self._send_json(500, {"error": repr(e)})
+            except Exception:
+                pass
+
+
+class OpsServer:
+    """The env-gated in-process ops plane: a ``ThreadingHTTPServer`` on a
+    daemon thread. ``port=0`` binds ephemerally; read ``.port`` after
+    ``start()``."""
+
+    def __init__(self, port: int, host: str = "0.0.0.0",
+                 telemetry: Optional[Telemetry] = None):
+        self._requested_port = int(port)
+        self.host = host
+        self._tel = telemetry or get_telemetry()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return (self._httpd.server_address[1]
+                if self._httpd is not None else None)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "OpsServer":
+        if self.running:
+            return self
+        httpd = ThreadingHTTPServer((self.host, self._requested_port),
+                                    _Handler)
+        httpd.daemon_threads = True
+        httpd.telemetry = self._tel  # type: ignore[attr-defined]
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, name="OpsServer", daemon=True,
+            kwargs={"poll_interval": 0.25})
+        self._thread.start()
+        self._tel.gauge("ops/port", self.port)
+        return self
+
+    def stop(self, timeout: float = 2.0) -> None:
+        httpd, thread = self._httpd, self._thread
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None and thread.is_alive():
+            thread.join(timeout)
+        self._httpd = None
+        self._thread = None
+
+
+_server: Optional[OpsServer] = None
+_server_lock = threading.Lock()
+
+
+def start_ops_server(port: int, host: str = "0.0.0.0",
+                     telemetry: Optional[Telemetry] = None) -> OpsServer:
+    """Start (or return) the process-wide ops server."""
+    global _server
+    with _server_lock:
+        if _server is not None and _server.running:
+            return _server
+        _server = OpsServer(port, host=host, telemetry=telemetry).start()
+        return _server
+
+
+def stop_ops_server() -> None:
+    global _server
+    with _server_lock:
+        if _server is not None:
+            _server.stop()
+            _server = None
+
+
+def current_ops_server() -> Optional[OpsServer]:
+    return _server
+
+
+def maybe_start_from_env(telemetry: Optional[Telemetry] = None
+                         ) -> Optional[OpsServer]:
+    """PADDLE_TPU_OPS_PORT set → start the server on it (the launcher
+    already offset it per rank). Unset/empty/malformed → None. Also arms
+    the env-gated SLO monitor (PADDLE_TPU_SLO) so a scrape-only process
+    still evaluates its objectives. Never raises: a busy port logs a
+    gauge and moves on — observability must not kill the workload."""
+    raw = os.environ.get("PADDLE_TPU_OPS_PORT", "")
+    if not raw.strip():
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        return None
+    if port < 0:
+        return None
+    try:
+        from . import slo
+
+        slo.maybe_start_from_env(telemetry=telemetry)
+    except Exception:
+        pass
+    try:
+        return start_ops_server(port, telemetry=telemetry)
+    except OSError:
+        # port taken (e.g. two unranked processes with one base port):
+        # record the failure where a scrape of a sibling can see it
+        (telemetry or get_telemetry()).counter("ops/bind_failures")
+        return None

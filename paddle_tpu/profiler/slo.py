@@ -1,0 +1,339 @@
+"""SLO monitor — declarative objectives, sliding windows, burn-rate alerts.
+
+Nine PRs of recorded signal (latency histograms, serve counters, TTFT/
+TPOT) still left "are we meeting our promises RIGHT NOW?" as a human
+judgment over dashboards. This module makes it a computation:
+
+- **Objectives** are declarative: an availability target over the serve
+  terminal counters, or a latency bound at a percentile over any
+  telemetry histogram (``ttft_ms:p99<500`` reads "99% of requests get
+  their first token within 500 ms").
+- **Burn rate** is the SRE-book quantity: ``bad_fraction / error_budget``
+  where the error budget is ``1 - target``. Burn 1.0 spends the budget
+  exactly at the objective's horizon; burn 14 spends a 30-day budget in
+  ~2 days. Each objective is evaluated over TWO sliding windows — a fast
+  one (catches a cliff in minutes) and a slow one (arms the fast one:
+  a single bad batch cannot page) — and the alert fires only when BOTH
+  exceed their thresholds, the standard multi-window guard against both
+  slow-burn blindness and single-spike flapping.
+- **Alerts are telemetry**: each firing bumps ``alert/<objective>``
+  through the schema-gated funnel (``tools/check_telemetry_schema.py``
+  pins ``counter/alert/* >= 0``), live burn rates publish as
+  ``gauge/slo/<objective>/burn_{fast,slow}``, and ``tools/telemetry_agg``
+  folds ``alert/*`` into SLO-BURN findings next to DEAD-RANK/straggler/
+  SUSPECT-CHIP. An active alert also degrades the ops plane's
+  ``/healthz`` (the monitor registers as a health source), so a load
+  balancer ejects a replica that is burning budget before users notice.
+
+Event accounting: counter objectives difference monotone counters, so
+windows are exact. Histogram objectives estimate newly-observed bad
+events from the histogram's bounded sample window
+(``Histogram.recent_above``) — exact while ticks outpace window
+overflow, a proportional estimate beyond (the monitor's tick default of
+1 s against the 1024-sample window makes overflow the overload case,
+where the estimate saturates toward "all bad" anyway).
+
+Env grammar (``PADDLE_TPU_SLO``, ';'-separated)::
+
+    PADDLE_TPU_SLO="availability:0.999;ttft_ms:p99<500;latency_ms:p95<200"
+
+- ``availability:<target>`` — good = ``serve/completed``, bad =
+  ``serve/errors`` + ``serve/deadline_exceeded`` (admission rejects are
+  load shedding by design, surfaced by their own counters).
+- ``<hist>:p<QQ><<bound_ms>`` — histogram ``serve/<hist>`` (or any fully
+  qualified histogram name containing '/'), target ``QQ/100``: "QQ% of
+  observations at or under bound_ms".
+
+Window/threshold knobs: ``PADDLE_TPU_SLO_FAST_S`` (default 60),
+``PADDLE_TPU_SLO_SLOW_S`` (default 300), ``PADDLE_TPU_SLO_FAST_BURN``
+(default 14.4), ``PADDLE_TPU_SLO_SLOW_BURN`` (default 6.0),
+``PADDLE_TPU_SLO_TICK_S`` (default 1.0).
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .telemetry import (Telemetry, _IntervalService, env_float,
+                        get_telemetry)
+
+__all__ = ["SLOObjective", "SLOMonitor", "parse_slos",
+           "install_slo_monitor", "get_slo_monitor", "clear_slo_monitor",
+           "maybe_start_from_env"]
+
+
+class SLOObjective:
+    """One declarative objective.
+
+    Args:
+        name: alert key — fires as ``counter/alert/<name>``.
+        target: good-event fraction promised (0 < target < 1], e.g.
+            0.999 availability or 0.99 for a p99 latency bound.
+        good / bad: counter names (availability mode) — totals are
+            differenced over the windows. ``total = good + bad``.
+        hist / bound_ms: histogram mode — an observation past
+            ``bound_ms`` is a bad event.
+    """
+
+    def __init__(self, name: str, target: float,
+                 good: Sequence[str] = (), bad: Sequence[str] = (),
+                 hist: Optional[str] = None,
+                 bound_ms: Optional[float] = None):
+        if not (0.0 < float(target) <= 1.0):
+            raise ValueError(f"target must be in (0, 1], got {target}")
+        if (hist is None) == (not good and not bad):
+            raise ValueError(
+                f"objective {name!r} needs counters (good/bad) XOR a "
+                f"histogram (hist + bound_ms)")
+        if hist is not None and bound_ms is None:
+            raise ValueError(f"objective {name!r}: hist without bound_ms")
+        self.name = str(name)
+        self.target = float(target)
+        self.good = tuple(good)
+        self.bad = tuple(bad)
+        self.hist = hist
+        self.bound_ms = None if bound_ms is None else float(bound_ms)
+
+    @property
+    def budget(self) -> float:
+        """Error budget: the bad fraction the objective tolerates."""
+        return 1.0 - self.target
+
+    def __repr__(self):
+        what = (f"hist={self.hist} p<={self.bound_ms}ms" if self.hist
+                else f"good={self.good} bad={self.bad}")
+        return f"SLOObjective({self.name}, target={self.target}, {what})"
+
+
+_SLO_HIST_RE = re.compile(r"^\s*([\w./-]+)\s*:\s*p(\d{1,2}(?:\.\d+)?)\s*"
+                          r"<\s*([0-9.]+)\s*$")
+_SLO_AVAIL_RE = re.compile(r"^\s*availability\s*:\s*(0?\.\d+|1(?:\.0*)?)\s*$")
+
+
+def parse_slos(spec: str) -> List[SLOObjective]:
+    """Objectives from the PADDLE_TPU_SLO grammar (see module docstring).
+    A malformed clause raises — a silently dropped objective is an SLO
+    that LOOKS monitored."""
+    out: List[SLOObjective] = []
+    for clause in (spec or "").split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        m = _SLO_AVAIL_RE.match(clause)
+        if m:
+            out.append(SLOObjective(
+                "availability", float(m.group(1)),
+                good=("serve/completed",),
+                bad=("serve/errors", "serve/deadline_exceeded")))
+            continue
+        m = _SLO_HIST_RE.match(clause)
+        if m:
+            hist, pct, bound = m.group(1), float(m.group(2)), \
+                float(m.group(3))
+            if not (0 < pct < 100):
+                raise ValueError(f"SLO percentile out of range: {clause!r}")
+            full = hist if "/" in hist else f"serve/{hist}"
+            out.append(SLOObjective(
+                f"{hist.rsplit('/', 1)[-1]}_p{m.group(2).replace('.', '_')}",
+                pct / 100.0, hist=full, bound_ms=bound))
+            continue
+        raise ValueError(f"unparsable SLO clause: {clause!r} "
+                         f"(grammar: 'availability:0.999' or "
+                         f"'ttft_ms:p99<500')")
+    return out
+
+
+class _ObjectiveState:
+    """Per-objective cumulative (total, bad) event accounting plus the
+    timestamped snapshot ring the windowed rates difference."""
+
+    def __init__(self, objective: SLOObjective):
+        self.obj = objective
+        self.snaps: deque = deque()  # (ts, total, bad)
+        self.alerting = False
+        # histogram mode: cumulative estimates folded from recent_above
+        self._hist_count = 0
+        self._bad_cum = 0.0
+
+    def observe(self, tel: Telemetry, now: float) -> Tuple[float, float]:
+        obj = self.obj
+        if obj.hist is None:
+            bad = float(sum(tel.counter_value(c) for c in obj.bad))
+            total = bad + float(sum(tel.counter_value(c)
+                                    for c in obj.good))
+        else:
+            h = tel._hists.get(obj.hist)  # peek, never create
+            if h is None:
+                total, bad = 0.0, 0.0
+            else:
+                count = h.count
+                new = count - self._hist_count
+                if new > 0:
+                    above, considered = h.recent_above(obj.bound_ms, new)
+                    frac = above / considered if considered else 0.0
+                    self._bad_cum += frac * new
+                    self._hist_count = count
+                total, bad = float(self._hist_count), self._bad_cum
+        self.snaps.append((now, total, bad))
+        return total, bad
+
+    def window_burn(self, window_s: float, now: float) -> float:
+        """Burn rate over the trailing window: bad-fraction of the events
+        that happened in it, divided by the error budget. No events in
+        the window → burn 0 (an idle replica is not failing anyone)."""
+        if not self.snaps:
+            return 0.0
+        now_ts, now_total, now_bad = self.snaps[-1]
+        # newest snapshot at or before the window's left edge (fall back
+        # to the oldest we have: early in a run the window is the run)
+        then_total, then_bad = self.snaps[0][1], self.snaps[0][2]
+        for ts, total, bad in reversed(self.snaps):
+            if now - ts >= window_s:
+                then_total, then_bad = total, bad
+                break
+        d_total = now_total - then_total
+        d_bad = now_bad - then_bad
+        if d_total <= 0:
+            return 0.0
+        bad_rate = min(max(d_bad / d_total, 0.0), 1.0)
+        budget = self.obj.budget
+        if budget <= 0:
+            return float("inf") if bad_rate > 0 else 0.0
+        return bad_rate / budget
+
+    def prune(self, keep_s: float, now: float) -> None:
+        while len(self.snaps) > 2 and now - self.snaps[0][0] > keep_s:
+            self.snaps.popleft()
+
+
+class SLOMonitor:
+    """Evaluates objectives over fast/slow sliding windows on each
+    ``evaluate()`` tick (or continuously via ``start()``'s daemon
+    thread), publishing burn gauges and ``alert/*`` counters."""
+
+    def __init__(self, objectives: Sequence[SLOObjective],
+                 telemetry: Optional[Telemetry] = None,
+                 fast_window_s: Optional[float] = None,
+                 slow_window_s: Optional[float] = None,
+                 fast_burn: Optional[float] = None,
+                 slow_burn: Optional[float] = None):
+        self._tel = telemetry or get_telemetry()
+        self._states = [_ObjectiveState(o) for o in objectives]
+        self.fast_window_s = (fast_window_s if fast_window_s is not None
+                              else env_float("PADDLE_TPU_SLO_FAST_S", 60.0))
+        self.slow_window_s = (slow_window_s if slow_window_s is not None
+                              else env_float("PADDLE_TPU_SLO_SLOW_S", 300.0))
+        self.fast_burn = (fast_burn if fast_burn is not None
+                          else env_float("PADDLE_TPU_SLO_FAST_BURN", 14.4))
+        self.slow_burn = (slow_burn if slow_burn is not None
+                          else env_float("PADDLE_TPU_SLO_SLOW_BURN", 6.0))
+        self._lock = threading.Lock()
+        # loop lifecycle via the shared service helper: each started
+        # thread owns its own stop event, so a stop whose join timed out
+        # (evaluate blocked on a contended lock) can never be revived by
+        # a later start into a second evaluator double-counting episodes
+        self._ticker = _IntervalService("SLOMonitor")
+
+    @property
+    def objectives(self) -> List[SLOObjective]:
+        return [s.obj for s in self._states]
+
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """One tick: snapshot every objective, compute both window burns,
+        latch/unlatch alerts. Returns {objective: {burn_fast, burn_slow,
+        alerting}}."""
+        now = time.monotonic() if now is None else now
+        tel = self._tel
+        out: Dict[str, dict] = {}
+        with self._lock:
+            for st in self._states:
+                st.observe(tel, now)
+                burn_fast = st.window_burn(self.fast_window_s, now)
+                burn_slow = st.window_burn(self.slow_window_s, now)
+                firing = (burn_fast >= self.fast_burn
+                          and burn_slow >= self.slow_burn)
+                if firing and not st.alerting:
+                    # rising edge: ONE alert event per episode — the
+                    # counter counts episodes, the gauge shows state
+                    tel.counter(f"alert/{st.obj.name}")
+                st.alerting = firing
+                name = st.obj.name
+                tel.gauge(f"slo/{name}/burn_fast", burn_fast)
+                tel.gauge(f"slo/{name}/burn_slow", burn_slow)
+                tel.gauge(f"slo/{name}/alerting", 1.0 if firing else 0.0)
+                st.prune(2.0 * self.slow_window_s, now)
+                out[name] = {"burn_fast": burn_fast,
+                             "burn_slow": burn_slow,
+                             "alerting": firing,
+                             "target": st.obj.target}
+            tel.gauge("slo/alerts_active",
+                      float(sum(1 for s in self._states if s.alerting)))
+        return out
+
+    def active_alerts(self) -> List[str]:
+        with self._lock:
+            return [s.obj.name for s in self._states if s.alerting]
+
+    # -- background evaluation --------------------------------------------
+    def start(self, tick_s: Optional[float] = None) -> "SLOMonitor":
+        tick = tick_s if tick_s is not None else env_float(
+            "PADDLE_TPU_SLO_TICK_S", 1.0)
+        self._ticker.start(tick, self.evaluate)
+        return self
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._ticker.stop(timeout)
+
+
+_monitor: Optional[SLOMonitor] = None
+_monitor_lock = threading.Lock()
+
+
+def install_slo_monitor(monitor: Optional[SLOMonitor]) -> None:
+    """Register the process-wide monitor (the ops server's /healthz
+    consults it). Stops and replaces any previous one."""
+    global _monitor
+    with _monitor_lock:
+        if _monitor is not None and _monitor is not monitor:
+            _monitor.stop()
+        _monitor = monitor
+
+
+def get_slo_monitor() -> Optional[SLOMonitor]:
+    return _monitor
+
+
+def clear_slo_monitor() -> None:
+    install_slo_monitor(None)
+
+
+def maybe_start_from_env(telemetry: Optional[Telemetry] = None
+                         ) -> Optional[SLOMonitor]:
+    """PADDLE_TPU_SLO set → parse it, build the monitor, start its tick
+    thread, install it process-wide. Unset/empty → None. Idempotent: an
+    installed monitor is returned as-is. A malformed spec must not kill
+    the workload, but it must be LOUD: a warning plus a
+    ``slo/spec_parse_failures`` counter — a swallowed parse error would
+    be an SLO that looks monitored and never alerts."""
+    existing = get_slo_monitor()
+    if existing is not None:
+        return existing
+    spec = os.environ.get("PADDLE_TPU_SLO", "")
+    if not spec.strip():
+        return None
+    try:
+        objectives = parse_slos(spec)
+    except ValueError as e:
+        import warnings
+
+        (telemetry or get_telemetry()).counter("slo/spec_parse_failures")
+        warnings.warn(f"PADDLE_TPU_SLO ignored — {e}; NO SLO objectives "
+                      f"are being monitored", stacklevel=2)
+        return None
+    monitor = SLOMonitor(objectives, telemetry=telemetry).start()
+    install_slo_monitor(monitor)
+    return monitor

@@ -44,6 +44,8 @@ __all__ = [
     "Span", "span", "current_span", "FlightRecorder", "flight_recorder",
     "SpanStore", "window_store", "open_window", "close_window",
     "window_active", "chrome_events", "drain_window",
+    "ReqTrace", "TraceStore", "trace_store", "trace_sample_rate",
+    "should_trace", "trace_chrome_events",
 ]
 
 _ids = itertools.count(1)  # process-unique span ids (GIL-atomic next())
@@ -281,6 +283,135 @@ def mark(name: str, cat: str = "host", step: Optional[int] = None) -> None:
     """Zero-duration marker span (``Profiler.step()`` boundaries)."""
     with Span(name, cat=cat, step=step):
         pass
+
+
+# -- request-scoped tracing ---------------------------------------------------
+# A sampled serving request carries ONE trace across its whole lifecycle
+# (submit → admit → queue → prefill chunks → decode steps → terminal), so
+# "p99 is slow" decomposes into queue wait vs prefill interleave vs decode
+# stalls for a real request instead of being argued from aggregate
+# histograms. Sampling is deterministic on the request id
+# (PADDLE_TPU_TRACE_SAMPLE: a fraction; 1 traces everything, 0.01 traces
+# every 100th id) so a replayed load plan samples the same requests.
+
+
+class ReqTrace:
+    """The timeline of one sampled request. Events are appended by the
+    submit path, the admission funnel, and the scheduler thread; each is
+    ``(name, t0_seconds_perf_counter, dur_seconds)``. Appends are plain
+    list appends (GIL-atomic) — the trace is written by at most one
+    thread per lifecycle stage and only read after the terminal
+    transition publishes it to the store."""
+
+    __slots__ = ("trace_id", "req_id", "events")
+
+    def __init__(self, req_id: int, trace_id: Optional[str] = None):
+        self.req_id = int(req_id)
+        self.trace_id = trace_id or f"{os.getpid()}-{req_id}"
+        self.events: list = []
+
+    def event(self, name: str, dur_s: float = 0.0) -> None:
+        """Record an event that ENDED now and lasted ``dur_s`` (0 for an
+        instant mark) — call sites measure a duration then stamp it."""
+        now = time.perf_counter()
+        self.events.append((str(name), now - float(dur_s), float(dur_s)))
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "req_id": self.req_id,
+            "events": [{"name": n, "ts_us": t0 * 1e6, "dur_us": d * 1e6}
+                       for n, t0, d in self.events],
+        }
+
+    def chrome_events(self, pid: Optional[int] = None) -> List[dict]:
+        """One self-contained catapult timeline: every event is a complete
+        ("X") slice on a per-request track, all carrying the trace id."""
+        pid = pid if pid is not None else os.getpid()
+        return [{"name": n, "ph": "X", "ts": t0 * 1e6, "dur": d * 1e6,
+                 "pid": pid, "tid": f"req {self.trace_id}", "cat": "request",
+                 "args": {"trace_id": self.trace_id, "req_id": self.req_id}}
+                for n, t0, d in self.events]
+
+
+class TraceStore:
+    """Bounded FIFO of COMPLETED request traces (terminal transition
+    publishes them). Snapshots feed ``/debug/requests``; chrome exports
+    drain (each export owns its window, like the span store)."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        cap = capacity or _env_int("PADDLE_TPU_TRACE_STORE", 256)
+        self._lock = threading.Lock()
+        self._traces: deque = deque(maxlen=cap)
+
+    def __len__(self) -> int:
+        return len(self._traces)
+
+    def add(self, trace: ReqTrace) -> None:
+        with self._lock:
+            self._traces.append(trace)
+
+    def snapshot(self, n: Optional[int] = None) -> List[ReqTrace]:
+        with self._lock:
+            out = list(self._traces)
+        if n is None:
+            return out
+        # n <= 0 means "none": out[-0:] would slice the WHOLE store,
+        # answering a request for the minimum with the maximum payload
+        return out[-n:] if n > 0 else []
+
+    def drain(self) -> List[ReqTrace]:
+        with self._lock:
+            out = list(self._traces)
+            self._traces.clear()
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+
+_traces = TraceStore()
+
+
+def trace_store() -> TraceStore:
+    return _traces
+
+
+def trace_sample_rate() -> float:
+    """PADDLE_TPU_TRACE_SAMPLE as a fraction in [0, 1] (0 = tracing off,
+    the default; malformed values read as 0 — observability must never
+    take the serving path down)."""
+    raw = os.environ.get("PADDLE_TPU_TRACE_SAMPLE", "")
+    if not raw:
+        return 0.0
+    try:
+        rate = float(raw)
+    except ValueError:
+        return 0.0
+    return min(max(rate, 0.0), 1.0)
+
+
+def should_trace(req_id: int, rate: Optional[float] = None) -> bool:
+    """Deterministic id-keyed sampling: rate 1 → every request, rate r →
+    every round(1/r)-th id. Id-keyed (not random) so a replayed load plan
+    samples the same requests and gates can assert on a specific one."""
+    r = trace_sample_rate() if rate is None else rate
+    if r <= 0.0:
+        return False
+    if r >= 1.0:
+        return True
+    return int(req_id) % max(1, int(round(1.0 / r))) == 0
+
+
+def trace_chrome_events(pid: Optional[int] = None,
+                        drain: bool = True) -> List[dict]:
+    """Catapult events of every stored request trace (chrome-export hook)."""
+    traces = _traces.drain() if drain else _traces.snapshot()
+    events: List[dict] = []
+    for t in traces:
+        events.extend(t.chrome_events(pid=pid))
+    return events
 
 
 def chrome_events(records=None, pid: Optional[int] = None) -> List[dict]:

@@ -48,7 +48,9 @@ import numpy as np
 
 from ..core import monitor
 
-__all__ = ["Histogram", "Telemetry", "get_telemetry", "sample_device_memory"]
+__all__ = ["Histogram", "Telemetry", "get_telemetry", "sample_device_memory",
+           "start_periodic_flush", "stop_periodic_flush",
+           "start_device_memory_sampler", "stop_device_memory_sampler"]
 
 _HIST_WINDOW = 1024  # sliding-window size backing the percentile estimates
 
@@ -85,10 +87,23 @@ class Histogram:
                 return float("nan")
             return float(np.percentile(np.asarray(self._window), q))
 
+    def recent_above(self, bound: float, n: int) -> tuple:
+        """``(above, considered)`` over the most recent ``min(n, window)``
+        samples — the SLO monitor's bad-event estimator (fraction of new
+        observations past an objective's latency bound). O(n), off the
+        hot path (called at the monitor tick, never per observe)."""
+        with self._lock:
+            win = list(self._window)[-int(n):] if n > 0 else []
+        return sum(1 for v in win if v > bound), len(win)
+
     def summary(self) -> Dict[str, float]:
         with self._lock:
             if self.count == 0:
-                return {"count": 0}
+                # count/sum must survive even the empty snapshot: the
+                # Prometheus exposition and burn-rate math difference
+                # consecutive snapshots, and a missing field reads as
+                # "metric disappeared", not zero
+                return {"count": 0, "sum": 0.0}
             # copy aggregates under the same lock as the window: an
             # in-flight observe() on another thread must not tear
             # count/sum apart (mean would be wrong in the export)
@@ -326,7 +341,157 @@ def get_telemetry() -> Telemetry:
 
                 _telemetry = Telemetry()
                 atexit.register(_flush_on_exit)
+                _autostart_background(_telemetry)
     return _telemetry
+
+
+def _autostart_background(tel: Telemetry) -> None:
+    """Arm the env-gated background observability services exactly once,
+    when the process-wide Telemetry comes up: the periodic JSONL flush
+    (PADDLE_TPU_TELEMETRY_FLUSH_EVERY_S), the device-memory sampler
+    (PADDLE_TPU_DEVICE_MEM_SAMPLE_EVERY_S), and the per-rank ops HTTP
+    server (PADDLE_TPU_OPS_PORT). All no-ops when their env is unset;
+    none may ever take the process down."""
+    if not tel.enabled:
+        return
+    try:
+        start_periodic_flush(telemetry=tel)
+    except Exception:
+        pass
+    try:
+        start_device_memory_sampler(telemetry=tel)
+    except Exception:
+        pass
+    try:
+        # armed here, NOT inside the ops server: objectives evaluate and
+        # alert into the JSONL/agg funnel even on processes that never
+        # export an HTTP port
+        from . import slo
+
+        slo.maybe_start_from_env(telemetry=tel)
+    except Exception:
+        pass
+    try:
+        from . import ops_server
+
+        ops_server.maybe_start_from_env(telemetry=tel)
+    except Exception:
+        pass
+
+
+# -- periodic JSONL flush -----------------------------------------------------
+# The atexit flush (_flush_on_exit) only covers orderly interpreter
+# teardown: a SIGKILLed / OOMed rank loses its ENTIRE telemetry record,
+# silently shrinking telemetry_agg's cluster medians (the dead-rank
+# detector then reports it, but the signal it did emit while alive is
+# gone). The periodic flusher appends an interval record so the JSONL
+# always holds a recent snapshot no matter how the process dies.
+
+def env_float(name: str, default: float = 0.0) -> float:
+    """Env var as float, ``default`` on unset/malformed — the shared
+    knob parser of the ops plane (slo.py / ops_server.py import it):
+    observability config must never crash the workload it watches."""
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class _IntervalService:
+    """Lifecycle of one background daemon loop (flusher, mem sampler).
+
+    Each started thread owns its OWN stop event: a stop whose join times
+    out (e.g. the body blocked on a stalled filesystem) can never be
+    "revived" by a later start clearing a shared event — the old thread
+    still sees its permanently-set event and exits at its next wait,
+    while the new thread runs off a fresh one. Start/stop are serialized
+    by a lock, so two racing starts cannot both spawn writers."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+
+    def start(self, interval_s: float, body) -> threading.Thread:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self._thread
+            stop = threading.Event()
+
+            def _loop():
+                while not stop.wait(interval_s):
+                    try:
+                        body()
+                    except Exception:
+                        pass  # one failed tick must never kill the loop
+
+            self._stop = stop
+            self._thread = threading.Thread(target=_loop, name=self.name,
+                                            daemon=True)
+            self._thread.start()
+            return self._thread
+
+    def stop(self, timeout: float = 2.0) -> None:
+        with self._lock:
+            stop, thread = self._stop, self._thread
+            self._stop = self._thread = None
+        if stop is not None:
+            stop.set()
+        if thread is not None and thread.is_alive():
+            thread.join(timeout)
+
+
+_flusher = _IntervalService("TelemetryFlush")
+_memsampler = _IntervalService("DeviceMemSampler")
+
+
+def start_periodic_flush(interval_s: Optional[float] = None,
+                         path: Optional[str] = None,
+                         telemetry: Optional[Telemetry] = None,
+                         tag: str = "periodic") -> Optional[threading.Thread]:
+    """Append a telemetry record to ``path`` every ``interval_s`` on a
+    daemon thread. Defaults come from PADDLE_TPU_TELEMETRY_FLUSH_EVERY_S
+    and PADDLE_TPU_TELEMETRY_JSONL; returns None (no thread) when either
+    resolves unset/<= 0. Idempotent: a live flusher is returned as-is."""
+    if interval_s is None:
+        interval_s = env_float("PADDLE_TPU_TELEMETRY_FLUSH_EVERY_S")
+    path = path or os.environ.get("PADDLE_TPU_TELEMETRY_JSONL")
+    if interval_s <= 0 or not path:
+        return None
+    tel = telemetry or get_telemetry()
+    return _flusher.start(interval_s,
+                          lambda: tel.to_jsonl(path, tag=tag))
+
+
+def stop_periodic_flush(timeout: float = 2.0) -> None:
+    _flusher.stop(timeout)
+
+
+# The device-memory sampler: /metrics can only show live HBM
+# in-use/peak if SOMETHING samples the allocator — callers historically
+# had to call sample_device_memory by hand at step boundaries. The
+# env-gated sampler keeps the device/* gauges fresh for scrapes with
+# zero call-site changes.
+
+
+def start_device_memory_sampler(interval_s: Optional[float] = None,
+                                telemetry: Optional[Telemetry] = None,
+                                ) -> Optional[threading.Thread]:
+    """Run ``sample_device_memory`` every ``interval_s`` on a daemon
+    thread (default: PADDLE_TPU_DEVICE_MEM_SAMPLE_EVERY_S; unset/<= 0 →
+    no thread). Idempotent while a sampler is alive."""
+    if interval_s is None:
+        interval_s = env_float("PADDLE_TPU_DEVICE_MEM_SAMPLE_EVERY_S")
+    if interval_s <= 0:
+        return None
+    tel = telemetry or get_telemetry()
+    return _memsampler.start(interval_s,
+                             lambda: sample_device_memory(tel))
+
+
+def stop_device_memory_sampler(timeout: float = 2.0) -> None:
+    _memsampler.stop(timeout)
 
 
 if os.environ.get("PADDLE_TPU_TELEMETRY_JSONL"):

@@ -27,7 +27,8 @@ import traceback
 from typing import Callable, Optional
 
 __all__ = ["EXIT_WATCHDOG", "Watchdog", "install_watchdog",
-           "uninstall_watchdog", "heartbeat", "current_watchdog"]
+           "uninstall_watchdog", "heartbeat", "current_watchdog",
+           "last_beat_age_s"]
 
 # Distinct exit code for "step deadline exceeded, self-aborted with a
 # stack dump" (see module docstring; EXIT_PREEMPTED = 77 is the
@@ -233,11 +234,28 @@ def current_watchdog() -> Optional[Watchdog]:
     return _active
 
 
+# monotonic stamp of the last heartbeat() call, armed watchdog or not —
+# the ops plane's /healthz judges liveness from it even on processes
+# that never installed an in-process watchdog (serving schedulers beat
+# every loop iteration)
+_last_beat: Optional[float] = None
+
+
+def last_beat_age_s() -> Optional[float]:
+    """Seconds since the last ``heartbeat()`` in this process, or None
+    when no beat has ever been emitted (a process with no step/serve
+    loop has no liveness signal to judge)."""
+    last = _last_beat
+    return None if last is None else time.monotonic() - last
+
+
 def heartbeat(step: Optional[int] = None) -> None:
     """Step-boundary beat — the one call sites use. Feeds the in-process
     watchdog (when armed) AND the per-rank heartbeat file the launch
     supervisor watches (when PADDLE_TPU_HEARTBEAT_FILE is exported).
-    Near-no-op (two global reads) when neither is configured."""
+    Near-no-op (three global reads/stores) when neither is configured."""
+    global _last_beat
+    _last_beat = time.monotonic()
     w = _active
     if w is not None:
         w.beat(step)

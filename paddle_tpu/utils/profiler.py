@@ -105,6 +105,10 @@ def export_chrome_tracing(path: str):
     each export owns its spans, so repeated windows cannot accumulate."""
     pid = os.getpid()
     events = _spans().chrome_events(pid=pid)
+    # sampled request timelines (profiler.spans.ReqTrace) ride along as
+    # per-request tracks: each sampled serving request exports its whole
+    # queue → prefill → decode → terminal lifecycle under one trace id
+    events += _spans().trace_chrome_events(pid=pid)
     # telemetry counter snapshots ride along as instant events ("i") so
     # counter values line up against the spans in the same timeline; a
     # final snapshot is always appended so the export carries the

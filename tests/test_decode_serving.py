@@ -671,6 +671,44 @@ _DRAIN_WORKER = textwrap.dedent("""
 """)
 
 
+class TestRequestTracing:
+    def test_sampled_generation_timeline(self, monkeypatch):
+        """ISSUE 13: a sampled generation request exports ONE
+        self-contained timeline — queue → prefill chunks → decode steps
+        → terminal — under one trace id."""
+        from paddle_tpu.profiler import spans
+
+        monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "1")
+        spans.trace_store().clear()
+        # one decode bucket: the timeline needs one compiled entry per
+        # kind, not the full bucket ladder's compile bill
+        eng, _ = make_engine(decode_buckets=(1,))
+        eng.start()
+        try:
+            # prompt spans 2 prefill chunks (chunk=8), then decodes
+            req = eng.submit(np.arange(1, 13, dtype=np.int32),
+                             max_new_tokens=4)
+            assert req.wait(60) and req.status == RequestStatus.OK
+        finally:
+            eng.shutdown()
+        traces = [t for t in spans.trace_store().snapshot()
+                  if t.req_id == req.id]
+        assert len(traces) == 1
+        names = [n for n, _t0, _d in traces[0].events]
+        assert names[0] == "submit" and names[1] == "admit"
+        assert "queue" in names
+        assert sum(1 for n in names if n.startswith("prefill.c8")) >= 2
+        assert any(n.startswith("decode.b") for n in names)
+        assert names[-1] == "terminal:ok"
+        # lifecycle order: all prefill slices precede the first decode
+        assert max(i for i, n in enumerate(names)
+                   if n.startswith("prefill.")) < \
+            min(i for i, n in enumerate(names) if n.startswith("decode."))
+        evs = traces[0].chrome_events(pid=1)
+        assert len({e["args"]["trace_id"] for e in evs}) == 1
+        spans.trace_store().clear()
+
+
 class TestDrainMidGeneration:
     def test_sigterm_mid_decode_exits_77_no_leaks(self, tmp_path):
         """ISSUE satellite: subprocess SIGTERM while N streams are

@@ -1,0 +1,747 @@
+"""Live operations plane (ISSUE 13): per-rank /metrics + health endpoints
+over the live Telemetry registry, request-scoped tracing threaded through
+the serving lifecycle, SLO burn-rate alerting through the schema-gated
+alert/* funnel, periodic telemetry flush, and the background
+device-memory sampler."""
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.inference.serving import ServeConfig, ServingEngine
+from paddle_tpu.inference.serving.decode import GenRequest
+from paddle_tpu.profiler import ops_server, slo, spans
+from paddle_tpu.profiler.telemetry import (Histogram, Telemetry,
+                                           get_telemetry,
+                                           start_device_memory_sampler,
+                                           start_periodic_flush,
+                                           stop_device_memory_sampler,
+                                           stop_periodic_flush)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+
+
+def _reset_ops_state():
+    from paddle_tpu.core import monitor
+
+    ops_server.stop_ops_server()
+    ops_server.set_serving_engine(None)
+    slo.clear_slo_monitor()
+    spans.trace_store().clear()
+    stop_periodic_flush()
+    stop_device_memory_sampler()
+    # the integrity health source reads process-lifetime counters (a
+    # real selftest failure SHOULD latch /healthz unhealthy forever);
+    # earlier suites (test_integrity, test_cluster_resilience) fail
+    # selftests and inject SDC on purpose — zero their counters so this
+    # file judges only its own runtime
+    for name in ("resilience/selftest_failures", "resilience/sdc_detected",
+                 "resilience/sdc_repaired"):
+        monitor.stat_reset(name)
+
+
+@pytest.fixture(autouse=True)
+def _clean_ops_state():
+    """The ops plane keeps process-wide registrations (server, serving
+    engine, SLO monitor, trace store) — isolate every test BOTH ways:
+    earlier suites (e.g. test_serving) may have left a drained engine
+    registered, and nothing here may leak forward."""
+    _reset_ops_state()
+    yield
+    _reset_ops_state()
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+
+
+class TestPrometheusText:
+    def test_counters_gauges_hists_render_and_parse(self):
+        tel = get_telemetry()
+        tel.counter("opstest/reqs", 7)
+        tel.gauge("opstest/depth", 3.5)
+        for v in (1.0, 2.0, 3.0):
+            tel.observe("opstest/lat_ms", v)
+        text = ops_server.prometheus_text(tel, rank_no=2)
+        parsed = ops_server.parse_prometheus_text(text)
+        rows = parsed["paddle_tpu_opstest_reqs_total"]
+        assert rows[0]["labels"]["rank"] == "2"
+        assert rows[0]["value"] == 7
+        assert parsed["paddle_tpu_opstest_depth"][0]["value"] == 3.5
+        assert parsed["paddle_tpu_opstest_lat_ms_count"][0]["value"] == 3
+        assert parsed["paddle_tpu_opstest_lat_ms_sum"][0]["value"] == 6.0
+        quantiles = {r["labels"]["quantile"]
+                     for r in parsed["paddle_tpu_opstest_lat_ms"]}
+        assert quantiles == {"0.5", "0.95", "0.99"}
+
+    def test_structured_suffixes_become_entry_labels(self):
+        tel = get_telemetry()
+        tel.observe("opstest/batch_ms.b4", 2.0)
+        tel.observe("opstest/batch_ms.b8", 4.0)
+        tel.gauge("opstest/mem.d0", 10.0)
+        parsed = ops_server.parse_prometheus_text(
+            ops_server.prometheus_text(tel, rank_no=0))
+        entries = {r["labels"]["entry"]
+                   for r in parsed["paddle_tpu_opstest_batch_ms_count"]}
+        assert entries == {"b4", "b8"}
+        assert parsed["paddle_tpu_opstest_mem"][0]["labels"]["entry"] == "d0"
+
+    def test_type_line_emitted_once_per_family(self):
+        tel = get_telemetry()
+        tel.observe("opstest/fam_ms.b1", 1.0)
+        tel.observe("opstest/fam_ms.b2", 1.0)
+        text = ops_server.prometheus_text(tel, rank_no=0)
+        assert text.count("# TYPE paddle_tpu_opstest_fam_ms summary") == 1
+
+    def test_parser_rejects_malformed(self):
+        with pytest.raises(ValueError):
+            ops_server.parse_prometheus_text("metric{unclosed 1\n")
+        with pytest.raises(ValueError):
+            ops_server.parse_prometheus_text("metric nan_is_not allowed\n")
+        with pytest.raises(ValueError):
+            ops_server.parse_prometheus_text("metric NaN\n")
+
+
+# ---------------------------------------------------------------------------
+# Histogram satellite: count/sum survive every snapshot; burn-math helper
+
+
+class TestHistogramAccounting:
+    def test_empty_summary_carries_count_and_sum(self):
+        s = Histogram().summary()
+        assert s["count"] == 0 and s["sum"] == 0.0
+
+    def test_summary_count_sum_consistent(self):
+        h = Histogram()
+        for v in (1.0, 2.0, 5.0):
+            h.observe(v)
+        s = h.summary()
+        assert s["count"] == 3 and s["sum"] == 8.0
+        assert abs(s["mean"] - 8.0 / 3) < 1e-12
+
+    def test_recent_above(self):
+        h = Histogram()
+        for v in (1.0, 1.0, 100.0, 100.0):
+            h.observe(v)
+        above, considered = h.recent_above(10.0, 3)
+        assert (above, considered) == (2, 3)
+        assert h.recent_above(10.0, 100) == (2, 4)  # clamped to window
+        assert h.recent_above(10.0, 0) == (0, 0)
+
+
+# ---------------------------------------------------------------------------
+# Request-scoped tracing primitives
+
+
+class TestTracing:
+    def test_should_trace_deterministic(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "1")
+        assert all(spans.should_trace(i) for i in range(5))
+        monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "0.25")
+        hits = [i for i in range(16) if spans.should_trace(i)]
+        assert hits == [0, 4, 8, 12]
+        monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "0")
+        assert not any(spans.should_trace(i) for i in range(5))
+        monkeypatch.delenv("PADDLE_TPU_TRACE_SAMPLE")
+        assert not spans.should_trace(0)
+        monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "garbage")
+        assert not spans.should_trace(0)  # malformed reads as off
+
+    def test_trace_events_and_chrome_export(self):
+        t = spans.ReqTrace(17)
+        t.event("submit")
+        t.event("queue", dur_s=0.25)
+        t.event("terminal:ok")
+        d = t.to_dict()
+        assert [e["name"] for e in d["events"]] == \
+            ["submit", "queue", "terminal:ok"]
+        assert d["events"][1]["dur_us"] == pytest.approx(0.25e6)
+        evs = t.chrome_events(pid=1)
+        assert all(e["args"]["trace_id"] == t.trace_id for e in evs)
+        assert all(e["ph"] == "X" for e in evs)
+        # ONE trace id ties the whole timeline together
+        assert len({e["tid"] for e in evs}) == 1
+
+    def test_trace_store_bounded_and_drained(self):
+        store = spans.TraceStore(capacity=3)
+        for i in range(5):
+            store.add(spans.ReqTrace(i))
+        assert len(store) == 3
+        assert [t.req_id for t in store.snapshot()] == [2, 3, 4]
+        assert [t.req_id for t in store.snapshot(2)] == [3, 4]
+        assert store.snapshot(0) == []  # n=0 means none, not all
+        assert len(store.drain()) == 3
+        assert len(store) == 0
+
+    def test_trace_chrome_events_drain_global_store(self):
+        t = spans.ReqTrace(99)
+        t.event("submit")
+        spans.trace_store().add(t)
+        evs = spans.trace_chrome_events(pid=1)
+        assert any(e["args"]["req_id"] == 99 for e in evs)
+        assert len(spans.trace_store()) == 0  # drained
+
+
+# ---------------------------------------------------------------------------
+# SLO objectives + burn-rate monitor
+
+
+class TestSLO:
+    def test_parse_grammar(self):
+        objs = slo.parse_slos(
+            "availability:0.999;ttft_ms:p99<500; latency_ms:p95<200")
+        assert [o.name for o in objs] == \
+            ["availability", "ttft_ms_p99", "latency_ms_p95"]
+        assert objs[0].good == ("serve/completed",)
+        assert objs[1].hist == "serve/ttft_ms"
+        assert objs[1].target == pytest.approx(0.99)
+        assert objs[2].bound_ms == 200.0
+        assert slo.parse_slos("") == []
+
+    def test_parse_rejects_malformed(self):
+        for bad in ("availability:2", "ttft_ms:p99", "wat",
+                    "ttft_ms:p0<10"):
+            with pytest.raises(ValueError):
+                slo.parse_slos(bad)
+
+    def test_hist_objective_clean_run_no_alert(self):
+        tel = get_telemetry()
+        mon = slo.SLOMonitor(
+            [slo.SLOObjective("clean_t", 0.99, hist="opstest/clean_ms",
+                              bound_ms=100.0)],
+            telemetry=tel, fast_window_s=0.1, slow_window_s=0.3,
+            fast_burn=2.0, slow_burn=1.0)
+        for _ in range(4):
+            for _ in range(10):
+                tel.observe("opstest/clean_ms", 5.0)
+            mon.evaluate()
+            time.sleep(0.05)
+        assert mon.active_alerts() == []
+        assert tel.counter_value("alert/clean_t") == 0
+
+    def test_hist_objective_storm_fires_once_per_episode(self):
+        tel = get_telemetry()
+        mon = slo.SLOMonitor(
+            [slo.SLOObjective("storm_t", 0.99, hist="opstest/storm_ms",
+                              bound_ms=100.0)],
+            telemetry=tel, fast_window_s=0.1, slow_window_s=0.3,
+            fast_burn=2.0, slow_burn=1.0)
+        for _ in range(5):
+            for _ in range(10):
+                tel.observe("opstest/storm_ms", 500.0)  # all bad
+            mon.evaluate()
+            time.sleep(0.05)
+        assert mon.active_alerts() == ["storm_t"]
+        # one EPISODE, not one count per tick
+        assert tel.counter_value("alert/storm_t") == 1
+        snap = tel.snapshot()["gauges"]
+        assert snap["slo/storm_t/alerting"] == 1.0
+        assert snap["slo/storm_t/burn_fast"] > 2.0
+        assert snap["slo/alerts_active"] == 1.0
+
+    def test_counter_objective_availability(self):
+        tel = get_telemetry()
+        obj = slo.SLOObjective("avail_t", 0.9,
+                               good=("opstest/av_good",),
+                               bad=("opstest/av_bad",))
+        mon = slo.SLOMonitor([obj], telemetry=tel, fast_window_s=0.1,
+                             slow_window_s=0.3, fast_burn=2.0,
+                             slow_burn=1.0)
+        for _ in range(5):
+            tel.counter("opstest/av_good", 1)
+            tel.counter("opstest/av_bad", 9)  # 90% bad vs 10% budget
+            mon.evaluate()
+            time.sleep(0.05)
+        assert mon.active_alerts() == ["avail_t"]
+        assert tel.counter_value("alert/avail_t") == 1
+
+    def test_objective_validation(self):
+        with pytest.raises(ValueError):
+            slo.SLOObjective("x", 0.0, hist="h", bound_ms=1)
+        with pytest.raises(ValueError):
+            slo.SLOObjective("x", 0.9)  # neither counters nor hist
+        with pytest.raises(ValueError):
+            slo.SLOObjective("x", 0.9, hist="h")  # hist without bound
+
+    def test_maybe_start_from_env(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_SLO", "availability:0.99")
+        mon = slo.maybe_start_from_env()
+        try:
+            assert mon is not None
+            assert slo.get_slo_monitor() is mon
+            assert mon is slo.maybe_start_from_env()  # idempotent
+        finally:
+            slo.clear_slo_monitor()
+        monkeypatch.delenv("PADDLE_TPU_SLO")
+        assert slo.maybe_start_from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoints
+
+
+def make_engine(capacity=8, buckets=(1, 2, 4), **kw):
+    paddle.seed(0)
+    net = nn.Linear(4, 3)
+    net.eval()
+    cfg = Config()
+    cfg.set_layer(net, [paddle.jit.InputSpec([None, 4], "float32", "x")])
+    return ServingEngine(create_predictor(cfg),
+                         ServeConfig(capacity=capacity, buckets=buckets,
+                                     **kw))
+
+
+class TestHttpEndpoints:
+    def test_metrics_healthz_debug(self):
+        tel = get_telemetry()
+        tel.counter("opstest/http_hits", 2)
+        srv = ops_server.start_ops_server(0, host="127.0.0.1")
+        assert srv.port and srv.running
+        code, body = _get(srv.port, "/metrics")
+        assert code == 200
+        parsed = ops_server.parse_prometheus_text(body)
+        assert parsed["paddle_tpu_opstest_http_hits_total"][0]["value"] >= 2
+        code, body = _get(srv.port, "/healthz")
+        assert code == 200 and json.loads(body)["ok"] is True
+        code, body = _get(srv.port, "/readyz")
+        assert code == 200 and json.loads(body)["ready"] is True
+        with spans.span("opstest_span"):
+            pass
+        code, body = _get(srv.port, "/debug/spans?n=10")
+        events = json.loads(body)["events"]
+        assert code == 200
+        assert any(e["name"] == "opstest_span" for e in events)
+        code, body = _get(srv.port, "/debug/telemetry")
+        assert code == 200
+        assert json.loads(body)["counter/opstest/http_hits"] >= 2
+        code, body = _get(srv.port, "/nope")
+        assert code == 404 and "/metrics" in json.loads(body)["routes"][0]
+        # scrapes are themselves counted
+        assert tel.counter_value("ops/scrapes") >= 1
+
+    def test_start_is_idempotent_and_stop_frees(self):
+        srv = ops_server.start_ops_server(0, host="127.0.0.1")
+        assert ops_server.start_ops_server(0) is srv
+        port = srv.port
+        ops_server.stop_ops_server()
+        assert ops_server.current_ops_server() is None
+        # the port is actually released: a new server can bind it
+        srv2 = ops_server.OpsServer(port, host="127.0.0.1").start()
+        try:
+            assert srv2.port == port
+        finally:
+            srv2.stop()
+
+    def test_healthz_flips_on_drain_latch(self):
+        eng = make_engine()
+        srv = ops_server.start_ops_server(0, host="127.0.0.1")
+        eng.start()
+        try:
+            code, _ = _get(srv.port, "/healthz")
+            assert code == 200
+            eng.drain(wait=True)
+            code, body = _get(srv.port, "/healthz")
+            assert code == 503
+            rep = json.loads(body)
+            assert rep["sources"]["serving"]["ok"] is False
+            assert "draining" in rep["sources"]["serving"]["detail"]
+            code, _ = _get(srv.port, "/readyz")
+            assert code == 503
+        finally:
+            eng.shutdown()
+
+    def test_healthz_flips_on_stale_heartbeat(self, monkeypatch):
+        from paddle_tpu.resilience import watchdog
+
+        srv = ops_server.start_ops_server(0, host="127.0.0.1")
+        watchdog.heartbeat()
+        monkeypatch.setenv("PADDLE_TPU_OPS_STALE_HEARTBEAT_S", "30")
+        code, _ = _get(srv.port, "/healthz")
+        assert code == 200
+        time.sleep(0.05)
+        monkeypatch.setenv("PADDLE_TPU_OPS_STALE_HEARTBEAT_S", "0.01")
+        code, body = _get(srv.port, "/healthz")
+        assert code == 503
+        assert "stale" in json.loads(body)["sources"]["watchdog"]["detail"]
+
+    def test_readyz_flips_on_queue_saturation(self, monkeypatch):
+        class Saturated:
+            draining = False
+            drain_reason = None
+            config = ServeConfig(capacity=4)
+            _queue = [0, 0, 0, 0]  # len() == capacity
+
+            def debug_requests(self, limit=256):
+                return []
+
+        ops_server.set_serving_engine(Saturated())
+        srv = ops_server.start_ops_server(0, host="127.0.0.1")
+        code, body = _get(srv.port, "/readyz")
+        assert code == 503
+        rep = json.loads(body)
+        assert rep["sources"]["serving"]["ready"] is False
+        assert rep["sources"]["serving"]["ok"] is True  # saturated ≠ sick
+        code, _ = _get(srv.port, "/healthz")
+        assert code == 200
+
+    def test_healthz_flips_on_slo_alert(self):
+        tel = get_telemetry()
+        mon = slo.SLOMonitor(
+            [slo.SLOObjective("http_slo_t", 0.99,
+                              hist="opstest/http_slo_ms", bound_ms=10.0)],
+            telemetry=tel, fast_window_s=0.05, slow_window_s=0.1,
+            fast_burn=1.0, slow_burn=1.0)
+        slo.install_slo_monitor(mon)
+        srv = ops_server.start_ops_server(0, host="127.0.0.1")
+        code, _ = _get(srv.port, "/healthz")
+        assert code == 200
+        for _ in range(3):
+            tel.observe("opstest/http_slo_ms", 1000.0)
+            mon.evaluate()
+            time.sleep(0.06)
+        code, body = _get(srv.port, "/healthz")
+        assert code == 503
+        assert "http_slo_t" in json.loads(body)["sources"]["slo"]["detail"]
+
+    def test_crashing_health_source_reports_unhealthy(self):
+        def boom():
+            raise RuntimeError("checker exploded")
+
+        ops_server.register_health_source("boom", boom)
+        try:
+            rep = ops_server.health_report()
+            assert rep["ok"] is False
+            assert "exploded" in rep["sources"]["boom"]["detail"]
+        finally:
+            ops_server.unregister_health_source("boom")
+        assert ops_server.health_report()["ok"] is True
+
+    def test_maybe_start_from_env(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_OPS_PORT", raising=False)
+        assert ops_server.maybe_start_from_env() is None
+        monkeypatch.setenv("PADDLE_TPU_OPS_PORT", "not-a-port")
+        assert ops_server.maybe_start_from_env() is None
+        monkeypatch.setenv("PADDLE_TPU_OPS_PORT", "0")
+        srv = ops_server.maybe_start_from_env()
+        assert srv is not None and srv.running
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: serving engine + trace + /debug/requests
+
+
+class TestServingIntegration:
+    def test_sampled_request_full_timeline(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "1")
+        eng = make_engine()
+        srv = ops_server.start_ops_server(0, host="127.0.0.1")
+        eng.start()
+        try:
+            reqs = [eng.submit([np.ones(4, "float32") * k])
+                    for k in range(4)]
+            for r in reqs:
+                assert r.wait(10)
+            code, body = _get(srv.port, "/debug/requests")
+            assert code == 200
+            traces = json.loads(body)["completed_traces"]
+            assert len(traces) == 4
+            ids = {t["trace_id"] for t in traces}
+            assert len(ids) == 4  # one id per request
+            names = [e["name"] for e in traces[0]["events"]]
+            assert names[0] == "submit"
+            assert names[1] == "admit"
+            assert "queue" in names
+            assert any(n.startswith("batch.b") for n in names)
+            assert names[-1] == "terminal:ok"
+        finally:
+            eng.shutdown()
+
+    def test_rejected_request_trace_terminal(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "1")
+        eng = make_engine()
+        eng.start()
+        try:
+            eng.drain(wait=True)  # admission now rejects
+            r = eng.submit([np.ones(4, "float32")])
+            assert r.status == "rejected"
+            traces = spans.trace_store().snapshot()
+            mine = [t for t in traces if t.req_id == r.id]
+            assert len(mine) == 1
+            assert mine[0].events[-1][0] == "terminal:rejected"
+        finally:
+            eng.shutdown()
+
+    def test_unsampled_requests_cost_nothing(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_TRACE_SAMPLE", raising=False)
+        eng = make_engine()
+        eng.start()
+        try:
+            r = eng.submit([np.ones(4, "float32")])
+            assert r.wait(10)
+            assert r.trace is None
+            assert len(spans.trace_store()) == 0
+        finally:
+            eng.shutdown()
+
+    def test_debug_requests_shows_inflight(self):
+        eng = make_engine(default_deadline_s=30.0)
+        eng.start()
+        try:
+            from paddle_tpu.resilience.inject import (FaultInjector,
+                                                      install_injector)
+
+            # stall the first batch so requests are observably in flight
+            install_injector(FaultInjector.from_spec("slow_req@0:0.5"))
+            reqs = [eng.submit([np.ones(4, "float32")]) for _ in range(3)]
+            deadline = time.monotonic() + 5.0
+            rows = []
+            while time.monotonic() < deadline:
+                rows = eng.debug_requests()
+                if rows:
+                    break
+                time.sleep(0.01)
+            assert rows, "no in-flight request ever visible"
+            row = rows[0]
+            assert row["phase"] == "inflight"
+            assert row["age_ms"] >= 0
+            assert row["deadline_remaining_ms"] > 0
+            for r in reqs:
+                r.wait(10)
+            assert eng.debug_requests() == []
+        finally:
+            from paddle_tpu.resilience.inject import clear_injector
+
+            clear_injector()
+            eng.shutdown()
+
+    def test_gen_request_debug_state(self):
+        r = GenRequest(5, np.asarray([1, 2, 3], np.int32),
+                       max_new_tokens=4, deadline_s=10.0)
+        assert r.phase() == "queued"
+        r.ncache = 1
+        assert r.phase() == "prefill"  # 2 known tokens not yet cached
+        r.ncache = 3
+        r.generated = [7]
+        r.toks.append(7)
+        assert r.phase() == "decode"
+        st = r.debug_state()
+        assert st["prompt_tokens"] == 3
+        assert st["tokens_generated"] == 1
+        assert st["kv_cached_tokens"] == 3
+        assert st["max_new_tokens"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Satellites: periodic flush + device-memory sampler
+
+
+class TestBackgroundThreads:
+    def test_periodic_flush_writes_interval_records(self, tmp_path):
+        sink = str(tmp_path / "tel.jsonl")
+        t = start_periodic_flush(interval_s=0.05, path=sink)
+        assert t is not None
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if os.path.exists(sink) and \
+                        sum(1 for _ in open(sink)) >= 2:
+                    break
+                time.sleep(0.05)
+        finally:
+            stop_periodic_flush()
+        recs = [json.loads(line) for line in open(sink)]
+        assert len(recs) >= 2  # interval records, not only atexit
+        assert all(r["tag"] == "periodic" for r in recs)
+        sys.path.insert(0, _TOOLS)
+        try:
+            from check_telemetry_schema import validate_file
+
+            n, err = validate_file(sink)
+        finally:
+            sys.path.pop(0)
+        assert err is None and n >= 2
+
+    def test_periodic_flush_disabled_without_config(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_TELEMETRY_FLUSH_EVERY_S",
+                           raising=False)
+        assert start_periodic_flush() is None
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY_FLUSH_EVERY_S", "0.1")
+        monkeypatch.delenv("PADDLE_TPU_TELEMETRY_JSONL", raising=False)
+        assert start_periodic_flush() is None  # interval without a sink
+
+    def test_device_memory_sampler_publishes_gauges(self):
+        tel = Telemetry()
+        t = start_device_memory_sampler(interval_s=0.05, telemetry=tel)
+        assert t is not None
+        try:
+            deadline = time.monotonic() + 5.0
+            seen = False
+            while time.monotonic() < deadline and not seen:
+                seen = "device/live_bytes" in tel.snapshot()["gauges"]
+                time.sleep(0.05)
+        finally:
+            stop_device_memory_sampler()
+        assert seen, "sampler never published device/live_bytes"
+
+    def test_sampler_disabled_without_env(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_DEVICE_MEM_SAMPLE_EVERY_S",
+                           raising=False)
+        assert start_device_memory_sampler() is None
+
+
+# ---------------------------------------------------------------------------
+# Schema + aggregation learn the new keys
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for scalars in records:
+            f.write(json.dumps({"ts": 1.0, "step": None, "tag": "t",
+                                "scalars": scalars}) + "\n")
+
+
+class TestSchemaContracts:
+    @pytest.fixture(autouse=True)
+    def _tools_path(self):
+        sys.path.insert(0, _TOOLS)
+        yield
+        sys.path.pop(0)
+
+    def test_alert_and_slo_contracts(self, tmp_path):
+        from check_telemetry_schema import validate_file
+
+        good = str(tmp_path / "good.jsonl")
+        _write_jsonl(good, [{"counter/alert/ttft_ms_p99": 2,
+                             "gauge/slo/ttft_ms_p99/burn_fast": 15.2,
+                             "gauge/slo/ttft_ms_p99/alerting": 1}])
+        assert validate_file(good)[1] is None
+        for bad_scalars in ({"counter/alert/x": -1},
+                            {"gauge/slo/x/burn_fast": -0.5},
+                            {"gauge/slo/x/alerting": 0.5}):
+            bad = str(tmp_path / "bad.jsonl")
+            _write_jsonl(bad, [bad_scalars])
+            assert validate_file(bad)[1] is not None, bad_scalars
+
+    def test_hist_count_sum_contracts(self, tmp_path):
+        from check_telemetry_schema import validate_file
+
+        good = str(tmp_path / "good.jsonl")
+        _write_jsonl(good, [{"hist/x/count": 4, "hist/x/sum": 10.0,
+                             "hist/x/mean": 2.5}])
+        assert validate_file(good)[1] is None
+        cases = (
+            {"hist/x/count": -1},                      # negative count
+            {"hist/x/count": 2.5, "hist/x/sum": 5.0},  # fractional count
+            {"hist/x/count": 3},                       # count without sum
+            {"hist/x/count": 4, "hist/x/sum": 10.0,
+             "hist/x/mean": 99.0},                     # torn mean
+        )
+        for scalars in cases:
+            bad = str(tmp_path / "bad.jsonl")
+            _write_jsonl(bad, [scalars])
+            assert validate_file(bad)[1] is not None, scalars
+
+    def test_live_export_passes_gate(self, tmp_path):
+        """The real exporter (with alert + slo + hist scalars live) must
+        satisfy its own schema — contracts and producer cannot drift."""
+        from check_telemetry_schema import validate_file
+
+        tel = get_telemetry()
+        tel.counter("alert/gate_t", 1)
+        tel.gauge("slo/gate_t/burn_fast", 3.0)
+        tel.gauge("slo/gate_t/alerting", 1)
+        tel.observe("opstest/gate_ms", 2.0)
+        sink = str(tmp_path / "live.jsonl")
+        tel.to_jsonl(sink)
+        n, err = validate_file(sink, require=["counter/alert/gate_t"])
+        assert err is None and n == 1
+
+
+class TestAggregation:
+    @pytest.fixture(autouse=True)
+    def _tools_path(self):
+        sys.path.insert(0, _TOOLS)
+        yield
+        sys.path.pop(0)
+
+    def test_detect_slo_burns(self):
+        from paddle_tpu.profiler import aggregate as agg
+
+        finds = agg.detect_slo_burns({
+            0: {"counter/alert/ttft_ms_p99": 2.0,
+                "gauge/slo/ttft_ms_p99/burn_fast": 20.0},
+            1: {"counter/alert/ttft_ms_p99": 0.0},
+            2: {"counter/alert/availability": 5.0},
+        })
+        assert [(f["rank"], f["objective"]) for f in finds] == \
+            [(2, "availability"), (0, "ttft_ms_p99")]
+        assert finds[1]["burn_fast"] == 20.0
+
+    def test_telemetry_agg_fail_on_alert(self, tmp_path):
+        from telemetry_agg import main as agg_main
+
+        clean = {"counter/serve/requests": 5}
+        burning = {"counter/serve/requests": 5,
+                   "counter/alert/latency_ms_p99": 1,
+                   "gauge/slo/latency_ms_p99/burn_fast": 30.0,
+                   "gauge/slo/latency_ms_p99/burn_slow": 8.0}
+        _write_jsonl(str(tmp_path / "telemetry.rank0.jsonl"), [clean])
+        _write_jsonl(str(tmp_path / "telemetry.rank1.jsonl"), [burning])
+        assert agg_main([str(tmp_path)]) == 0  # report-only: informative
+        assert agg_main([str(tmp_path), "--fail-on-alert"]) == 1
+        out = subprocess.run(
+            [sys.executable, os.path.join(_TOOLS, "telemetry_agg.py"),
+             str(tmp_path)], capture_output=True, text=True, timeout=120)
+        assert "SLO BURNS" in out.stdout
+        assert "latency_ms_p99" in out.stdout
+
+    def test_telemetry_agg_clean_no_findings(self, tmp_path):
+        from telemetry_agg import main as agg_main
+
+        _write_jsonl(str(tmp_path / "telemetry.rank0.jsonl"),
+                     [{"counter/serve/requests": 5}])
+        assert agg_main([str(tmp_path), "--fail-on-alert"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Launcher: per-rank ops-port offsetting
+
+
+class TestLauncherPortOffset:
+    def test_ranks_get_offset_ports(self, tmp_path):
+        from paddle_tpu.distributed.launch import launch
+
+        out_dir = tmp_path / "out"
+        out_dir.mkdir()
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os, sys\n"
+            "rank = os.environ['PADDLE_TRAINER_ID']\n"
+            "open(os.path.join(sys.argv[1], 'port.' + rank), 'w')"
+            ".write(os.environ.get('PADDLE_TPU_OPS_PORT', 'MISSING'))\n")
+        rc = launch(str(script), [str(out_dir)], nproc_per_node=2,
+                    log_dir=str(tmp_path / "log"), backend="cpu",
+                    extra_env={"PADDLE_TPU_OPS_PORT": "9310",
+                               "PADDLE_TPU_TELEMETRY": "0"})
+        assert rc == 0
+        ports = {i: (out_dir / f"port.{i}").read_text() for i in (0, 1)}
+        assert ports == {0: "9310", 1: "9311"}

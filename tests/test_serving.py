@@ -334,8 +334,13 @@ class TestInjectionHooks:
 
     def test_deadline_storm_sheds_without_stalling_live_traffic(self):
         eng, _ = make_engine(capacity=16, buckets=(1, 2, 4))
+        # 1 µs: hopeless by construction. A 100 µs storm deadline was
+        # occasionally BEATEN by a warm 1-row batch on a fast CPU
+        # (submit→dispatch→complete under 0.1 ms), flaking this test
+        # with status 'ok'; the storm's premise is a deadline no server
+        # could meet, so make it unmeetable even at enqueue
         install_injector(FaultInjector(deadline_storms={0: 4},
-                                       storm_deadline_s=1e-4))
+                                       storm_deadline_s=1e-6))
         eng.start()
         try:
             stormed = [eng.submit(sample(seed=k)) for k in range(4)]

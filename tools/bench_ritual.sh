@@ -73,6 +73,16 @@ JAX_PLATFORMS=cpu python tools/check_sdc.py
 # through the preemption relaunch path.
 JAX_PLATFORMS=cpu python tools/check_serving.py
 
+# ops-plane gate: the live-operations acceptance — during a real serving
+# load, /metrics + /healthz scrapes must parse cleanly and RECONCILE
+# with the accounting ledger and the flushed JSONL (every serve counter
+# equal at drain), /healthz must flip 503 on the drain latch, a sampled
+# request must export one submit→admit→queue→batch→terminal timeline
+# under one trace id, and an injected slow_req storm must trip the SLO
+# burn-rate alert (telemetry_agg --fail-on-alert finding) while the
+# clean phase raises zero alerts.
+JAX_PLATFORMS=cpu python tools/check_ops_server.py
+
 # decode gate: the token-level twin — paged-KV greedy decode must be
 # token-identical to the dense recompute-the-prefix reference (logits
 # within tolerance), and a mixed prefill+decode load with injected

@@ -1,0 +1,322 @@
+#!/usr/bin/env python
+"""Ops-plane gate — the live window is exercised against the ledger, not
+claimed.
+
+End-to-end on the CPU backend against the REAL runtime (ServingEngine +
+ops HTTP server + SLO monitor + fault injection, no mocks):
+
+1. build a tiny layer-mode predictor, a small serving engine, the ops
+   server on an ephemeral port, and an SLO monitor with a tight latency
+   objective over the real ``serve/latency_ms`` histogram;
+2. CLEAN phase: closed-loop load while a scraper thread hits
+   ``/metrics`` + ``/healthz`` + ``/debug/requests`` live — every
+   exposition must parse cleanly (strict parser, not "bytes came
+   back"), health must be 200, and the SLO monitor must raise ZERO
+   alerts;
+3. STORM phase: an injected ``slow_req`` straggler storm stalls real
+   batches; the latency objective's fast+slow burn windows must both
+   trip — the alert episode lands in ``counter/alert/*`` and
+   ``telemetry_agg --fail-on-alert`` turns it into an SLO-BURN finding;
+4. DRAIN: ``/healthz`` must flip 503 (drain latch) while the server
+   still answers ``/metrics``;
+5. RECONCILE: the final live scrape's serve counters must EQUAL the
+   engine's accounting ledger AND the flushed JSONL record, counter by
+   counter — a /metrics page that drifts from the accounting it claims
+   to expose is worse than no page;
+6. a sampled request's exported timeline (PADDLE_TPU_TRACE_SAMPLE=1)
+   must carry submit → admit → queue → batch → terminal under one
+   trace id.
+
+Gate conventions per tools/_gate.py (``ops server: OK|FAIL — ...``,
+exit 0/1, ``--json``). Wired into tools/bench_ritual.sh.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_TOOLS)
+sys.path.insert(0, _TOOLS)
+if _REPO not in sys.path:
+    sys.path.insert(1, _REPO)
+from _gate import add_gate_args, finish, read_counters  # noqa: E402
+
+# the counters the scrape and the ledger must agree on, scrape-name ->
+# accounting ledger key (None = engine-submitted total)
+_RECONCILE = {
+    "paddle_tpu_serve_requests_total": None,
+    "paddle_tpu_serve_completed_total": "ok",
+    "paddle_tpu_serve_admission_rejects_total": "rejected",
+    "paddle_tpu_serve_deadline_exceeded_total": "deadline_exceeded",
+    "paddle_tpu_serve_drained_total": "drained",
+    "paddle_tpu_serve_errors_total": "errors",
+}
+
+
+def _get(port, path, timeout=5.0):
+    """(status, body_text) — HTTP errors return their status instead of
+    raising (healthz 503 is an expected, asserted outcome)."""
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def _counter_samples(parsed, metric):
+    rows = parsed.get(metric, [])
+    return sum(int(r["value"]) for r in rows
+               if not r["labels"].get("entry"))
+
+
+def run_demo(workdir, n_clean=24, n_storm=12, stall_s=0.25,
+             bound_ms=50.0):
+    """Returns (ok, detail, payload)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PADDLE_TPU_TRACE_SAMPLE"] = "1"
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.inference.serving import (ServeConfig, ServingEngine,
+                                              run_streams)
+    from paddle_tpu.profiler import ops_server, slo
+    from paddle_tpu.profiler.telemetry import get_telemetry
+    from paddle_tpu.resilience.inject import (FaultInjector, clear_injector,
+                                              install_injector)
+
+    tel_path = os.path.join(workdir, "TELEMETRY.jsonl")
+    payload = {}
+    tel = get_telemetry()
+
+    paddle.seed(0)
+    net = nn.Linear(16, 8)
+    net.eval()
+    cfg = Config()
+    cfg.set_layer(net, [paddle.jit.InputSpec([None, 16], "float32", "x")])
+    eng = ServingEngine(create_predictor(cfg),
+                        ServeConfig(capacity=8, buckets=(1, 2, 4),
+                                    drain_grace_s=3.0))
+    monitor = slo.SLOMonitor(
+        slo.parse_slos(f"latency_ms:p99<{bound_ms:g}"), telemetry=tel,
+        fast_window_s=0.5, slow_window_s=2.0, fast_burn=5.0, slow_burn=2.0)
+    slo.install_slo_monitor(monitor)
+    server = ops_server.start_ops_server(0, host="127.0.0.1", telemetry=tel)
+    port = server.port
+    payload["port"] = port
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(1024, 16).astype("float32")
+    input_fn = lambda k: [xs[k % len(xs)]]  # noqa: E731
+
+    scrape_errors = []
+    scrapes = [0]
+    stop_scraper = threading.Event()
+
+    def _scraper():
+        while not stop_scraper.wait(0.05):
+            try:
+                code, body = _get(port, "/metrics")
+                if code != 200:
+                    scrape_errors.append(f"/metrics -> {code}")
+                    continue
+                ops_server.parse_prometheus_text(body)  # must PARSE
+                code, body = _get(port, "/debug/requests")
+                if code != 200:
+                    scrape_errors.append(f"/debug/requests -> {code}")
+                    continue
+                json.loads(body)
+                monitor.evaluate()
+                scrapes[0] += 1
+            except Exception as e:  # noqa: BLE001 — collected, asserted
+                scrape_errors.append(repr(e))
+
+    try:
+        clear_injector()
+        eng.start()
+        scraper = threading.Thread(target=_scraper, daemon=True)
+        scraper.start()
+
+        # -- clean phase: live scrapes parse, health green, no alert --
+        run_streams(eng, n_streams=2, requests_per_stream=n_clean // 2,
+                    input_fn=input_fn, deadline_s=10.0)
+        time.sleep(0.3)  # let the scraper/monitor observe the tail
+        monitor.evaluate()
+        code, _body = _get(port, "/healthz")
+        if code != 200:
+            return False, f"/healthz {code} on a healthy engine", payload
+        code, _body = _get(port, "/readyz")
+        if code != 200:
+            return False, f"/readyz {code} on an idle engine", payload
+        clean_alerts = tel.counter_value("alert/latency_ms_p99")
+        payload["clean_alerts"] = clean_alerts
+        if clean_alerts != 0:
+            return False, (f"clean load fired {clean_alerts} burn "
+                           f"alert(s) — objective or windows are "
+                           f"miscalibrated"), payload
+
+        # -- storm phase: injected stragglers must burn the budget --
+        first_storm_id = eng.accounting()["submitted"]
+        spec = ",".join(
+            f"slow_req@{first_storm_id + k}:{stall_s:g}"
+            for k in range(n_storm))
+        install_injector(FaultInjector.from_spec(spec))
+        run_streams(eng, n_streams=2, requests_per_stream=n_storm // 2,
+                    input_fn=input_fn, deadline_s=10.0)
+        deadline = time.monotonic() + 10.0
+        while (tel.counter_value("alert/latency_ms_p99") == 0
+               and time.monotonic() < deadline):
+            monitor.evaluate()
+            time.sleep(0.05)
+        storm_alerts = tel.counter_value("alert/latency_ms_p99")
+        payload["storm_alerts"] = storm_alerts
+        if storm_alerts < 1:
+            return False, (f"slow_req storm ({n_storm} stalls of "
+                           f"{stall_s}s vs a {bound_ms}ms p99 bound) "
+                           f"never tripped the burn-rate alert"), payload
+
+        # -- drain: healthz must flip before the process goes away --
+        stop_scraper.set()
+        scraper.join(2.0)
+        acct = eng.drain(wait=True, reason="gate drain")
+        code, body = _get(port, "/healthz")
+        payload["healthz_after_drain"] = code
+        if code != 503:
+            return False, (f"/healthz {code} after drain — a draining "
+                           f"replica must be ejectable"), payload
+        if "draining" not in body:
+            return False, "healthz 503 without the drain source named", \
+                payload
+
+        # -- reconcile: live scrape == ledger == JSONL --
+        code, body = _get(port, "/metrics")
+        if code != 200:
+            return False, f"/metrics {code} after drain", payload
+        parsed = ops_server.parse_prometheus_text(body)
+        by_status = acct["by_status"]
+        payload["by_status"] = by_status
+        payload["scrapes"] = scrapes[0]
+        for metric, key in sorted(_RECONCILE.items()):
+            want = (acct["submitted"] if key is None
+                    else by_status.get(key, 0))
+            got = _counter_samples(parsed, metric)
+            if got != want:
+                return False, (f"scrape/ledger drift: {metric} = {got} "
+                               f"but accounting says {want}"), payload
+        if acct["unaccounted"] or acct["double_terminal"]:
+            return False, f"ledger not clean at drain: {acct}", payload
+        if scrapes[0] < 3:
+            return False, (f"only {scrapes[0]} live scrape(s) landed "
+                           f"during load — the gate never actually "
+                           f"watched the runtime"), payload
+        if scrape_errors:
+            return False, (f"{len(scrape_errors)} scrape failure(s): "
+                           f"{scrape_errors[:3]}"), payload
+
+        # -- sampled trace: one self-contained timeline per request --
+        code, body = _get(port, "/debug/requests")
+        traces = json.loads(body)["completed_traces"]
+        ok_trace = None
+        for t in traces:
+            names = [e["name"] for e in t["events"]]
+            if (names[:2] == ["submit", "admit"]
+                    and any(n == "queue" for n in names)
+                    and any(n.startswith("batch.") for n in names)
+                    and names[-1] == "terminal:ok"):
+                ok_trace = t
+                break
+        if ok_trace is None:
+            return False, ("no completed trace carries the full "
+                           "submit→admit→queue→batch→terminal timeline "
+                           f"({len(traces)} trace(s) stored)"), payload
+        payload["trace_id"] = ok_trace["trace_id"]
+
+        # -- JSONL: schema-valid, counters equal to the scrape --
+        tel.to_jsonl(tel_path, tag="ops_gate")
+        from check_telemetry_schema import validate_file
+
+        _n, err = validate_file(
+            tel_path,
+            require=["counter/serve/requests", "counter/ops/scrapes",
+                     "counter/alert/latency_ms_p99"],
+            require_prefix=["gauge/slo/"])
+        if err:
+            return False, f"telemetry: {err}", payload
+        jsonl_counters = read_counters(tel_path)
+        for metric, key in sorted(_RECONCILE.items()):
+            name = ("counter/serve/requests" if key is None else None)
+            if name is None:
+                # scrape name back to the telemetry name
+                name = "counter/serve/" + metric[
+                    len("paddle_tpu_serve_"):-len("_total")]
+            got = int(jsonl_counters.get(name, 0))
+            want = _counter_samples(parsed, metric)
+            if got != want:
+                return False, (f"JSONL/scrape drift: {name} = {got} but "
+                               f"the live scrape says {want}"), payload
+
+        # -- the aggregate view turns the alert into a finding --
+        from telemetry_agg import main as agg_main
+
+        rankfile = os.path.join(workdir, "telemetry.rank0.jsonl")
+        os.replace(tel_path, rankfile)
+        rc = agg_main([workdir, "--fail-on-alert"])
+        if rc != 1:
+            return False, ("telemetry_agg --fail-on-alert exited "
+                           f"{rc} over a log with a fired alert"), payload
+
+        return True, (f"{scrapes[0]} live scrapes reconciled with the "
+                      f"ledger ({acct['submitted']} submitted, "
+                      f"{by_status}), clean run 0 alerts, storm fired "
+                      f"{storm_alerts}, healthz flipped 503 on drain, "
+                      f"trace {ok_trace['trace_id']} complete"), payload
+    finally:
+        stop_scraper.set()
+        clear_injector()
+        slo.clear_slo_monitor()
+        ops_server.set_serving_engine(None)
+        ops_server.stop_ops_server()
+        try:
+            eng.shutdown()
+        except Exception:
+            pass
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="End-to-end ops-plane gate: live /metrics + /healthz "
+                    "scrapes during a real serving load must parse, "
+                    "reconcile with the accounting ledger, flip on "
+                    "drain, and burn-rate-alert on an injected storm")
+    ap.add_argument("--clean-requests", type=int, default=24)
+    ap.add_argument("--storm-requests", type=int, default=12)
+    ap.add_argument("--stall-s", type=float, default=0.25)
+    ap.add_argument("--bound-ms", type=float, default=50.0)
+    ap.add_argument("--workdir", default=None,
+                    help="keep artifacts here instead of a temp dir")
+    add_gate_args(ap)
+    args = ap.parse_args(argv)
+    kw = dict(n_clean=args.clean_requests, n_storm=args.storm_requests,
+              stall_s=args.stall_s, bound_ms=args.bound_ms)
+    if args.workdir:
+        os.makedirs(args.workdir, exist_ok=True)
+        ok, detail, payload = run_demo(args.workdir, **kw)
+    else:
+        with tempfile.TemporaryDirectory(prefix="ops-gate-") as d:
+            ok, detail, payload = run_demo(d, **kw)
+    return finish("ops server", ok, detail, payload=payload,
+                  json_mode=args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

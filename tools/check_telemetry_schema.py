@@ -53,6 +53,15 @@ and within one record ``gauge/serve/queue_depth`` must sit in
 [0, ``gauge/serve/queue_capacity``] — a depth past the configured
 capacity means the bounded admission queue is not actually bounded.
 
+SLO/alert contracts (``profiler.slo``): ``counter/alert/*`` (burn-alert
+episodes) and ``gauge/slo/*`` (burn rates) are ≥ 0, and
+``gauge/slo/<obj>/alerting`` ∈ {0, 1}. Histogram accounting:
+``hist/*/count`` is a non-negative integer, and within one record a
+positive count requires its ``hist/*/sum`` (with ``mean`` ==
+``sum/count`` when present) — the ops-plane exposition and burn-rate
+math difference count/sum between snapshots, so a torn triple is a
+broken consistent-cut promise.
+
 Token-level serving contracts (``inference.serving.decode``):
 ``gauge/serve/kv_occupancy`` ∈ [0, 1] and
 ``gauge/serve/spec_accept_rate`` ∈ [0, 1] (both are fractions by
@@ -110,6 +119,29 @@ def validate_record(rec, lineno):
         if name.startswith("gauge/compile/") and float(value) < 0:
             return (f"line {lineno}: scalar {name!r} = {value!r} "
                     f"is negative (flops/bytes accounting)")
+        # SLO/alert contracts (profiler.slo): alert counters count
+        # rising-edge episodes and burn-rate gauges are ratios of
+        # non-negative quantities — a negative value means a producer
+        # wrote deltas or garbage into the operator-facing funnel
+        if (name.startswith("counter/alert/")
+                or name.startswith("gauge/slo/")) and float(value) < 0:
+            return (f"line {lineno}: scalar {name!r} = {value!r} "
+                    f"is negative (alert episodes / burn rates are >= 0)")
+        if name.startswith("gauge/slo/") and name.endswith("/alerting") \
+                and float(value) not in (0.0, 1.0):
+            return (f"line {lineno}: scalar {name!r} = {value!r} "
+                    f"not in {{0, 1}} (alerting is a state flag)")
+        # histogram accounting: count is a monotone total (and the
+        # denominator of every mean/burn computation) — never negative,
+        # never fractional
+        if name.startswith("hist/") and name.endswith("/count"):
+            if float(value) < 0:
+                return (f"line {lineno}: scalar {name!r} = {value!r} "
+                        f"is negative (histogram counts are monotone)")
+            if float(value) != int(float(value)):
+                return (f"line {lineno}: scalar {name!r} = {value!r} "
+                        f"is fractional (a histogram count is a number "
+                        f"of observations)")
         # cluster-resilience name contracts: restart/rank-failure
         # counters and checkpoint-commit accounting are monotone totals
         if (name.startswith("counter/resilience/")
@@ -202,6 +234,27 @@ def validate_record(rec, lineno):
             return (f"line {lineno}: gauge/serve/queue_depth = {depth!r} "
                     f"exceeds gauge/serve/queue_capacity = {cap!r} "
                     f"(the admission queue must be bounded)")
+    # cross-field: histogram count/sum/mean must agree within one record
+    # — the Prometheus exposition and the SLO burn-rate math difference
+    # count/sum between snapshots, so a torn triple means the histogram
+    # snapshot is not the consistent cut Telemetry promises
+    for name, value in scalars.items():
+        if not (name.startswith("hist/") and name.endswith("/count")):
+            continue
+        base = name[:-len("/count")]
+        cnt = float(value)
+        total = scalars.get(base + "/sum")
+        if cnt > 0 and total is None:
+            return (f"line {lineno}: {name} = {cnt:.0f} but {base}/sum "
+                    f"is missing — count without sum breaks every "
+                    f"rate/mean derivation downstream")
+        mean = scalars.get(base + "/mean")
+        if cnt > 0 and total is not None and mean is not None:
+            expect = float(total) / cnt
+            if abs(float(mean) - expect) > 1e-6 * max(1.0, abs(expect)):
+                return (f"line {lineno}: {base}/mean = {mean!r} "
+                        f"inconsistent with sum/count = "
+                        f"{float(total)!r}/{cnt:.0f}")
     return None
 
 

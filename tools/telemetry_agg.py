@@ -23,6 +23,13 @@ record at exit). This tool merges them (paddle_tpu.profiler.aggregate):
   reported as a SUSPECT-CHIP finding — one repair is a cosmic ray,
   repeated repairs of the same rank are a marginal chip the repair loop
   is laundering; replace the hardware.
+- **SLO-burn detection**: a rank whose log carries a fired burn-rate
+  alert (``counter/alert/<objective>`` > 0 — ``profiler.slo`` bumps one
+  per alert episode) is reported as an SLO-BURN finding with the
+  objective and final burn gauges; ``--fail-on-alert`` makes any such
+  finding fail the run (gate mode) — a load test that tripped a burn
+  alert shipped a user-visible degradation even if the medians look
+  fine.
 
 Usage:
     python tools/telemetry_agg.py LOG_DIR              # telemetry.rank*.jsonl
@@ -31,12 +38,14 @@ Usage:
     python tools/telemetry_agg.py LOG_DIR --fail-on-straggler   # gate mode
     python tools/telemetry_agg.py LOG_DIR --expect-ranks 4      # dead ranks
     python tools/telemetry_agg.py LOG_DIR --fail-on-suspect     # bad chips
+    python tools/telemetry_agg.py LOG_DIR --fail-on-alert       # SLO burns
 
 Exit code 0; with ``--fail-on-straggler``, 1 when any rank is flagged;
 with ``--expect-ranks N``, 1 when any expected rank left no usable
 telemetry (asking for N ranks IS the check); with ``--fail-on-suspect``,
-1 when any rank's repair count exceeds the threshold. ``--json`` emits
-the full aggregate object.
+1 when any rank's repair count exceeds the threshold; with
+``--fail-on-alert``, 1 when any rank carries a fired SLO burn alert.
+``--json`` emits the full aggregate object.
 """
 from __future__ import annotations
 
@@ -72,6 +81,7 @@ _HEADLINE = (
     "gauge/mfu", "counter/engine/steps", "counter/executor/runs",
     "gauge/engine/tokens_per_s",
     "counter/resilience/sdc_detected", "counter/resilience/sdc_repaired",
+    "gauge/slo/alerts_active",
 )
 
 
@@ -129,6 +139,20 @@ def format_report(result) -> str:
                 f"not bad luck; replace the hardware")
     else:
         lines.append("suspect chips: none")
+    burns = result.get("slo_burns")
+    if burns:
+        lines.append(f"SLO BURNS ({len(burns)} finding(s)):")
+        for b in burns:
+            rates = ""
+            if b.get("burn_fast") is not None:
+                rates = (f" (final burn fast={b['burn_fast']:.1f}x"
+                         f" slow={b.get('burn_slow') or 0:.1f}x)")
+            lines.append(
+                f"  rank {b['rank']}: objective {b['objective']!r} fired "
+                f"{b['episodes']:.0f} alert episode(s){rates} — the error "
+                f"budget was burning while this replica served traffic")
+    else:
+        lines.append("SLO burns: none")
     stragglers = result["stragglers"]
     if stragglers:
         lines.append(f"stragglers (> {result['threshold']:.2f}x cluster "
@@ -171,6 +195,9 @@ def main(argv=None):
     ap.add_argument("--fail-on-suspect", action="store_true",
                     help="exit 1 when any rank exceeds --suspect-repairs "
                          "(gate mode)")
+    ap.add_argument("--fail-on-alert", action="store_true",
+                    help="exit 1 when any rank carries a fired SLO "
+                         "burn-rate alert (counter/alert/* > 0; gate mode)")
     args = ap.parse_args(argv)
     paths = _resolve_paths(args.paths)
     if not paths:
@@ -198,6 +225,8 @@ def main(argv=None):
     if args.fail_on_straggler and result["stragglers"]:
         return 1
     if args.fail_on_suspect and result.get("suspect_chips"):
+        return 1
+    if args.fail_on_alert and result.get("slo_burns"):
         return 1
     if result.get("dead_ranks"):
         return 1
