@@ -726,7 +726,8 @@ def main():
              "bert": bench_bert_dp, "longctx": bench_gpt_long_context,
              "pipeline": bench_input_pipeline, "serving": bench_serving,
              "decode": bench_decode}
-    from paddle_tpu.profiler import get_telemetry, xla_cost
+    from paddle_tpu.profiler import (bottleneck, device_profile,
+                                     get_telemetry, xla_cost)
 
     tel = get_telemetry()
     results = []
@@ -750,6 +751,7 @@ def main():
         # the hand-derived mfu_pct estimates above are checked against
         row = xla_cost.headline(tel)
         if row is not None:
+            r["attribution_entry"] = row["entry"]
             r["compile_flops"] = row["flops"]
             r["compile_bytes_accessed"] = row["bytes_accessed"]
             r["compile_peak_hbm_bytes"] = row["peak_hbm_bytes"]
@@ -758,6 +760,22 @@ def main():
             if "mfu_pct" in row:
                 r["mfu_measured_pct"] = round(row["mfu_pct"], 3)
                 r["hbm_gbps_achieved"] = round(row["hbm_gbps"], 3)
+        # automated bottleneck verdict (profiler.bottleneck): folds any
+        # device-profile decomposition captured during this config with
+        # the roofline/MFU gauges into one word per entry. The headline
+        # entry's verdict and its dominating numbers become columns —
+        # check_bench_trajectory names the suspect from exactly these on
+        # a regression.
+        verdicts = bottleneck.publish(tel)
+        head_entry = row["entry"] if row is not None else None
+        if head_entry in verdicts:
+            r["bottleneck"] = verdicts[head_entry]["verdict"]
+            for k, v in verdicts[head_entry]["evidence"].items():
+                if isinstance(v, (int, float)) and k.endswith("_frac"):
+                    r[f"profile_{k}"] = round(float(v), 4)
+        fracs = device_profile.publish(tel).get(head_entry or "", {})
+        for cat, v in fracs.items():
+            r.setdefault(f"profile_{cat}", round(float(v), 4))
         print(json.dumps(r), flush=True)
         # machine-readable telemetry, one record per config written the
         # moment the config finishes — its gauge/compile/* and gauge/mfu

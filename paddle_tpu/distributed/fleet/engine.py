@@ -39,6 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.profiler import device_profile as _device_profile
 from paddle_tpu.profiler import spans as _spans
 from paddle_tpu.profiler import xla_cost as _xla_cost
 from paddle_tpu.profiler.retrace import tracked_jit
@@ -672,6 +673,8 @@ class ParallelTrainStep:
 
     def __call__(self, inputs, labels):
         _watchdog_heartbeat()
+        # windowed device-profile capture boundary (no-op unless armed)
+        _device_profile.step_boundary("fleet.train_step")
         t_enter = time.perf_counter()
         with _spans.span("step", cat="step",
                          step=self._optimizer._global_step):
@@ -766,6 +769,9 @@ class ParallelTrainStep:
         (sharding_optimizer.py:168-183 gradient-merge modes).
         """
         _watchdog_heartbeat()
+        # one capture boundary per WINDOW; attribution divides by the
+        # registered steps-per-call so per-step numbers stay per-step
+        _device_profile.step_boundary("fleet.train_step_multi")
         t_enter = time.perf_counter()
 
         # the whole window — h2d, scan compile, LR sampling, dispatch —

@@ -51,6 +51,7 @@ from typing import Dict, List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from ...profiler import device_profile as _device_profile
 from ...profiler.retrace import tracked_jit
 from ...profiler.telemetry import get_telemetry
 from ...resilience.inject import active_injector
@@ -387,6 +388,10 @@ class DecodeScheduler:
                 if inj is not None:
                     for r in running:  # injected straggler stalls the round
                         inj.slow_req(r.id)
+                # device-profile capture boundary: one scheduler round
+                # (≤1 prefill chunk + one decode step for every running
+                # sequence) is this loop's "step"
+                _device_profile.step_boundary("serve.decode")
                 prefilling = [r for r in running if r.pending > 1]
                 decoding = [r for r in running if r.pending == 1]
                 if prefilling:

@@ -35,6 +35,7 @@ from typing import Dict, List
 
 import numpy as np
 
+from ...profiler import device_profile as _device_profile
 from ...profiler.retrace import tracked_jit
 from ...profiler.telemetry import get_telemetry
 from ...resilience.inject import active_injector
@@ -124,6 +125,9 @@ class BatchScheduler:
                     if eng.draining and len(eng._queue) == 0:
                         return  # drained dry — engine finalizes
                     continue
+                # device-profile capture boundary: one serving batch is
+                # one "step" of this loop (no-op unless a capture armed)
+                _device_profile.step_boundary("serve.step")
                 self._run_batch(ready)
                 self.batch_index += 1
                 inj = active_injector()

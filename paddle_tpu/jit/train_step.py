@@ -20,6 +20,7 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 from ..optimizer.optimizer import Optimizer
+from ..profiler import device_profile as _device_profile
 from ..profiler import spans as _spans
 from ..profiler.retrace import tracked_jit
 from ..profiler.telemetry import get_telemetry
@@ -260,6 +261,9 @@ class TrainStep:
 
     def __call__(self, inputs, labels):
         _watchdog_heartbeat()
+        # on-demand device profiling: a no-op global check unless a
+        # windowed capture is armed (env cadence or POST /debug/profile)
+        _device_profile.step_boundary("jit.train_step")
         with contextlib.ExitStack() as _stk:
             if not _spans.in_category("step"):
                 # hapi fit (or another loop-level owner) may already hold

@@ -35,7 +35,11 @@ The legacy span API (``RecordEvent``, ``Profiler``, ``start_profiler``…)
 stays in ``paddle_tpu.utils.profiler`` and is re-exported here so
 ``paddle.profiler.Profiler``-style code ports unchanged.
 """
-from . import aggregate, spans, xla_cost  # noqa: F401
+from . import aggregate, bottleneck, device_profile, hlo_attrib  # noqa: F401
+from . import spans, xla_cost  # noqa: F401
+from .bottleneck import VERDICT_IDS, VERDICT_NAMES  # noqa: F401
+from .device_profile import request_capture  # noqa: F401
+from .hlo_attrib import attribute_trace, hlo_registry, parse_hlo_text  # noqa: F401
 from ..utils.profiler import (  # noqa: F401
     Profiler,
     RecordEvent,
@@ -90,5 +94,8 @@ __all__ = [
     "set_steps_per_call", "capture_compile_cost",
     "Profiler", "RecordEvent", "record_event", "start_profiler",
     "stop_profiler", "export_chrome_tracing",
+    "request_capture", "VERDICT_IDS", "VERDICT_NAMES",
+    "attribute_trace", "hlo_registry", "parse_hlo_text",
     "spans", "xla_cost", "aggregate", "ops_server", "slo",
+    "device_profile", "hlo_attrib", "bottleneck",
 ]

@@ -35,8 +35,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 __all__ = [
     "read_jsonl", "rank_of_path", "final_scalars", "load_rank_scalars",
     "cluster_view", "detect_stragglers", "detect_dead_ranks",
-    "detect_suspect_chips", "detect_slo_burns", "aggregate",
-    "STEP_HIST_PATTERN", "SDC_REPAIR_PATTERN", "ALERT_PATTERN",
+    "detect_suspect_chips", "detect_slo_burns", "collect_bottlenecks",
+    "aggregate", "STEP_HIST_PATTERN", "SDC_REPAIR_PATTERN",
+    "ALERT_PATTERN", "BOTTLENECK_PATTERN", "BOTTLENECK_NAMES",
 ]
 
 # any per-rank step-latency p50 qualifies for straggler comparison
@@ -52,6 +53,12 @@ SDC_REPAIR_PATTERN = re.compile(
 # SLO burn-rate alert episodes (profiler.slo bumps counter/alert/<name>
 # on every rising edge of a multi-window burn alert)
 ALERT_PATTERN = re.compile(r"^counter/alert/(.+)$")
+
+# automated bottleneck verdicts (profiler.bottleneck publishes the id of
+# a CLOSED vocabulary per compiled entry; keep the map in sync)
+BOTTLENECK_PATTERN = re.compile(r"^gauge/bottleneck/(.+)$")
+BOTTLENECK_NAMES = {0: "compute_bound", 1: "memory_bound", 2: "comm_bound",
+                    3: "input_bound", 4: "host_bound"}
 
 _RANK_RE = re.compile(r"rank[._-]?(\d+)")
 
@@ -210,6 +217,27 @@ def detect_slo_burns(rank_scalars: Dict[int, Dict[str, float]]) -> List[dict]:
     return findings
 
 
+def collect_bottlenecks(rank_scalars: Dict[int, Dict[str, float]]
+                        ) -> List[dict]:
+    """Every rank's published bottleneck verdicts, named: one row per
+    (entry, rank) — ``{"entry", "rank", "verdict"}``. Purely a surface
+    (verdicts are diagnoses, not failures): the operator reading the
+    cluster report sees WHY each entry spends its step time next to how
+    long the step takes."""
+    findings: List[dict] = []
+    for rank, scalars in sorted(rank_scalars.items()):
+        for name, v in scalars.items():
+            m = BOTTLENECK_PATTERN.match(name)
+            if not m:
+                continue
+            findings.append({
+                "entry": m.group(1), "rank": rank,
+                "verdict": BOTTLENECK_NAMES.get(int(v), f"unknown({v:g})"),
+            })
+    findings.sort(key=lambda f: (f["entry"], f["rank"]))
+    return findings
+
+
 def detect_dead_ranks(paths: Sequence[str],
                       rank_scalars: Dict[int, Dict[str, float]],
                       expected_ranks: int) -> List[dict]:
@@ -271,6 +299,7 @@ def aggregate(paths: Sequence[str], threshold: float = 1.25,
                                               max_repairs=suspect_repairs),
         "suspect_repairs": float(suspect_repairs),
         "slo_burns": detect_slo_burns(rank_scalars),
+        "bottlenecks": collect_bottlenecks(rank_scalars),
     }
     if expected_ranks is not None:
         # liveness is judged on UNFILTERED records: a healthy rank whose

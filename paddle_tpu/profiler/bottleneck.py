@@ -1,0 +1,145 @@
+"""Automated bottleneck verdicts: one word per entry on why the step
+takes as long as it does.
+
+Folds the device-profile decomposition (``profile/*_frac.<entry>`` — when
+a capture ran) with the always-on roofline/MFU attribution
+(``gauge/roofline/<entry>``, ``gauge/mfu/<entry>`` from ``xla_cost``)
+into ``gauge/bottleneck/<entry>`` over a CLOSED vocabulary:
+
+======== ================ ====================================================
+ id       verdict          meaning / dominant evidence
+======== ================ ====================================================
+ 0        compute_bound    device busy, arithmetic intensity above the
+                           machine balance point — you are spending MXU
+ 1        memory_bound     device busy, intensity below balance — HBM
+                           bandwidth is the wall
+ 2        comm_bound       collectives dominate the device time
+ 3        input_bound      the device waits on data — large host gap with
+                           significant h2d/d2h transfer share
+ 4        host_bound       the device waits on Python — large host gap
+                           with no transfer signal (dispatch/feed overhead,
+                           the static-executor 16.7%-vs-52.2% class)
+======== ================ ====================================================
+
+Verdicts publish as gauge VALUES (the id) so they ride /metrics, the
+JSONL schema gate, and telemetry_agg untouched; :data:`VERDICT_NAMES`
+maps back. Without a capture the decomposition half is absent and the
+verdict degrades honestly to the roofline's compute/memory split — a
+capture upgrades it to the full five-way call with the dominating
+numbers attached (returned per entry, surfaced as bench columns).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .telemetry import Telemetry, get_telemetry
+
+__all__ = ["VERDICT_IDS", "VERDICT_NAMES", "verdicts", "publish",
+           "COMM_FRAC_THRESHOLD", "HOST_GAP_THRESHOLD",
+           "TRANSFER_FRAC_THRESHOLD"]
+
+VERDICT_IDS = {
+    "compute_bound": 0,
+    "memory_bound": 1,
+    "comm_bound": 2,
+    "input_bound": 3,
+    "host_bound": 4,
+}
+VERDICT_NAMES = {v: k for k, v in VERDICT_IDS.items()}
+
+# collectives past this fraction of wall dominate the step
+COMM_FRAC_THRESHOLD = 0.35
+# the device idling past this fraction of wall makes the host the story
+HOST_GAP_THRESHOLD = 0.40
+# within a host-gapped step, this much transfer implicates the input
+# pipeline rather than Python dispatch
+TRANSFER_FRAC_THRESHOLD = 0.05
+
+
+def _entry_fractions(scalars: Dict[str, float]) -> Dict[str, Dict[str, float]]:
+    """Group ``gauge/profile/<cat>_frac.<entry>`` scalars per entry."""
+    out: Dict[str, Dict[str, float]] = {}
+    for name, v in scalars.items():
+        if not name.startswith("gauge/profile/"):
+            continue
+        rest = name[len("gauge/profile/"):]
+        if "_frac." not in rest:
+            continue
+        cat, entry = rest.split("_frac.", 1)
+        out.setdefault(entry, {})[cat] = float(v)
+    return out
+
+
+def _judge(fracs: Optional[Dict[str, float]],
+           roofline: Optional[float],
+           mfu: Optional[float]) -> Optional[dict]:
+    """One entry's verdict from whatever evidence exists."""
+    if fracs:
+        comm = fracs.get("collective", 0.0)
+        gap = fracs.get("host_gap", 0.0)
+        transfer = fracs.get("transfer", 0.0)
+        compute = fracs.get("compute", 0.0)
+        if comm >= COMM_FRAC_THRESHOLD and comm >= compute:
+            return {"verdict": "comm_bound",
+                    "evidence": {"collective_frac": comm,
+                                 "compute_frac": compute}}
+        if gap >= HOST_GAP_THRESHOLD and gap >= compute:
+            if transfer >= TRANSFER_FRAC_THRESHOLD:
+                return {"verdict": "input_bound",
+                        "evidence": {"host_gap_frac": gap,
+                                     "transfer_frac": transfer}}
+            return {"verdict": "host_bound",
+                    "evidence": {"host_gap_frac": gap,
+                                 "compute_frac": compute}}
+        # device-dominated: the roofline decides compute vs memory
+        if roofline is not None:
+            name = "compute_bound" if roofline >= 0.5 else "memory_bound"
+            ev = {"compute_frac": compute, "roofline": roofline}
+            if mfu is not None:
+                ev["mfu_pct"] = mfu
+            return {"verdict": name, "evidence": ev}
+        return {"verdict": "compute_bound",
+                "evidence": {"compute_frac": compute}}
+    if roofline is not None:
+        name = "compute_bound" if roofline >= 0.5 else "memory_bound"
+        ev = {"roofline": roofline}
+        if mfu is not None:
+            ev["mfu_pct"] = mfu
+        return {"verdict": name, "evidence": ev}
+    return None
+
+
+def verdicts(telemetry: Optional[Telemetry] = None) -> Dict[str, dict]:
+    """``{entry: {"verdict", "id", "evidence"}}`` for every entry with
+    any attribution signal (a profile decomposition, or a roofline
+    verdict from the compile-time cost model)."""
+    tel = telemetry or get_telemetry()
+    snap = tel.snapshot()
+    gauges = snap["gauges"]
+    scalars = {f"gauge/{k}": v for k, v in gauges.items()}
+    per_entry = _entry_fractions(scalars)
+    entries = set(per_entry)
+    for name in gauges:
+        if name.startswith("roofline/"):
+            entries.add(name[len("roofline/"):])
+    out: Dict[str, dict] = {}
+    for entry in sorted(entries):
+        row = _judge(per_entry.get(entry),
+                     gauges.get(f"roofline/{entry}"),
+                     gauges.get(f"mfu/{entry}"))
+        if row is not None:
+            row["id"] = VERDICT_IDS[row["verdict"]]
+            out[entry] = row
+    return out
+
+
+def publish(telemetry: Optional[Telemetry] = None) -> Dict[str, dict]:
+    """Evaluate and publish ``gauge/bottleneck/<entry>`` for every
+    judged entry (hooked from ``Telemetry.to_jsonl`` so each exported
+    record carries current verdicts; also the seam ``bench_all.py`` and
+    the ops plane read)."""
+    tel = telemetry or get_telemetry()
+    out = verdicts(tel)
+    for entry, row in out.items():
+        tel.gauge(f"bottleneck/{entry}", row["id"])
+    return out

@@ -367,6 +367,17 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if url.path == "/metrics":
                 tel.counter("ops/scrapes")
+                try:
+                    # refresh the derived attribution gauges (MFU,
+                    # bottleneck verdicts) so a live scrape sees current
+                    # values, not the last to_jsonl's — cheap dict math
+                    # over existing snapshots
+                    from . import bottleneck, xla_cost
+
+                    xla_cost.publish_mfu(tel)
+                    bottleneck.publish(tel)
+                except Exception:
+                    pass
                 self._send(200, prometheus_text(tel),
                            "text/plain; version=0.0.4; charset=utf-8")
             elif url.path == "/healthz":
@@ -388,13 +399,58 @@ class _Handler(BaseHTTPRequestHandler):
                         int(n) if n else None)})
             elif url.path == "/debug/telemetry":
                 self._send_json(200, tel.scalars())
+            elif url.path == "/debug/profile":
+                from . import device_profile
+
+                self._send_json(200, {
+                    "rank": rank(),
+                    "state": device_profile.capture_state(),
+                    "report": device_profile.last_report()})
             else:
                 self._send_json(404, {"error": f"no route {url.path}",
                                       "routes": ["/metrics", "/healthz",
                                                  "/readyz",
                                                  "/debug/requests",
                                                  "/debug/spans",
-                                                 "/debug/telemetry"]})
+                                                 "/debug/telemetry",
+                                                 "/debug/profile"]})
+        except Exception as e:  # noqa: BLE001 — handler must not die
+            try:
+                self._send_json(500, {"error": repr(e)})
+            except Exception:
+                pass
+
+    def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        try:
+            if url.path == "/debug/profile":
+                # arm an on-demand windowed device capture: the next N
+                # step boundaries of whatever engine is running get
+                # traced + attributed (profiler.device_profile). The
+                # response says armed-or-refused; the report lands on
+                # GET /debug/profile (and in telemetry) once the window
+                # closes.
+                from . import device_profile
+
+                try:
+                    steps = int(q.get("steps", ["0"])[0]) or None
+                except ValueError:
+                    self._send_json(400, {"error": "steps must be an int"})
+                    return
+                armed = device_profile.request_capture(steps=steps)
+                self._send_json(200 if armed else 409, {
+                    "rank": rank(), "armed": armed,
+                    "state": device_profile.capture_state(),
+                    "detail": ("capture armed — report appears on GET "
+                               "/debug/profile after the window closes"
+                               if armed else
+                               "refused: a capture or profiler window is "
+                               "already live (profile/capture_skipped "
+                               "counted)")})
+            else:
+                self._send_json(404, {"error": f"no POST route {url.path}",
+                                      "routes": ["/debug/profile"]})
         except Exception as e:  # noqa: BLE001 — handler must not die
             try:
                 self._send_json(500, {"error": repr(e)})

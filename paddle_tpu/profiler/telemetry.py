@@ -268,6 +268,18 @@ class Telemetry:
             xla_cost.publish_mfu(self)
         except Exception:
             pass  # attribution must never block a telemetry export
+        profile_payload = None
+        try:
+            # refresh the device-profile decomposition gauges and the
+            # bottleneck verdicts the same way, and pick up the last
+            # capture's structured top-K table for the record
+            from . import bottleneck, device_profile
+
+            device_profile.publish(self)
+            bottleneck.publish(self)
+            profile_payload = device_profile.jsonl_payload()
+        except Exception:
+            pass
         scalars = self.scalars()
         for k, v in (extra or {}).items():
             f = _coerce_scalar(v)
@@ -276,6 +288,11 @@ class Telemetry:
         rec = {"ts": time.time(),
                "step": int(step) if step is not None else None,
                "tag": str(tag), "scalars": scalars}
+        if profile_payload:
+            # the per-op/per-line top-K tables ride as a STRUCTURED
+            # top-level key (they are tables, not scalars); the schema
+            # gate validates their shape when present
+            rec["profile"] = profile_payload
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -306,6 +323,15 @@ class Telemetry:
             from .xla_cost import reset as _xla_reset
 
             _xla_reset()
+        except Exception:
+            pass
+        try:
+            # forget the last device-profile report (and abandon any
+            # in-flight capture): a record written after reset must not
+            # inherit the previous config's decomposition table
+            from .device_profile import reset as _devprof_reset
+
+            _devprof_reset()
         except Exception:
             pass
 

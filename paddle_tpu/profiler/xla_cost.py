@@ -265,12 +265,20 @@ def set_steps_per_call(entry: str, n: int) -> None:
 
 
 def reset() -> None:
-    """Drop all records and the cached chip peaks (tests re-read env)."""
+    """Drop all records and the cached chip peaks (tests re-read env).
+    The compiled-HLO registry feeding device-profile attribution resets
+    with the cost records — both describe the same compiles."""
     global _peaks_cache
     _registry.reset()
     _mfu_overflow_warned.clear()
     with _peaks_lock:
         _peaks_cache = None
+    try:
+        from . import hlo_attrib
+
+        hlo_attrib.hlo_registry().reset()
+    except Exception:
+        pass
 
 
 # -- capture ---------------------------------------------------------------
@@ -365,6 +373,7 @@ def capture(entry: str, jitted, args, kwargs) -> Optional[CostRecord]:
             compiled = lowered.compile()
             ca = _normalize_cost(compiled.cost_analysis())
             mem = compiled.memory_analysis()
+            _stash_hlo(entry, compiled=compiled)
             return record_compile(
                 entry, flops=ca.get("flops", 0.0),
                 bytes_accessed=ca.get("bytes accessed", 0.0),
@@ -384,6 +393,7 @@ def capture(entry: str, jitted, args, kwargs) -> Optional[CostRecord]:
                 out_bytes = _leaf_bytes(jitted.eval_shape(*args, **kwargs))
             except Exception:
                 pass
+        _stash_hlo(entry, lowered=lowered)
         return record_compile(
             entry, flops=ca.get("flops", 0.0),
             bytes_accessed=ca.get("bytes accessed", 0.0),
@@ -392,6 +402,23 @@ def capture(entry: str, jitted, args, kwargs) -> Optional[CostRecord]:
     except Exception as e:
         logger.debug("xla_cost: cost analysis failed for %s: %s", entry, e)
         return None
+
+
+def _stash_hlo(entry: str, compiled=None, lowered=None) -> None:
+    """Feed the device-profile attribution layer the compiled HLO this
+    capture already holds: optimized text in full mode (no extra work —
+    the compile happened above), the in-hand Lowered otherwise
+    (hlo_attrib compiles it to text only if a profile is ever taken).
+    Best-effort like everything else in this module."""
+    try:
+        from . import hlo_attrib
+
+        if compiled is not None:
+            hlo_attrib.hlo_registry().put_text(entry, compiled.as_text())
+        elif lowered is not None:
+            hlo_attrib.hlo_registry().put_lowered(entry, lowered)
+    except Exception as e:  # noqa: BLE001
+        logger.debug("xla_cost: HLO stash failed for %s: %s", entry, e)
 
 
 # -- MFU / roofline --------------------------------------------------------

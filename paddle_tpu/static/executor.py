@@ -17,6 +17,7 @@ import numpy as np
 
 from ..core.enforce import InvalidArgumentError, enforce
 from ..core.tensor import Parameter, Tensor
+from ..profiler import device_profile as _device_profile
 from ..profiler import spans as _spans
 from ..profiler import xla_cost as _xla_cost
 from ..profiler.retrace import tracked_jit
@@ -102,6 +103,8 @@ class Executor:
             fetch_var_name="fetch", scope=None, return_numpy=True,
             use_program_cache=True):
         _watchdog_heartbeat()  # run boundary feeds the hang watchdog
+        # windowed device-profile capture boundary (no-op unless armed)
+        _device_profile.step_boundary("executor.train_step")
         t_enter = time.perf_counter()
         tel = get_telemetry()
         program = program if isinstance(program, Program) else (
@@ -615,6 +618,9 @@ class Executor:
                 "run_steps requires a program with an optimizer "
                 "(opt.minimize(loss) recorded)")
         _watchdog_heartbeat()
+        # one capture boundary per window (steps-per-call registered
+        # below divides the attribution back to per-step)
+        _device_profile.step_boundary("executor.run_steps")
         feed = feed or {}
         if n_steps is None:
             raise InvalidArgumentError("n_steps is required")

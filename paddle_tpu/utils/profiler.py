@@ -31,6 +31,7 @@ __all__ = [
 _host_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
 _counter_events = []  # (name, ts_us, scalars) — telemetry snapshots
 _device_tracing = False  # whether jax.profiler.start_trace is live
+_degraded_starts = 0  # device_trace=True starts that degraded host-only
 _trace_dir = None
 
 
@@ -41,6 +42,14 @@ def _spans():
     from ..profiler import spans
 
     return spans
+
+
+def _device_profile():
+    # same circular-import caveat as _spans(); device_profile owns the
+    # process-wide "who holds the one live jax device trace" latch
+    from ..profiler import device_profile
+
+    return device_profile
 
 
 def spans_active() -> bool:
@@ -109,6 +118,14 @@ def export_chrome_tracing(path: str):
     # per-request tracks: each sampled serving request exports its whole
     # queue → prefill → decode → terminal lifecycle under one trace id
     events += _spans().trace_chrome_events(pid=pid)
+    # the last windowed device-profile capture rides along too: per-op
+    # device slices realigned onto the host clock, so the XLA lanes line
+    # up against the step-correlated spans in ONE timeline (drained,
+    # like the span window — each export owns its capture)
+    try:
+        events += _device_profile().chrome_events(drain=True)
+    except Exception:
+        pass
     # telemetry counter snapshots ride along as instant events ("i") so
     # counter values line up against the spans in the same timeline; a
     # final snapshot is always appended so the export carries the
@@ -146,7 +163,15 @@ def start_profiler(state="All", tracer_option="Default",
     """``device_trace=False`` opens a host-only window: spans + counter
     snapshots record for chrome export without paying for (or requiring)
     a full XLA device trace — the cheap mode tests and always-on step
-    sampling use."""
+    sampling use.
+
+    Re-entrant-safe and backend-guarded: exactly one jax device trace
+    can be live per process (shared latch with the windowed
+    ``profiler.device_profile`` captures), so a second
+    ``start_profiler(device_trace=True)`` — or one racing an in-flight
+    capture — degrades to a host-only window with a warning, and a
+    backend that cannot start a trace (unsupported platform, profiler
+    plugin missing) warns instead of raising mid-training."""
     global _trace_dir, _device_tracing
     _trace_dir = log_dir
     fresh = not _spans().window_active()
@@ -157,20 +182,68 @@ def start_profiler(state="All", tracer_option="Default",
     # device-trace window) must NOT wipe the outer window's spans
     _spans().open_window(clear=fresh)
     if device_trace:
-        os.makedirs(log_dir, exist_ok=True)
-        jax.profiler.start_trace(log_dir)
+        global _degraded_starts
+        dp = _device_profile()
+        if not dp.acquire_device_trace("utils.profiler"):
+            import logging
+
+            logging.getLogger("paddle_tpu.profiler").warning(
+                "start_profiler: a device trace is already live "
+                "(owner=%r) — opening a host-only window instead",
+                dp.device_trace_owner())
+            # pair this degraded start with ITS stop: stop_profiler
+            # consumes one degraded start before it may touch the real
+            # device trace, so a nested window closing can never stop
+            # the outer window's trace out from under it
+            _degraded_starts += 1
+            return
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+            jax.profiler.start_trace(log_dir)
+        except Exception as e:  # noqa: BLE001 — profiling must not kill
+            dp.release_device_trace("utils.profiler")
+            import logging
+
+            logging.getLogger("paddle_tpu.profiler").warning(
+                "start_profiler: jax.profiler.start_trace failed (%s) — "
+                "continuing with a host-only window", e)
+            _degraded_starts += 1
+            return
         _device_tracing = True
     # device_trace=False must NOT clear the flag: a host-only window
     # opened while a device trace is live would otherwise orphan it
     # (stop_profiler would never call jax.profiler.stop_trace)
 
 
-def stop_profiler(sorted_key="total", profile_path="/tmp/profile"):
-    global _device_tracing
-    _spans().close_window()
-    if _device_tracing:
+def _stop_device_trace():
+    """Close the jax device trace this module owns, if any. Warn-and-
+    noop without one (a stray ``stop_profiler`` must never raise out of
+    a training loop); guarded stop (a backend failing to finalize the
+    trace loses the artifact, not the run). A stop paired with a
+    DEGRADED start (nested/refused device_trace=True) consumes that
+    debt instead — windows close LIFO, so the inner stop must never
+    take down the outer window's live trace."""
+    global _device_tracing, _degraded_starts
+    if _degraded_starts > 0:
+        _degraded_starts -= 1
+        return
+    if not _device_tracing:
+        return
+    try:
         jax.profiler.stop_trace()
-        _device_tracing = False
+    except Exception as e:  # noqa: BLE001
+        import logging
+
+        logging.getLogger("paddle_tpu.profiler").warning(
+            "stop_profiler: jax.profiler.stop_trace failed (%s) — device "
+            "trace artifact lost", e)
+    _device_tracing = False
+    _device_profile().release_device_trace("utils.profiler")
+
+
+def stop_profiler(sorted_key="total", profile_path="/tmp/profile"):
+    _spans().close_window()
+    _stop_device_trace()
     if profile_path:
         # reference semantics: the timeline lands at profile_path
         export_chrome_tracing(profile_path)
@@ -212,12 +285,9 @@ class Profiler:
         self._running = True
 
     def stop(self):
-        global _device_tracing
         if self._running:
             _spans().close_window()
-            if _device_tracing:
-                jax.profiler.stop_trace()
-                _device_tracing = False
+            _stop_device_trace()
             self._running = False
 
     def step(self, num_samples=None):

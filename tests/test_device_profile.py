@@ -1,0 +1,729 @@
+"""Device profiling + source-line attribution + bottleneck verdicts +
+bench-trajectory gate (profiler.hlo_attrib / device_profile / bottleneck,
+tools/check_bench_trajectory.py, the _gate ports of the model/op
+benchmark gates, and the utils.profiler re-entrancy satellites).
+
+Golden fixtures live in tests/profiler_fixtures/: a handcrafted
+TPU-style trace (XLA Ops lanes + a shadowing host event that must be
+excluded), its CPU-style twin (no lanes — the thunk-executor fallback),
+the HLO text they join against, and malformed/empty traces for the
+degrade-to-warning path. The golden numbers are exact by construction:
+device total 6.0 ms over wall 10 ms, compute/collective/transfer =
+4.0/1.5/0.5 ms, so the tables and the reconciliation invariant are
+asserted to the digit.
+"""
+import gzip
+import json
+import logging
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.profiler import (bottleneck, device_profile, get_telemetry,
+                                 hlo_attrib)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "profiler_fixtures")
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _golden_hlo():
+    with open(os.path.join(FIXTURES, "golden_hlo.txt")) as f:
+        return f.read()
+
+
+def _golden_trace(name="golden.trace.json.gz"):
+    return hlo_attrib.load_trace(os.path.join(FIXTURES, name))
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler_state():
+    get_telemetry().reset()
+    device_profile.reset()
+    yield
+    get_telemetry().reset()
+    device_profile.reset()
+
+
+def _tiny_step(d=32, classes=10):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(d, d), nn.ReLU(), nn.Linear(d, classes))
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    step = paddle.jit.TrainStep(net, loss_fn=nn.CrossEntropyLoss(),
+                                optimizer=opt)
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, d).astype(np.float32)
+    y = rng.randint(0, classes, 16).astype(np.int64)
+    return step, (x,), (y,)
+
+
+# -- HLO parsing --------------------------------------------------------------
+
+class TestParseHlo:
+    def test_names_opcodes_sources(self):
+        ops = hlo_attrib.parse_hlo_text(_golden_hlo())
+        assert ops["dot.3"].opcode == "dot"
+        assert ops["dot.3"].src == "model.py:10"
+        assert ops["dot.3"].op_name == "jit(step)/jit(main)/dot_general"
+        assert ops["tanh.4"].src == "model.py:11"
+        assert ops["all-reduce.5"].opcode == "all-reduce"
+        assert ops["fusion.7"].opcode == "fusion"
+        # tuple-typed result: the opcode parser must skip the
+        # parenthesized type, not mistake it for the operand list
+        assert ops["copy-start.6"].opcode == "copy-start"
+        # ROOT-prefixed and computation-internal instructions register too
+        assert "add.8" in ops and "reduce.10" in ops
+
+    def test_categories(self):
+        ops = hlo_attrib.parse_hlo_text(_golden_hlo())
+        assert ops["dot.3"].category == "compute"
+        assert ops["all-reduce.5"].category == "collective"
+        assert ops["copy-start.6"].category == "transfer"
+        assert ops["fusion.7"].category == "compute"
+
+    def test_real_compiled_hlo_parses(self):
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, w):
+            return jnp.tanh(x @ w).sum()
+
+        x = jnp.ones((16, 16))
+        text = f.lower(x, x).compile().as_text()
+        ops = hlo_attrib.parse_hlo_text(text)
+        assert any(o.opcode == "dot" for o in ops.values())
+        # at least one op carries a real source line from this file/jax
+        assert any(":" in o.src and o.src != "?" for o in ops.values())
+
+
+# -- golden attribution -------------------------------------------------------
+
+class TestGoldenAttribution:
+    def _report(self, trace_name="golden.trace.json.gz"):
+        return hlo_attrib.attribute_trace(
+            _golden_trace(trace_name), {"train.step": _golden_hlo()},
+            steps={"train.step": 2}, wall_ms=10.0,
+            trigger_entry="train.step")
+
+    def test_exact_per_op_table(self):
+        rep = self._report()
+        att = rep.entries["train.step"]
+        assert att.by_op["dot.3"] == pytest.approx(2.0)
+        assert att.by_op["all-reduce.5"] == pytest.approx(1.5)
+        assert att.by_op["tanh.4"] == pytest.approx(1.0)
+        assert att.by_op["fusion.7"] == pytest.approx(0.7)
+        assert att.by_op["copy-start.6"] == pytest.approx(0.5)
+        assert att.by_op["<unattributed:rendezvous>"] == pytest.approx(0.3)
+        top = att.top_ops(3)
+        assert [r["op"] for r in top] == ["dot.3", "all-reduce.5", "tanh.4"]
+        assert top[0]["ms_per_step"] == pytest.approx(1.0)
+        assert top[0]["src"] == "model.py:10"
+
+    def test_exact_per_line_table(self):
+        rep = self._report()
+        att = rep.entries["train.step"]
+        assert att.by_line["model.py:10"] == pytest.approx(2.0)
+        assert att.by_line["model.py:11"] == pytest.approx(1.0)
+        assert att.by_line["model.py:12"] == pytest.approx(0.7)
+        assert att.by_line["grad.py:20"] == pytest.approx(1.5)
+        assert att.by_line["io.py:5"] == pytest.approx(0.5)
+
+    def test_category_totals_reconcile_within_1pct(self):
+        rep = self._report()
+        att = rep.entries["train.step"]
+        assert rep.device_total_ms == pytest.approx(6.0)
+        assert att.category_ms["compute"] == pytest.approx(4.0)
+        assert att.category_ms["collective"] == pytest.approx(1.5)
+        assert att.category_ms["transfer"] == pytest.approx(0.5)
+        assert rep.reconciliation_error() < 0.01
+
+    def test_fractions_and_host_gap(self):
+        rep = self._report()
+        fr = rep.fractions("train.step")
+        assert fr["compute_frac"] == pytest.approx(0.40)
+        assert fr["collective_frac"] == pytest.approx(0.15)
+        assert fr["transfer_frac"] == pytest.approx(0.05)
+        assert fr["host_gap_frac"] == pytest.approx(0.40)
+        assert sum(fr.values()) <= 1.0 + 1e-9
+
+    def test_host_event_shadowing_hlo_name_excluded(self):
+        # the python-pid "dot.3" event (99999 us) must NOT be counted:
+        # XLA Ops lanes exist, so lane membership wins over name match
+        rep = self._report()
+        assert rep.device_total_ms < 7.0
+
+    def test_cpu_style_trace_name_fallback(self):
+        rep = self._report("golden_cpu.trace.json.gz")
+        att = rep.entries["train.step"]
+        assert att.by_op["dot.3"] == pytest.approx(1.0)
+        # runtime bookkeeping events (ThunkExecutor waits) never match
+        # HLO names, so they are excluded on the fallback path
+        assert rep.device_total_ms == pytest.approx(3.0 - 0.15)
+
+    def test_overlapping_device_time_normalizes(self):
+        # wall SHORTER than device time (parallel thunks): fractions
+        # scale down so the per-entry sum stays <= 1
+        rep = hlo_attrib.attribute_trace(
+            _golden_trace(), {"train.step": _golden_hlo()},
+            steps={"train.step": 2}, wall_ms=3.0,
+            trigger_entry="train.step")
+        fr = rep.fractions("train.step")
+        assert sum(fr.values()) <= 1.0 + 1e-9
+        assert fr["host_gap_frac"] == pytest.approx(0.0)
+
+    def test_malformed_trace_degrades_to_warning(self, caplog):
+        with caplog.at_level(logging.WARNING, "paddle_tpu.profiler"):
+            trace = hlo_attrib.load_trace(
+                os.path.join(FIXTURES, "malformed.trace.json.gz"))
+        assert trace is None
+        assert any("unreadable trace" in r.message for r in caplog.records)
+
+    def test_empty_trace_degrades_to_warning(self, caplog):
+        trace = _golden_trace("empty.trace.json.gz")
+        with caplog.at_level(logging.WARNING, "paddle_tpu.profiler"):
+            rep = hlo_attrib.attribute_trace(
+                trace, {"train.step": _golden_hlo()}, wall_ms=10.0)
+        assert rep is None
+        assert any("no attributable device events" in r.message
+                   for r in caplog.records)
+
+    def test_missing_logdir_degrades(self, tmp_path, caplog):
+        with caplog.at_level(logging.WARNING, "paddle_tpu.profiler"):
+            assert hlo_attrib.load_trace(str(tmp_path)) is None
+
+
+# -- live capture e2e ---------------------------------------------------------
+
+class TestLiveCapture:
+    def test_programmatic_capture_train_step(self):
+        step, inp, lab = _tiny_step()
+        for _ in range(3):
+            step(inp, lab)
+        compiles_before = step._jitted.tracker.compiles
+        assert device_profile.request_capture(steps=2)
+        assert device_profile.capture_state() == "armed"
+        for _ in range(4):
+            step(inp, lab)
+        assert device_profile.capture_state() == "idle"
+        rep = device_profile.last_report()
+        assert rep is not None
+        assert rep["steps"]["jit.train_step"] == 2
+        att = rep["entries"]["jit.train_step"]
+        # category totals reconcile with device total within 1%
+        cat = sum(att["category_ms"].values())
+        assert cat == pytest.approx(rep["device_total_ms"], rel=0.01)
+        fr = att["fractions"]
+        assert 0 <= sum(fr.values()) <= 1 + 1e-6
+        assert rep["top_ops"], "per-op table must not be empty"
+        assert rep["top_ops"][0]["src"] != ""
+        # zero retraces: arming/stopping a capture is host-side only
+        assert step._jitted.tracker.compiles == compiles_before
+
+    def test_capture_publishes_gauges_and_verdict(self):
+        step, inp, lab = _tiny_step()
+        step(inp, lab)
+        assert device_profile.request_capture(steps=2)
+        for _ in range(3):
+            step(inp, lab)
+        tel = get_telemetry()
+        scal = tel.scalars()
+        assert "gauge/profile/compute_frac.jit.train_step" in scal
+        assert "gauge/bottleneck/jit.train_step" in scal
+        assert scal["gauge/bottleneck/jit.train_step"] in (0, 1, 2, 3, 4)
+        assert tel.counter_value("profile/captures") == 1
+
+    def test_overlapping_capture_refused_and_counted(self):
+        step, inp, lab = _tiny_step()
+        step(inp, lab)
+        assert device_profile.request_capture(steps=4)
+        assert not device_profile.request_capture(steps=1)
+        assert get_telemetry().counter_value(
+            "profile/capture_skipped") == 1
+
+    def test_env_triggered_capture(self, monkeypatch):
+        step, inp, lab = _tiny_step()
+        step(inp, lab)
+        device_profile.configure(every=4, steps=2)
+        for _ in range(8):
+            step(inp, lab)
+        assert get_telemetry().counter_value("profile/captures") >= 1
+        assert device_profile.last_report() is not None
+
+    def test_jsonl_record_carries_profile_and_passes_schema(self, tmp_path):
+        step, inp, lab = _tiny_step()
+        step(inp, lab)
+        assert device_profile.request_capture(steps=2)
+        for _ in range(3):
+            step(inp, lab)
+        path = tmp_path / "t.jsonl"
+        get_telemetry().to_jsonl(str(path), tag="bench/fake")
+        rec = json.loads(path.read_text().strip())
+        assert "profile" in rec
+        assert rec["profile"]["top_ops"]
+        r = subprocess.run(
+            [sys.executable, os.path.join(TOOLS,
+                                          "check_telemetry_schema.py"),
+             str(path)], capture_output=True, text=True)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_chrome_export_merges_device_ops(self, tmp_path):
+        from paddle_tpu.utils import profiler as host_profiler
+
+        step, inp, lab = _tiny_step()
+        step(inp, lab)
+        assert device_profile.request_capture(steps=2)
+        for _ in range(3):
+            step(inp, lab)
+        out = host_profiler.export_chrome_tracing(
+            str(tmp_path / "trace.json"))
+        events = json.load(open(out))["traceEvents"]
+        dev = [e for e in events if e.get("cat") == "device"]
+        assert dev, "device-op slices must ride the chrome export"
+        assert all(e["tid"] == "device ops" for e in dev)
+        # drained: a second export has no stale device ops
+        out2 = host_profiler.export_chrome_tracing(
+            str(tmp_path / "trace2.json"))
+        events2 = json.load(open(out2))["traceEvents"]
+        assert not [e for e in events2 if e.get("cat") == "device"]
+
+    def test_reset_discards_armed_capture_tempdir(self):
+        import glob
+
+        before = set(glob.glob("/tmp/paddle_tpu_devprof_*"))
+        assert device_profile.request_capture(steps=2)  # arms a tempdir
+        get_telemetry().reset()  # abandons the ARMED capture
+        after = set(glob.glob("/tmp/paddle_tpu_devprof_*"))
+        assert after - before == set(), "armed-then-reset leaked a dir"
+
+    def test_reset_forgets_report(self):
+        step, inp, lab = _tiny_step()
+        step(inp, lab)
+        assert device_profile.request_capture(steps=1)
+        for _ in range(2):
+            step(inp, lab)
+        assert device_profile.last_report() is not None
+        get_telemetry().reset()
+        assert device_profile.last_report() is None
+        assert device_profile.jsonl_payload() is None
+
+
+class TestOpsServerTrigger:
+    def test_post_arms_get_reports(self):
+        from paddle_tpu.profiler.ops_server import OpsServer
+
+        step, inp, lab = _tiny_step()
+        step(inp, lab)
+        srv = OpsServer(0, host="127.0.0.1").start()
+        try:
+            port = srv.port
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/debug/profile?steps=2",
+                method="POST")
+            resp = json.load(urllib.request.urlopen(req))
+            assert resp["armed"] is True
+            # overlap -> 409 + counted skip
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{port}/debug/profile?steps=2",
+                    method="POST"))
+            assert ei.value.code == 409
+            for _ in range(3):
+                step(inp, lab)
+            rep = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/profile"))
+            assert rep["state"] == "idle"
+            assert rep["report"]["entries"]["jit.train_step"]
+            # verdict gauges ride the live /metrics scrape
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics").read().decode()
+            assert "paddle_tpu_bottleneck_jit_train_step" in text.replace(
+                ".", "_")
+            from paddle_tpu.profiler.ops_server import parse_prometheus_text
+
+            parse_prometheus_text(text)
+        finally:
+            srv.stop()
+
+    def test_bad_steps_is_400_and_unknown_post_404(self):
+        from paddle_tpu.profiler.ops_server import OpsServer
+
+        srv = OpsServer(0, host="127.0.0.1").start()
+        try:
+            port = srv.port
+            for path, code in (("/debug/profile?steps=abc", 400),
+                               ("/nope", 404)):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(urllib.request.Request(
+                        f"http://127.0.0.1:{port}{path}", method="POST"))
+                assert ei.value.code == code
+        finally:
+            srv.stop()
+
+
+# -- utils.profiler re-entrancy satellites ------------------------------------
+
+class TestProfilerReentrancy:
+    def test_double_start_warns_and_noops(self, tmp_path, caplog):
+        from paddle_tpu.utils import profiler as host_profiler
+
+        with caplog.at_level(logging.WARNING, "paddle_tpu.profiler"):
+            host_profiler.start_profiler(log_dir=str(tmp_path / "a"))
+            host_profiler.start_profiler(log_dir=str(tmp_path / "b"))
+        assert any("already live" in r.message for r in caplog.records)
+        # stops pair LIFO: the first stop closes the DEGRADED inner
+        # window and must leave the outer window's device trace live
+        host_profiler.stop_profiler(profile_path=str(tmp_path / "t.json"))
+        assert device_profile.device_trace_owner() == "utils.profiler"
+        host_profiler.stop_profiler(profile_path=str(tmp_path / "t2.json"))
+        # fully released: a fresh device-trace window opens again
+        assert device_profile.device_trace_owner() is None
+
+    def test_stop_without_start_never_raises(self, tmp_path):
+        from paddle_tpu.utils import profiler as host_profiler
+
+        host_profiler.stop_profiler(profile_path=str(tmp_path / "t.json"))
+
+    def test_capture_refused_while_profiler_window_open(self, tmp_path):
+        from paddle_tpu.utils import profiler as host_profiler
+
+        host_profiler.start_profiler(log_dir=str(tmp_path / "w"))
+        try:
+            assert not device_profile.request_capture(steps=1)
+            assert get_telemetry().counter_value(
+                "profile/capture_skipped") == 1
+        finally:
+            host_profiler.stop_profiler(
+                profile_path=str(tmp_path / "t.json"))
+
+    def test_profiler_window_degrades_while_capture_live(self, tmp_path,
+                                                         caplog):
+        from paddle_tpu.utils import profiler as host_profiler
+
+        step, inp, lab = _tiny_step()
+        step(inp, lab)
+        assert device_profile.request_capture(steps=50)
+        step(inp, lab)  # starts the trace
+        assert device_profile.capture_state() == "capturing"
+        try:
+            with caplog.at_level(logging.WARNING, "paddle_tpu.profiler"):
+                host_profiler.start_profiler(log_dir=str(tmp_path / "w"))
+            assert any("already live" in r.message for r in caplog.records)
+            host_profiler.stop_profiler(
+                profile_path=str(tmp_path / "t.json"))
+            # the capture still owns the device trace
+            assert device_profile.device_trace_owner() == "device_profile"
+        finally:
+            device_profile.reset()
+
+
+# -- bottleneck verdicts ------------------------------------------------------
+
+class TestBottleneckVerdicts:
+    def _publish_fracs(self, tel, entry, compute=0.0, collective=0.0,
+                       transfer=0.0, host_gap=0.0):
+        tel.gauge(f"profile/compute_frac.{entry}", compute)
+        tel.gauge(f"profile/collective_frac.{entry}", collective)
+        tel.gauge(f"profile/transfer_frac.{entry}", transfer)
+        tel.gauge(f"profile/host_gap_frac.{entry}", host_gap)
+
+    def test_comm_bound(self):
+        tel = get_telemetry()
+        self._publish_fracs(tel, "e", compute=0.3, collective=0.6)
+        out = bottleneck.publish(tel)
+        assert out["e"]["verdict"] == "comm_bound"
+        assert tel.scalars()["gauge/bottleneck/e"] == 2
+
+    def test_host_vs_input_bound(self):
+        tel = get_telemetry()
+        self._publish_fracs(tel, "h", compute=0.2, host_gap=0.8)
+        self._publish_fracs(tel, "i", compute=0.2, host_gap=0.7,
+                            transfer=0.1)
+        out = bottleneck.publish(tel)
+        assert out["h"]["verdict"] == "host_bound"
+        assert out["i"]["verdict"] == "input_bound"
+
+    def test_device_bound_defers_to_roofline(self):
+        tel = get_telemetry()
+        self._publish_fracs(tel, "c", compute=0.9, host_gap=0.1)
+        tel.gauge("roofline/c", 1.0)
+        self._publish_fracs(tel, "m", compute=0.9, host_gap=0.1)
+        tel.gauge("roofline/m", 0.0)
+        out = bottleneck.publish(tel)
+        assert out["c"]["verdict"] == "compute_bound"
+        assert out["m"]["verdict"] == "memory_bound"
+
+    def test_roofline_fallback_without_capture(self):
+        tel = get_telemetry()
+        tel.gauge("roofline/r", 0.0)
+        tel.gauge("mfu/r", 12.5)
+        out = bottleneck.publish(tel)
+        assert out["r"]["verdict"] == "memory_bound"
+        assert out["r"]["evidence"]["mfu_pct"] == 12.5
+
+    def test_agg_surfaces_named_verdicts(self):
+        from paddle_tpu.profiler import aggregate
+
+        rank_scalars = {0: {"gauge/bottleneck/fleet.train_step": 4.0},
+                        1: {"gauge/bottleneck/fleet.train_step": 0.0}}
+        rows = aggregate.collect_bottlenecks(rank_scalars)
+        assert rows == [
+            {"entry": "fleet.train_step", "rank": 0,
+             "verdict": "host_bound"},
+            {"entry": "fleet.train_step", "rank": 1,
+             "verdict": "compute_bound"},
+        ]
+
+
+# -- schema contracts ---------------------------------------------------------
+
+class TestSchemaContracts:
+    def _check(self, tmp_path, scalars, profile=None):
+        rec = {"ts": 1.0, "step": 0, "tag": "t", "scalars": scalars}
+        if profile is not None:
+            rec["profile"] = profile
+        p = tmp_path / "x.jsonl"
+        p.write_text(json.dumps(rec) + "\n")
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(TOOLS, "check_telemetry_schema.py"), str(p)],
+            capture_output=True, text=True)
+        return r.returncode, r.stdout + r.stderr
+
+    def test_frac_bounds(self, tmp_path):
+        rc, _ = self._check(tmp_path,
+                            {"gauge/profile/compute_frac.e": 0.5})
+        assert rc == 0
+        rc, out = self._check(tmp_path,
+                              {"gauge/profile/compute_frac.e": 1.5})
+        assert rc == 1 and "outside [0, 1]" in out
+
+    def test_frac_sum_cross_field(self, tmp_path):
+        rc, out = self._check(tmp_path, {
+            "gauge/profile/compute_frac.e": 0.7,
+            "gauge/profile/host_gap_frac.e": 0.5})
+        assert rc == 1 and "sum" in out
+        rc, _ = self._check(tmp_path, {
+            "gauge/profile/compute_frac.e": 0.7,
+            "gauge/profile/host_gap_frac.e": 0.3})
+        assert rc == 0
+
+    def test_bottleneck_closed_vocabulary(self, tmp_path):
+        rc, _ = self._check(tmp_path, {"gauge/bottleneck/e": 3})
+        assert rc == 0
+        rc, out = self._check(tmp_path, {"gauge/bottleneck/e": 7})
+        assert rc == 1 and "verdict id" in out
+
+    def test_profile_table_well_formed(self, tmp_path):
+        good = {"top_ops": [{"op": "dot.3", "category": "compute",
+                             "ms": 1.0, "ms_per_step": 0.5, "frac": 0.4}],
+                "top_lines": [{"src": "model.py:10", "ms": 1.0}]}
+        rc, _ = self._check(tmp_path, {}, profile=good)
+        assert rc == 0
+        bad = {"top_ops": [{"op": "dot.3", "category": "magic",
+                            "ms": 1.0}], "top_lines": []}
+        rc, out = self._check(tmp_path, {}, profile=bad)
+        assert rc == 1 and "closed set" in out
+        bad2 = {"top_ops": [{"op": "dot.3", "category": "compute",
+                             "ms": -1.0}], "top_lines": []}
+        rc, out = self._check(tmp_path, {}, profile=bad2)
+        assert rc == 1
+
+
+# -- bench trajectory gate ----------------------------------------------------
+
+class TestBenchTrajectoryGate:
+    def _run(self, *args):
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(TOOLS, "check_bench_trajectory.py"), *args],
+            capture_output=True, text=True)
+        return r.returncode, r.stdout + r.stderr
+
+    def test_committed_history_passes(self):
+        rc, out = self._run("--root", REPO, "--tol-override",
+                            "lenet_mnist_dygraph_samples_per_sec=0.25")
+        assert rc == 0, out
+        assert out.startswith("bench trajectory: OK")
+
+    def _synth(self, tmp_path, regress=True):
+        import shutil
+
+        for f in ("BENCH_r01.json", "BENCH_r05.json"):
+            shutil.copy(os.path.join(REPO, f), tmp_path / f)
+        metric = "gpt_small_L8192_longctx_train_tokens_per_sec"
+        prev = json.load(open(os.path.join(REPO, "BENCH_extra.prev.json")))
+        for r in prev:
+            if r["metric"] == metric:
+                r["mfu_measured_pct"] = 41.0
+                r["attribution_entry"] = "fleet.train_step"
+                r["profile_host_gap_frac"] = 0.10
+        (tmp_path / "BENCH_extra.prev.json").write_text(json.dumps(prev))
+        cand = json.load(open(os.path.join(REPO, "BENCH_extra.json")))
+        out = []
+        for r in cand:
+            r = dict(r)
+            if r["metric"] == metric and regress:
+                r["value"] *= 0.7
+                r["mfu_measured_pct"] = 41.0
+                r["attribution_entry"] = "fleet.train_step"
+                r["profile_host_gap_frac"] = 0.62
+            out.append(r)
+        (tmp_path / "BENCH_extra.json").write_text(json.dumps(out))
+        return metric
+
+    def test_synthetic_regression_names_metric_and_suspect(self, tmp_path):
+        metric = self._synth(tmp_path)
+        rc, out = self._run("--root", str(tmp_path))
+        assert rc == 1
+        assert "FAIL" in out
+        assert metric in out
+        # suspect entry + the moved attribution column are both named
+        assert "fleet.train_step" in out
+        assert "profile_host_gap_frac" in out
+
+    def test_best_ever_catches_slow_bleed(self, tmp_path):
+        # candidate above previous but 15% below the best round
+        rounds = {"BENCH_r01.json": 100.0, "BENCH_r02.json": 84.0,
+                  "BENCH_r03.json": 85.0}
+        for name, v in rounds.items():
+            (tmp_path / name).write_text(json.dumps(
+                {"parsed": {"metric": "m", "value": v}}))
+        rc, out = self._run("--root", str(tmp_path))
+        assert rc == 1 and "best" in out
+
+    def test_json_contract(self, tmp_path):
+        metric = self._synth(tmp_path)
+        rc, out = self._run("--root", str(tmp_path), "--json")
+        assert rc == 1
+        doc = json.loads(out)
+        assert doc["gate"] == "bench trajectory"
+        assert doc["status"] == "FAIL"
+        assert any(metric in f for f in doc["failures"])
+
+    def test_removed_metric_fails(self, tmp_path):
+        (tmp_path / "BENCH_extra.prev.json").write_text(json.dumps(
+            [{"metric": "gone", "value": 1.0, "backend": "cpu"}]))
+        (tmp_path / "BENCH_extra.json").write_text(json.dumps([]))
+        rc, out = self._run("--root", str(tmp_path))
+        assert rc == 1 and "gone" in out
+
+
+# -- _gate ports of the model/op benchmark gates ------------------------------
+
+class TestGatePorts:
+    def test_model_gate_ok_and_json(self, tmp_path):
+        rows = [{"metric": "m", "value": 10.0, "backend": "cpu"}]
+        a = tmp_path / "a.json"
+        b = tmp_path / "b.json"
+        a.write_text(json.dumps(rows))
+        b.write_text(json.dumps(rows))
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(TOOLS, "check_model_benchmark_result.py"),
+             str(a), str(b)], capture_output=True, text=True)
+        assert r.returncode == 0
+        assert "model benchmark: OK —" in r.stdout
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(TOOLS, "check_model_benchmark_result.py"),
+             str(a), str(b), "--json"], capture_output=True, text=True)
+        doc = json.loads(r.stdout)  # --json stdout is pure JSON
+        assert doc["status"] == "OK" and doc["gate"] == "model benchmark"
+
+    def test_model_gate_regression_exits_1(self, tmp_path):
+        a = tmp_path / "a.json"
+        b = tmp_path / "b.json"
+        a.write_text(json.dumps(
+            [{"metric": "m", "value": 10.0, "backend": "cpu"}]))
+        b.write_text(json.dumps(
+            [{"metric": "m", "value": 5.0, "backend": "cpu"}]))
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(TOOLS, "check_model_benchmark_result.py"),
+             str(a), str(b)], capture_output=True, text=True)
+        assert r.returncode == 1
+        assert "model benchmark: FAIL —" in r.stderr
+
+    def test_op_gate_ok_fail_and_json(self, tmp_path):
+        base = {"backend": "cpu", "cases": {"matmul": {"ms": 1.0}}}
+        a = tmp_path / "a.json"
+        b = tmp_path / "b.json"
+        a.write_text(json.dumps(base))
+        b.write_text(json.dumps(
+            {"backend": "cpu", "cases": {"matmul": {"ms": 1.02}}}))
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(TOOLS, "check_op_benchmark_result.py"),
+             str(a), str(b)], capture_output=True, text=True)
+        assert r.returncode == 0 and "op benchmark: OK —" in r.stdout
+        b.write_text(json.dumps(
+            {"backend": "cpu", "cases": {"matmul": {"ms": 2.0}}}))
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(TOOLS, "check_op_benchmark_result.py"),
+             str(a), str(b), "--json"], capture_output=True, text=True)
+        assert r.returncode == 1
+        doc = json.loads(r.stdout)  # --json stdout is pure JSON
+        assert doc["status"] == "FAIL" and "matmul" in doc["detail"]
+
+    def test_op_gate_unreadable_input_exits_1(self, tmp_path):
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(TOOLS, "check_op_benchmark_result.py"),
+             str(tmp_path / "nope.json"), str(tmp_path / "nope.json")],
+            capture_output=True, text=True)
+        assert r.returncode == 1
+
+
+# -- the bench e2e (slow): env + ops-server captures during bench_all --------
+
+@pytest.mark.slow
+class TestBenchE2E:
+    def test_env_capture_during_bench_config(self, tmp_path):
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu",
+                    "PADDLE_TPU_DEVICE_PROFILE_EVERY": "8",
+                    "PADDLE_TPU_DEVICE_PROFILE_STEPS": "2",
+                    "PYTHONPATH": REPO})
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench_all.py"),
+             "--smoke", "bert"],
+            cwd=str(tmp_path), env=env, capture_output=True, text=True,
+            timeout=600)
+        assert r.returncode == 0, r.stdout + r.stderr
+        recs = [json.loads(ln) for ln in
+                open(tmp_path / "TELEMETRY.jsonl") if ln.strip()]
+        rec = recs[-1]
+        sc = rec["scalars"]
+        assert sc.get("counter/profile/captures", 0) >= 1
+        fr = {k: v for k, v in sc.items()
+              if k.startswith("gauge/profile/") and "_frac." in k}
+        assert fr, "decomposition fractions must be recorded"
+        cats = sum(v for k, v in sc.items()
+                   if k.startswith("gauge/profile/")
+                   and ("_frac.fleet.train_step" in k)
+                   and "host_gap" not in k)
+        # category fracs * wall == category ms; reconcile vs device total
+        wall = sc["gauge/profile/wall_ms"]
+        dev = sc["gauge/profile/device_total_ms"]
+        assert cats * wall == pytest.approx(min(dev, wall), rel=0.02)
+        assert sc.get("gauge/bottleneck/fleet.train_step") in (0, 1, 2,
+                                                               3, 4)
+        # retrace budget untouched by the capture
+        assert sc.get("counter/compile/fleet.train_step", 0) <= 6
+        # schema gate passes on the record with the profile table
+        chk = subprocess.run(
+            [sys.executable,
+             os.path.join(TOOLS, "check_telemetry_schema.py"),
+             str(tmp_path / "TELEMETRY.jsonl")],
+            capture_output=True, text=True)
+        assert chk.returncode == 0, chk.stdout + chk.stderr

@@ -3,95 +3,66 @@ HLO's metadata (source_file/source_line/op_name) — attributes every fusion
 to the model source line that produced it. This is how the r4 perf work
 located the LayerNorm-backward and attention-backward costs.
 
+The parsing/joining logic now lives in the TESTED library
+``paddle_tpu.profiler.hlo_attrib`` (the in-framework
+``profiler.device_profile`` runs it live at step boundaries — env knob
+``PADDLE_TPU_DEVICE_PROFILE_EVERY`` or ops-server ``POST
+/debug/profile``); this CLI keeps the original post-hoc interface for
+traces captured by hand:
+
 Usage:
   1. dump compiled HLO: jitted.lower(*args).compile().as_text() -> hlo.txt
   2. profile N steps with jax.profiler.trace(logdir)
   3. python tools/attribute_profile.py hlo.txt logdir N
 """
-import collections, glob, gzip, json, re, sys
+import os
+import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def device_total_ms(logdir):
-    """Total device time (ms) across the XLA Ops lanes of the newest trace
+    """Total device time (ms) across the XLA-op lanes of the newest trace
     under ``logdir`` — shared by the experiment benchmarks."""
-    import glob as _glob
-    import gzip as _gzip
-    import json as _json
+    from paddle_tpu.profiler import hlo_attrib
 
-    paths = sorted(_glob.glob(f"{logdir}/plugins/profile/*/*.trace.json.gz"))
-    with _gzip.open(paths[-1]) as fh:
-        trace = _json.load(fh)
-    events = trace["traceEvents"]
-    procs, lanes = {}, set()
-    for ev in events:
-        if ev.get("ph") != "M":
-            continue
-        if ev.get("name") == "process_name":
-            procs[ev["pid"]] = ev["args"]["name"]
-        elif (ev.get("name") == "thread_name"
-              and "XLA Ops" in ev["args"].get("name", "")):
-            lanes.add((ev["pid"], ev.get("tid")))
-    tpu = {p for p, n in procs.items()
-           if "TPU" in n or "xla" in n.lower() or "/device" in n.lower()}
-    return sum(ev.get("dur", 0) / 1000.0 for ev in events
-               if ev.get("ph") == "X" and ev.get("pid") in tpu
-               and (ev.get("pid"), ev.get("tid")) in lanes)
+    trace = hlo_attrib.load_trace(logdir)
+    if trace is None:
+        return 0.0
+    return sum(e.get("dur", 0) / 1e3
+               for e in hlo_attrib.device_events(trace))
 
 
 def main():
     if len(sys.argv) != 4:
-        raise SystemExit("usage: attribute_profile.py <hlo.txt> <trace_logdir> <n_steps>")
+        raise SystemExit(
+            "usage: attribute_profile.py <hlo.txt> <trace_logdir> <n_steps>")
     hlo_path, logdir, steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    from paddle_tpu.profiler import hlo_attrib
 
-    # fusion name -> (file:line, op_name) from HLO metadata
-    meta = {}
-    pat = re.compile(r"%(\S+?) = .*?metadata=\{([^}]*)\}")
-    for line in open(hlo_path):
-        m = pat.search(line)
-        if not m:
-            continue
-        name, md = m.group(1), m.group(2)
-        f = re.search(r'source_file="([^"]+)"', md)
-        l = re.search(r"source_line=(\d+)", md)
-        op = re.search(r'op_name="([^"]+)"', md)
-        meta[name] = (
-            (f.group(1).split("/")[-1] if f else "?") + ":" + (l.group(1) if l else "?"),
-            op.group(1) if op else "?",
-        )
-
-    paths = sorted(glob.glob(f"{logdir}/plugins/profile/*/*.trace.json.gz"))
-    with gzip.open(paths[-1]) as f:
-        trace = json.load(f)
-    events = trace["traceEvents"]
-    procs, op_lanes = {}, set()
-    for e in events:
-        if e.get("ph") != "M":
-            continue
-        if e.get("name") == "process_name":
-            procs[e["pid"]] = e["args"]["name"]
-        elif e.get("name") == "thread_name" and "XLA Ops" in e["args"].get("name", ""):
-            op_lanes.add((e["pid"], e.get("tid")))
-    tpu_pids = {p for p, n in procs.items()
-                if "TPU" in n or "xla" in n.lower() or "/device" in n.lower()}
-    by_src = collections.Counter()
-    by_op = collections.Counter()
-    for e in events:
-        if (e.get("ph") != "X" or e.get("pid") not in tpu_pids
-                or (e.get("pid"), e.get("tid")) not in op_lanes):
-            continue
-        name = e.get("name", "")
-        dur = e.get("dur", 0) / 1000.0
-        src, op = meta.get(name, ("<unattributed:" + re.sub(r"[.\d]+$", "", name) + ">", "?"))
-        by_src[src] += dur
-        opshort = re.sub(r"\[\d+\]", "", op)
-        by_op[(src, opshort)] += dur
+    with open(hlo_path) as f:
+        hlo_text = f.read()
+    trace = hlo_attrib.load_trace(logdir)
+    if trace is None:
+        raise SystemExit(f"no readable trace under {logdir}")
+    entry = os.path.basename(hlo_path)
+    report = hlo_attrib.attribute_trace(
+        trace, {entry: hlo_text}, steps={entry: steps}, wall_ms=0.0,
+        trigger_entry=entry, default_steps=steps)
+    if report is None:
+        raise SystemExit("trace carries no attributable device events")
+    att = report.entries[entry]
     print("== by source line (ms/step) ==")
-    for src, ms in by_src.most_common(30):
-        print(f"{ms/steps:9.3f}  {src}")
+    for row in att.top_lines(30):
+        print(f"{row['ms_per_step']:9.3f}  {row['src']}")
     print("\n== by (source, op_name) ==")
-    for (src, op), ms in by_op.most_common(40):
-        print(f"{ms/steps:9.3f}  {src:34s}  {op[:90]}")
+    for row in att.top_ops(40):
+        print(f"{row['ms_per_step']:9.3f}  {row['src']:34s}  "
+              f"{row['op_name'][:90]}")
+    print(f"\n== categories (ms/step over {steps} steps) ==")
+    for cat, ms in sorted(att.category_ms.items(), key=lambda kv: -kv[1]):
+        print(f"{ms / steps:9.3f}  {cat}")
+    print(f"{report.device_total_ms / steps:9.3f}  device total")
 
 
 if __name__ == "__main__":

@@ -2,8 +2,10 @@
 # The round's model-benchmark ritual — the counterpart of the reference's
 # tools/test_model_benchmark.sh CI loop:
 #   1. re-measure every config (bench_all.py, real backend)
-#   2. GATE: fail (exit 8) if any config regressed >5% vs the last
-#      PASSING baseline (BENCH_extra.prev.json)
+#   2. GATE: fail (exit 1, tools/_gate.py conventions) if any config
+#      regressed >5% vs the last PASSING baseline
+#      (BENCH_extra.prev.json) or the whole-history trajectory gate
+#      trips (check_bench_trajectory.py)
 #   3. on PASS only, advance the baseline to this run
 # Run from the repo root on the bench rig:  bash tools/bench_ritual.sh
 set -e
@@ -34,6 +36,16 @@ python tools/check_retrace_budget.py TELEMETRY.jsonl --budget 6
 # shape silently streaming through blockwise is a ~10x cliff that fails
 # the ritual instead of hiding in a log line.
 python tools/check_attribution.py TELEMETRY.jsonl
+
+# bench-trajectory gate: the WHOLE recorded history — every BENCH_r*
+# round plus the BENCH_extra prev->candidate pair — per metric vs both
+# the previous and the best-ever round, so a slow multi-round bleed
+# fails as loudly as a cliff. On regression the failure names the
+# suspect from the attribution delta (which entry's MFU / profile
+# fraction / step time moved). Lenet tolerance mirrors the model gate's
+# r5 variance study (tools/profiles/r5_lenet_variance.txt).
+python tools/check_bench_trajectory.py \
+  --tol-override lenet_mnist_dygraph_samples_per_sec=0.25
 
 # tpu-lint gate: the STATIC twin of the retrace-budget gate — AST
 # analysis over the framework for tracer-safety hazards (R1-R8: tracer

@@ -1,0 +1,306 @@
+#!/usr/bin/env python
+"""Bench-trajectory regression gate: the WHOLE recorded history, not
+just the last two points.
+
+``check_model_benchmark_result.py`` compares one run against one
+baseline; this gate walks every committed round — the headline
+``BENCH_r*.json`` series plus the per-config ``BENCH_extra.prev.json`` →
+``BENCH_extra.json`` pair — and fails when any metric's newest value
+regressed beyond tolerance against EITHER its previous round or its
+best-ever round (a slow bleed of 3% per round never trips a
+prev-only gate; the best-ever check catches it).
+
+On a regression the gate does not just name the metric: it names the
+**suspect** from the attribution delta — which entry's numbers moved
+between the baseline row and the candidate row (``mfu_measured_pct``,
+``hbm_gbps_achieved``, ``compile_*``, the ``profile_*_frac`` device
+decomposition columns, step-time) — so the failure message says
+*"decode tokens/s -18%, suspect serve.decode.b8: profile_host_gap_frac
+0.12 → 0.55"* instead of a bare number. With ``--telemetry`` and
+``--prev-telemetry`` the per-entry ``hist/*step_ms/p50`` and
+``gauge/profile/*_frac`` scalars of the two runs' bench records are
+diffed too.
+
+Usage (defaults match the committed repo layout; run from the root):
+
+    python tools/check_bench_trajectory.py [--root .] [--tol 0.05]
+        [--best-tol 0.10] [--tol-override METRIC=TOL]
+        [--candidate BENCH_extra.json] [--baseline BENCH_extra.prev.json]
+        [--telemetry TELEMETRY.jsonl --prev-telemetry PREV.jsonl] [--json]
+
+Summary line, exit codes (0/1), and ``--json`` follow tools/_gate.py.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _gate import add_gate_args, finish  # noqa: E402
+
+GATE = "bench trajectory"
+
+# candidate-row attribution columns diffed to name a suspect (relative
+# movement; the biggest mover is reported)
+_ATTRIB_COLUMNS = (
+    "mfu_measured_pct", "hbm_gbps_achieved", "compile_flops",
+    "compile_bytes_accessed", "compile_peak_hbm_bytes", "mfu_pct",
+    "profile_compute_frac", "profile_collective_frac",
+    "profile_transfer_frac", "profile_host_gap_frac",
+)
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def load_rounds(root):
+    """The headline series: ``[(round_no, metric, value, row)]`` sorted
+    by round, from every BENCH_r<NN>.json (each holds one parsed
+    record)."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            raise ValueError(f"{path}: unreadable round file: {e}")
+        row = doc.get("parsed") if isinstance(doc, dict) else None
+        if not isinstance(row, dict) or "metric" not in row \
+                or "value" not in row:
+            continue  # a round without a parsed record contributes nothing
+        out.append((int(m.group(1)), row["metric"], float(row["value"]),
+                    row))
+    out.sort(key=lambda r: r[0])
+    return out
+
+
+def load_extra(path):
+    """``{metric: row}`` from a BENCH_extra-style list, {} if missing."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        rows = json.load(f)
+    return {r["metric"]: r for r in rows if isinstance(r, dict)
+            and "metric" in r and "value" in r}
+
+
+def series_checks(series, tol, best_tol, overrides):
+    """Walk one metric's chronological value series: the NEWEST point
+    must hold against its previous point (tol) and its best-so-far
+    (best_tol). Returns (failures, rows) — rows describe every
+    comparison for the report."""
+    failures, rows = [], []
+    for metric, points in series.items():
+        if len(points) < 2:
+            rows.append({"metric": metric, "status": "single-point",
+                         "value": points[-1][1]})
+            continue
+        cand_label, cand = points[-1]
+        prev_label, prev = points[-2]
+        best_label, best = max(points[:-1], key=lambda p: p[1])
+        t = overrides.get(metric, tol)
+        bt = overrides.get(metric, best_tol)
+        vs_prev = cand / max(prev, 1e-9)
+        vs_best = cand / max(best, 1e-9)
+        row = {"metric": metric, "value": cand, "candidate": cand_label,
+               "prev": prev, "prev_label": prev_label,
+               "vs_prev": round(vs_prev, 4),
+               "best": best, "best_label": best_label,
+               "vs_best": round(vs_best, 4), "status": "ok"}
+        if vs_prev < 1.0 - t:
+            row["status"] = "regressed-vs-prev"
+            failures.append((metric, f"{metric}: {prev:.2f} -> {cand:.2f} "
+                                     f"(x{vs_prev:.3f} vs {prev_label}, "
+                                     f"tol {t:.0%})"))
+        elif vs_best < 1.0 - bt:
+            row["status"] = "regressed-vs-best"
+            failures.append((metric, f"{metric}: best {best:.2f} "
+                                     f"({best_label}) -> {cand:.2f} "
+                                     f"(x{vs_best:.3f}, tol {bt:.0%})"))
+        rows.append(row)
+    return failures, rows
+
+
+def attribution_suspect(base_row, cand_row):
+    """The biggest relative mover among the attribution columns of the
+    two rows, as ``(entry, 'column a -> b (xR)')`` or None."""
+    moves = []
+    for col in _ATTRIB_COLUMNS:
+        b, c = base_row.get(col), cand_row.get(col)
+        if not isinstance(b, (int, float)) or not isinstance(c, (int, float)):
+            continue
+        if isinstance(b, bool) or isinstance(c, bool):
+            continue
+        denom = max(abs(float(b)), 1e-9)
+        move = abs(float(c) - float(b)) / denom
+        if move > 0.02:  # ignore noise-level wiggle
+            moves.append((move, col, float(b), float(c)))
+    if not moves:
+        return None
+    move, col, b, c = max(moves)
+    entry = (cand_row.get("attribution_entry")
+             or base_row.get("attribution_entry") or "?")
+    verdict = cand_row.get("bottleneck")
+    detail = f"{col} {b:.4g} -> {c:.4g}"
+    if verdict:
+        detail += f", verdict {verdict}"
+    return entry, detail
+
+
+def _bench_scalars(path, metric):
+    """The ``bench/<metric>`` record's per-entry attribution scalars
+    (step-time p50s + profile fractions + per-entry mfu) from a
+    telemetry JSONL, or {}."""
+    want = f"bench/{metric}"
+    out = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("tag") != want:
+                    continue
+                for k, v in (rec.get("scalars") or {}).items():
+                    if (re.match(r"^hist/.*step_ms/p50$", k)
+                            or k.startswith("gauge/profile/")
+                            or k.startswith("gauge/mfu/")
+                            or k.startswith("gauge/bottleneck/")):
+                        if isinstance(v, (int, float)):
+                            out[k] = float(v)
+    except OSError:
+        pass
+    return out
+
+
+def telemetry_suspect(prev_path, cand_path, metric):
+    """Biggest per-entry mover between the two runs' bench records."""
+    base = _bench_scalars(prev_path, metric)
+    cand = _bench_scalars(cand_path, metric)
+    moves = []
+    for k in set(base) & set(cand):
+        denom = max(abs(base[k]), 1e-9)
+        move = abs(cand[k] - base[k]) / denom
+        if move > 0.05:
+            moves.append((move, k, base[k], cand[k]))
+    if not moves:
+        return None
+    move, k, b, c = max(moves)
+    return f"{k} {b:.4g} -> {c:.4g} (x{c / max(b, 1e-9):.2f})"
+
+
+def run(args):
+    root = args.root
+    # -- series 1: the headline rounds ----------------------------------
+    series = {}
+    for rnd, metric, value, _row in load_rounds(root):
+        series.setdefault(metric, []).append((f"r{rnd:02d}", value))
+    # -- series 2: BENCH_extra prev -> candidate ------------------------
+    base = load_extra(os.path.join(root, args.baseline))
+    cand = load_extra(os.path.join(root, args.candidate))
+    removed = []
+    extra_pairs = {}
+    for metric, brow in base.items():
+        crow = cand.get(metric)
+        if crow is None:
+            removed.append(metric)
+            continue
+        if brow.get("smoke") or crow.get("smoke"):
+            continue  # smoke shapes measure nothing comparable
+        if brow.get("backend") != crow.get("backend"):
+            continue  # cpu-vs-tpu rows are different experiments
+        extra_pairs[metric] = (brow, crow)
+        series.setdefault(metric, []).extend(
+            [("prev", float(brow["value"])),
+             ("candidate", float(crow["value"]))])
+    overrides = {}
+    for ov in args.tol_override:
+        k, _, v = ov.partition("=")
+        overrides[k] = float(v)
+    failures, rows = series_checks(series, args.tol, args.best_tol,
+                                   overrides)
+    for metric in removed:
+        failures.append((metric, f"{metric}: present in {args.baseline} "
+                                 f"but missing from {args.candidate} "
+                                 f"(config removed?)"))
+    # -- suspect naming from the attribution delta ----------------------
+    detailed = []
+    for metric, msg in failures:
+        suspect = None
+        pair = extra_pairs.get(metric)
+        if pair is not None:
+            suspect = attribution_suspect(*pair)
+        tsusp = None
+        if args.telemetry and args.prev_telemetry:
+            tsusp = telemetry_suspect(args.prev_telemetry, args.telemetry,
+                                      metric)
+        if suspect is not None:
+            entry, d = suspect
+            msg += f" — suspect {entry}: {d}"
+        if tsusp is not None:
+            msg += f" — telemetry delta: {tsusp}"
+        if suspect is None and tsusp is None:
+            msg += " — no attribution delta available (headline round " \
+                   "records carry no attribution columns)"
+        detailed.append(msg)
+    n_series = len(series)
+    n_points = sum(len(p) for p in series.values())
+    payload = {"series": rows, "failures": detailed,
+               "metrics": n_series, "points": n_points}
+    if detailed:
+        return finish(GATE, False,
+                      f"{len(detailed)} regression(s) across {n_series} "
+                      f"metric(s): " + " | ".join(detailed),
+                      payload=payload, json_mode=args.json)
+    if n_series == 0:
+        return finish(GATE, False,
+                      f"no bench history found under {root} — nothing to "
+                      f"gate means the trajectory is not being recorded",
+                      payload=payload, json_mode=args.json)
+    return finish(GATE, True,
+                  f"{n_series} metric(s), {n_points} recorded points — "
+                  f"newest holds vs previous (tol {args.tol:.0%}) and "
+                  f"best-ever (tol {args.best_tol:.0%})",
+                  payload=payload, json_mode=args.json)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Gate the whole bench history: newest value per "
+                    "metric vs previous and best-ever round, naming the "
+                    "attribution suspect on regression")
+    ap.add_argument("--root", default=".",
+                    help="repo root holding BENCH_r*.json / BENCH_extra*")
+    ap.add_argument("--candidate", default="BENCH_extra.json")
+    ap.add_argument("--baseline", default="BENCH_extra.prev.json")
+    ap.add_argument("--tol", type=float, default=0.05,
+                    help="max fractional drop vs the previous round")
+    ap.add_argument("--best-tol", type=float, default=0.10,
+                    help="max fractional drop vs the best recorded round")
+    ap.add_argument("--tol-override", action="append", default=[],
+                    metavar="METRIC=TOL",
+                    help="per-metric tolerance (applies to both checks)")
+    ap.add_argument("--telemetry", default=None,
+                    help="candidate TELEMETRY.jsonl for per-entry deltas")
+    ap.add_argument("--prev-telemetry", default=None,
+                    help="baseline TELEMETRY.jsonl for per-entry deltas")
+    add_gate_args(ap)
+    args = ap.parse_args(argv)
+    try:
+        return run(args)
+    except (OSError, ValueError) as e:
+        return finish(GATE, False, str(e), json_mode=args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

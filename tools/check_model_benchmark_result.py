@@ -8,15 +8,27 @@ absolute numbers need publishing.
 Usage:
   python bench_all.py                    # writes BENCH_extra.json
   python tools/check_model_benchmark_result.py prev/BENCH_extra.json \
-         BENCH_extra.json [--tol 0.05]
-Exit code 0 = pass, 8 = any config's samples/sec dropped more than --tol
-(default 5%) vs the previous round. New configs pass; removed configs fail.
+         BENCH_extra.json [--tol 0.05] [--json]
+
+Summary line, exit codes (0 pass / 1 fail), and ``--json`` follow the
+shared gate conventions (tools/_gate.py): ``model benchmark: OK|FAIL —
+<detail>``. Per-row comparisons still print for humans. New configs
+pass; removed configs fail. For the whole-history trajectory (vs best
+AND previous round, with attribution-suspect naming) see
+``tools/check_bench_trajectory.py`` — this gate stays the minimal
+two-file comparison.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _gate import add_gate_args, finish  # noqa: E402
+
+GATE = "model benchmark"
 
 
 def _index(path):
@@ -25,7 +37,7 @@ def _index(path):
     return {r["metric"]: r for r in rows}
 
 
-def main():
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("baseline")
     ap.add_argument("candidate")
@@ -35,26 +47,38 @@ def main():
                     metavar="METRIC=TOL",
                     help="per-metric tolerance (e.g. a dispatch-bound eager "
                          "config whose run-to-run jitter exceeds the default)")
-    args = ap.parse_args()
+    add_gate_args(ap)
+    args = ap.parse_args(argv)
     overrides = {}
     for ov in args.tol_override:
         k, _, v = ov.partition("=")
         overrides[k] = float(v)
-    base = _index(args.baseline)
-    cand = _index(args.candidate)
+    # --json promises a machine-readable stdout: the per-row human
+    # comparison lines move to stderr there
+    rowout = sys.stderr if args.json else sys.stdout
+    try:
+        base = _index(args.baseline)
+        cand = _index(args.candidate)
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        return finish(GATE, False, f"unreadable input: {e}",
+                      json_mode=args.json)
     failures = []
+    rows = []
     for name, b in base.items():
         c = cand.get(name)
         if c is None:
-            print(f"[check_model_benchmark] MISSING  {name} (config removed?)")
-            failures.append(name)
+            print(f"[check_model_benchmark] MISSING  {name} (config removed?)", file=rowout)
+            failures.append(f"{name} missing from candidate")
+            rows.append({"metric": name, "status": "missing"})
             continue
         if b.get("smoke") or c.get("smoke"):
-            print(f"[check_model_benchmark] skip     {name} (smoke run)")
+            print(f"[check_model_benchmark] skip     {name} (smoke run)", file=rowout)
+            rows.append({"metric": name, "status": "skip-smoke"})
             continue
         if b.get("backend") != c.get("backend"):
             print(f"[check_model_benchmark] skip     {name} (backend "
-                  f"{b.get('backend')} vs {c.get('backend')})")
+                  f"{b.get('backend')} vs {c.get('backend')})", file=rowout)
+            rows.append({"metric": name, "status": "skip-backend"})
             continue
         tol = overrides.get(name, args.tol)
         ratio = c["value"] / max(b["value"], 1e-9)
@@ -65,15 +89,21 @@ def main():
             extra = f"  mfu {c['mfu_pct']:.1f}%"
         print(f"[check_model_benchmark] {tag} {name:46s} "
               f"{b['value']:10.2f} -> {c['value']:10.2f} {c.get('unit', '')}"
-              f"  x{ratio:.3f}{extra}")
+              f"  x{ratio:.3f}{extra}", file=rowout)
+        rows.append({"metric": name, "status": tag.strip(),
+                     "ratio": round(ratio, 4)})
         if ratio < 1.0 - tol:
-            failures.append(name)
+            failures.append(f"{name} x{ratio:.3f} (tol {tol:.0%})")
+    payload = {"rows": rows, "failures": failures,
+               "baseline": args.baseline, "candidate": args.candidate}
     if failures:
-        print(f"[check_model_benchmark] FAILED: {len(failures)} "
-              f"regression(s): {', '.join(failures)}")
-        return 8
-    print("[check_model_benchmark] PASSED")
-    return 0
+        return finish(GATE, False,
+                      f"{len(failures)} regression(s): "
+                      + "; ".join(failures), payload=payload,
+                      json_mode=args.json)
+    return finish(GATE, True,
+                  f"{len(rows)} config(s) compared, none regressed "
+                  f"beyond tol", payload=payload, json_mode=args.json)
 
 
 if __name__ == "__main__":

@@ -8,58 +8,86 @@ perf PR-vs-develop, never on absolute numbers).
 Usage:
   python tools/op_benchmark.py --out develop.json      # on the base commit
   python tools/op_benchmark.py --out pr.json           # on the PR
-  python tools/check_op_benchmark_result.py develop.json pr.json [--tol 1.10]
-Exit code 0 = pass, 8 = regression found (mirrors the reference's fail
-code path).
+  python tools/check_op_benchmark_result.py develop.json pr.json \
+         [--tol 1.10] [--json]
+
+Summary line, exit codes (0 pass / 1 fail), and ``--json`` follow the
+shared gate conventions (tools/_gate.py): ``op benchmark: OK|FAIL —
+<detail>``. Per-case comparisons still print for humans.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _gate import add_gate_args, finish  # noqa: E402
 
-def main():
+GATE = "op benchmark"
+
+
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("baseline")
     ap.add_argument("candidate")
     ap.add_argument("--tol", type=float, default=1.10,
                     help="max allowed ms ratio candidate/baseline")
-    args = ap.parse_args()
-    with open(args.baseline) as f:
-        base = json.load(f)
-    with open(args.candidate) as f:
-        cand = json.load(f)
+    add_gate_args(ap)
+    args = ap.parse_args(argv)
+    try:
+        with open(args.baseline) as f:
+            base = json.load(f)
+        with open(args.candidate) as f:
+            cand = json.load(f)
+    except (OSError, ValueError) as e:
+        return finish(GATE, False, f"unreadable input: {e}",
+                      json_mode=args.json)
+    # --json promises a machine-readable stdout: the per-case human
+    # comparison lines move to stderr there
+    rowout = sys.stderr if args.json else sys.stdout
     if base.get("backend") != cand.get("backend"):
-        print(f"[check_op_benchmark] backend mismatch: "
-              f"{base.get('backend')} vs {cand.get('backend')}")
-        return 8
+        return finish(GATE, False,
+                      f"backend mismatch: {base.get('backend')} vs "
+                      f"{cand.get('backend')} — runs are not comparable",
+                      json_mode=args.json)
     regressions = []
+    rows = []
     for name, b in base.get("cases", {}).items():
         c = cand.get("cases", {}).get(name)
         if c is None:
-            print(f"[check_op_benchmark] MISSING  {name} (case removed?)")
-            regressions.append(name)
+            print(f"[check_op_benchmark] MISSING  {name} (case removed?)", file=rowout)
+            regressions.append(f"{name} missing")
+            rows.append({"case": name, "status": "missing"})
             continue
         if "error" in c and "error" not in b:
-            print(f"[check_op_benchmark] BROKE    {name}: {c['error']}")
-            regressions.append(name)
+            print(f"[check_op_benchmark] BROKE    {name}: {c['error']}", file=rowout)
+            regressions.append(f"{name} broke: {c['error']}")
+            rows.append({"case": name, "status": "broke"})
             continue
         if "error" in b or "error" in c:
+            rows.append({"case": name, "status": "skip-error"})
             continue
         ratio = c["ms"] / max(b["ms"], 1e-9)
         tag = "REGRESS " if ratio > args.tol else ("improve " if ratio < 0.95
                                                    else "same    ")
         print(f"[check_op_benchmark] {tag} {name:28s} "
-              f"{b['ms']:9.4f} -> {c['ms']:9.4f} ms  x{ratio:.3f}")
+              f"{b['ms']:9.4f} -> {c['ms']:9.4f} ms  x{ratio:.3f}", file=rowout)
+        rows.append({"case": name, "status": tag.strip(),
+                     "ratio": round(ratio, 4)})
         if ratio > args.tol:
-            regressions.append(name)
+            regressions.append(f"{name} x{ratio:.3f} (tol {args.tol:.2f})")
+    payload = {"rows": rows, "failures": regressions,
+               "baseline": args.baseline, "candidate": args.candidate}
     if regressions:
-        print(f"[check_op_benchmark] FAILED: {len(regressions)} "
-              f"regression(s): {', '.join(regressions)}")
-        return 8
-    print("[check_op_benchmark] PASSED")
-    return 0
+        return finish(GATE, False,
+                      f"{len(regressions)} regression(s): "
+                      + "; ".join(regressions), payload=payload,
+                      json_mode=args.json)
+    return finish(GATE, True,
+                  f"{len(rows)} case(s) compared, none regressed beyond "
+                  f"x{args.tol:.2f}", payload=payload, json_mode=args.json)
 
 
 if __name__ == "__main__":

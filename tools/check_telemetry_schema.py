@@ -62,6 +62,20 @@ positive count requires its ``hist/*/sum`` (with ``mean`` ==
 math difference count/sum between snapshots, so a torn triple is a
 broken consistent-cut promise.
 
+Device-profile contracts (``profiler.device_profile`` /
+``profiler.bottleneck``): every ``gauge/profile/*`` scalar is ≥ 0;
+the decomposition fractions (``gauge/profile/<cat>_frac.<entry>``,
+cat ∈ {compute, collective, transfer, host_gap}) are each ∈ [0, 1]
+AND within one record the fractions of one entry must sum ≤ 1 (they
+partition the window's wall time — a sum past 1 means the decomposition
+double-counts); ``gauge/bottleneck/<entry>`` must be an id from the
+CLOSED verdict vocabulary {0 compute_bound, 1 memory_bound,
+2 comm_bound, 3 input_bound, 4 host_bound}. A record carrying the
+structured top-level ``"profile"`` object (the capture's top-K op/line
+tables) must be well-formed: ``top_ops``/``top_lines`` lists whose rows
+carry a non-empty op/src, a category from the closed set, non-negative
+``ms``/``ms_per_step``, and ``frac`` ∈ [0, 1].
+
 Token-level serving contracts (``inference.serving.decode``):
 ``gauge/serve/kv_occupancy`` ∈ [0, 1] and
 ``gauge/serve/spec_accept_rate`` ∈ [0, 1] (both are fractions by
@@ -82,6 +96,48 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from _gate import add_gate_args, finish  # noqa: E402
+
+# profiler.bottleneck's closed verdict vocabulary (keep in sync)
+BOTTLENECK_IDS = {0, 1, 2, 3, 4}
+_PROFILE_CATEGORIES = {"compute", "collective", "transfer"}
+_FRAC_CATEGORIES = _PROFILE_CATEGORIES | {"host_gap"}
+
+
+def _validate_profile_table(profile, lineno):
+    """Shape check of the structured ``"profile"`` report object."""
+    if not isinstance(profile, dict):
+        return f"line {lineno}: 'profile' must be an object"
+    for key in ("top_ops", "top_lines"):
+        rows = profile.get(key, [])
+        if not isinstance(rows, list):
+            return f"line {lineno}: profile.{key} must be a list"
+        for i, row in enumerate(rows):
+            if not isinstance(row, dict):
+                return f"line {lineno}: profile.{key}[{i}] not an object"
+            label = row.get("op" if key == "top_ops" else "src")
+            if not isinstance(label, str) or not label:
+                return (f"line {lineno}: profile.{key}[{i}] lacks a "
+                        f"non-empty {'op' if key == 'top_ops' else 'src'}")
+            if key == "top_ops" and row.get("category") \
+                    not in _PROFILE_CATEGORIES:
+                return (f"line {lineno}: profile.top_ops[{i}] category "
+                        f"{row.get('category')!r} outside the closed set "
+                        f"{sorted(_PROFILE_CATEGORIES)}")
+            for fld in ("ms", "ms_per_step"):
+                v = row.get(fld)
+                if v is not None and (not isinstance(v, (int, float))
+                                      or isinstance(v, bool)
+                                      or not math.isfinite(float(v))
+                                      or float(v) < 0):
+                    return (f"line {lineno}: profile.{key}[{i}].{fld} = "
+                            f"{v!r} must be a finite number >= 0")
+            fr = row.get("frac")
+            if fr is not None and (not isinstance(fr, (int, float))
+                                   or isinstance(fr, bool)
+                                   or not (0 <= float(fr) <= 1)):
+                return (f"line {lineno}: profile.{key}[{i}].frac = {fr!r} "
+                        f"outside [0, 1]")
+    return None
 
 
 def validate_record(rec, lineno):
@@ -179,6 +235,28 @@ def validate_record(rec, lineno):
                 and not (0 <= float(value) <= 1):
             return (f"line {lineno}: scalar {name!r} = {value!r} "
                     f"outside [0, 1] (occupancy = batch size / bucket)")
+        # device-profile decomposition: every profile gauge is a
+        # non-negative quantity, and the per-entry fractions are of the
+        # window's wall time — [0, 1] by definition
+        if name.startswith("gauge/profile/"):
+            if float(value) < 0:
+                return (f"line {lineno}: scalar {name!r} = {value!r} "
+                        f"is negative (profile decomposition)")
+            rest = name[len("gauge/profile/"):]
+            if "_frac." in rest:
+                cat = rest.split("_frac.", 1)[0]
+                if cat in _FRAC_CATEGORIES and not (0 <= float(value) <= 1):
+                    return (f"line {lineno}: scalar {name!r} = {value!r} "
+                            f"outside [0, 1] (a fraction of window wall)")
+        # bottleneck verdicts come from a CLOSED vocabulary — any other
+        # value means a producer invented a verdict the dashboards and
+        # gates cannot name
+        if name.startswith("gauge/bottleneck/") \
+                and float(value) not in BOTTLENECK_IDS:
+            return (f"line {lineno}: scalar {name!r} = {value!r} not a "
+                    f"known verdict id {sorted(BOTTLENECK_IDS)} "
+                    f"(0 compute_bound, 1 memory_bound, 2 comm_bound, "
+                    f"3 input_bound, 4 host_bound)")
         # integrity contracts: the fingerprint interval is a count of
         # steps (>= 1 when fingerprinting is on — 0/off publishes no
         # gauge at all); the XOR fold is a uint32 word
@@ -234,6 +312,28 @@ def validate_record(rec, lineno):
             return (f"line {lineno}: gauge/serve/queue_depth = {depth!r} "
                     f"exceeds gauge/serve/queue_capacity = {cap!r} "
                     f"(the admission queue must be bounded)")
+    # cross-field: one entry's decomposition fractions partition (a
+    # subset of) the window wall — their sum cannot exceed 1
+    frac_sums = {}
+    for name, value in scalars.items():
+        if not name.startswith("gauge/profile/"):
+            continue
+        rest = name[len("gauge/profile/"):]
+        if "_frac." not in rest:
+            continue
+        cat, entry = rest.split("_frac.", 1)
+        if cat in _FRAC_CATEGORIES:
+            frac_sums[entry] = frac_sums.get(entry, 0.0) + float(value)
+    for entry, total in frac_sums.items():
+        if total > 1.0 + 1e-6:
+            return (f"line {lineno}: profile fractions for entry "
+                    f"{entry!r} sum to {total:.6f} > 1 — the "
+                    f"decomposition double-counts the window")
+    # structured top-K table (device_profile captures attach it)
+    if "profile" in rec:
+        err = _validate_profile_table(rec["profile"], lineno)
+        if err:
+            return err
     # cross-field: histogram count/sum/mean must agree within one record
     # — the Prometheus exposition and the SLO burn-rate math difference
     # count/sum between snapshots, so a torn triple means the histogram

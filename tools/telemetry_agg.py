@@ -153,6 +153,12 @@ def format_report(result) -> str:
                 f"budget was burning while this replica served traffic")
     else:
         lines.append("SLO burns: none")
+    bottlenecks = result.get("bottlenecks")
+    if bottlenecks:
+        lines.append("bottleneck verdicts (gauge/bottleneck/<entry>):")
+        for b in bottlenecks:
+            lines.append(f"  rank {b['rank']}: {b['entry']} -> "
+                         f"{b['verdict']}")
     stragglers = result["stragglers"]
     if stragglers:
         lines.append(f"stragglers (> {result['threshold']:.2f}x cluster "
