@@ -726,8 +726,9 @@ def main():
              "bert": bench_bert_dp, "longctx": bench_gpt_long_context,
              "pipeline": bench_input_pipeline, "serving": bench_serving,
              "decode": bench_decode}
-    from paddle_tpu.profiler import (bottleneck, device_profile,
-                                     get_telemetry, xla_cost)
+    from paddle_tpu.profiler import (bottleneck, collective_attrib,
+                                     device_profile, get_telemetry,
+                                     xla_cost)
 
     tel = get_telemetry()
     results = []
@@ -766,8 +767,28 @@ def main():
         # entry's verdict and its dominating numbers become columns —
         # check_bench_trajectory names the suspect from exactly these on
         # a regression.
-        verdicts = bottleneck.publish(tel)
+        # per-axis collective attribution (profiler.collective_attrib):
+        # the compiled HLO's collectives mapped onto the registered mesh
+        # axes — on multi-dev configs the headline entry grows
+        # collective_<axis>_{bytes,count}[,_ms] columns (bytes/count are
+        # static per-step inventory; ms appears when a device capture
+        # ran). These are attribution movers for check_bench_trajectory:
+        # a regression whose collective_dp_ms doubled names its suspect.
+        # Published BEFORE the verdicts so comm_bound refines per-axis.
         head_entry = row["entry"] if row is not None else None
+        try:
+            collective_attrib.publish_static(tel)
+            if head_entry is not None:
+                for axis, crow in sorted(
+                        collective_attrib.entry_summary(head_entry)
+                        .items()):
+                    r[f"collective_{axis}_bytes"] = crow.get("bytes", 0.0)
+                    r[f"collective_{axis}_count"] = crow.get("count", 0.0)
+                    if "ms" in crow:
+                        r[f"collective_{axis}_ms"] = round(crow["ms"], 4)
+        except Exception:
+            pass  # attribution must never fail a bench record
+        verdicts = bottleneck.publish(tel)
         if head_entry in verdicts:
             r["bottleneck"] = verdicts[head_entry]["verdict"]
             for k, v in verdicts[head_entry]["evidence"].items():

@@ -24,9 +24,13 @@ wrapped.)
 """
 from __future__ import annotations
 
+import contextlib
+import itertools
 import json
 import os
+import threading
 import time
+from collections import deque
 from typing import Optional
 
 import jax
@@ -40,7 +44,8 @@ __all__ = [
     "ReduceOp", "all_reduce", "all_gather", "all_gather_object", "broadcast",
     "reduce", "scatter", "alltoall", "reduce_scatter", "barrier", "send",
     "recv", "wait", "new_group", "get_group", "split_group",
-    "launch_world_rank",
+    "launch_world_rank", "collective_events", "collective_log_path",
+    "reset_collective_recorder",
 ]
 
 
@@ -123,6 +128,141 @@ def _hang_guard(name: str):
     return collective_guard(f"communication.{name}")
 
 
+# -- the eager-collective recorder --------------------------------------------
+# Every eager multi-host collective records (seq, name, axis, arrival,
+# duration, payload bytes). Eager collectives execute in program order
+# on every rank (SPMD), so the per-rank sequence numbers identify the
+# SAME instance across ranks — which is exactly what
+# ``profiler.cluster_trace`` fuses into per-instance arrival skew ("rank
+# 3 late 41 ms into all-reduce #17"). Sinks:
+#  - a bounded in-memory tail (``collective_events`` — the ops server's
+#    ``/debug/collectives`` reads it);
+#  - with ``PADDLE_TPU_COLLECTIVE_LOG`` set, one JSONL line per event
+#    appended to this RANK's file (a base path grows ``.rank<i>`` like
+#    the telemetry sink, so a shared launcher env never tears a file);
+#  - telemetry gauges ``gauge/collective/<axis>/{count,ms,bytes}.eager``
+#    — cumulative process totals (the ``eager`` entry is exempt from the
+#    schema gate's capture-window cross-field, which compares per-window
+#    quantities).
+# Events are timestamped with ``time.perf_counter`` — the same clock the
+# span/chrome exports use, so the merged cluster timeline aligns them
+# with one per-rank offset.
+
+_COLLECTIVE_LOG_ENV = "PADDLE_TPU_COLLECTIVE_LOG"
+_recorder_lock = threading.Lock()
+_collective_seq = itertools.count()
+_collective_tail: deque = deque(maxlen=512)
+_eager_totals: dict = {}  # axis -> {count, ms, bytes}
+_log_path_cache: Optional[str] = None
+_log_path_checked = False
+
+
+def collective_log_path() -> Optional[str]:
+    """This rank's collective-event JSONL path (None = recording to the
+    in-memory tail only). A configured base path lands per-rank:
+    ``/tmp/c.jsonl`` → ``/tmp/c.rank3.jsonl`` (paths already naming a
+    rank are kept verbatim)."""
+    global _log_path_cache, _log_path_checked
+    if _log_path_checked:
+        return _log_path_cache
+    base = os.environ.get(_COLLECTIVE_LOG_ENV)
+    if base:
+        import re
+
+        _, rank = launch_world_rank()
+        # only an actual rank<N> token opts out of suffixing — a basename
+        # that merely CONTAINS "rank" ("ranked.jsonl") must still get a
+        # per-rank file, or N processes tear one shared log apart
+        if re.search(r"rank\d+", os.path.basename(base)):
+            _log_path_cache = base
+        else:
+            root, ext = os.path.splitext(base)
+            _log_path_cache = f"{root}.rank{rank}{ext or '.jsonl'}"
+    _log_path_checked = True
+    return _log_path_cache
+
+
+def reset_collective_recorder() -> None:
+    """Drop the tail/totals and re-read the log env (test isolation)."""
+    global _collective_seq, _log_path_cache, _log_path_checked
+    with _recorder_lock:
+        _collective_seq = itertools.count()
+        _collective_tail.clear()
+        _eager_totals.clear()
+        _log_path_cache = None
+        _log_path_checked = False
+
+
+def collective_events(n: Optional[int] = None) -> list:
+    """The most recent eager-collective events (newest last)."""
+    with _recorder_lock:
+        events = list(_collective_tail)
+    return events if n is None else events[-int(n):]
+
+
+def _record_collective(name: str, axis: Optional[str], t_start: float,
+                       dur_s: float, nbytes: float) -> None:
+    _, rank = launch_world_rank()
+    ev = {"seq": next(_collective_seq), "name": name,
+          "axis": axis or "world", "t_start": float(t_start),
+          "dur_s": float(dur_s), "nbytes": float(nbytes), "rank": rank}
+    with _recorder_lock:
+        _collective_tail.append(ev)
+        tot = _eager_totals.setdefault(ev["axis"],
+                                       {"count": 0.0, "ms": 0.0,
+                                        "bytes": 0.0})
+        tot["count"] += 1
+        tot["ms"] += dur_s * 1e3
+        tot["bytes"] += ev["nbytes"]
+        snapshot = {a: dict(t) for a, t in _eager_totals.items()}
+    try:
+        from ..profiler.collective_attrib import _gauge_axis
+        from ..profiler.telemetry import get_telemetry
+
+        tel = get_telemetry()
+        tel.counter("collective/eager_calls")
+        for a, tot in snapshot.items():
+            # gauge names ride the schema gate's closed axis vocabulary;
+            # a custom group axis_name keeps its real label in the
+            # recorder events/log, publishing under "unmapped"
+            ga = _gauge_axis(a)
+            tel.gauge(f"collective/{ga}/count.eager", tot["count"])
+            tel.gauge(f"collective/{ga}/ms.eager", tot["ms"])
+            tel.gauge(f"collective/{ga}/bytes.eager", tot["bytes"])
+    except Exception:  # noqa: BLE001 — recording never breaks the call
+        pass
+    path = collective_log_path()
+    if path:
+        try:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(path, "a") as f:
+                f.write(json.dumps(ev) + "\n")
+        except OSError:
+            pass
+
+
+@contextlib.contextmanager
+def _collective_span(name: str, group=None, nbytes: float = 0.0):
+    """Measure ONE eager collective for the recorder: arrival time is
+    the context entry (before any transport work — a straggler's stall
+    shows up as a late arrival, not a long duration on its peers)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _record_collective(name, _axis_of(group), t0,
+                           time.perf_counter() - t0, nbytes)
+
+
+def _nbytes_of(raw) -> float:
+    try:
+        return float(getattr(raw, "nbytes", 0) or 0)
+    except Exception:  # noqa: BLE001
+        return 0.0
+
+
 def _reduce_fn(op):
     return {
         ReduceOp.SUM: jax.lax.psum,
@@ -141,8 +281,10 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     elif get_world_size() > 1:
         from jax.experimental import multihost_utils
 
-        with _hang_guard("all_reduce"):
-            stacked = multihost_utils.process_allgather(np.asarray(raw))
+        arr = np.asarray(raw)
+        with _collective_span("all_reduce", group, _nbytes_of(arr)), \
+                _hang_guard("all_reduce"):
+            stacked = multihost_utils.process_allgather(arr)
         red = {
             ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max, ReduceOp.MIN: np.min,
             ReduceOp.PROD: np.prod, ReduceOp.AVG: np.mean,
@@ -165,8 +307,10 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     elif get_world_size() > 1:
         from jax.experimental import multihost_utils
 
-        with _hang_guard("all_gather"):
-            stacked = multihost_utils.process_allgather(np.asarray(raw))
+        arr = np.asarray(raw)
+        with _collective_span("all_gather", group, _nbytes_of(arr)), \
+                _hang_guard("all_gather"):
+            stacked = multihost_utils.process_allgather(arr)
         parts = [jnp.asarray(stacked[i]) for i in range(stacked.shape[0])]
     else:
         parts = [raw]
@@ -251,7 +395,8 @@ def all_gather_object(obj, key, rendezvous_dir=None, timeout_s=120.0,
         frame[:8] = np.frombuffer(
             np.uint64(len(data)).tobytes(), np.uint8)
         frame[8:8 + len(data)] = np.frombuffer(data, np.uint8)
-        with _hang_guard("all_gather_object"):
+        with _collective_span("all_gather_object", None, len(data)), \
+                _hang_guard("all_gather_object"):
             stacked = multihost_utils.process_allgather(frame)
         out = []
         for row in np.asarray(stacked):
@@ -271,28 +416,30 @@ def all_gather_object(obj, key, rendezvous_dir=None, timeout_s=120.0,
 
     os.makedirs(rendezvous_dir, exist_ok=True)
     mine = os.path.join(rendezvous_dir, f"{key}.rank{r}.json")
+    data = json.dumps(obj)  # serialized ONCE: payload and byte count
 
     def _write(tmp):
         with open(tmp, "w") as f:
-            json.dump(obj, f)
+            f.write(data)
 
-    atomic_replace(mine, _write)
-    paths = [os.path.join(rendezvous_dir, f"{key}.rank{i}.json")
-             for i in range(world)]
-    deadline = time.monotonic() + float(timeout_s)
-    while not all(os.path.exists(p) for p in paths):
-        if time.monotonic() > deadline:
-            missing = [i for i, p in enumerate(paths)
-                       if not os.path.exists(p)]
-            raise CollectiveTimeout(
-                f"rank {r}: all_gather_object({key!r}) gave up waiting "
-                f"for rank(s) {missing} after {timeout_s:.1f}s — a peer "
-                f"rank is dead or hung")
-        time.sleep(float(poll_s))
-    out = []
-    for p in paths:
-        with open(p) as f:
-            out.append(json.load(f))
+    with _collective_span("all_gather_object", None, len(data)):
+        atomic_replace(mine, _write)
+        paths = [os.path.join(rendezvous_dir, f"{key}.rank{i}.json")
+                 for i in range(world)]
+        deadline = time.monotonic() + float(timeout_s)
+        while not all(os.path.exists(p) for p in paths):
+            if time.monotonic() > deadline:
+                missing = [i for i, p in enumerate(paths)
+                           if not os.path.exists(p)]
+                raise CollectiveTimeout(
+                    f"rank {r}: all_gather_object({key!r}) gave up waiting "
+                    f"for rank(s) {missing} after {timeout_s:.1f}s — a peer "
+                    f"rank is dead or hung")
+            time.sleep(float(poll_s))
+        out = []
+        for p in paths:
+            with open(p) as f:
+                out.append(json.load(f))
     if cleanup_prev:
         prev = _prev_gather_file.get((rendezvous_dir, r))
         if prev and prev != mine:
@@ -323,8 +470,10 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
         from jax.experimental import multihost_utils
         from .parallel import get_rank
 
-        with _hang_guard("reduce_scatter"):
-            stacked = multihost_utils.process_allgather(np.asarray(raw))
+        arr = np.asarray(raw)
+        with _collective_span("reduce_scatter", group, _nbytes_of(arr)), \
+                _hang_guard("reduce_scatter"):
+            stacked = multihost_utils.process_allgather(arr)
         total = stacked.sum(axis=0)
         n = get_world_size()
         shard = np.split(total, n, axis=0)[get_rank()]
@@ -347,9 +496,11 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     elif get_world_size() > 1:
         from jax.experimental import multihost_utils
 
-        with _hang_guard("broadcast"):
+        arr = np.asarray(raw)
+        with _collective_span("broadcast", group, _nbytes_of(arr)), \
+                _hang_guard("broadcast"):
             gathered = multihost_utils.broadcast_one_to_all(
-                np.asarray(raw), is_source=(jax.process_index() == src)
+                arr, is_source=(jax.process_index() == src)
             )
         out = jnp.asarray(gathered)
     else:
@@ -378,7 +529,8 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
                           for t in tensor_list])
     from jax.experimental import multihost_utils
 
-    with _hang_guard("scatter"):
+    with _collective_span("scatter", group, _nbytes_of(src_stack)), \
+            _hang_guard("scatter"):
         all_ = multihost_utils.broadcast_one_to_all(
             src_stack, is_source=(jax.process_index() == src)
         )
@@ -397,9 +549,10 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
         from jax.experimental import multihost_utils
         from .parallel import get_rank
 
-        with _hang_guard("alltoall"):
-            stacked = multihost_utils.process_allgather(
-                np.stack([np.asarray(r) for r in raws]))
+        stacked_in = np.stack([np.asarray(r) for r in raws])
+        with _collective_span("alltoall", group, _nbytes_of(stacked_in)), \
+                _hang_guard("alltoall"):
+            stacked = multihost_utils.process_allgather(stacked_in)
         # stacked: [world, world, ...]; rank r receives stacked[s][r] for all s
         parts = [jnp.asarray(stacked[s][get_rank()]) for s in range(stacked.shape[0])]
     else:
@@ -415,7 +568,7 @@ def barrier(group=None):
     if get_world_size() > 1:
         from jax.experimental import multihost_utils
 
-        with _hang_guard("barrier"):
+        with _collective_span("barrier", group), _hang_guard("barrier"):
             multihost_utils.sync_global_devices("paddle_tpu_barrier")
 
 

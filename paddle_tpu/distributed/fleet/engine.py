@@ -346,6 +346,16 @@ class ParallelTrainStep:
         from paddle_tpu.ops.attention import set_ring_context
 
         set_ring_context(mesh, sp_axis, batch_axis=dim0)
+        try:
+            # per-axis collective attribution maps the compiled HLO's
+            # replica_groups back to THIS mesh's named axes — the most
+            # recently constructed engine's mesh describes the programs
+            # compiled after it (same last-wins rule as the ring context)
+            from paddle_tpu.profiler import collective_attrib
+
+            collective_attrib.register_mesh(mesh)
+        except Exception:  # noqa: BLE001 — attribution never blocks build
+            pass
         if self._sp_axis is not None:
             self._batch_sharding = NamedSharding(
                 mesh, P(dim0, self._sp_axis))

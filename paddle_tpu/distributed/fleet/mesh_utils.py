@@ -34,12 +34,25 @@ def init_mesh(shape: Sequence[int] = None, axis_names: Sequence[str] = ("dp",),
         known = int(np.prod([s for s in shape if s != -1]))
         shape[shape.index(-1)] = len(devs) // known
     _mesh = Mesh(devs.reshape(shape), tuple(axis_names))
+    _register_for_attribution(_mesh)
     return _mesh
+
+
+def _register_for_attribution(mesh: Mesh) -> None:
+    """Feed the per-axis collective attribution its axis map (best
+    effort — attribution must never block mesh setup)."""
+    try:
+        from ...profiler import collective_attrib
+
+        collective_attrib.register_mesh(mesh)
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def set_mesh(mesh: Mesh):
     global _mesh
     _mesh = mesh
+    _register_for_attribution(mesh)
 
 
 def get_mesh() -> Optional[Mesh]:

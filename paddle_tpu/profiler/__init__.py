@@ -31,11 +31,21 @@ Cross-rank aggregation (``aggregate`` + ``tools/telemetry_agg.py``):
 merges the per-rank JSONL files a ``distributed.launch`` job leaves into
 one cluster view with straggler detection.
 
+Cluster attribution plane (this PR): ``collective_attrib`` walks the
+compiled HLO already held by ``xla_cost``/``hlo_attrib`` into a per-axis
+collective inventory (``gauge/collective/<axis>/{bytes,ms,count}
+.<entry>``, the ``comm_bound:<axis>`` verdict refinement);
+``cluster_trace`` fuses per-rank trace/collective/clock artifacts into
+ONE timeline with per-rank tracks and names the late rank per collective
+instance (LATE-RANK findings in ``telemetry_agg``, gated by
+``tools/check_cluster_timeline.py``).
+
 The legacy span API (``RecordEvent``, ``Profiler``, ``start_profiler``…)
 stays in ``paddle_tpu.utils.profiler`` and is re-exported here so
 ``paddle.profiler.Profiler``-style code ports unchanged.
 """
 from . import aggregate, bottleneck, device_profile, hlo_attrib  # noqa: F401
+from . import cluster_trace, collective_attrib  # noqa: F401
 from . import spans, xla_cost  # noqa: F401
 from .bottleneck import VERDICT_IDS, VERDICT_NAMES  # noqa: F401
 from .device_profile import request_capture  # noqa: F401
@@ -98,4 +108,5 @@ __all__ = [
     "attribute_trace", "hlo_registry", "parse_hlo_text",
     "spans", "xla_cost", "aggregate", "ops_server", "slo",
     "device_profile", "hlo_attrib", "bottleneck",
+    "collective_attrib", "cluster_trace",
 ]

@@ -19,7 +19,16 @@ merges them:
   no parsable record (truncated mid-write) becomes an explicit finding
   instead of silently shrinking every cluster median — an N-1-rank
   aggregate that LOOKS healthy is the most dangerous report this tool
-  could produce.
+  could produce;
+- **late-rank detection** — per-collective-instance arrival skew from
+  the fused cluster timeline (``profiler.cluster_trace`` — clock-offset-
+  aligned eager-collective logs): a rank arriving more than the
+  threshold late into a collective becomes a LATE-RANK finding naming
+  the instance ("rank 3 late 41 ms into all-reduce #17, axis dp") —
+  the *why* behind a straggler median, which only says *that* a rank is
+  slow. Straggler findings additionally cite per-axis collective
+  evidence (``gauge/collective/<axis>/ms.*``) when the flagged rank's
+  record carries it.
 
 Pure host-side file munching — no jax import — so the CLI wrapper
 (``tools/telemetry_agg.py``) stays fast enough for a watch loop.
@@ -36,8 +45,10 @@ __all__ = [
     "read_jsonl", "rank_of_path", "final_scalars", "load_rank_scalars",
     "cluster_view", "detect_stragglers", "detect_dead_ranks",
     "detect_suspect_chips", "detect_slo_burns", "collect_bottlenecks",
+    "detect_late_ranks", "dominant_collective_axis",
     "aggregate", "STEP_HIST_PATTERN", "SDC_REPAIR_PATTERN",
     "ALERT_PATTERN", "BOTTLENECK_PATTERN", "BOTTLENECK_NAMES",
+    "COLLECTIVE_PATTERN",
 ]
 
 # any per-rank step-latency p50 qualifies for straggler comparison
@@ -59,6 +70,11 @@ ALERT_PATTERN = re.compile(r"^counter/alert/(.+)$")
 BOTTLENECK_PATTERN = re.compile(r"^gauge/bottleneck/(.+)$")
 BOTTLENECK_NAMES = {0: "compute_bound", 1: "memory_bound", 2: "comm_bound",
                     3: "input_bound", 4: "host_bound"}
+
+# per-axis collective attribution gauges (profiler.collective_attrib):
+# gauge/collective/<axis>/<field>.<entry>
+COLLECTIVE_PATTERN = re.compile(
+    r"^gauge/collective/([^/]+)/(bytes|ms|count)\.(.+)$")
 
 _RANK_RE = re.compile(r"rank[._-]?(\d+)")
 
@@ -162,12 +178,48 @@ def detect_stragglers(rank_scalars: Dict[int, Dict[str, float]],
             continue
         for rank, value in per_rank:
             if value > threshold * med:
-                findings.append({
+                finding = {
                     "rank": rank, "metric": metric, "value": value,
                     "cluster_median": med, "ratio": value / med,
-                })
+                }
+                # cite per-axis collective evidence when the flagged
+                # rank's record carries it: "rank 3 is 1.4x the median"
+                # becomes actionable when the same record says its dp
+                # all-reduces ate N ms of the last captured window
+                evidence = dominant_collective_axis(
+                    rank_scalars.get(rank, {}), with_entry=True)
+                if evidence is not None:
+                    finding["collective_axis"] = evidence[0]
+                    finding["collective_ms"] = evidence[1]
+                    finding["collective_entry"] = evidence[2]
+                findings.append(finding)
     findings.sort(key=lambda f: -f["ratio"])
     return findings
+
+
+def dominant_collective_axis(scalars: Dict[str, float],
+                             entry: Optional[str] = None,
+                             with_entry: bool = False):
+    """``(axis, ms)`` — or ``(axis, ms, entry)`` with ``with_entry`` —
+    of the biggest measured per-axis collective gauge in one rank's
+    scalars (optionally restricted to one entry; the cumulative
+    ``eager`` entry is skipped when any captured entry exists), or
+    None. Shared by straggler evidence and the ``comm_bound:<axis>``
+    verdict refinement."""
+    rows = []
+    for name, v in scalars.items():
+        m = COLLECTIVE_PATTERN.match(name)
+        if not m or m.group(2) != "ms":
+            continue
+        axis, _, ent = m.group(1), m.group(2), m.group(3)
+        if entry is not None and ent != entry:
+            continue
+        rows.append((axis, ent, float(v)))
+    if not rows:
+        return None
+    captured = [r for r in rows if r[1] != "eager"]
+    pick = max(captured or rows, key=lambda r: r[2])
+    return (pick[0], pick[2], pick[1]) if with_entry else (pick[0], pick[2])
 
 
 def detect_suspect_chips(rank_scalars: Dict[int, Dict[str, float]],
@@ -230,12 +282,41 @@ def collect_bottlenecks(rank_scalars: Dict[int, Dict[str, float]]
             m = BOTTLENECK_PATTERN.match(name)
             if not m:
                 continue
-            findings.append({
-                "entry": m.group(1), "rank": rank,
-                "verdict": BOTTLENECK_NAMES.get(int(v), f"unknown({v:g})"),
-            })
+            entry = m.group(1)
+            verdict = BOTTLENECK_NAMES.get(int(v), f"unknown({v:g})")
+            if verdict == "comm_bound":
+                # refine from the same record's per-axis collective
+                # gauges — the vocabulary extension the schema gate
+                # documents (comm_bound:<axis>)
+                evidence = dominant_collective_axis(scalars, entry=entry)
+                if evidence is not None:
+                    verdict = f"comm_bound:{evidence[0]}"
+            findings.append({"entry": entry, "rank": rank,
+                             "verdict": verdict})
     findings.sort(key=lambda f: (f["entry"], f["rank"]))
     return findings
+
+
+def detect_late_ranks(instances, threshold_ms: float = 100.0) -> List[dict]:
+    """LATE-RANK findings from fused collective instances (one per late
+    rank, naming its worst instance) — the skew math lives in
+    ``profiler.cluster_trace`` (stdlib-only, loadable standalone the
+    same way this module is); this is the findings surface the
+    telemetry_agg CLI and the gates consume."""
+    try:
+        from . import cluster_trace  # normal package context
+    except ImportError:
+        # standalone path-load (tools/telemetry_agg.py loads this file
+        # via spec_from_file_location, so relative imports don't exist)
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "cluster_trace.py")
+        spec = importlib.util.spec_from_file_location(
+            "_ptpu_cluster_trace", path)
+        cluster_trace = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(cluster_trace)
+    return cluster_trace.detect_late_ranks(instances, threshold_ms)
 
 
 def detect_dead_ranks(paths: Sequence[str],

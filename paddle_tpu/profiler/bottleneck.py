@@ -129,8 +129,41 @@ def verdicts(telemetry: Optional[Telemetry] = None) -> Dict[str, dict]:
                      gauges.get(f"mfu/{entry}"))
         if row is not None:
             row["id"] = VERDICT_IDS[row["verdict"]]
+            _refine_comm_axis(entry, row, gauges)
             out[entry] = row
     return out
+
+
+def _refine_comm_axis(entry: str, row: dict, gauges: Dict[str, float]
+                      ) -> None:
+    """Refine a ``comm_bound`` verdict into ``comm_bound:<axis>`` from
+    the per-axis collective gauges (``collective/<axis>/ms.<entry>``,
+    measured by the last capture join; bytes as the static fallback).
+    The numeric ``id`` stays 2 — the closed vocabulary is untouched; the
+    axis rides the string verdict and the evidence, the same place
+    telemetry_agg and the bench columns read it."""
+    if row.get("verdict") != "comm_bound":
+        return
+    best = None
+    for field in ("ms", "bytes"):
+        per_axis = {}
+        prefix = "collective/"
+        suffix = f"/{field}.{entry}"
+        for name, v in gauges.items():
+            if name.startswith(prefix) and name.endswith(suffix):
+                axis = name[len(prefix):-len(suffix)]
+                if "/" not in axis:
+                    per_axis[axis] = float(v)
+        if per_axis:
+            axis = max(per_axis, key=per_axis.get)
+            best = (axis, field, per_axis[axis])
+            break
+    if best is None:
+        return
+    axis, field, value = best
+    row["verdict"] = f"comm_bound:{axis}"
+    row["evidence"]["axis"] = axis
+    row["evidence"][f"axis_collective_{field}"] = value
 
 
 def publish(telemetry: Optional[Telemetry] = None) -> Dict[str, dict]:

@@ -1,0 +1,407 @@
+"""Cluster timeline: fuse per-rank trace artifacts into ONE view and
+name the late rank.
+
+Distributed stalls are invisible from any single rank: the straggler's
+own timeline looks busy, every peer's looks idle-inside-a-collective.
+The reference shipped a post-hoc multi-trainer timeline tool
+(fluid ``tools/timeline.py``) for exactly this reason. This module is
+the axis-aware, gated version over our artifacts:
+
+- **per-rank inputs** (all under one job ``log_dir``):
+  ``trace.rank<i>.json`` chrome exports (``utils.profiler
+  .export_chrome_tracing`` — rank-stamped pids since this PR),
+  ``collectives.rank<i>.jsonl`` eager-collective event logs
+  (``distributed.communication`` recorder, armed by
+  ``PADDLE_TPU_COLLECTIVE_LOG``), and ``clock.rank<i>.json`` clock
+  handshakes;
+- **clock offsets**: :func:`clock_handshake` runs K barrier-echo rounds
+  over the existing ``all_gather_object`` transport — each round every
+  rank records when its gather COMPLETED; completion is within one poll
+  quantum of the same global instant on every rank, so the median
+  per-round delta to rank 0 estimates this rank's ``perf_counter``
+  offset (error ≈ the handshake poll interval, reported alongside);
+- **collective instances**: eager collectives execute in the same order
+  on every rank (SPMD), so the recorder's per-rank sequence numbers
+  identify instances. Per instance, each rank's aligned ARRIVAL time
+  yields its skew vs the earliest rank — the late rank by name
+  ("rank 3 late 41 ms into all-reduce #17, axis dp");
+- **one merged chrome trace**: per-rank process tracks (pid = rank,
+  ``process_name`` metadata), offset-aligned timestamps, per-instance
+  collective slices and flow arrows binding the same instance across
+  ranks.
+
+Offline pieces are stdlib-only (``tools/telemetry_agg.py`` loads this
+file standalone, like ``aggregate.py``); only :func:`clock_handshake`
+touches the framework, lazily. LATE-RANK findings surface through
+``aggregate.detect_late_ranks`` / ``tools/telemetry_agg.py
+--fail-on-late-rank`` and the ``tools/check_cluster_timeline.py`` gate.
+
+Offset-estimation caveats (README "Operations plane" has the operator
+view): the estimate rides the rendezvous transport's poll quantum — use
+a small handshake ``poll_s`` (default 5 ms) and judge skews only well
+above ``offset_error_s``; clocks are assumed drift-free over the run
+(re-run the handshake near the window of interest for long jobs).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import statistics
+import time
+from typing import Dict, List, Optional, Sequence
+
+__all__ = [
+    "clock_handshake", "load_clock_files", "estimate_offsets",
+    "load_collective_logs", "collective_instances", "merge_chrome_traces",
+    "write_merged_trace", "analyze", "trace_paths",
+    "CLOCK_FILE", "COLLECTIVES_FILE", "TRACE_FILE", "DEFAULT_LATE_MS",
+]
+
+CLOCK_FILE = "clock.rank{rank}.json"
+COLLECTIVES_FILE = "collectives.rank{rank}.jsonl"
+TRACE_FILE = "trace.rank{rank}.json"
+
+# arrival skew above this names a late rank (well above the handshake
+# poll quantum + scheduling jitter of the CPU gate topology; real
+# cross-host runs may tighten it via --late-ms / analyze(threshold_ms=))
+DEFAULT_LATE_MS = 100.0
+
+_RANK_RE = re.compile(r"rank(\d+)")
+
+
+def _rank_of(path: str, fallback: int) -> int:
+    m = _RANK_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else fallback
+
+
+# -- in-run: the barrier-echo clock handshake ---------------------------------
+
+def clock_handshake(out_dir: str, rounds: int = 8,
+                    rendezvous_dir: Optional[str] = None,
+                    poll_s: float = 0.005, timeout_s: float = 60.0,
+                    key_prefix: str = "clocksync") -> dict:
+    """Run K barrier-echo rounds over ``all_gather_object`` and write
+    this rank's ``clock.rank<r>.json`` under ``out_dir``. Every rank of
+    the job must call it (it IS a collective); call it near the window
+    being analyzed — the offline merge assumes drift-free clocks between
+    handshake and events. Returns this rank's record."""
+    from ..distributed.communication import all_gather_object, \
+        launch_world_rank
+
+    world, rank = launch_world_rank()
+    rows = []
+    for k in range(int(rounds)):
+        t_send = time.perf_counter()
+        all_gather_object({"rank": rank, "t_send": t_send},
+                          key=f"{key_prefix}.{k}",
+                          rendezvous_dir=rendezvous_dir,
+                          timeout_s=timeout_s, poll_s=poll_s,
+                          cleanup_prev=True)
+        # the gather completes within one poll quantum of the same
+        # global instant on every rank — t_done is the echo the offline
+        # offset estimate is built from
+        rows.append({"t_send": t_send, "t_done": time.perf_counter()})
+    rec = {"rank": rank, "world": world, "rounds": rows,
+           "poll_s": float(poll_s), "pid": os.getpid()}
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, CLOCK_FILE.format(rank=rank))
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+    os.replace(tmp, path)
+    return rec
+
+
+# -- offline: loading ---------------------------------------------------------
+
+def load_clock_files(log_dir: str) -> Dict[int, dict]:
+    out: Dict[int, dict] = {}
+    for i, path in enumerate(sorted(glob.glob(
+            os.path.join(log_dir, "clock.rank*.json")))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        out[_rank_of(path, i)] = rec
+    return out
+
+
+def estimate_offsets(clock: Dict[int, dict]
+                     ) -> Dict[int, Dict[str, float]]:
+    """``{rank: {offset_s, error_s}}`` — rank r's ``perf_counter``
+    minus rank 0's at the same instant (subtract ``offset_s`` from
+    rank r's local timestamps to land on rank 0's clock). Median over
+    rounds; ``error_s`` is the half-spread of the per-round deltas
+    (bounded by the handshake poll quantum plus scheduling jitter)."""
+    if 0 not in clock:
+        return {r: {"offset_s": 0.0, "error_s": float("inf")}
+                for r in clock}
+    base = [row["t_done"] for row in clock[0].get("rounds", [])]
+    out: Dict[int, Dict[str, float]] = {}
+    for rank, rec in clock.items():
+        rows = rec.get("rounds", [])
+        deltas = [row["t_done"] - b
+                  for row, b in zip(rows, base)
+                  if isinstance(row.get("t_done"), (int, float))]
+        if not deltas:
+            out[rank] = {"offset_s": 0.0, "error_s": float("inf")}
+            continue
+        out[rank] = {
+            "offset_s": float(statistics.median(deltas)),
+            "error_s": float((max(deltas) - min(deltas)) / 2.0),
+        }
+    return out
+
+
+def load_collective_logs(log_dir: str) -> Dict[int, List[dict]]:
+    """``{rank: [event]}`` from the recorder's per-rank JSONL (events
+    carry seq/name/axis/t_start/dur_s/nbytes). Torn tail lines (a
+    killed rank mid-write) are skipped, not fatal."""
+    out: Dict[int, List[dict]] = {}
+    for i, path in enumerate(sorted(glob.glob(
+            os.path.join(log_dir, "collectives.rank*.jsonl")))):
+        events = []
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(ev, dict) and "seq" in ev:
+                        events.append(ev)
+        except OSError:
+            continue
+        out[_rank_of(path, i)] = events
+    return out
+
+
+# -- offline: instance fusion + skew ------------------------------------------
+
+def collective_instances(rank_events: Dict[int, List[dict]],
+                         offsets: Optional[Dict[int, dict]] = None
+                         ) -> List[dict]:
+    """Fuse per-rank recorder events into per-INSTANCE rows. Eager
+    collectives run in program order on every rank, so equal sequence
+    numbers are the same instance; an instance only forms when every
+    reporting rank logged that seq (a missing rank is a dead-rank
+    problem, not a skew). Arrival/end times are offset-aligned onto
+    rank 0's clock; ``skew_ms[rank]`` is the rank's arrival lag behind
+    the earliest rank."""
+    offsets = offsets or {}
+    ranks = sorted(rank_events)
+    if not ranks:
+        return []
+    by_seq: Dict[int, Dict[int, dict]] = {}
+    for rank, events in rank_events.items():
+        for ev in events:
+            by_seq.setdefault(int(ev["seq"]), {})[rank] = ev
+    out: List[dict] = []
+    for seq in sorted(by_seq):
+        per_rank = by_seq[seq]
+        if set(per_rank) != set(ranks):
+            continue
+        arrivals, ends, durs = {}, {}, {}
+        for rank, ev in per_rank.items():
+            off = float(offsets.get(rank, {}).get("offset_s", 0.0))
+            t0 = float(ev.get("t_start", 0.0)) - off
+            dur = float(ev.get("dur_s", 0.0))
+            arrivals[rank] = t0
+            ends[rank] = t0 + dur
+            durs[rank] = dur
+        first = min(arrivals.values())
+        names = {ev.get("name", "?") for ev in per_rank.values()}
+        name = per_rank[ranks[0]].get("name", "?") \
+            if len(names) == 1 else "mixed:" + "/".join(sorted(names))
+        out.append({
+            "seq": seq,
+            "name": name,
+            "axis": per_rank[ranks[0]].get("axis", "world"),
+            "arrivals": arrivals,
+            "ends": ends,
+            "durs": durs,
+            "skew_ms": {r: (arrivals[r] - first) * 1e3 for r in arrivals},
+            "end_spread_ms": (max(ends.values()) - min(ends.values())) * 1e3,
+            # the job's FIRST common collective is its startup
+            # synchronization point: its arrival skew measures import/
+            # compile-time differences, not a straggler — flagged so
+            # detect_late_ranks can skip it (every later instance starts
+            # from the aligned exit of the previous one)
+            "startup": False,
+        })
+    if out:
+        out[0]["startup"] = True
+    return out
+
+
+# -- offline: the merged chrome trace -----------------------------------------
+
+def trace_paths(log_dir: str) -> Dict[int, str]:
+    return {_rank_of(p, i): p
+            for i, p in enumerate(sorted(glob.glob(
+                os.path.join(log_dir, "trace.rank*.json"))))}
+
+
+def merge_chrome_traces(traces: Dict[int, str],
+                        offsets: Optional[Dict[int, dict]] = None,
+                        instances: Optional[Sequence[dict]] = None) -> dict:
+    """One chrome trace from per-rank exports: every rank becomes its
+    own process track (pid = rank + ``process_name`` metadata —
+    pre-stamped pids are overridden so hand-merged mixed-vintage
+    artifacts cannot collide), timestamps are shifted onto rank 0's
+    clock, and each collective instance contributes per-rank slices on
+    a ``collectives`` lane plus flow arrows binding the instance across
+    ranks (the arrow points from the earliest arrival to each later
+    one — the visual form of the skew table). Events are sorted by
+    timestamp, so the merged timeline is monotonic by construction."""
+    offsets = offsets or {}
+    meta_events: List[dict] = []
+    events: List[dict] = []
+    for rank, path in sorted(traces.items()):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        off_us = float(offsets.get(rank, {}).get("offset_s", 0.0)) * 1e6
+        meta_events.append({"name": "process_name", "ph": "M", "pid": rank,
+                            "args": {"name": f"rank {rank}"}})
+        meta_events.append({"name": "process_sort_index", "ph": "M",
+                            "pid": rank, "args": {"sort_index": rank}})
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            if ev.get("ph") == "M":
+                if ev.get("name") in ("process_name", "process_sort_index"):
+                    continue  # re-stamped above on the merged pid
+                ev["pid"] = rank
+                meta_events.append(ev)
+                continue
+            if isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] = float(ev["ts"]) - off_us
+            ev["pid"] = rank
+            events.append(ev)
+    for inst in instances or []:
+        first_rank = min(inst["arrivals"], key=inst["arrivals"].get)
+        label = f'{inst["name"]} #{inst["seq"]}'
+        for rank, t0 in inst["arrivals"].items():
+            events.append({
+                "name": label, "ph": "X", "ts": t0 * 1e6,
+                "dur": max(inst["durs"].get(rank, 0.0), 0.0) * 1e6,
+                "pid": rank, "tid": "collectives", "cat": "collective",
+                "args": {"seq": inst["seq"], "axis": inst["axis"],
+                         "skew_ms": round(inst["skew_ms"][rank], 3)}})
+            flow = {"name": label, "cat": "collective_flow",
+                    "id": int(inst["seq"]), "pid": rank,
+                    "tid": "collectives", "ts": t0 * 1e6}
+            if rank == first_rank:
+                events.append({**flow, "ph": "s"})
+            else:
+                events.append({**flow, "ph": "f", "bp": "e"})
+    events.sort(key=lambda e: (float(e.get("ts", 0.0)),
+                               str(e.get("ph", ""))))
+    return {"traceEvents": meta_events + events,
+            "displayTimeUnit": "ms",
+            "metadata": {
+                "clock_offsets_s": {str(r): o.get("offset_s", 0.0)
+                                    for r, o in (offsets or {}).items()},
+                "ranks": sorted(traces),
+            }}
+
+
+def write_merged_trace(path: str, merged: dict) -> str:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged, f)
+    os.replace(tmp, path)
+    return path
+
+
+# -- offline: one-call analysis ----------------------------------------------
+
+def analyze(log_dir: str, threshold_ms: float = DEFAULT_LATE_MS,
+            merged_path: Optional[str] = None) -> dict:
+    """The whole pipeline over one job's ``log_dir``: offsets from the
+    clock handshakes (identity + infinite error when absent — skews are
+    then raw and flagged ``offsets_estimated: false``), collective
+    instances with per-rank skews, LATE-RANK findings past
+    ``threshold_ms`` (one finding per late rank, naming its worst
+    instance and counting the rest), and — when ``merged_path`` is set —
+    the merged chrome trace written there."""
+    clock = load_clock_files(log_dir)
+    offsets = estimate_offsets(clock) if clock else {}
+    rank_events = load_collective_logs(log_dir)
+    instances = collective_instances(rank_events, offsets)
+    # blame needs ALIGNED clocks: every rank with events must have a
+    # finite-error offset estimate, or the "skews" are differences of
+    # unrelated perf_counter epochs — fabricated lateness. Skipping
+    # (with the reason) beats gating CI on garbage.
+    skip_reason = None
+    if not clock:
+        skip_reason = ("no clock.rank*.json handshake artifacts — run "
+                       "cluster_trace.clock_handshake on every rank")
+    else:
+        unaligned = [r for r in rank_events
+                     if not (offsets.get(r, {}).get("error_s",
+                                                    float("inf"))
+                             < float("inf"))]
+        if unaligned:
+            skip_reason = (f"rank(s) {unaligned} have no finite clock-"
+                           f"offset estimate (missing/torn handshake "
+                           f"file, or rank 0's is gone)")
+    findings = [] if skip_reason else detect_late_ranks(instances,
+                                                        threshold_ms)
+    result = {
+        "log_dir": log_dir,
+        "ranks": sorted(rank_events),
+        "offsets_estimated": skip_reason is None,
+        "offsets": {str(r): o for r, o in offsets.items()},
+        "n_instances": len(instances),
+        "instances": instances,
+        "threshold_ms": float(threshold_ms),
+        "late_ranks": findings,
+    }
+    if skip_reason:
+        result["late_rank_analysis_skipped"] = skip_reason
+    if merged_path:
+        merged = merge_chrome_traces(trace_paths(log_dir), offsets,
+                                     instances)
+        result["merged_trace"] = write_merged_trace(merged_path, merged)
+        result["merged_events"] = len(merged["traceEvents"])
+    return result
+
+
+def detect_late_ranks(instances: Sequence[dict],
+                      threshold_ms: float = DEFAULT_LATE_MS) -> List[dict]:
+    """One finding per rank whose arrival skew exceeded ``threshold_ms``
+    on any instance: the worst instance named (seq, collective name,
+    axis, skew) plus the count of late instances. Sorted worst-first.
+    (``profiler.aggregate.detect_late_ranks`` delegates here — this is
+    the one implementation.)"""
+    worst: Dict[int, dict] = {}
+    counts: Dict[int, int] = {}
+    for inst in instances:
+        if inst.get("startup"):
+            continue  # startup sync absorbs import/compile-time skew
+        for rank, skew in inst["skew_ms"].items():
+            if skew <= float(threshold_ms):
+                continue
+            counts[rank] = counts.get(rank, 0) + 1
+            cur = worst.get(rank)
+            if cur is None or skew > cur["skew_ms"]:
+                worst[rank] = {"seq": inst["seq"], "name": inst["name"],
+                               "axis": inst["axis"],
+                               "skew_ms": float(skew)}
+    findings = [{"rank": rank, "late_instances": counts[rank],
+                 "threshold_ms": float(threshold_ms), "worst": w}
+                for rank, w in worst.items()]
+    findings.sort(key=lambda f: -f["worst"]["skew_ms"])
+    return findings

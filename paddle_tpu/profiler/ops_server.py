@@ -406,6 +406,30 @@ class _Handler(BaseHTTPRequestHandler):
                     "rank": rank(),
                     "state": device_profile.capture_state(),
                     "report": device_profile.last_report()})
+            elif url.path == "/debug/collectives":
+                # the per-axis collective picture of THIS rank: the
+                # static compiled-HLO inventory + latest-capture measured
+                # ms (collective_attrib), and the eager recorder's tail
+                # (?n= limits). On-demand like /debug/profile — the
+                # inventory may compile a stored lowering once (counted
+                # profile/hlo_compiles) in the default cost mode.
+                from . import collective_attrib
+
+                payload = {
+                    "rank": rank(),
+                    "axes": collective_attrib.registered_axes(),
+                    "inventory": collective_attrib.inventory_dict(),
+                    "summary": collective_attrib.summary(),
+                }
+                try:
+                    from ..distributed import communication
+
+                    n = int(q.get("n", ["64"])[0])
+                    payload["eager_tail"] = \
+                        communication.collective_events(n)
+                except Exception:  # noqa: BLE001 — recorder optional
+                    payload["eager_tail"] = []
+                self._send_json(200, payload)
             else:
                 self._send_json(404, {"error": f"no route {url.path}",
                                       "routes": ["/metrics", "/healthz",
@@ -413,7 +437,8 @@ class _Handler(BaseHTTPRequestHandler):
                                                  "/debug/requests",
                                                  "/debug/spans",
                                                  "/debug/telemetry",
-                                                 "/debug/profile"]})
+                                                 "/debug/profile",
+                                                 "/debug/collectives"]})
         except Exception as e:  # noqa: BLE001 — handler must not die
             try:
                 self._send_json(500, {"error": repr(e)})

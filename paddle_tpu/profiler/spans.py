@@ -46,7 +46,45 @@ __all__ = [
     "window_active", "chrome_events", "drain_window",
     "ReqTrace", "TraceStore", "trace_store", "trace_sample_rate",
     "should_trace", "trace_chrome_events",
+    "rank_pid", "rank_process_metadata",
 ]
+
+
+def rank_pid() -> int:
+    """The ``pid`` every chrome export of this process stamps its events
+    with: the global trainer RANK under a multi-process launch, else the
+    OS pid. Per-rank exports used to all emit ``os.getpid()`` with no
+    rank identity, so naively concatenated traces overlaid ranks on one
+    track (and pids can genuinely collide across hosts); a rank-scoped
+    pid makes every per-rank artifact merge-safe by construction
+    (``profiler.cluster_trace`` and anyone hand-merging)."""
+    try:
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1)
+    except ValueError:
+        world = 1
+    if world > 1:
+        for var in ("PADDLE_TRAINER_ID", "PROCESS_ID"):
+            raw = os.environ.get(var)
+            if raw:
+                try:
+                    return int(raw)
+                except ValueError:
+                    pass
+    return os.getpid()
+
+
+def rank_process_metadata(pid: Optional[int] = None) -> List[dict]:
+    """The chrome metadata events naming this process's track: a
+    ``process_name`` of ``rank <r>`` (or ``pid <p>`` standalone) plus a
+    ``process_sort_index`` so merged traces list ranks in order."""
+    p = rank_pid() if pid is None else int(pid)
+    label = f"rank {p}" if p != os.getpid() else f"pid {p}"
+    return [
+        {"name": "process_name", "ph": "M", "pid": p,
+         "args": {"name": label}},
+        {"name": "process_sort_index", "ph": "M", "pid": p,
+         "args": {"sort_index": p}},
+    ]
 
 _ids = itertools.count(1)  # process-unique span ids (GIL-atomic next())
 _tls = threading.local()   # per-thread stack of open spans

@@ -177,6 +177,19 @@ class Telemetry:
         with self._lock:
             self._gauges[name] = value
 
+    def remove_gauges(self, match) -> int:
+        """Drop every gauge whose name satisfies ``match(name)`` and
+        return how many were dropped. For WINDOWED gauges (a device-
+        profile capture's per-entry decomposition): a new window must
+        retract the old window's values for entries it did not observe,
+        or stale numbers outlive the capture that produced them and
+        poison cross-field contracts."""
+        with self._lock:
+            stale = [n for n in self._gauges if match(n)]
+            for n in stale:
+                del self._gauges[n]
+        return len(stale)
+
     def observe(self, name: str, value) -> None:
         if not self.enabled:
             return

@@ -279,6 +279,14 @@ def reset() -> None:
         hlo_attrib.hlo_registry().reset()
     except Exception:
         pass
+    try:
+        # the per-axis collective attribution layer caches parses of (and
+        # registers the mesh for) the same compiles — same lifetime
+        from . import collective_attrib
+
+        collective_attrib.reset()
+    except Exception:
+        pass
 
 
 # -- capture ---------------------------------------------------------------

@@ -272,6 +272,7 @@ class StepGuard:
                 corrupt_param_bit(self._engine)
             inputs = inj.corrupt_batch(step_i, inputs)
             inj.maybe_slow(step_i)
+            inj.maybe_slow_rank(step_i)  # rank-scoped straggler stall
         if self._snap is None:
             # the load-time state is known-good by definition; every
             # later snapshot is taken only AFTER a verified-good step

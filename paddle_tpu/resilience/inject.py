@@ -11,6 +11,12 @@ consulted by the runtime itself:
   example or an overflowed activation would);
 - ``maybe_slow(step)`` — StepGuard sleeps at a step boundary, tripping
   the Watchdog deadline;
+- ``maybe_slow_rank(step)`` — rank-scoped boundary stall
+  (``slow_rank@step:rank:secs``): exactly ONE rank of a multi-process
+  job straggles deterministically — short enough not to trip the hang
+  supervisor, long enough that the cluster-timeline skew analysis
+  (``profiler.cluster_trace`` / ``check_cluster_timeline``) must name
+  this rank late into the next collective;
 - ``maybe_sigterm(step)`` — StepGuard delivers a real SIGTERM to this
   process, driving the preemption path end-to-end;
 - ``worker_kill_due(batch_idx)`` — the DataLoader multiprocess iterator
@@ -53,7 +59,7 @@ Env-driven for subprocess runs (the CI smoke gate, launch children):
 
     PADDLE_TPU_INJECT="nan@3,sigterm@7,slow@5:1.5,kill_worker@2"
     PADDLE_TPU_INJECT="kill_rank@4:1,hang_rank@2:0,corrupt_ckpt@1"
-    PADDLE_TPU_INJECT="bitflip_param@3:1"
+    PADDLE_TPU_INJECT="bitflip_param@3:1,slow_rank@5:1:0.75"
     PADDLE_TPU_INJECT="slow_req@10:0.4,drop_req@12,deadline_storm@20:8"
 
 One-shot semantics: every injection fires at most once per injector.
@@ -86,6 +92,10 @@ class FaultInjector:
         sigterm_steps: step indices at whose boundary a real SIGTERM is
             delivered to this process.
         slow_steps: ``{step: seconds}`` boundary sleeps (watchdog food).
+        slow_rank_steps: ``{step: (rank, seconds)}`` — boundary sleep
+            only when this process's trainer rank matches: the
+            deterministic single-rank straggler the cluster-timeline
+            gate blames.
         kill_worker_batches: batch indices after whose delivery the
             producing DataLoader worker is SIGKILLed.
         kill_rank_steps: ``{step: rank}`` — SIGKILL this process at the
@@ -105,6 +115,7 @@ class FaultInjector:
     def __init__(self, nan_steps: Iterable[int] = (),
                  sigterm_steps: Iterable[int] = (),
                  slow_steps: Optional[Dict[int, float]] = None,
+                 slow_rank_steps: Optional[Dict[int, tuple]] = None,
                  kill_worker_batches: Iterable[int] = (),
                  kill_rank_steps: Optional[Dict[int, int]] = None,
                  hang_rank_steps: Optional[Dict[int, int]] = None,
@@ -120,6 +131,9 @@ class FaultInjector:
         self.sigterm_steps = {int(s) for s in sigterm_steps}
         self.slow_steps = {int(k): float(v)
                            for k, v in (slow_steps or {}).items()}
+        self.slow_rank_steps = {
+            int(k): (int(v[0]), float(v[1]))
+            for k, v in (slow_rank_steps or {}).items()}
         self.kill_worker_batches = {int(b) for b in kill_worker_batches}
         self.kill_rank_steps = {int(k): int(v)
                                 for k, v in (kill_rank_steps or {}).items()}
@@ -149,6 +163,7 @@ class FaultInjector:
         slow_req@10:0.4,drop_req@12,deadline_storm@20:8"``."""
         nan, sig, kill, corrupt, drop_req = [], [], [], [], []
         slow: Dict[int, float] = {}
+        slow_rank: Dict[int, tuple] = {}
         kill_rank: Dict[int, int] = {}
         hang_rank: Dict[int, int] = {}
         bitflip: Dict[int, int] = {}
@@ -163,6 +178,16 @@ class FaultInjector:
             if kind == "slow":
                 step, _, secs = where.partition(":")
                 slow[int(step)] = float(secs or 1.0)
+            elif kind == "slow_rank":
+                # slow_rank@step:rank:secs — the rank field is required
+                # (a rank-scoped fault without a rank is a spec bug, not
+                # a default-to-0 guess)
+                step, _, rest = where.partition(":")
+                r, _, secs = rest.partition(":")
+                if not r.strip():
+                    raise ValueError(
+                        f"slow_rank needs step:rank[:secs], got {part!r}")
+                slow_rank[int(step)] = (int(r), float(secs or 1.0))
             elif kind == "nan":
                 nan.append(int(where))
             elif kind == "sigterm":
@@ -187,6 +212,7 @@ class FaultInjector:
             else:
                 raise ValueError(f"unknown fault kind {kind!r} in {spec!r}")
         return cls(nan_steps=nan, sigterm_steps=sig, slow_steps=slow,
+                   slow_rank_steps=slow_rank,
                    kill_worker_batches=kill, kill_rank_steps=kill_rank,
                    hang_rank_steps=hang_rank, bitflip_param_steps=bitflip,
                    corrupt_ckpt_gens=corrupt,
@@ -249,6 +275,22 @@ class FaultInjector:
             time.sleep(secs)
             return secs
         return 0.0
+
+    def maybe_slow_rank(self, step: int) -> float:
+        """Boundary sleep when BOTH the step and this process's trainer
+        rank match the plan (``slow_rank@step:rank:secs``) — exactly one
+        rank of the job straggles, deterministically. One-shot across
+        relaunches via the state-dir marker (the secs field stays out of
+        the marker key, like every other fault). Returns seconds slept."""
+        due = self.slow_rank_steps.get(int(step))
+        if due is None:
+            return 0.0
+        r, secs = due
+        if r != self._rank() or not self._once(f"slow_rank@{step}:{r}"):
+            return 0.0
+        self._count("slow_rank")
+        time.sleep(secs)
+        return secs
 
     def maybe_sigterm(self, step: int) -> bool:
         if int(step) in self.sigterm_steps and self._once(f"sigterm@{step}"):

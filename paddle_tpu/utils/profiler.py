@@ -111,9 +111,16 @@ def export_chrome_tracing(path: str):
     ``log_dir`` (TensorBoard/perfetto). Spans nest (engine hierarchy
     fit → epoch → step → h2d/compute/d2h/...) and carry
     ``span_id``/``parent_id``/``step`` in ``args``. DRAINS the window:
-    each export owns its spans, so repeated windows cannot accumulate."""
-    pid = os.getpid()
-    events = _spans().chrome_events(pid=pid)
+    each export owns its spans, so repeated windows cannot accumulate.
+
+    Under a multi-process launch the ``pid`` field is the global trainer
+    RANK (plus ``process_name``/``process_sort_index`` metadata), so
+    per-rank exports merge into per-rank tracks instead of overlaying
+    each other in one pid/tid namespace — the contract
+    ``profiler.cluster_trace.merge_chrome_traces`` builds on."""
+    pid = _spans().rank_pid()
+    events = list(_spans().rank_process_metadata(pid))
+    events += _spans().chrome_events(pid=pid)
     # sampled request timelines (profiler.spans.ReqTrace) ride along as
     # per-request tracks: each sampled serving request exports its whole
     # queue → prefill → decode → terminal lifecycle under one trace id

@@ -1,0 +1,881 @@
+"""Cluster timeline: per-axis collective attribution
+(profiler.collective_attrib), cross-rank trace fusion + late-rank blame
+(profiler.cluster_trace), the eager-collective recorder
+(distributed.communication), the rank-scoped slow_rank injection, the
+rank-stamped chrome exports, and the check_cluster_timeline gate.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401 — device init for engine tests
+from paddle_tpu.distributed import communication as comm
+from paddle_tpu.profiler import cluster_trace, collective_attrib
+from paddle_tpu.profiler.telemetry import Telemetry, get_telemetry
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+sys.path.insert(0, TOOLS)
+import check_telemetry_schema as schema_gate  # noqa: E402
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "profiler_fixtures")
+
+
+def _fixture(name):
+    with open(os.path.join(FIXTURES, name)) as f:
+        return f.read()
+
+
+def _rec(scalars, **kw):
+    rec = {"ts": 1.0, "step": None, "tag": "t", "scalars": scalars}
+    rec.update(kw)
+    return rec
+
+
+# -- shape/bytes + group parsing ---------------------------------------------
+
+
+class TestHloParsing:
+    def test_shape_bytes(self):
+        assert collective_attrib._shape_bytes("f32[128,64]{1,0}") == 32768
+        assert collective_attrib._shape_bytes("bf16[512,32]{1,0}") == 32768
+        assert collective_attrib._shape_bytes("f32[]") == 4
+        assert collective_attrib._shape_bytes(
+            "(f32[8]{0}, bf16[4,2]{1,0})") == 48
+        # opaque/token types carry no payload
+        assert collective_attrib._shape_bytes("token[]") == 0
+
+    def test_literal_groups(self):
+        got = collective_attrib._parse_group_sets(
+            "f32[8] all-reduce(%x), replica_groups={{0,1},{2,3}}, to_apply=%a")
+        assert got == [(0, 1), (2, 3)]
+
+    def test_iota_groups_plain(self):
+        got = collective_attrib._parse_group_sets(
+            "f32[8] all-reduce(%x), replica_groups=[2,2]<=[4]")
+        assert got == [(0, 1), (2, 3)]
+
+    def test_iota_groups_transposed(self):
+        got = collective_attrib._parse_group_sets(
+            "f32[8] all-reduce(%x), replica_groups=[2,2]<=[2,2]T(1,0)")
+        assert got == [(0, 2), (1, 3)]
+
+    def test_pairs(self):
+        got = collective_attrib._parse_pairs(
+            "bf16[4] collective-permute(%x), "
+            "source_target_pairs={{0,1},{1,2},{2,3},{3,0}}")
+        assert got == [(0, 1), (1, 2), (2, 3), (3, 0)]
+
+    def test_done_half_skipped(self):
+        ops = collective_attrib.parse_collectives(
+            _fixture("hlo_collective_sp_ring.txt"), {"dp": 1, "sp": 4})
+        names = [op.name for op in ops]
+        assert "collective-permute-done.5" not in names
+        assert "collective-permute-start.4" in names
+
+
+class TestAxisMapping:
+    AXES = {"dp": 2, "tp": 2}
+
+    def test_single_axes(self):
+        assert collective_attrib.map_groups_to_axes(
+            [(0, 1), (2, 3)], self.AXES) == "tp"
+        assert collective_attrib.map_groups_to_axes(
+            [(0, 2), (1, 3)], self.AXES) == "dp"
+
+    def test_flattened_multi_axis(self):
+        assert collective_attrib.map_groups_to_axes(
+            [(0, 1, 2, 3)], self.AXES) == "dp+tp"
+
+    def test_unmapped_never_guesses(self):
+        assert collective_attrib.map_groups_to_axes(
+            [(0, 3), (1, 2)], self.AXES) == "unmapped"
+        assert collective_attrib.map_groups_to_axes([], self.AXES) \
+            == "unmapped"
+        assert collective_attrib.map_groups_to_axes([(0, 1)], {}) \
+            == "unmapped"
+
+    def test_empty_replica_groups_is_all_devices(self):
+        # XLA's `replica_groups={}` shorthand: ONE group of all devices
+        text = ("ENTRY %m (p: f32[8]) -> f32[8] {\n"
+                "  %p = f32[8]{0} parameter(0)\n"
+                "  ROOT %all-reduce.1 = f32[8]{0} all-reduce(%p), "
+                "replica_groups={}, to_apply=%add\n}")
+        ops = collective_attrib.parse_collectives(text, {"dp": 2, "tp": 2})
+        assert ops[0].axis == "dp+tp"
+        ops = collective_attrib.parse_collectives(text, {"dp": 4})
+        assert ops[0].axis == "dp"
+
+    def test_degenerate_one_device(self):
+        # a 1-device mesh maps {{0}} onto its first axis deterministically
+        assert collective_attrib.map_groups_to_axes([(0,)], {"dp": 1}) \
+            == "dp"
+
+    def test_permute_ring_axis(self):
+        pairs = [(0, 1), (1, 2), (2, 3), (3, 0)]
+        assert collective_attrib.map_pairs_to_axis(
+            pairs, {"dp": 1, "sp": 4}) == "sp"
+        # a diagonal hop crosses two axes: honest unmapped
+        assert collective_attrib.map_pairs_to_axis(
+            [(0, 3)], {"dp": 2, "tp": 2}) == "unmapped"
+
+    def test_three_axis_mesh(self):
+        axes = {"dp": 2, "tp": 2, "sp": 2}
+        # tp groups on a 2x2x2 mesh: fix dp and sp, vary tp (stride 2)
+        assert collective_attrib.map_groups_to_axes(
+            [(0, 2), (1, 3), (4, 6), (5, 7)], axes) == "tp"
+
+
+# -- golden fixtures: exact axis/bytes tables ---------------------------------
+
+
+class TestGoldenFixtures:
+    def test_dp_only(self):
+        ops = collective_attrib.parse_collectives(
+            _fixture("hlo_collective_dp.txt"), {"dp": 4})
+        table = {op.name: (op.opcode, op.axis, op.bytes) for op in ops}
+        assert table == {
+            "all-reduce.3": ("all-reduce", "dp", 32768.0),
+            "all-gather.4": ("all-gather", "dp", 32768.0),
+        }
+
+    def test_dp_x_tp(self):
+        ops = collective_attrib.parse_collectives(
+            _fixture("hlo_collective_dptp.txt"), {"dp": 2, "tp": 2})
+        table = {op.name: (op.opcode, op.axis, op.bytes) for op in ops}
+        assert table == {
+            "all-reduce.4": ("all-reduce", "tp", 262144.0),
+            "all-reduce.5": ("all-reduce", "dp", 256.0),
+            "reduce-scatter.6": ("reduce-scatter", "tp", 32768.0),
+            "all-reduce.8": ("all-reduce", "dp+tp", 4.0),
+        }
+        per_axis = collective_attrib._per_axis(ops)
+        assert per_axis["tp"] == {"bytes": 294912.0, "count": 2.0}
+        assert per_axis["dp"] == {"bytes": 256.0, "count": 1.0}
+        assert per_axis["dp+tp"] == {"bytes": 4.0, "count": 1.0}
+
+    def test_sp_ring(self):
+        ops = collective_attrib.parse_collectives(
+            _fixture("hlo_collective_sp_ring.txt"), {"dp": 1, "sp": 4})
+        table = {op.name: (op.opcode, op.axis, op.bytes) for op in ops}
+        assert table == {
+            "collective-permute.3":
+                ("collective-permute", "sp", 262144.0),
+            "collective-permute-start.4":
+                ("collective-permute-start", "sp", 128.0),
+        }
+
+
+# -- laneless degrade: static inventory with no capture -----------------------
+
+
+class TestPublishStatic:
+    def _seed_registry(self, entry="fleet.train_step"):
+        from paddle_tpu.profiler import hlo_attrib
+
+        get_telemetry().reset()
+        collective_attrib.register_mesh({"dp": 2, "tp": 2})
+        hlo_attrib.hlo_registry().put_text(
+            entry, _fixture("hlo_collective_dptp.txt"))
+        return entry
+
+    def test_static_gauges_without_capture(self, tmp_path):
+        entry = self._seed_registry()
+        tel = Telemetry()
+        tables = collective_attrib.publish_static(tel)
+        assert tables[entry]["tp"] == {"bytes": 294912.0, "count": 2.0}
+        scalars = tel.scalars()
+        assert scalars[f"gauge/collective/tp/bytes.{entry}"] == 294912.0
+        assert scalars[f"gauge/collective/dp/count.{entry}"] == 1.0
+        # no capture ran: the measured ms gauges are absent, bytes stand
+        assert not any("/ms." in k for k in scalars
+                       if k.startswith("gauge/collective/"))
+        # and the record passes the schema gate
+        path = tmp_path / "static.jsonl"
+        tel.to_jsonl(str(path), tag="t")
+        n, err = schema_gate.validate_file(
+            str(path), require_prefix=["gauge/collective/"])
+        assert err is None and n == 1
+
+    def test_steps_per_call_divides(self):
+        from paddle_tpu.profiler import xla_cost
+
+        entry = self._seed_registry("fleet.train_step_multi")
+        xla_cost.set_steps_per_call(entry, 4)
+        tel = Telemetry()
+        tables = collective_attrib.publish_static(tel)
+        assert tables[entry]["tp"] == {"bytes": 73728.0, "count": 0.5}
+
+    def test_entry_summary(self):
+        entry = self._seed_registry()
+        summary = collective_attrib.entry_summary(entry)
+        assert summary["tp"]["bytes"] == 294912.0
+        assert "ms" not in summary["tp"]
+
+    def test_custom_axis_names_publish_schema_safe(self, tmp_path):
+        # a mesh with non-canonical axis names keeps its REAL labels in
+        # the inventory but publishes gauges under "unmapped" so the
+        # schema gate's closed vocabulary never fails a healthy run
+        from paddle_tpu.profiler import hlo_attrib
+
+        get_telemetry().reset()
+        collective_attrib.register_mesh({"data": 2, "model": 2})
+        entry = "fleet.train_step"
+        hlo_attrib.hlo_registry().put_text(
+            entry, _fixture("hlo_collective_dptp.txt"))
+        tel = Telemetry()
+        tables = collective_attrib.publish_static(tel)
+        assert "model" in tables[entry]  # real name in the table
+        scalars = tel.scalars()
+        assert not any("/model/" in k or "/data/" in k for k in scalars)
+        assert f"gauge/collective/unmapped/bytes.{entry}" in scalars
+        path = tmp_path / "custom.jsonl"
+        tel.to_jsonl(str(path), tag="t")
+        n, err = schema_gate.validate_file(str(path))
+        assert err is None
+
+
+# -- capture join: measured per-axis ms ---------------------------------------
+
+
+class TestOnCapture:
+    def _report(self, entry, by_op, steps=1):
+        from paddle_tpu.profiler.hlo_attrib import (AttributionReport,
+                                                    EntryAttribution)
+
+        att = EntryAttribution(entry=entry, steps=steps)
+        for op, (src, op_name, cat, ms) in by_op.items():
+            att.add(op, src, op_name, cat, ms)
+        return AttributionReport(wall_ms=10.0, device_total_ms=att.device_ms,
+                                 entries={entry: att})
+
+    def test_join_publishes_per_axis_ms(self, tmp_path):
+        from paddle_tpu.profiler import hlo_attrib
+
+        get_telemetry().reset()
+        entry = "fleet.train_step"
+        collective_attrib.register_mesh({"dp": 2, "tp": 2})
+        hlo_attrib.hlo_registry().put_text(
+            entry, _fixture("hlo_collective_dptp.txt"))
+        report = self._report(entry, {
+            "all-reduce.4": ("tp.py:44", "psum", "collective", 3.0),
+            "all-reduce.5": ("dp.py:18", "psum", "collective", 1.5),
+            "fusion.7": ("loss.py:9", "fusion", "compute", 5.0),
+        })
+        tel = Telemetry()
+        joined = collective_attrib.on_capture(report, tel)
+        assert joined[entry] == {"tp": 3.0, "dp": 1.5}
+        scalars = tel.scalars()
+        assert scalars[f"gauge/collective/tp/ms.{entry}"] == 3.0
+        assert scalars[f"gauge/collective/dp/ms.{entry}"] == 1.5
+        # static bytes ride along in the same record
+        assert scalars[f"gauge/collective/tp/bytes.{entry}"] == 294912.0
+        # the cross-field contract holds: comm ms <= device total
+        tel.gauge("profile/device_total_ms", report.device_total_ms)
+        path = tmp_path / "cap.jsonl"
+        tel.to_jsonl(str(path), tag="t")
+        n, err = schema_gate.validate_file(str(path))
+        assert err is None
+
+    def test_new_capture_retracts_stale_ms_gauges(self, tmp_path):
+        # capture 1 measures entry A's collectives; capture 2 covers a
+        # DIFFERENT entry with a much smaller window — A's stale ms
+        # gauge must not outlive its window and break the
+        # "comm ms <= device total" cross-field on the next record
+        from paddle_tpu.profiler import hlo_attrib
+
+        get_telemetry().reset()
+        collective_attrib.register_mesh({"dp": 2, "tp": 2})
+        hlo_attrib.hlo_registry().put_text(
+            "fleet.train_step", _fixture("hlo_collective_dptp.txt"))
+        hlo_attrib.hlo_registry().put_text(
+            "jit.train_step", _fixture("hlo_collective_dp.txt"))
+        tel = Telemetry()
+        rep1 = self._report("fleet.train_step", {
+            "all-reduce.4": ("tp.py:44", "psum", "collective", 80.0)})
+        collective_attrib.on_capture(rep1, tel)
+        tel.gauge("profile/device_total_ms", 100.0)
+        rep2 = self._report("jit.train_step", {
+            "all-reduce.3": ("grad.py:20", "psum", "collective", 1.0)})
+        collective_attrib.on_capture(rep2, tel)
+        tel.gauge("profile/device_total_ms", 5.0)  # the shorter window
+        scalars = tel.scalars()
+        assert "gauge/collective/tp/ms.fleet.train_step" not in scalars
+        # the dp fixture's 4-member group is dp+tp on the 2x2 mesh
+        assert scalars["gauge/collective/dp+tp/ms.jit.train_step"] == 1.0
+        path = tmp_path / "two_caps.jsonl"
+        tel.to_jsonl(str(path), tag="t")
+        n, err = schema_gate.validate_file(str(path))
+        assert err is None
+
+    def test_unattributed_collective_lands_unmapped(self):
+        from paddle_tpu.profiler import hlo_attrib
+
+        get_telemetry().reset()
+        entry = "jit.train_step"
+        collective_attrib.register_mesh({"dp": 2})
+        hlo_attrib.hlo_registry().put_text(
+            entry, _fixture("hlo_collective_dp.txt"))
+        report = self._report(entry, {
+            "<unattributed:all-reduce>": ("?", "?", "collective", 2.0),
+        })
+        joined = collective_attrib.on_capture(report, Telemetry())
+        assert joined[entry] == {"unmapped": 2.0}
+
+    def test_dominant_axis(self):
+        from paddle_tpu.profiler import hlo_attrib
+
+        get_telemetry().reset()
+        entry = "fleet.train_step"
+        collective_attrib.register_mesh({"dp": 2, "tp": 2})
+        hlo_attrib.hlo_registry().put_text(
+            entry, _fixture("hlo_collective_dptp.txt"))
+        # without a capture: dominant by static bytes
+        axis, val = collective_attrib.dominant_axis(entry)
+        assert axis == "tp" and val == 294912.0
+        report = self._report(entry, {
+            "all-reduce.5": ("dp.py:18", "psum", "collective", 9.0),
+            "all-reduce.4": ("tp.py:44", "psum", "collective", 1.0),
+        })
+        collective_attrib.on_capture(report, Telemetry())
+        axis, val = collective_attrib.dominant_axis(entry)
+        assert axis == "dp" and val == 9.0
+
+
+# -- comm_bound:<axis> verdict refinement -------------------------------------
+
+
+class TestBottleneckRefinement:
+    def test_comm_bound_gains_axis(self):
+        from paddle_tpu.profiler import bottleneck
+
+        tel = Telemetry()
+        entry = "fleet.train_step"
+        tel.gauge(f"profile/collective_frac.{entry}", 0.6)
+        tel.gauge(f"profile/compute_frac.{entry}", 0.3)
+        tel.gauge(f"collective/dp/ms.{entry}", 7.0)
+        tel.gauge(f"collective/tp/ms.{entry}", 2.0)
+        out = bottleneck.verdicts(tel)
+        assert out[entry]["verdict"] == "comm_bound:dp"
+        assert out[entry]["id"] == 2  # numeric vocabulary unchanged
+        assert out[entry]["evidence"]["axis"] == "dp"
+        # the published gauge stays in the closed id set
+        bottleneck.publish(tel)
+        assert tel.scalars()[f"gauge/bottleneck/{entry}"] == 2.0
+
+    def test_comm_bound_without_gauges_stays_plain(self):
+        from paddle_tpu.profiler import bottleneck
+
+        tel = Telemetry()
+        entry = "jit.train_step"
+        tel.gauge(f"profile/collective_frac.{entry}", 0.6)
+        tel.gauge(f"profile/compute_frac.{entry}", 0.3)
+        out = bottleneck.verdicts(tel)
+        assert out[entry]["verdict"] == "comm_bound"
+
+
+# -- the eager-collective recorder --------------------------------------------
+
+
+class TestEagerRecorder:
+    def test_fs_gather_records_and_logs(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_COLLECTIVE_LOG",
+                           str(tmp_path / "collectives.jsonl"))
+        comm.reset_collective_recorder()
+        get_telemetry().reset()
+        rdv = str(tmp_path / "rdv")
+        results = {}
+
+        def run(rank):
+            results[rank] = comm.all_gather_object(
+                {"r": rank}, key="t0", rendezvous_dir=rdv, rank=rank,
+                world_size=2, poll_s=0.005)
+
+        threads = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results[0] == [{"r": 0}, {"r": 1}]
+        events = comm.collective_events()
+        assert len(events) == 2  # one per calling thread
+        assert {e["name"] for e in events} == {"all_gather_object"}
+        assert all(e["axis"] == "world" and e["dur_s"] >= 0
+                   for e in events)
+        assert [e["seq"] for e in events] == [0, 1]
+        # the rank file got one parsable line per event
+        path = comm.collective_log_path()
+        assert path.endswith(".rank0.jsonl")
+        lines = [json.loads(ln) for ln in open(path)]
+        assert len(lines) == 2
+        # cumulative gauges rode into telemetry, schema-clean
+        scalars = get_telemetry().scalars()
+        assert scalars["gauge/collective/world/count.eager"] == 2.0
+        assert scalars["counter/collective/eager_calls"] == 2
+        assert schema_gate.validate_record(_rec(scalars), 1) is None
+
+    def test_log_path_suffixing(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_COLLECTIVE_LOG", "/x/c.jsonl")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+        comm.reset_collective_recorder()
+        assert comm.collective_log_path() == "/x/c.rank3.jsonl"
+        monkeypatch.setenv("PADDLE_TPU_COLLECTIVE_LOG",
+                           "/x/collectives.rank7.jsonl")
+        comm.reset_collective_recorder()
+        assert comm.collective_log_path() == "/x/collectives.rank7.jsonl"
+        # a basename merely CONTAINING "rank" still gets per-rank files
+        # (a shared file torn by N appending processes is the bug)
+        monkeypatch.setenv("PADDLE_TPU_COLLECTIVE_LOG", "/x/ranked.jsonl")
+        comm.reset_collective_recorder()
+        assert comm.collective_log_path() == "/x/ranked.rank3.jsonl"
+        monkeypatch.delenv("PADDLE_TPU_COLLECTIVE_LOG")
+        comm.reset_collective_recorder()
+        assert comm.collective_log_path() is None
+
+
+# -- clock offsets + instance fusion + late-rank detection --------------------
+
+
+def _write_synthetic_logs(logdir, stall_seq=3, stall_s=0.5, offset=5.0,
+                          n=6):
+    os.makedirs(logdir, exist_ok=True)
+    for r, (off, stall) in enumerate([(0.0, 0.0), (offset, stall_s)]):
+        rows = []
+        for k in range(8):
+            t = 100.0 + k * 0.01
+            rows.append({"t_send": t + off,
+                         "t_done": t + off + 0.002 * r})
+        with open(os.path.join(logdir, f"clock.rank{r}.json"), "w") as f:
+            json.dump({"rank": r, "world": 2, "rounds": rows}, f)
+        with open(os.path.join(logdir,
+                               f"collectives.rank{r}.jsonl"), "w") as f:
+            for seq in range(n):
+                t0 = 50.0 + seq + off + (stall if seq == stall_seq else 0.0)
+                f.write(json.dumps(
+                    {"seq": seq, "name": "all_gather_object",
+                     "axis": "world", "t_start": t0, "dur_s": 0.02,
+                     "nbytes": 8, "rank": r}) + "\n")
+
+
+class TestClockAndSkew:
+    def test_offsets_recovered(self, tmp_path):
+        _write_synthetic_logs(str(tmp_path))
+        offsets = cluster_trace.estimate_offsets(
+            cluster_trace.load_clock_files(str(tmp_path)))
+        assert offsets[0]["offset_s"] == 0.0
+        assert abs(offsets[1]["offset_s"] - 5.002) < 1e-6
+        assert offsets[1]["error_s"] < 0.01
+
+    def test_missing_rank0_clock_degrades(self, tmp_path):
+        _write_synthetic_logs(str(tmp_path))
+        os.unlink(tmp_path / "clock.rank0.json")
+        offsets = cluster_trace.estimate_offsets(
+            cluster_trace.load_clock_files(str(tmp_path)))
+        assert offsets[1]["offset_s"] == 0.0
+        assert offsets[1]["error_s"] == float("inf")
+
+    def test_late_rank_named(self, tmp_path):
+        _write_synthetic_logs(str(tmp_path))
+        res = cluster_trace.analyze(str(tmp_path), threshold_ms=100.0)
+        assert res["offsets_estimated"]
+        assert res["n_instances"] == 6
+        late = res["late_ranks"]
+        assert len(late) == 1 and late[0]["rank"] == 1
+        assert late[0]["worst"]["seq"] == 3
+        assert abs(late[0]["worst"]["skew_ms"] - 500.0) < 60.0
+        assert late[0]["worst"]["axis"] == "world"
+
+    def test_startup_instance_absorbs_skew(self, tmp_path):
+        # the stall on the FIRST instance is startup skew (import/compile
+        # difference), not a straggler: no finding
+        _write_synthetic_logs(str(tmp_path), stall_seq=0)
+        res = cluster_trace.analyze(str(tmp_path), threshold_ms=100.0)
+        assert res["instances"][0]["startup"] is True
+        assert res["late_ranks"] == []
+
+    def test_partial_instance_not_fused(self, tmp_path):
+        _write_synthetic_logs(str(tmp_path))
+        # rank 1's log truncated after 4 events (killed mid-run): only
+        # the common prefix fuses
+        path = tmp_path / "collectives.rank1.jsonl"
+        lines = open(path).readlines()[:4]
+        open(path, "w").writelines(lines)
+        res = cluster_trace.analyze(str(tmp_path), threshold_ms=100.0)
+        assert res["n_instances"] == 4
+
+    def test_aggregate_delegates(self, tmp_path):
+        from paddle_tpu.profiler import aggregate as agg
+
+        _write_synthetic_logs(str(tmp_path))
+        res = cluster_trace.analyze(str(tmp_path), threshold_ms=100.0)
+        findings = agg.detect_late_ranks(res["instances"], 100.0)
+        assert [f["rank"] for f in findings] == [1]
+
+
+class TestMergedTrace:
+    def test_merge_shifts_and_stamps(self, tmp_path):
+        _write_synthetic_logs(str(tmp_path))
+        for r, off in ((0, 0.0), (5.0, 5.0)):
+            rank = 0 if r == 0 else 1
+            with open(tmp_path / f"trace.rank{rank}.json", "w") as f:
+                json.dump({"traceEvents": [
+                    {"name": "step", "ph": "X", "ts": (60.0 + off) * 1e6,
+                     "dur": 1e3, "pid": 999, "tid": 1, "cat": "host"}]}, f)
+        res = cluster_trace.analyze(
+            str(tmp_path), threshold_ms=100.0,
+            merged_path=str(tmp_path / "merged.json"))
+        merged = json.load(open(tmp_path / "merged.json"))
+        events = merged["traceEvents"]
+        steps = [e for e in events if e.get("name") == "step"]
+        assert {e["pid"] for e in steps} == {0, 1}  # 999 overridden
+        # offset-aligned: both step slices land at ~the same instant
+        ts = sorted(e["ts"] for e in steps)
+        assert abs(ts[1] - ts[0]) < 0.01 * 1e6
+        named = {e["pid"] for e in events
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert {0, 1} <= named
+        assert any(e.get("ph") == "s" for e in events)  # flow arrows
+        xs = [e["ts"] for e in events if e.get("ph") == "X"]
+        assert xs == sorted(xs)
+        assert res["merged_events"] == len(events)
+
+
+# -- slow_rank injection grammar ----------------------------------------------
+
+
+class TestSlowRankInjection:
+    def test_parse(self):
+        from paddle_tpu.resilience.inject import FaultInjector
+
+        inj = FaultInjector.from_spec("slow_rank@5:1:0.75,nan@2")
+        assert inj.slow_rank_steps == {5: (1, 0.75)}
+        assert inj.nan_steps == {2}
+        # secs defaults to 1.0
+        inj = FaultInjector.from_spec("slow_rank@3:0")
+        assert inj.slow_rank_steps == {3: (0, 1.0)}
+
+    def test_parse_requires_rank(self):
+        from paddle_tpu.resilience.inject import FaultInjector
+
+        with pytest.raises(ValueError):
+            FaultInjector.from_spec("slow_rank@3")
+
+    def test_rank_scoping(self, monkeypatch):
+        from paddle_tpu.resilience.inject import FaultInjector
+
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        inj = FaultInjector(slow_rank_steps={2: (0, 0.2)})
+        assert inj.maybe_slow_rank(2) == 0.0  # wrong rank: no stall
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        inj = FaultInjector(slow_rank_steps={2: (0, 0.05)})
+        assert inj.maybe_slow_rank(1) == 0.0  # wrong step
+        t0 = time.perf_counter()
+        assert inj.maybe_slow_rank(2) == 0.05
+        assert time.perf_counter() - t0 >= 0.045
+        assert inj.maybe_slow_rank(2) == 0.0  # one-shot per process
+
+    def test_one_shot_across_relaunch(self, tmp_path, monkeypatch):
+        from paddle_tpu.resilience.inject import FaultInjector
+
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        state = str(tmp_path / "inject-state")
+        first = FaultInjector.from_spec("slow_rank@2:0:0.01",
+                                        state_dir=state)
+        assert first.maybe_slow_rank(2) == 0.01
+        # a relaunched process (fresh injector, same state dir) must not
+        # re-fire the same stall
+        relaunched = FaultInjector.from_spec("slow_rank@2:0:0.01",
+                                             state_dir=state)
+        assert relaunched.maybe_slow_rank(2) == 0.0
+
+    def test_guard_consults_slow_rank(self, monkeypatch):
+        from paddle_tpu import nn
+        from paddle_tpu.jit.train_step import TrainStep
+        from paddle_tpu.resilience import RecoveryPolicy, StepGuard
+        from paddle_tpu.resilience.inject import FaultInjector
+
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters())
+        step = TrainStep(net, lambda o, y: ((o - y) ** 2).mean(), opt,
+                         guard_updates=True)
+        inj = FaultInjector(slow_rank_steps={1: (0, 0.3)})
+        guard = StepGuard(step, RecoveryPolicy(quarantine_dir=None),
+                          injector=inj)
+        x = np.ones((2, 4), np.float32)
+        y = np.zeros((2, 2), np.float32)
+        guard((x,), (y,))  # step 0: warm compile, no stall
+        t0 = time.perf_counter()
+        guard((x,), (y,))  # step 1: the rank-scoped stall fires
+        assert time.perf_counter() - t0 >= 0.28
+
+
+# -- rank-stamped chrome exports ----------------------------------------------
+
+
+class TestRankStampedExports:
+    def test_rank_pid_under_launch(self, monkeypatch):
+        from paddle_tpu.profiler import spans
+
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+        assert spans.rank_pid() == 3
+        meta = spans.rank_process_metadata()
+        assert meta[0]["args"]["name"] == "rank 3"
+        assert meta[1]["args"]["sort_index"] == 3
+
+    def test_rank_pid_standalone(self, monkeypatch):
+        from paddle_tpu.profiler import spans
+
+        monkeypatch.delenv("PADDLE_TRAINERS_NUM", raising=False)
+        monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+        assert spans.rank_pid() == os.getpid()
+
+    def test_export_stamps_rank(self, tmp_path, monkeypatch):
+        from paddle_tpu.utils import profiler as host_prof
+
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        host_prof.start_profiler(device_trace=False)
+        with host_prof.RecordEvent("unit_span"):
+            pass
+        host_prof._spans().close_window()
+        out = host_prof.export_chrome_tracing(str(tmp_path / "t.json"))
+        events = json.load(open(out))["traceEvents"]
+        named = [e for e in events if e.get("ph") == "M"
+                 and e["name"] == "process_name"]
+        assert named and named[0]["pid"] == 1
+        assert named[0]["args"]["name"] == "rank 1"
+        span_events = [e for e in events if e.get("name") == "unit_span"]
+        assert span_events and all(e["pid"] == 1 for e in span_events)
+
+
+# -- schema contracts ---------------------------------------------------------
+
+
+class TestSchemaContracts:
+    def test_axis_vocabulary(self):
+        ok = {"gauge/collective/dp/ms.fleet.train_step": 1.0,
+              "gauge/collective/dp+tp/bytes.x": 2.0,
+              "gauge/collective/unmapped/count.x": 1.0,
+              "gauge/collective/world/ms.eager": 9e9}
+        assert schema_gate.validate_record(_rec(ok), 1) is None
+        bad = {"gauge/collective/banana/ms.x": 1.0}
+        assert "vocabulary" in schema_gate.validate_record(_rec(bad), 1)
+        bad_field = {"gauge/collective/dp/seconds.x": 1.0}
+        assert schema_gate.validate_record(_rec(bad_field), 1) is not None
+
+    def test_non_negative(self):
+        bad = {"gauge/collective/dp/bytes.x": -1.0}
+        assert "negative" in schema_gate.validate_record(_rec(bad), 1)
+
+    def test_comm_ms_bounded_by_device_total(self):
+        bad = {"gauge/collective/dp/ms.fleet.train_step": 20.0,
+               "gauge/collective/tp/ms.fleet.train_step": 20.0,
+               "gauge/profile/device_total_ms": 30.0}
+        err = schema_gate.validate_record(_rec(bad), 1)
+        assert err is not None and "device total" in err
+        ok = {"gauge/collective/dp/ms.fleet.train_step": 10.0,
+              "gauge/collective/tp/ms.fleet.train_step": 20.0,
+              "gauge/profile/device_total_ms": 30.0}
+        assert schema_gate.validate_record(_rec(ok), 1) is None
+
+    def test_eager_entry_exempt_from_window_bound(self):
+        ok = {"gauge/collective/world/ms.eager": 1e6,
+              "gauge/profile/device_total_ms": 30.0}
+        assert schema_gate.validate_record(_rec(ok), 1) is None
+
+
+# -- aggregation surfaces -----------------------------------------------------
+
+
+class TestAggregationSurfaces:
+    def test_straggler_cites_collective_evidence(self):
+        from paddle_tpu.profiler import aggregate as agg
+
+        scal = {0: {"hist/engine/step_ms/p50": 10.0},
+                1: {"hist/engine/step_ms/p50": 20.0,
+                    "gauge/collective/dp/ms.fleet.train_step": 7.5,
+                    "gauge/collective/tp/ms.fleet.train_step": 1.0}}
+        findings = agg.detect_stragglers(scal, threshold=1.25)
+        assert len(findings) == 1 and findings[0]["rank"] == 1
+        assert findings[0]["collective_axis"] == "dp"
+        assert findings[0]["collective_ms"] == 7.5
+
+    def test_dominant_axis_prefers_captured_over_eager(self):
+        from paddle_tpu.profiler import aggregate as agg
+
+        scal = {"gauge/collective/world/ms.eager": 1e6,
+                "gauge/collective/dp/ms.fleet.train_step": 3.0}
+        assert agg.dominant_collective_axis(scal) == ("dp", 3.0)
+
+    def test_bottleneck_refined_in_agg(self):
+        from paddle_tpu.profiler import aggregate as agg
+
+        scal = {0: {"gauge/bottleneck/fleet.train_step": 2.0,
+                    "gauge/collective/sp/ms.fleet.train_step": 4.0}}
+        rows = agg.collect_bottlenecks(scal)
+        assert rows == [{"entry": "fleet.train_step", "rank": 0,
+                         "verdict": "comm_bound:sp"}]
+
+    def test_telemetry_agg_cli_late_rank(self, tmp_path, capsys):
+        import telemetry_agg
+
+        logdir = str(tmp_path)
+        _write_synthetic_logs(logdir)
+        for r in (0, 1):
+            with open(os.path.join(logdir,
+                                   f"telemetry.rank{r}.jsonl"), "w") as f:
+                f.write(json.dumps(
+                    {"ts": 1.0, "step": None, "tag": "exit",
+                     "scalars": {"counter/engine/steps": 6}}) + "\n")
+        rc = telemetry_agg.main([logdir, "--fail-on-late-rank"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "LATE RANKS" in out
+        assert "rank 1 late" in out and "#3" in out
+        # without the gate flag the findings print but don't fail
+        assert telemetry_agg.main([logdir]) == 0
+
+    def test_fail_on_late_rank_requires_verifiable_artifacts(
+            self, tmp_path, capsys):
+        import telemetry_agg
+
+        logdir = str(tmp_path)
+        for r in (0, 1):
+            with open(os.path.join(logdir,
+                                   f"telemetry.rank{r}.jsonl"), "w") as f:
+                f.write(json.dumps(
+                    {"ts": 1.0, "step": None, "tag": "exit",
+                     "scalars": {"counter/engine/steps": 6}}) + "\n")
+        # no collectives artifacts at all: the gate flag must FAIL, not
+        # greenlight a run it verified nothing about
+        assert telemetry_agg.main([logdir, "--fail-on-late-rank"]) == 1
+        assert "could not verify" in capsys.readouterr().err
+        # collectives present but NO clock handshake: skews would be
+        # differences of unrelated clocks — analysis skipped, gate fails
+        _write_synthetic_logs(logdir)
+        for r in (0, 1):
+            os.unlink(os.path.join(logdir, f"clock.rank{r}.json"))
+        assert telemetry_agg.main([logdir, "--fail-on-late-rank"]) == 1
+        out = capsys.readouterr()
+        assert "analysis skipped" in out.out
+        # without the gate flag the skip is reported but not fatal
+        assert telemetry_agg.main([logdir]) == 0
+
+    def test_telemetry_agg_cli_clean(self, tmp_path, capsys):
+        import telemetry_agg
+
+        logdir = str(tmp_path)
+        _write_synthetic_logs(logdir, stall_s=0.0)
+        for r in (0, 1):
+            with open(os.path.join(logdir,
+                                   f"telemetry.rank{r}.jsonl"), "w") as f:
+                f.write(json.dumps(
+                    {"ts": 1.0, "step": None, "tag": "exit",
+                     "scalars": {"counter/engine/steps": 6}}) + "\n")
+        rc = telemetry_agg.main([logdir, "--fail-on-late-rank"])
+        assert rc == 0
+        assert "late ranks: none" in capsys.readouterr().out
+
+
+# -- the ops-server surface ---------------------------------------------------
+
+
+class TestDebugCollectives:
+    def test_endpoint_payload(self):
+        import urllib.request
+
+        from paddle_tpu.profiler import hlo_attrib, ops_server
+
+        get_telemetry().reset()
+        collective_attrib.register_mesh({"dp": 2, "tp": 2})
+        hlo_attrib.hlo_registry().put_text(
+            "fleet.train_step", _fixture("hlo_collective_dptp.txt"))
+        comm.reset_collective_recorder()
+        comm._record_collective("barrier", None, time.perf_counter(),
+                                0.001, 0)
+        srv = ops_server.OpsServer(port=0, host="127.0.0.1").start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/collectives",
+                timeout=5).read()
+            doc = json.loads(body)
+            assert doc["axes"] == {"dp": 2, "tp": 2}
+            inv = doc["inventory"]["fleet.train_step"]
+            assert {op["axis"] for op in inv} == {"dp", "tp", "dp+tp"}
+            assert doc["summary"]["fleet.train_step"]["tp"]["bytes"] \
+                == 294912.0
+            assert doc["eager_tail"][-1]["name"] == "barrier"
+        finally:
+            srv.stop()
+
+
+# -- compiled-HLO end-to-end: real dp×tp program ------------------------------
+
+
+class TestCompiledInventory:
+    def test_real_mesh_program_maps_axes(self, monkeypatch):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from paddle_tpu.profiler.retrace import tracked_jit
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs the 8-device CPU host")
+        monkeypatch.setenv("PADDLE_TPU_COST_ANALYSIS", "full")
+        get_telemetry().reset()
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("dp", "tp"))
+        collective_attrib.register_mesh(mesh)
+        xsh = NamedSharding(mesh, P("dp", "tp"))
+        step = tracked_jit(lambda x: (x * 2.0).sum(),
+                           name="unit.allsum", in_shardings=xsh,
+                           out_shardings=NamedSharding(mesh, P()))
+        x = jax.device_put(np.ones((8, 8), np.float32), xsh)
+        np.asarray(step(x))
+        inv = collective_attrib.inventory().get("unit.allsum", [])
+        assert inv, "compiled dp×tp program yielded no collectives"
+        axes = {op.axis for op in inv}
+        assert axes & {"dp", "tp", "dp+tp", "tp+dp"}
+        assert all(op.bytes >= 0 for op in inv)
+
+    def test_fleet_engine_registers_mesh(self):
+        import jax
+        from jax.sharding import Mesh
+
+        from paddle_tpu import nn
+        from paddle_tpu.distributed.fleet.engine import ParallelTrainStep
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the 8-device CPU host")
+        get_telemetry().reset()
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters())
+        mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+        ParallelTrainStep(net, loss_fn=lambda o, y: ((o - y) ** 2).mean(),
+                          optimizer=opt, mesh=mesh)
+        assert collective_attrib.registered_axes() == {"dp": 2}
+
+
+# -- the gate, end to end (slow) ----------------------------------------------
+
+
+@pytest.mark.slow
+class TestGateEndToEnd:
+    def test_gate(self, tmp_path):
+        import check_cluster_timeline as gate
+
+        ok, detail, payload = gate.run_demo(str(tmp_path), steps=8,
+                                            stall_step=5, stall_s=0.75)
+        assert ok, detail
+        assert payload["injected"]["late_ranks"][0]["rank"] == 1
+        assert payload["clean"]["late_ranks"] == []

@@ -95,6 +95,17 @@ JAX_PLATFORMS=cpu python tools/check_serving.py
 # clean phase raises zero alerts.
 JAX_PLATFORMS=cpu python tools/check_ops_server.py
 
+# cluster-timeline gate: the cross-rank twin of the ops plane — a
+# 2-process run with a rank-scoped injected stall (slow_rank@5:1:…)
+# must produce a LATE-RANK finding naming the stalled rank ("rank 1
+# late 750 ms into all_gather_object #5"), the per-rank trace/
+# collective/clock artifacts must fuse into ONE chrome timeline with
+# per-rank tracks, flow arrows, and monotonic aligned timestamps, the
+# clean run must raise ZERO findings, and the static per-axis collective
+# inventory (compiled dp×tp HLO → gauge/collective/<axis>/*) must pass
+# the schema gate — all with zero new retraces.
+JAX_PLATFORMS=cpu python tools/check_cluster_timeline.py
+
 # decode gate: the token-level twin — paged-KV greedy decode must be
 # token-identical to the dense recompute-the-prefix reference (logits
 # within tolerance), and a mixed prefill+decode load with injected

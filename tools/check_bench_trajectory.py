@@ -14,7 +14,8 @@ On a regression the gate does not just name the metric: it names the
 **suspect** from the attribution delta — which entry's numbers moved
 between the baseline row and the candidate row (``mfu_measured_pct``,
 ``hbm_gbps_achieved``, ``compile_*``, the ``profile_*_frac`` device
-decomposition columns, step-time) — so the failure message says
+decomposition columns, the per-axis ``collective_<axis>_{bytes,ms,
+count}`` columns, step-time) — so the failure message says
 *"decode tokens/s -18%, suspect serve.decode.b8: profile_host_gap_frac
 0.12 → 0.55"* instead of a bare number. With ``--telemetry`` and
 ``--prev-telemetry`` the per-entry ``hist/*step_ms/p50`` and
@@ -52,6 +53,12 @@ _ATTRIB_COLUMNS = (
     "profile_compute_frac", "profile_collective_frac",
     "profile_transfer_frac", "profile_host_gap_frac",
 )
+# the per-axis collective columns (collective_<axis>_{bytes,ms,count} —
+# axis names are mesh-dependent, so matched by pattern) are attribution
+# movers too: a regression whose dp all-reduce ms doubled should name
+# that, not a generic fraction
+_COLLECTIVE_COLUMN_RE = re.compile(
+    r"^collective_[a-z+]+_(bytes|ms|count)$")
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -131,7 +138,9 @@ def attribution_suspect(base_row, cand_row):
     """The biggest relative mover among the attribution columns of the
     two rows, as ``(entry, 'column a -> b (xR)')`` or None."""
     moves = []
-    for col in _ATTRIB_COLUMNS:
+    dynamic = sorted(col for col in set(base_row) | set(cand_row)
+                     if _COLLECTIVE_COLUMN_RE.match(str(col)))
+    for col in tuple(_ATTRIB_COLUMNS) + tuple(dynamic):
         b, c = base_row.get(col), cand_row.get(col)
         if not isinstance(b, (int, float)) or not isinstance(c, (int, float)):
             continue
@@ -175,7 +184,8 @@ def _bench_scalars(path, metric):
                     if (re.match(r"^hist/.*step_ms/p50$", k)
                             or k.startswith("gauge/profile/")
                             or k.startswith("gauge/mfu/")
-                            or k.startswith("gauge/bottleneck/")):
+                            or k.startswith("gauge/bottleneck/")
+                            or k.startswith("gauge/collective/")):
                         if isinstance(v, (int, float)):
                             out[k] = float(v)
     except OSError:

@@ -1,0 +1,356 @@
+#!/usr/bin/env python
+"""Cluster-timeline smoke gate — cross-rank trace fusion and late-rank
+blame are exercised, not claimed.
+
+End-to-end on the CPU backend against the REAL runtime (tracked_jit
+engines + StepGuard + ``distributed.launch`` + the eager-collective
+recorder + fault injection, no mocks):
+
+1. **static per-axis inventory** (in-gate, 8-device CPU host): a dp×tp
+   mesh program is compiled through ``tracked_jit`` (full cost-analysis
+   mode), ``profiler.collective_attrib`` walks the stashed HLO and must
+   map its all-reduces onto BOTH named axes; the published
+   ``gauge/collective/<axis>/{bytes,count}.<entry>`` record must pass
+   the telemetry schema gate — the laneless degrade path that needs no
+   device capture;
+2. **clean 2-process run**: each rank trains a tiny seeded step loop
+   with a per-step ``all_gather_object`` sync (the fs transport — the
+   no-sockets CPU topology), records its collective log + chrome trace
+   + barrier-echo clock handshake; ``cluster_trace.analyze`` must
+   produce ZERO late-rank findings, and the merged chrome trace must
+   parse with monotonic aligned timestamps, one process track per rank,
+   and collective flow arrows;
+3. **injected run**: the same job under
+   ``PADDLE_TPU_INJECT="slow_rank@<step>:1:<secs>"`` — exactly rank 1
+   stalls at one step boundary. The skew analysis must name rank 1 late
+   into the right collective instance by roughly the injected stall,
+   and ``telemetry_agg --fail-on-late-rank`` semantics
+   (``aggregate.detect_late_ranks``) must fail on it;
+4. the per-rank telemetry must carry ``gauge/collective/*`` (eager
+   recorder totals) passing the schema gate, and the run must stay
+   within the retrace budget (capture/merge is host-side only — zero
+   new retraces).
+
+Gate conventions per tools/_gate.py (``cluster timeline: OK|FAIL —
+...``, exit 0/1, ``--json``). Wired into tools/bench_ritual.sh after
+check_ops_server.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import textwrap
+
+# the static-inventory phase wants a multi-device CPU host; must land
+# before jax initializes (the gate imports jax lazily inside run_demo,
+# but set it first thing to be safe against transitive imports)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+os.environ.setdefault("PADDLE_TPU_COST_ANALYSIS", "full")
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_TOOLS)
+sys.path.insert(0, _TOOLS)
+if _REPO not in sys.path:  # runnable from anywhere, not just the repo root
+    sys.path.insert(1, _REPO)
+from _gate import add_gate_args, finish  # noqa: E402
+
+# The demo worker: a tiny seeded guarded train loop with ONE eager
+# collective per step (the cluster synchronization point the timeline
+# names the late rank from), plus the three per-rank artifacts the
+# offline fusion consumes: the collective log (recorder env), the clock
+# handshake, and the rank-stamped chrome trace.
+WORKER = textwrap.dedent("""
+    import json, os
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.communication import all_gather_object
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.profiler import cluster_trace
+    from paddle_tpu.resilience import RecoveryPolicy, StepGuard
+    from paddle_tpu.utils.profiler import (export_chrome_tracing,
+                                           start_profiler)
+
+    STEPS = int(os.environ["DEMO_STEPS"])
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    out = os.environ["DEMO_OUT"]
+    rdv = os.environ["DEMO_RENDEZVOUS"]
+
+    start_profiler(device_trace=False)  # host-only window for the export
+    paddle.seed(0)
+    net = nn.Linear(8, 4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    step = TrainStep(net, lambda o, y: ((o - y) ** 2).mean(), opt,
+                     guard_updates=True)
+    guard = StepGuard(step, RecoveryPolicy(quarantine_dir=None))
+    rng = np.random.RandomState(0)
+    xs = rng.randn(STEPS, 16, 8).astype("float32")
+    ys = rng.randn(STEPS, 16, 4).astype("float32")
+    for i in range(STEPS):
+        loss = guard((xs[i],), (ys[i],))
+        # per-step cluster sync: a rank stalled at the boundary above
+        # arrives LATE here while its peer waits inside the gather
+        all_gather_object(float(np.asarray(loss._value)), key=f"step{i}",
+                          rendezvous_dir=rdv, poll_s=0.01, timeout_s=120.0)
+    # barrier-echo clock handshake near the window being analyzed
+    cluster_trace.clock_handshake(out, rendezvous_dir=rdv)
+    export_chrome_tracing(os.path.join(out, f"trace.rank{rank}.json"))
+""")
+
+
+def _static_inventory_phase(workdir):
+    """Compile a dp×tp program and prove the per-axis static inventory
+    + schema-clean gauges. Returns (ok, detail, payload)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.profiler import collective_attrib, get_telemetry
+    from paddle_tpu.profiler.retrace import tracked_jit
+
+    if len(jax.devices()) < 4:
+        return False, "needs >= 4 CPU devices (XLA_FLAGS not applied?)", {}
+    tel = get_telemetry()
+    tel.reset()
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    collective_attrib.register_mesh(mesh)
+    xsh = NamedSharding(mesh, P("dp", "tp"))
+    # a full cross-mesh sum lowers to one all-reduce per axis on this
+    # toolchain (partial sums combine axis-by-axis), so the inventory
+    # must see BOTH dp and tp — or a flattened dp+tp group on toolchains
+    # that fuse them; either way every axis token is dp/tp-derived
+    step = tracked_jit(lambda x: (x * 2.0).sum(), name="gate.allsum",
+                       in_shardings=xsh,
+                       out_shardings=NamedSharding(mesh, P()))
+    x = jax.device_put(np.ones((8, 8), np.float32), xsh)
+    np.asarray(step(x))
+    inv = collective_attrib.inventory().get("gate.allsum", [])
+    if not inv:
+        return False, ("static inventory empty — the compiled dp×tp "
+                       "program's collectives were not walked"), {}
+    axes = {op.axis for op in inv}
+    derived = {"dp", "tp", "dp+tp", "tp+dp"}
+    if not axes & derived:
+        return False, (f"no collective mapped onto the dp/tp mesh axes "
+                       f"(got {sorted(axes)})"), {"axes": sorted(axes)}
+    if any(op.bytes < 0 for op in inv):
+        return False, "negative bytes in the inventory", {}
+    tables = collective_attrib.publish_static(tel)
+    jsonl = os.path.join(workdir, "static-inventory.jsonl")
+    tel.to_jsonl(jsonl, tag="cluster_timeline_static")
+
+    from check_telemetry_schema import validate_file
+
+    n, err = validate_file(jsonl, require_prefix=["gauge/collective/"])
+    if err:
+        return False, f"static gauges failed the schema gate: {err}", {}
+    payload = {"axes": sorted(axes),
+               "ops": [op.opcode for op in inv],
+               "tables": tables.get("gate.allsum", {})}
+    return True, (f"{len(inv)} collective(s) mapped onto "
+                  f"{sorted(axes)}"), payload
+
+
+def _run(workdir, tag, steps, inject=None, tel_path=None):
+    """One 2-process launch; returns (rc, out_dir)."""
+    from paddle_tpu.distributed.launch import launch
+
+    worker = os.path.join(workdir, "worker.py")
+    with open(worker, "w") as f:
+        f.write(WORKER)
+    sub = os.path.join(workdir, tag)
+    out = os.path.join(sub, "artifacts")
+    os.makedirs(out, exist_ok=True)
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",  # one CPU device per rank, not the test 8-dev host
+        "PYTHONPATH": _REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "PADDLE_TPU_TELEMETRY": "1",
+        "PADDLE_TPU_COST_ANALYSIS": "1",  # no full-compile tax per rank
+        "PADDLE_TPU_COLLECTIVE_LOG": os.path.join(out, "collectives.jsonl"),
+        "DEMO_STEPS": str(steps),
+        "DEMO_OUT": out,
+        "DEMO_RENDEZVOUS": os.path.join(sub, "rendezvous"),
+    }
+    if inject:
+        env["PADDLE_TPU_INJECT"] = inject
+        env["PADDLE_TPU_INJECT_STATE"] = os.path.join(sub, "inject-state")
+    rc = launch(worker, [], nproc_per_node=2,
+                log_dir=os.path.join(sub, "logs"), backend="cpu",
+                extra_env=env,
+                telemetry_jsonl=tel_path or os.path.join(out,
+                                                         "telemetry.jsonl"))
+    return rc, out
+
+
+def _check_merged_trace(merged_path):
+    """The merged-trace contract: parses, one track per rank with
+    process_name metadata, collective flow arrows, and monotonic
+    timestamps. Returns an error string or None."""
+    try:
+        with open(merged_path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return f"merged trace unreadable: {e}"
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        return "merged trace has no traceEvents"
+    pids = {e.get("pid") for e in events if e.get("ph") == "X"}
+    if not {0, 1} <= pids:
+        return f"merged trace lacks per-rank tracks (pids {sorted(pids)})"
+    named = {e.get("pid") for e in events
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    if not {0, 1} <= named:
+        return f"process_name metadata missing for ranks (got {named})"
+    if not any(e.get("ph") in ("s", "f") for e in events):
+        return "no collective flow arrows in the merged trace"
+    last = None
+    for e in events:
+        if e.get("ph") == "M":
+            continue
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)):
+            return f"event without numeric ts: {e.get('name')!r}"
+        if last is not None and ts < last - 1e-6:
+            return (f"timestamps not monotonic after alignment "
+                    f"({ts} after {last})")
+        last = ts
+    return None
+
+
+def run_demo(workdir, steps=8, stall_step=5, stall_s=0.75,
+             late_ms=100.0):
+    """Returns (ok, detail, payload)."""
+    from paddle_tpu.profiler import cluster_trace
+    from paddle_tpu.profiler.aggregate import detect_late_ranks
+
+    ok, detail, static_payload = _static_inventory_phase(workdir)
+    if not ok:
+        return False, f"static inventory: {detail}", static_payload
+    payload = {"static": static_payload}
+
+    # 1. clean 2-process reference: zero findings, mergeable timeline
+    rc, clean_out = _run(workdir, "clean", steps)
+    if rc != 0:
+        return False, f"clean run failed rc={rc}", payload
+    clean_merged = os.path.join(workdir, "clean-merged.json")
+    clean = cluster_trace.analyze(clean_out, threshold_ms=late_ms,
+                                  merged_path=clean_merged)
+    payload["clean"] = {"n_instances": clean["n_instances"],
+                        "late_ranks": clean["late_ranks"],
+                        "offsets": clean["offsets"]}
+    if clean["n_instances"] < steps:
+        return False, (f"clean run fused only {clean['n_instances']} "
+                       f"collective instance(s), expected >= {steps} — "
+                       f"the recorder or the fusion lost events"), payload
+    if not clean["offsets_estimated"]:
+        return False, "clock handshake left no offset estimate", payload
+    if clean["late_ranks"]:
+        return False, (f"FALSE POSITIVE: clean lockstep run flagged "
+                       f"{clean['late_ranks']}"), payload
+    err = _check_merged_trace(clean_merged)
+    if err:
+        return False, f"clean merged trace: {err}", payload
+
+    # 2. rank-scoped injected stall on rank 1
+    inject = f"slow_rank@{stall_step}:1:{stall_s}"
+    rc, inj_out = _run(workdir, "injected", steps, inject=inject)
+    if rc != 0:
+        return False, f"injected run failed rc={rc}", payload
+    inj_merged = os.path.join(workdir, "injected-merged.json")
+    inj = cluster_trace.analyze(inj_out, threshold_ms=late_ms,
+                                merged_path=inj_merged)
+    payload["injected"] = {"n_instances": inj["n_instances"],
+                           "late_ranks": inj["late_ranks"]}
+    findings = inj["late_ranks"]
+    if not findings:
+        return False, (f"injected {inject} produced NO late-rank "
+                       f"finding — the stalled rank is invisible"), payload
+    if [f["rank"] for f in findings] != [1]:
+        return False, (f"wrong blame: expected exactly rank 1, got "
+                       f"{[f['rank'] for f in findings]}"), payload
+    worst = findings[0]["worst"]
+    if worst["skew_ms"] < stall_s * 1e3 * 0.5:
+        return False, (f"skew {worst['skew_ms']:.0f} ms names rank 1 but "
+                       f"is far below the injected {stall_s * 1e3:.0f} ms "
+                       f"stall — the clock alignment is off"), payload
+    # the stall fires at the step-{stall_step} boundary, so the late
+    # arrival is into that step's collective (the startup instance and
+    # any handshake rounds must not soak it up)
+    if worst["seq"] != stall_step:
+        return False, (f"blamed instance #{worst['seq']}, expected the "
+                       f"step-{stall_step} collective"), payload
+    # the aggregate/telemetry_agg surface fails on it (gate mode)
+    if not detect_late_ranks(inj["instances"], late_ms):
+        return False, "aggregate.detect_late_ranks missed the finding", \
+            payload
+    err = _check_merged_trace(inj_merged)
+    if err:
+        return False, f"injected merged trace: {err}", payload
+
+    # 3. per-rank telemetry: eager collective gauges pass the schema
+    # gate; retrace budget unchanged (capture/merge is host-side only)
+    from check_retrace_budget import collect_compile_counters
+    from check_telemetry_schema import validate_file
+
+    for r in (0, 1):
+        tel = os.path.join(inj_out, f"telemetry.rank{r}.jsonl")
+        n, err = validate_file(tel,
+                               require_prefix=["gauge/collective/"])
+        if err:
+            return False, f"rank {r} telemetry: {err}", payload
+    peaks = collect_compile_counters(
+        os.path.join(inj_out, "telemetry.rank0.jsonl"))
+    over = {k: v for k, v in peaks.items() if v > 6}
+    if over:
+        return False, (f"retrace budget exceeded (recording/fusion must "
+                       f"be host-side only): {over}"), payload
+    payload["compile_peaks"] = peaks
+
+    return True, (f"{inject}: rank 1 blamed {worst['skew_ms']:.0f} ms "
+                  f"late into {worst['name']} #{worst['seq']} (axis "
+                  f"{worst['axis']}); clean run {clean['n_instances']} "
+                  f"instances, zero findings; merged traces parse with "
+                  f"per-rank tracks + flow arrows; static dp×tp "
+                  f"inventory mapped {static_payload['axes']}"), payload
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="End-to-end cluster-timeline smoke gate (a rank-"
+                    "scoped injected stall on a 2-process CPU run must "
+                    "produce a LATE-RANK finding naming that rank, and "
+                    "the per-rank artifacts must fuse into one parseable "
+                    "aligned chrome trace)")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--stall-step", type=int, default=5)
+    ap.add_argument("--stall-s", type=float, default=0.75)
+    ap.add_argument("--late-ms", type=float, default=100.0)
+    ap.add_argument("--workdir", default=None,
+                    help="keep artifacts here instead of a temp dir")
+    add_gate_args(ap)
+    args = ap.parse_args(argv)
+    if args.workdir:
+        os.makedirs(args.workdir, exist_ok=True)
+        ok, detail, payload = run_demo(args.workdir, args.steps,
+                                       args.stall_step, args.stall_s,
+                                       args.late_ms)
+    else:
+        with tempfile.TemporaryDirectory(prefix="cluster-timeline-") as d:
+            ok, detail, payload = run_demo(d, args.steps, args.stall_step,
+                                           args.stall_s, args.late_ms)
+    return finish("cluster timeline", ok, detail, payload=payload,
+                  json_mode=args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

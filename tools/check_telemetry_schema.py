@@ -76,6 +76,23 @@ tables) must be well-formed: ``top_ops``/``top_lines`` lists whose rows
 carry a non-empty op/src, a category from the closed set, non-negative
 ``ms``/``ms_per_step``, and ``frac`` ∈ [0, 1].
 
+Per-axis collective contracts (``profiler.collective_attrib`` +
+the eager recorder in ``distributed.communication``): every
+``gauge/collective/<axis>/{bytes,ms,count}.<entry>`` scalar is ≥ 0; the
+``<axis>`` token must come from the registered-axis vocabulary — each
+``+``-joined component in {dp, mp, tp, pp, sp, sharding, world}, or the
+honest ``unmapped`` degrade (an invented axis name means attribution is
+guessing); the field must be one of bytes/ms/count. Cross-field: within
+one record the summed per-axis collective ``ms`` of a captured entry
+must not exceed the same record's ``gauge/profile/device_total_ms`` —
+collectives are a subset of the device's captured window (the
+cumulative ``eager`` entry is exempt: it counts process totals, not a
+capture window). The bottleneck verdict vocabulary extension rides the
+same gauges: a ``comm_bound`` verdict (id 2 — the numeric closed set is
+unchanged) whose entry carries per-axis collective gauges reports
+``comm_bound:<axis>`` wherever verdicts are strings (telemetry_agg
+rows, ``bench_all.py`` bottleneck columns).
+
 Token-level serving contracts (``inference.serving.decode``):
 ``gauge/serve/kv_occupancy`` ∈ [0, 1] and
 ``gauge/serve/spec_accept_rate`` ∈ [0, 1] (both are fractions by
@@ -101,6 +118,19 @@ from _gate import add_gate_args, finish  # noqa: E402
 BOTTLENECK_IDS = {0, 1, 2, 3, 4}
 _PROFILE_CATEGORIES = {"compute", "collective", "transfer"}
 _FRAC_CATEGORIES = _PROFILE_CATEGORIES | {"host_gap"}
+# profiler.collective_attrib's registered-axis vocabulary (keep in
+# sync with KNOWN_AXIS_TOKENS there): "+"-joined components of a
+# multi-axis group each come from this set; "unmapped" stands alone
+_COLLECTIVE_AXIS_TOKENS = {"dp", "mp", "tp", "pp", "sp", "sharding",
+                           "world"}
+_COLLECTIVE_FIELDS = {"bytes", "ms", "count"}
+
+
+def _collective_axis_ok(axis):
+    if axis == "unmapped":
+        return True
+    parts = axis.split("+")
+    return bool(parts) and all(p in _COLLECTIVE_AXIS_TOKENS for p in parts)
 
 
 def _validate_profile_table(profile, lineno):
@@ -248,6 +278,25 @@ def validate_record(rec, lineno):
                 if cat in _FRAC_CATEGORIES and not (0 <= float(value) <= 1):
                     return (f"line {lineno}: scalar {name!r} = {value!r} "
                             f"outside [0, 1] (a fraction of window wall)")
+        # per-axis collective attribution: non-negative quantities under
+        # an axis token from the registered vocabulary — an invented
+        # axis or field name means attribution is guessing
+        if name.startswith("gauge/collective/"):
+            rest = name[len("gauge/collective/"):]
+            axis, sep, tail = rest.partition("/")
+            field = tail.split(".", 1)[0]
+            if not sep or field not in _COLLECTIVE_FIELDS:
+                return (f"line {lineno}: scalar {name!r} malformed — "
+                        f"expected gauge/collective/<axis>/"
+                        f"{{bytes,ms,count}}.<entry>")
+            if not _collective_axis_ok(axis):
+                return (f"line {lineno}: scalar {name!r} axis {axis!r} "
+                        f"outside the registered-axis vocabulary "
+                        f"{sorted(_COLLECTIVE_AXIS_TOKENS)} "
+                        f"(+-joined) / 'unmapped'")
+            if float(value) < 0:
+                return (f"line {lineno}: scalar {name!r} = {value!r} "
+                        f"is negative (collective bytes/ms/count)")
         # bottleneck verdicts come from a CLOSED vocabulary — any other
         # value means a producer invented a verdict the dashboards and
         # gates cannot name
@@ -329,6 +378,30 @@ def validate_record(rec, lineno):
             return (f"line {lineno}: profile fractions for entry "
                     f"{entry!r} sum to {total:.6f} > 1 — the "
                     f"decomposition double-counts the window")
+    # cross-field: a captured entry's summed per-axis collective ms is a
+    # SUBSET of the captured device window — it cannot exceed the same
+    # record's device total. The cumulative "eager" entry is exempt
+    # (process totals, not a window).
+    device_total = scalars.get("gauge/profile/device_total_ms")
+    if device_total is not None:
+        comm_sums = {}
+        for name, value in scalars.items():
+            if not name.startswith("gauge/collective/"):
+                continue
+            rest = name[len("gauge/collective/"):]
+            axis, _, tail = rest.partition("/")
+            if not tail.startswith("ms."):
+                continue
+            entry = tail[len("ms."):]
+            if entry == "eager":
+                continue
+            comm_sums[entry] = comm_sums.get(entry, 0.0) + float(value)
+        for entry, total in comm_sums.items():
+            if total > float(device_total) * (1 + 1e-6) + 1e-9:
+                return (f"line {lineno}: collective ms for entry "
+                        f"{entry!r} sum to {total:.6f} > captured "
+                        f"device total {float(device_total):.6f} ms — "
+                        f"the per-axis join double-counts the window")
     # structured top-K table (device_profile captures attach it)
     if "profile" in rec:
         err = _validate_profile_table(rec["profile"], lineno)

@@ -30,6 +30,16 @@ record at exit). This tool merges them (paddle_tpu.profiler.aggregate):
   finding fail the run (gate mode) — a load test that tripped a burn
   alert shipped a user-visible degradation even if the medians look
   fine.
+- **late-rank detection**: when the log dir also holds the cluster-
+  timeline artifacts (``collectives.rank<i>.jsonl`` eager-collective
+  logs + ``clock.rank<i>.json`` handshakes — ``profiler.cluster_trace``),
+  per-collective-instance arrival skews are computed and a rank arriving
+  more than ``--late-ms`` (default 100) late into any instance is
+  reported as a LATE-RANK finding naming the instance ("rank 1 late
+  741 ms into all_gather_object #5, axis world"); ``--fail-on-late-rank``
+  makes any such finding fail the run (gate mode). Straggler findings
+  additionally cite per-axis collective evidence
+  (``gauge/collective/<axis>/ms.*``) when the flagged rank recorded it.
 
 Usage:
     python tools/telemetry_agg.py LOG_DIR              # telemetry.rank*.jsonl
@@ -39,13 +49,16 @@ Usage:
     python tools/telemetry_agg.py LOG_DIR --expect-ranks 4      # dead ranks
     python tools/telemetry_agg.py LOG_DIR --fail-on-suspect     # bad chips
     python tools/telemetry_agg.py LOG_DIR --fail-on-alert       # SLO burns
+    python tools/telemetry_agg.py LOG_DIR --fail-on-late-rank --late-ms 100
 
 Exit code 0; with ``--fail-on-straggler``, 1 when any rank is flagged;
 with ``--expect-ranks N``, 1 when any expected rank left no usable
 telemetry (asking for N ranks IS the check); with ``--fail-on-suspect``,
 1 when any rank's repair count exceeds the threshold; with
-``--fail-on-alert``, 1 when any rank carries a fired SLO burn alert.
-``--json`` emits the full aggregate object.
+``--fail-on-alert``, 1 when any rank carries a fired SLO burn alert;
+with ``--fail-on-late-rank``, 1 when any rank arrives > ``--late-ms``
+late into any collective instance. ``--json`` emits the full aggregate
+object.
 """
 from __future__ import annotations
 
@@ -58,20 +71,22 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _load_aggregate():
-    """Load profiler/aggregate.py by path: it is dependency-free (no
-    jax), and importing it through the package would drag the whole
-    framework (and a jax init) into a file-munching CLI."""
+def _load_by_path(fname, modname):
+    """Load a profiler module by path: aggregate.py and cluster_trace.py
+    are dependency-free (no jax), and importing them through the package
+    would drag the whole framework (and a jax init) into a file-munching
+    CLI."""
     import importlib.util
 
-    path = os.path.join(_REPO, "paddle_tpu", "profiler", "aggregate.py")
-    spec = importlib.util.spec_from_file_location("_ptpu_aggregate", path)
+    path = os.path.join(_REPO, "paddle_tpu", "profiler", fname)
+    spec = importlib.util.spec_from_file_location(modname, path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
 
 
-agg = _load_aggregate()
+agg = _load_by_path("aggregate.py", "_ptpu_aggregate")
+cluster_trace = _load_by_path("cluster_trace.py", "_ptpu_cluster_trace")
 
 # scalars worth a per-rank column when present (everything else is still
 # in --json / the min-median-max view)
@@ -159,15 +174,43 @@ def format_report(result) -> str:
         for b in bottlenecks:
             lines.append(f"  rank {b['rank']}: {b['entry']} -> "
                          f"{b['verdict']}")
+    late = result.get("late_ranks")
+    if late:
+        lines.append(f"LATE RANKS (> {result.get('late_ms', 100):.0f} ms "
+                     f"arrival skew into a collective instance):")
+        for f in late:
+            w = f["worst"]
+            lines.append(
+                f"  rank {f['rank']} late {w['skew_ms']:.0f} ms into "
+                f"{w['name']} #{w['seq']}, axis {w['axis']} "
+                f"({f['late_instances']} late instance(s)) — every peer "
+                f"sat idle inside the collective waiting for this rank")
+    elif result.get("late_rank_analysis_skipped"):
+        lines.append("late ranks: analysis skipped — "
+                     + result["late_rank_analysis_skipped"])
+    elif "late_ranks" in result:
+        lines.append("late ranks: none")
     stragglers = result["stragglers"]
     if stragglers:
         lines.append(f"stragglers (> {result['threshold']:.2f}x cluster "
                      f"median step-latency p50):")
         for s in stragglers:
-            lines.append(
+            msg = (
                 f"  rank {s['rank']}: {s['metric']} = {s['value']:.2f} ms "
                 f"({s['ratio']:.2f}x the cluster median "
                 f"{s['cluster_median']:.2f} ms)")
+            if s.get("collective_axis"):
+                if s.get("collective_entry") == "eager":
+                    msg += (f" — collective evidence: "
+                            f"{s['collective_ms']:.2f} ms cumulative in "
+                            f"eager axis-{s['collective_axis']} "
+                            f"collectives")
+                else:
+                    msg += (f" — collective evidence: axis "
+                            f"{s['collective_axis']} ate "
+                            f"{s['collective_ms']:.2f} ms of the captured "
+                            f"window ({s.get('collective_entry', '?')})")
+            lines.append(msg)
     else:
         lines.append("stragglers: none")
     return "\n".join(lines)
@@ -204,6 +247,17 @@ def main(argv=None):
     ap.add_argument("--fail-on-alert", action="store_true",
                     help="exit 1 when any rank carries a fired SLO "
                          "burn-rate alert (counter/alert/* > 0; gate mode)")
+    ap.add_argument("--collectives-dir", default=None,
+                    help="directory holding collectives.rank*.jsonl + "
+                         "clock.rank*.json cluster-timeline artifacts "
+                         "(default: the first directory among PATHS)")
+    ap.add_argument("--late-ms", type=float, default=100.0,
+                    help="arrival skew into a collective instance above "
+                         "which a rank is a LATE-RANK finding "
+                         "(default 100)")
+    ap.add_argument("--fail-on-late-rank", action="store_true",
+                    help="exit 1 when any rank arrives > --late-ms late "
+                         "into any collective instance (gate mode)")
     args = ap.parse_args(argv)
     paths = _resolve_paths(args.paths)
     if not paths:
@@ -220,6 +274,26 @@ def main(argv=None):
     result = agg.aggregate(paths, threshold=args.threshold, tag=args.tag,
                            expected_ranks=args.expect_ranks,
                            suspect_repairs=args.suspect_repairs)
+    # cluster-timeline late-rank analysis rides along when the job left
+    # its collective/clock artifacts next to the telemetry logs
+    coll_dir = args.collectives_dir or next(
+        (p for p in args.paths if os.path.isdir(p)), None)
+    late_unverifiable = None  # reason the gate flag could not verify
+    if coll_dir and glob.glob(os.path.join(coll_dir,
+                                           "collectives.rank*.jsonl")):
+        timeline = cluster_trace.analyze(coll_dir,
+                                         threshold_ms=args.late_ms)
+        result["late_ranks"] = timeline["late_ranks"]
+        result["late_ms"] = args.late_ms
+        result["collective_instances"] = timeline["n_instances"]
+        result["clock_offsets"] = timeline["offsets"]
+        late_unverifiable = timeline.get("late_rank_analysis_skipped")
+        if late_unverifiable:
+            result["late_rank_analysis_skipped"] = late_unverifiable
+    elif args.fail_on_late_rank:
+        late_unverifiable = (f"no collectives.rank*.jsonl under "
+                             f"{coll_dir or args.paths} — arm the "
+                             f"recorder with PADDLE_TPU_COLLECTIVE_LOG")
     if not result["n_ranks"] and not result.get("dead_ranks"):
         print("telemetry aggregate: no parsable records in "
               + ", ".join(paths), file=sys.stderr)
@@ -234,6 +308,14 @@ def main(argv=None):
         return 1
     if args.fail_on_alert and result.get("slo_burns"):
         return 1
+    if args.fail_on_late_rank:
+        if late_unverifiable:
+            # a gate flag that verified nothing must not report success
+            print(f"telemetry aggregate: --fail-on-late-rank could not "
+                  f"verify: {late_unverifiable}", file=sys.stderr)
+            return 1
+        if result.get("late_ranks"):
+            return 1
     if result.get("dead_ranks"):
         return 1
     return 0
