@@ -39,6 +39,11 @@ import time
 # and absorbed entirely by the persistent compilation cache where
 # configured. The env wins if the rig already set a mode.
 os.environ.setdefault("PADDLE_TPU_COST_ANALYSIS", "full")
+# bench runs also lint every compiled program (analysis.hlo H-rules):
+# the counter/hlolint/findings.* counters ride each config's telemetry
+# record, and the HLO_SNAPSHOTS/ dump below feeds the offline
+# tools/hlo_lint.py ratchet gate in bench_ritual.sh
+os.environ.setdefault("PADDLE_TPU_HLO_LINT", "1")
 
 import jax
 import jax.numpy as jnp
@@ -692,6 +697,47 @@ def bench_decode():
             "kv_evictions": int(evictions)}
 
 
+def _dump_hlo_snapshots(config_name):
+    """Write every program this config compiled to
+    ``HLO_SNAPSHOTS/<config>/<entry>.hlo.txt`` plus a ``MANIFEST.json``
+    carrying the compile-time context (registered mesh, amp policy) —
+    the corpus tools/hlo_lint.py self-runs over in bench_ritual.sh.
+    Free under PADDLE_TPU_COST_ANALYSIS=full (the text was stashed at
+    compile time); best-effort like every attribution surface."""
+    import shutil
+
+    from paddle_tpu.profiler import collective_attrib, xla_cost
+
+    try:
+        texts = xla_cost.hlo_texts()
+        if not texts:
+            return
+        bf16 = False
+        try:
+            from paddle_tpu.amp.auto_cast import amp_state
+
+            st = amp_state()
+            bf16 = bool(st.enabled) and "float16" in str(st.dtype)
+        except Exception:
+            pass
+        d = os.path.join("HLO_SNAPSHOTS", config_name)
+        shutil.rmtree(d, ignore_errors=True)  # no stale entries linger
+        os.makedirs(d, exist_ok=True)
+        for entry, text in sorted(texts.items()):
+            safe = entry.replace("/", "_")
+            with open(os.path.join(d, safe + ".hlo.txt"), "w") as f:
+                f.write(text)
+        with open(os.path.join(d, "MANIFEST.json"), "w") as f:
+            json.dump({"config": config_name,
+                       "mesh": collective_attrib.registered_axes(),
+                       "bf16_policy": bf16,
+                       "entries": sorted(texts)}, f, indent=1)
+            f.write("\n")
+    except Exception as e:
+        print(f"hlo snapshot dump failed for {config_name}: {e}",
+              file=sys.stderr)
+
+
 def _merge_telemetry_record(tel, tag, extra, step):
     """Replace THIS config's record in TELEMETRY.jsonl, keeping every
     other config's — a subset run (`bench_all.py serving`) must not
@@ -797,6 +843,15 @@ def main():
         fracs = device_profile.publish(tel).get(head_entry or "", {})
         for cat, v in fracs.items():
             r.setdefault(f"profile_{cat}", round(float(v), 4))
+        # hlo-lint: the compile-time hook counted findings per rule as
+        # this config's programs compiled; the total is an attribution
+        # mover for check_bench_trajectory (a regression that arrived
+        # with new lint findings names them as the suspect), and the
+        # snapshot dump feeds the offline ratchet gate in bench_ritual
+        r["hlolint_findings"] = sum(
+            v for k, v in tel.counter_scalars().items()
+            if k.startswith("counter/hlolint/findings."))
+        _dump_hlo_snapshots(name)
         print(json.dumps(r), flush=True)
         # machine-readable telemetry, one record per config written the
         # moment the config finishes — its gauge/compile/* and gauge/mfu

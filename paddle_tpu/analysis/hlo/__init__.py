@@ -1,0 +1,42 @@
+"""hlo-lint — post-compile static analysis of optimized HLO programs.
+
+The runtime analogue of tpu-lint's AST pass: where tpu-lint reads the
+*Python source* for tracer hazards before anything compiles, this
+package reads the *compiled artifact* — the optimized HLO text
+``profiler.xla_cost.capture`` already stashes per ``tracked_jit`` entry
+(never a second lowering) — for the hazards only the compiled program
+can show: MXU padding waste, dtype downgrades, layout-change copies,
+host round-trips inside device loops, collective anti-patterns,
+unmapped/missed sharding, and dead fetch outputs (rules H1–H8).
+
+Layout (mirrors the AST side one directory up):
+
+- ``parsing``   — the structured HLO text parser (modules /
+  computations / instructions), the ONE home of the low-level helpers
+  ``profiler.hlo_attrib`` and ``profiler.collective_attrib`` also use;
+- ``axes``      — the pure replica-group → mesh-axis mapper (the
+  framework-facing wrapper with the registered-mesh default lives in
+  ``profiler.collective_attrib``);
+- ``hlo_rules`` — rule metadata + checks (H1–H8);
+- ``analyzer``  — :class:`HloFinding` and :func:`analyze_hlo_text`.
+
+The ratchet store and renderers are shared with tpu-lint
+(``..baseline`` / ``..report``): an :class:`HloFinding` exposes the
+same ``key()`` / ``path`` / ``context`` surface, so the Infer-style
+baseline mechanics needed no second implementation. CLI front end:
+``tools/hlo_lint.py``; opt-in compile-time hook:
+``PADDLE_TPU_HLO_LINT=1`` (see ``profiler.xla_cost``).
+
+Like the rest of ``paddle_tpu/analysis``, this package imports no
+framework and no jax — ``tools/hlo_lint.py`` loads it standalone.
+"""
+from .analyzer import AnalysisContext, HloFinding, analyze_hlo_text
+from .hlo_rules import HLO_RULES
+from .parsing import (HloComputation, HloInstr, HloModule, parse_module,
+                      shape_bytes)
+
+__all__ = [
+    "AnalysisContext", "HloFinding", "analyze_hlo_text", "HLO_RULES",
+    "HloComputation", "HloInstr", "HloModule", "parse_module",
+    "shape_bytes",
+]

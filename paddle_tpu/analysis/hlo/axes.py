@@ -1,0 +1,109 @@
+"""Pure replica-group → mesh-axis mapping.
+
+The math behind PR 13's per-axis collective attribution, hoisted out of
+``profiler.collective_attrib`` so the standalone linter (rules H5/H6)
+can name axes without importing the framework. These functions take the
+mesh explicitly as an ordered ``{axis_name: size}`` dict; the
+framework-facing wrappers in ``profiler.collective_attrib`` keep their
+``registered_axes()`` default on top of these.
+
+Partition ids are assumed row-major over the mesh axis order — jax's
+own device-array layout, which is how GSPMD numbers them. Matching is
+exact set equality: attribution (and lint) never guesses, anything
+non-canonical degrades to :data:`UNMAPPED`.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Tuple
+
+__all__ = ["UNMAPPED", "strides", "expected_groups",
+           "map_groups_to_axes", "map_pairs_to_axis", "expand_world"]
+
+UNMAPPED = "unmapped"
+
+
+def strides(sizes: List[int]) -> List[int]:
+    st = [1] * len(sizes)
+    for i in range(len(sizes) - 2, -1, -1):
+        st[i] = st[i + 1] * sizes[i + 1]
+    return st
+
+
+def expected_groups(axes: Dict[str, int],
+                    subset: Tuple[str, ...]) -> frozenset:
+    """The canonical group set of a collective over ``subset`` of the
+    mesh axes: members vary along the subset, everything else fixed."""
+    names = list(axes)
+    sizes = [axes[n] for n in names]
+    stride = dict(zip(names, strides(sizes)))
+    complement = [n for n in names if n not in subset]
+    groups = []
+    for fixed in itertools.product(*[range(axes[n]) for n in complement]):
+        base = sum(f * stride[n] for n, f in zip(complement, fixed))
+        members = []
+        for var in itertools.product(*[range(axes[n]) for n in subset]):
+            members.append(base + sum(v * stride[n]
+                                      for n, v in zip(subset, var)))
+        groups.append(frozenset(members))
+    return frozenset(groups)
+
+
+def map_groups_to_axes(groups: List[Tuple[int, ...]],
+                       axes: Dict[str, int]) -> str:
+    """The axis label of a replica-group set: the MINIMAL subset of
+    mesh axes whose expected grouping matches exactly ("dp", or "dp+tp"
+    for a flattened multi-axis group), else ``unmapped``."""
+    if not axes or not groups:
+        return UNMAPPED
+    canonical = frozenset(frozenset(g) for g in groups)
+    names = list(axes)
+    # smallest subsets first; ties broken by mesh axis order so a
+    # degenerate (size-1) axis match is deterministic
+    for k in range(1, len(names) + 1):
+        for subset in itertools.combinations(names, k):
+            if expected_groups(axes, subset) == canonical:
+                return "+".join(subset)
+    return UNMAPPED
+
+
+def map_pairs_to_axis(pairs: List[Tuple[int, int]],
+                      axes: Dict[str, int]) -> str:
+    """The axis of a ``collective-permute``: every (source, target) pair
+    must differ along exactly one non-trivial mesh axis — the ring axis
+    of PR 8's sp rotation. Anything else is ``unmapped``."""
+    if not axes or not pairs:
+        return UNMAPPED
+    names = list(axes)
+    sizes = [axes[n] for n in names]
+    stride = strides(sizes)
+
+    def coords(idx: int) -> Tuple[int, ...]:
+        return tuple((idx // stride[i]) % sizes[i]
+                     for i in range(len(names)))
+
+    for i, name in enumerate(names):
+        if sizes[i] <= 1:
+            continue
+        ok = True
+        for s, t in pairs:
+            cs, ct = coords(s), coords(t)
+            if cs[i] == ct[i] or any(cs[j] != ct[j]
+                                     for j in range(len(names)) if j != i):
+                ok = False
+                break
+        if ok:
+            return name
+    return UNMAPPED
+
+
+def expand_world(groups, axes: Dict[str, int]):
+    """XLA's ``replica_groups={}`` is shorthand for ONE group of ALL
+    devices — expand it against the mesh so the global reduction maps to
+    the full axis product instead of degrading to unmapped."""
+    if groups == [] and axes:
+        world = 1
+        for size in axes.values():
+            world *= size
+        return [tuple(range(world))]
+    return groups

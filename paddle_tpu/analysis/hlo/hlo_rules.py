@@ -1,0 +1,468 @@
+"""hlo-lint rules H1–H8: compiled-program hazards the AST linter cannot
+see, keyed to the regressions the ROADMAP chases (padding waste and
+missed sharding for the layout planner, collective anti-patterns from
+the PR 13 axis work, the static-executor host gap).
+
+Each rule is metadata (id, severity, title, fix hint) plus a whole-
+module check over the parsed :class:`~.parsing.HloModule`. Adding a
+rule = one ``Rule`` entry with its check function. Checks are
+best-effort by contract: an instruction whose operands or attributes
+don't resolve is skipped, never guessed at.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import axes as _axes
+from .analyzer import AnalysisContext, HloFinding, make_finding
+from .parsing import (COLLECTIVE_OPCODES, DONE_OPCODES, HloComputation,
+                      HloInstr, HloModule)
+
+__all__ = ["HLO_RULES", "Rule"]
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str
+    title: str
+    hint: str
+    check: Callable[[HloModule, AnalysisContext], List[HloFinding]]
+
+
+# MXU/VPU tiling (pallas guide): lane dim is always 128; the sublane
+# minimum depends on dtype width — f32 tiles (8,128), bf16 (16,128),
+# int8/fp8 (32,128). A dot whose M/N/K sit between tile multiples is
+# silently padded up and the padding FLOPs are real wall-clock.
+_SUBLANE = {"f32": 8, "f16": 16, "bf16": 16, "s8": 32, "u8": 32,
+            "f8e4m3fn": 32, "f8e5m2": 32}
+_LANE = 128
+
+_INDEX_RE = re.compile(r"\bindex=(\d+)")
+_DIM_LABELS_RE = re.compile(r"dim_labels=([\w?]+)_([\w?]+)->([\w?]+)")
+_HOST_TARGET_RE = re.compile(r"host|callback|py_func|cpu_", re.IGNORECASE)
+
+
+def _pad(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+def _prod(vals) -> int:
+    out = 1
+    for v in vals:
+        out *= int(v)
+    return out
+
+
+def _operand_shape(comp: HloComputation, name: str
+                   ) -> Optional[Tuple[str, Tuple[int, ...]]]:
+    instr = comp.by_name().get(name)
+    if instr is None:
+        return None
+    shapes = instr.shapes()
+    return shapes[0] if shapes else None
+
+
+def _dot_mnk(comp: HloComputation, instr: HloInstr
+             ) -> Optional[Tuple[int, int, int, int]]:
+    """(B, M, N, K) of one dot, from its operand shapes and
+    contracting/batch dim attributes; None when anything is missing."""
+    if len(instr.operands) < 2:
+        return None
+    lhs = _operand_shape(comp, instr.operands[0])
+    rhs = _operand_shape(comp, instr.operands[1])
+    if lhs is None or rhs is None:
+        return None
+    ldims, rdims = lhs[1], rhs[1]
+    lcd = instr.attr_dims("lhs_contracting_dims") or ()
+    rcd = instr.attr_dims("rhs_contracting_dims") or ()
+    lbd = instr.attr_dims("lhs_batch_dims") or ()
+    rbd = instr.attr_dims("rhs_batch_dims") or ()
+    if not lcd or max(lcd, default=-1) >= len(ldims) \
+            or max(rcd, default=-1) >= len(rdims) \
+            or max(lbd, default=-1) >= len(ldims) \
+            or max(rbd, default=-1) >= len(rdims):
+        return None
+    k = _prod(ldims[d] for d in lcd)
+    b = _prod(ldims[d] for d in lbd)
+    m = _prod(d for i, d in enumerate(ldims) if i not in lcd and i not in lbd)
+    n = _prod(d for i, d in enumerate(rdims) if i not in rcd and i not in rbd)
+    return b, m, n, k
+
+
+def _conv_mnk(comp: HloComputation, instr: HloInstr
+              ) -> Optional[Tuple[int, int, int, int]]:
+    """(B=1, M, N, K) of one convolution viewed as the implicit GEMM the
+    MXU runs: M = batch x output spatial, K = Cin x kernel spatial,
+    N = Cout — dims located via the dim_labels attribute."""
+    m = _DIM_LABELS_RE.search(instr.body)
+    if not m or len(instr.operands) < 2:
+        return None
+    kernel_labels, out_labels = m.group(2), m.group(3)
+    kernel = _operand_shape(comp, instr.operands[1])
+    out_shapes = instr.shapes()
+    if kernel is None or not out_shapes:
+        return None
+    kdims, odims = kernel[1], out_shapes[0][1]
+    if len(kdims) != len(kernel_labels) or len(odims) != len(out_labels):
+        return None
+    try:
+        cin = kdims[kernel_labels.index("i")]
+        cout = kdims[kernel_labels.index("o")]
+        f_out = out_labels.index("f")
+    except ValueError:
+        return None
+    k_spatial = _prod(d for i, d in enumerate(kdims)
+                      if kernel_labels[i] not in ("i", "o"))
+    m_out = _prod(d for i, d in enumerate(odims) if i != f_out)
+    return 1, m_out, cout, cin * k_spatial
+
+
+def _check_h1(module: HloModule, ctx: AnalysisContext) -> List[HloFinding]:
+    out: List[HloFinding] = []
+    rule = HLO_RULES["H1"]
+    for comp in module.computations.values():
+        for instr in comp.instrs:
+            if instr.opcode == "dot":
+                mnk = _dot_mnk(comp, instr)
+            elif instr.opcode == "convolution":
+                mnk = _conv_mnk(comp, instr)
+            else:
+                continue
+            if mnk is None:
+                continue
+            b, m, n, k = mnk
+            if min(m, n, k) <= 0:
+                continue
+            shapes = instr.shapes()
+            dtype = shapes[0][0] if shapes else "f32"
+            sub = _SUBLANE.get(dtype, 8)
+            pm, pn, pk = _pad(m, sub), _pad(n, _LANE), _pad(k, _LANE)
+            flops = 2.0 * b * m * n * k
+            waste = 1.0 - (m * n * k) / float(pm * pn * pk)
+            if flops < ctx.h1_min_flops or waste < ctx.h1_min_waste:
+                continue
+            out.append(make_finding(
+                rule, ctx, instr,
+                f"{instr.opcode} M×N×K = {m}×{n}×{k} "
+                f"pads to {pm}×{pn}×{pk} "
+                f"({dtype} tile {sub}×{_LANE}): "
+                f"~{waste:.0%} of MXU FLOPs are padding"))
+    return out
+
+
+def _check_h2(module: HloModule, ctx: AnalysisContext) -> List[HloFinding]:
+    out: List[HloFinding] = []
+    rule = HLO_RULES["H2"]
+    for comp in module.computations.values():
+        for instr in comp.instrs:
+            wide = sorted({dt for dt, _ in instr.shapes()
+                           if dt in ("f64", "c128")})
+            if wide:
+                out.append(make_finding(
+                    rule, ctx, instr,
+                    f"{instr.opcode} produces {'/'.join(wide)} — TPU has "
+                    f"no f64 units, this runs emulated or downcast"))
+                continue
+            if ctx.bf16_policy and instr.opcode in ("dot", "convolution"):
+                shapes = instr.shapes()
+                if shapes and shapes[0][0] == "f32":
+                    out.append(make_finding(
+                        rule, ctx, instr,
+                        f"f32 {instr.opcode} compiled while a bf16 "
+                        f"autocast policy is active — this matmul "
+                        f"escaped the policy"))
+    return out
+
+
+def _check_h3(module: HloModule, ctx: AnalysisContext) -> List[HloFinding]:
+    out: List[HloFinding] = []
+    rule = HLO_RULES["H3"]
+    for comp in module.computations.values():
+        for instr in comp.instrs:
+            if instr.opcode not in ("copy", "transpose"):
+                continue
+            nbytes = instr.result_bytes()
+            if nbytes >= ctx.h3_min_bytes:
+                out.append(make_finding(
+                    rule, ctx, instr,
+                    f"layout-change {instr.opcode} moves "
+                    f"{nbytes / (1 << 20):.1f} MiB"))
+    return out
+
+
+_HOST_OPCODES = {"infeed", "outfeed", "send", "recv", "send-done",
+                 "recv-done"}
+
+
+def _check_h4(module: HloModule, ctx: AnalysisContext) -> List[HloFinding]:
+    out: List[HloFinding] = []
+    rule = HLO_RULES["H4"]
+    flagged = set()
+    for comp in module.computations.values():
+        for instr in comp.instrs:
+            if instr.opcode != "while":
+                continue
+            for called in instr.called_computations():
+                for sub in module.reachable_from(called):
+                    for si in sub.instrs:
+                        is_host = si.opcode in _HOST_OPCODES
+                        if not is_host and si.opcode == "custom-call":
+                            target = si.custom_call_target() or ""
+                            is_host = bool(_HOST_TARGET_RE.search(target))
+                        if not is_host or (sub.name, si.name) in flagged:
+                            continue
+                        flagged.add((sub.name, si.name))
+                        what = (si.custom_call_target()
+                                if si.opcode == "custom-call"
+                                else si.opcode)
+                        out.append(make_finding(
+                            rule, ctx, si,
+                            f"{what} inside while body %{sub.name} — "
+                            f"one host round-trip per loop iteration"))
+    return out
+
+
+def _axis_of(instr: HloInstr, mesh: Dict[str, int]) -> str:
+    """The mapped mesh-axis label of one collective instruction (the
+    pure-math twin of collective_attrib's mapping, taking the mesh
+    explicitly)."""
+    if instr.opcode.startswith("collective-permute"):
+        from .parsing import parse_pairs
+
+        return _axes.map_pairs_to_axis(parse_pairs(instr.body) or [], mesh)
+    groups = _axes.expand_world(instr.replica_groups(), mesh)
+    return _axes.map_groups_to_axes(groups or [], mesh)
+
+
+def _check_h5(module: HloModule, ctx: AnalysisContext) -> List[HloFinding]:
+    out: List[HloFinding] = []
+    rule = HLO_RULES["H5"]
+    for comp in module.computations.values():
+        users = comp.users()
+        # (a) all-gather immediately consumed by dynamic-slice: each
+        # device gathers everything then keeps a slice — a reduce-scatter
+        # (or no gather at all) moves 1/shard of the bytes
+        for instr in comp.instrs:
+            if instr.opcode not in ("all-gather", "all-gather-start"):
+                continue
+            consumers = []
+            for u in users.get(instr.name, []):
+                if u.opcode in DONE_OPCODES:
+                    consumers.extend(users.get(u.name, []))
+                else:
+                    consumers.append(u)
+            ds = next((u for u in consumers
+                       if u.opcode == "dynamic-slice"), None)
+            if ds is not None:
+                out.append(make_finding(
+                    rule, ctx, instr,
+                    f"all-gather result is consumed by dynamic-slice "
+                    f"%{ds.name} — a reduce-scatter (or sharded consumer) "
+                    f"would move 1/shard of the bytes"))
+        # (b) same-group all-reduces that could be bucketed into one
+        by_groups: Dict[frozenset, List[HloInstr]] = {}
+        for instr in comp.instrs:
+            if instr.opcode not in ("all-reduce", "all-reduce-start"):
+                continue
+            groups = instr.replica_groups()
+            if groups is None:
+                continue
+            key = frozenset(frozenset(g) for g in groups) or frozenset({()})
+            by_groups.setdefault(key, []).append(instr)
+        for instrs in by_groups.values():
+            if len(instrs) < 2:
+                continue
+            first = instrs[0]
+            axis = (_axis_of(first, ctx.mesh_axes)
+                    if ctx.mesh_axes else None)
+            label = f" on axis {axis}" if axis and axis != _axes.UNMAPPED \
+                else ""
+            out.append(make_finding(
+                rule, ctx, first,
+                f"{len(instrs)} all-reduces over identical replica "
+                f"groups{label} in %{comp.name} — bucket them into one "
+                f"launch (latency is per-launch, not per-byte)"))
+        # (c) a collective inside a while body whose operand is passed
+        # through the loop unchanged recomputes the same result every
+        # iteration — hoist it above the loop
+        for instr in comp.instrs:
+            if instr.opcode != "while":
+                continue
+            for called in instr.called_computations():
+                body = module.computations.get(called)
+                if body is None:
+                    continue
+                out.extend(_invariant_collectives(rule, ctx, body))
+    return out
+
+
+def _invariant_collectives(rule: Rule, ctx: AnalysisContext,
+                           body: HloComputation) -> List[HloFinding]:
+    params = body.params()
+    root = body.root
+    if len(params) != 1 or root is None or root.opcode != "tuple":
+        return []
+    param_name = params[0].name
+    # tuple element j is invariant when the root's j-th operand is a
+    # get-tuple-element(param) of index j — the value rides the loop
+    # carry untouched
+    invariant = set()
+    for instr in body.instrs:
+        if instr.opcode != "get-tuple-element" \
+                or param_name not in instr.operands:
+            continue
+        m = _INDEX_RE.search(instr.body)
+        if not m:
+            continue
+        j = int(m.group(1))
+        if j < len(root.operands) and root.operands[j] == instr.name:
+            invariant.add(instr.name)
+    out = []
+    for instr in body.instrs:
+        if instr.opcode in DONE_OPCODES \
+                or instr.opcode not in COLLECTIVE_OPCODES:
+            continue
+        inv = next((op for op in instr.operands if op in invariant), None)
+        if inv is not None:
+            out.append(make_finding(
+                rule, ctx, instr,
+                f"{instr.opcode} operand %{inv} is loop-invariant "
+                f"(carried through %{body.name} unchanged) — hoist the "
+                f"collective out of the while"))
+    return out
+
+
+def _check_h6(module: HloModule, ctx: AnalysisContext) -> List[HloFinding]:
+    if not ctx.mesh_axes:
+        return []
+    out: List[HloFinding] = []
+    rule = HLO_RULES["H6"]
+    for comp in module.computations.values():
+        for instr in comp.instrs:
+            if instr.opcode in DONE_OPCODES \
+                    or instr.opcode not in COLLECTIVE_OPCODES:
+                continue
+            if _axis_of(instr, ctx.mesh_axes) == _axes.UNMAPPED:
+                out.append(make_finding(
+                    rule, ctx, instr,
+                    f"{instr.opcode} replica groups match no axis of the "
+                    f"registered mesh {ctx.mesh_desc()} — the layout "
+                    f"planner cannot price this collective"))
+    return out
+
+
+def _check_h7(module: HloModule, ctx: AnalysisContext) -> List[HloFinding]:
+    if not any(size > 1 for size in ctx.mesh_axes.values()):
+        return []
+    entry = module.entry_computation()
+    if entry is None:
+        return []
+    out: List[HloFinding] = []
+    rule = HLO_RULES["H7"]
+    for p in entry.params():
+        if p.sharding() != "replicated":
+            continue
+        nbytes = p.result_bytes()
+        if nbytes < ctx.h7_min_bytes:
+            continue
+        out.append(make_finding(
+            rule, ctx, p,
+            f"parameter {p.type_text} ({nbytes / (1 << 20):.1f} MiB) is "
+            f"replicated on every device of mesh {ctx.mesh_desc()} — "
+            f"shard it along a mesh axis"))
+    return out
+
+
+def _check_h8(module: HloModule, ctx: AnalysisContext) -> List[HloFinding]:
+    entry = module.entry_computation()
+    if entry is None:
+        return []
+    root = entry.root
+    if root is None or root.opcode != "tuple":
+        return []
+    out: List[HloFinding] = []
+    rule = HLO_RULES["H8"]
+    by_name = entry.by_name()
+    param_names = {p.name for p in entry.params()}
+
+    def passthrough_of(name: str) -> Optional[str]:
+        """The parameter this output returns unchanged (possibly through
+        the copy XLA inserts for aliased outputs), else None."""
+        if name in param_names:
+            return name
+        instr = by_name.get(name)
+        if instr is not None and instr.opcode == "copy" \
+                and len(instr.operands) == 1 \
+                and instr.operands[0] in param_names:
+            return instr.operands[0]
+        return None
+
+    seen: Dict[str, int] = {}
+    for i, op in enumerate(root.operands):
+        src = passthrough_of(op)
+        if src is not None:
+            out.append(make_finding(
+                rule, ctx, root,
+                f"entry output #{i} returns parameter %{src} unchanged — "
+                f"drop it from the fetch list (it is fetched, transferred "
+                f"and never produced)", context=f"{root.stem}#{i}"))
+        elif op in seen:
+            out.append(make_finding(
+                rule, ctx, root,
+                f"entry output #{i} duplicates output #{seen[op]} "
+                f"(%{op}) — fetch it once", context=f"{root.stem}#{i}"))
+        else:
+            seen[op] = i
+    return out
+
+
+HLO_RULES: Dict[str, Rule] = {r.id: r for r in [
+    Rule("H1", "warning", "MXU padding waste",
+         "pad-aware sizing: pick batch/feature dims that are multiples "
+         "of the dtype tile (f32 8×128, bf16 16×128, MXU "
+         "128×128) — or fold the ragged dim into a padded bucket "
+         "(io.ShapeBuckets) so XLA pads once, not per step.",
+         _check_h1),
+    Rule("H2", "error", "dtype hazard",
+         "f64 never runs natively on TPU; an f32 dot under a bf16 "
+         "policy means an input bypassed amp.auto_cast (a constant, a "
+         "loaded buffer, or an op outside the policy's op set) — cast "
+         "the operand or extend the policy.",
+         _check_h2),
+    Rule("H3", "warning", "large layout-change copy",
+         "a multi-MiB copy/transpose is XLA repairing a layout mismatch "
+         "— keep producers and consumers in one layout (donate buffers, "
+         "avoid host-round-trips that reset layouts, check "
+         "dimension_order of custom kernels).",
+         _check_h3),
+    Rule("H4", "error", "host round-trip inside device loop",
+         "an infeed/outfeed/host callback inside a compiled while body "
+         "stalls the loop on the host every iteration — move host I/O "
+         "outside the loop, or replace the callback with an in-graph op.",
+         _check_h4),
+    Rule("H5", "warning", "collective anti-pattern",
+         "gather-then-slice wants reduce-scatter; same-group all-reduces "
+         "want one bucketed launch; a collective over a loop-invariant "
+         "operand wants hoisting above the while.",
+         _check_h5),
+    Rule("H6", "warning", "collective unmapped to mesh",
+         "the replica groups match no registered mesh axis (and no axis "
+         "product) — re-express the sharding over the mesh axes, or "
+         "register the real mesh, so per-axis attribution and the "
+         "layout planner can price it.",
+         _check_h6),
+    Rule("H7", "warning", "large replicated parameter",
+         "a mesh axis exists but this parameter is materialized fully "
+         "on every device — shard it (NamedSharding over a mesh axis) "
+         "or mark it intentionally replicated in the baseline with a "
+         "comment.",
+         _check_h7),
+    Rule("H8", "info", "dead computation output",
+         "every entry output is fetched and transferred each step — "
+         "returning an input unchanged (or the same value twice) pays "
+         "D2H bandwidth for nothing; prune the fetch list.",
+         _check_h8),
+]}

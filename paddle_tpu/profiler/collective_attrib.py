@@ -41,12 +41,12 @@ the measured ``ms`` gauges need a capture.
 from __future__ import annotations
 
 import dataclasses
-import itertools
 import logging
-import re
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis.hlo import axes as _hloaxes
+from ..analysis.hlo import parsing as _hloparse
 from .telemetry import Telemetry, get_telemetry
 
 __all__ = [
@@ -59,113 +59,24 @@ __all__ = [
 
 logger = logging.getLogger("paddle_tpu.profiler")
 
-# every opcode the inventory claims (async halves map to their base op);
-# kept aligned with hlo_attrib's category vocabulary
-COLLECTIVE_OPCODES = {
-    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
-    "collective-permute", "collective-broadcast",
-    "all-reduce-start", "all-gather-start", "collective-permute-start",
-}
-# the *-done halves carry no replica_groups; the start half owns the
-# instance (counting both would double every async collective)
-_DONE_OPCODES = {"all-reduce-done", "all-gather-done",
-                 "collective-permute-done"}
+# The low-level HLO text primitives live in ``analysis.hlo.parsing`` —
+# the standalone hlo-lint package, which must not import the framework,
+# so the dependency points THIS way. Re-exported under their historic
+# names: profiler callers and tests keep one import surface.
+COLLECTIVE_OPCODES = _hloparse.COLLECTIVE_OPCODES
+_DONE_OPCODES = _hloparse.DONE_OPCODES
+_DTYPE_BYTES = _hloparse.DTYPE_BYTES
+_NAME_RE = _hloparse.NAME_RE
+_shape_bytes = _hloparse.shape_bytes
+_parse_group_sets = _hloparse.parse_group_sets
+_parse_pairs = _hloparse.parse_pairs
+_opcode_and_type = _hloparse.opcode_and_type
 
 # the framework's registered axis vocabulary (mesh_utils docstring +
 # fleet engine ctor args) plus the eager process-level "world" and the
 # honest "unmapped" degrade — the closed set the schema gate enforces
 KNOWN_AXIS_TOKENS = ("dp", "mp", "tp", "pp", "sp", "sharding", "world")
-UNMAPPED = "unmapped"
-
-_DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
-    "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1, "f8e5m2fnuz": 1,
-    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
-    "s32": 4, "u32": 4, "f32": 4,
-    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
-    "c128": 16,
-}
-
-_NAME_RE = re.compile(r"^(?:ROOT\s+)?%?([\w.\-]+)\s+=\s+(.*)$")
-_SHAPE_RE = re.compile(r"([a-z]\d*[a-z0-9]*)\[([\d,]*)\]")
-_GROUPS_LITERAL_RE = re.compile(
-    r"replica_groups=\{(\{[\d,\s]*\}(?:,\s*\{[\d,\s]*\})*)?\}")
-_GROUPS_IOTA_RE = re.compile(
-    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
-_PAIRS_RE = re.compile(
-    r"source_target_pairs=\{(\{[\d,\s]*\}(?:,\s*\{[\d,\s]*\})*)?\}")
-_INNER_GROUP_RE = re.compile(r"\{([\d,\s]*)\}")
-
-
-def _shape_bytes(type_text: str) -> float:
-    """Byte size of one HLO result type (scalar, array, or tuple): sum
-    over every ``dtype[dims]`` token. ``f32[]`` is a scalar (4 bytes)."""
-    total = 0.0
-    for dtype, dims in _SHAPE_RE.findall(type_text):
-        size = _DTYPE_BYTES.get(dtype)
-        if size is None:
-            continue  # token/opaque types carry no payload
-        n = 1
-        for d in dims.split(","):
-            if d.strip():
-                n *= int(d)
-        total += n * size
-    return total
-
-
-def _parse_group_sets(body: str) -> Optional[List[Tuple[int, ...]]]:
-    """The instruction's replica groups as explicit member tuples, from
-    either the literal or the iota form; None when absent."""
-    m = _GROUPS_IOTA_RE.search(body)
-    if m:
-        n_groups, group_size = int(m.group(1)), int(m.group(2))
-        dims = [int(d) for d in m.group(3).split(",")]
-        total = 1
-        for d in dims:
-            total *= d
-        # iota semantics: arange(prod(dims)).reshape(dims).transpose(perm)
-        # .reshape(n_groups, group_size) — each row is one group
-        import numpy as np
-
-        arr = np.arange(total).reshape(dims)
-        if m.group(4):
-            perm = [int(p) for p in m.group(4).split(",")]
-            arr = arr.transpose(perm)
-        arr = arr.reshape(n_groups, group_size)
-        return [tuple(int(v) for v in row) for row in arr]
-    m = _GROUPS_LITERAL_RE.search(body)
-    if m:
-        inner = m.group(1) or ""
-        groups = []
-        for g in _INNER_GROUP_RE.findall(inner):
-            members = tuple(int(v) for v in g.split(",") if v.strip())
-            if members:
-                groups.append(members)
-        return groups
-    return None
-
-
-def _parse_pairs(body: str) -> Optional[List[Tuple[int, int]]]:
-    m = _PAIRS_RE.search(body)
-    if not m:
-        return None
-    pairs = []
-    for g in _INNER_GROUP_RE.findall(m.group(1) or ""):
-        members = [int(v) for v in g.split(",") if v.strip()]
-        if len(members) == 2:
-            pairs.append((members[0], members[1]))
-    return pairs
-
-
-def _opcode_and_type(body: str) -> Tuple[str, str]:
-    """(opcode, result-type text) of one instruction body. The result
-    type is everything left of the opcode token (one shape, or a
-    parenthesized tuple of shapes)."""
-    stripped = body.lstrip()
-    m = re.match(r"^(\([^)]*\)|\S+)\s+([a-z][\w\-]*)\(", stripped)
-    if not m:
-        return "?", ""
-    return m.group(2).lower(), m.group(1)
+UNMAPPED = _hloaxes.UNMAPPED
 
 
 # -- mesh registry ------------------------------------------------------------
@@ -211,30 +122,11 @@ def axis_vocabulary() -> Tuple[str, ...]:
     return tuple(out)
 
 
-def _strides(sizes: List[int]) -> List[int]:
-    st = [1] * len(sizes)
-    for i in range(len(sizes) - 2, -1, -1):
-        st[i] = st[i + 1] * sizes[i + 1]
-    return st
-
-
-def _expected_groups(axes: Dict[str, int],
-                     subset: Tuple[str, ...]) -> frozenset:
-    """The canonical group set of a collective over ``subset`` of the
-    mesh axes: members vary along the subset, everything else fixed."""
-    names = list(axes)
-    sizes = [axes[n] for n in names]
-    strides = dict(zip(names, _strides(sizes)))
-    complement = [n for n in names if n not in subset]
-    groups = []
-    for fixed in itertools.product(*[range(axes[n]) for n in complement]):
-        base = sum(f * strides[n] for n, f in zip(complement, fixed))
-        members = []
-        for var in itertools.product(*[range(axes[n]) for n in subset]):
-            members.append(base + sum(v * strides[n]
-                                      for n, v in zip(subset, var)))
-        groups.append(frozenset(members))
-    return frozenset(groups)
+# the group/pair → axis math itself lives in analysis.hlo.axes (pure,
+# mesh passed explicitly, shared with hlo-lint's H5/H6); these wrappers
+# add the framework default — the live registered mesh
+_strides = _hloaxes.strides
+_expected_groups = _hloaxes.expected_groups
 
 
 def map_groups_to_axes(groups: List[Tuple[int, ...]],
@@ -244,18 +136,8 @@ def map_groups_to_axes(groups: List[Tuple[int, ...]],
     ("dp", or "dp+tp" for a flattened multi-axis group), else
     ``unmapped``. Matching is exact set equality — attribution never
     guesses."""
-    axes = registered_axes() if axes is None else dict(axes)
-    if not axes or not groups:
-        return UNMAPPED
-    canonical = frozenset(frozenset(g) for g in groups)
-    names = list(axes)
-    # smallest subsets first; ties broken by mesh axis order so a
-    # degenerate (size-1) axis match is deterministic
-    for k in range(1, len(names) + 1):
-        for subset in itertools.combinations(names, k):
-            if _expected_groups(axes, subset) == canonical:
-                return "+".join(subset)
-    return UNMAPPED
+    return _hloaxes.map_groups_to_axes(
+        groups, registered_axes() if axes is None else dict(axes))
 
 
 def map_pairs_to_axis(pairs: List[Tuple[int, int]],
@@ -263,30 +145,8 @@ def map_pairs_to_axis(pairs: List[Tuple[int, int]],
     """The axis of a ``collective-permute``: every (source, target) pair
     must differ along exactly one non-trivial mesh axis — the ring axis
     of PR 8's sp rotation. Anything else is ``unmapped``."""
-    axes = registered_axes() if axes is None else dict(axes)
-    if not axes or not pairs:
-        return UNMAPPED
-    names = list(axes)
-    sizes = [axes[n] for n in names]
-    strides = _strides(sizes)
-
-    def coords(idx: int) -> Tuple[int, ...]:
-        return tuple((idx // strides[i]) % sizes[i]
-                     for i in range(len(names)))
-
-    for i, name in enumerate(names):
-        if sizes[i] <= 1:
-            continue
-        ok = True
-        for s, t in pairs:
-            cs, ct = coords(s), coords(t)
-            if cs[i] == ct[i] or any(cs[j] != ct[j]
-                                     for j in range(len(names)) if j != i):
-                ok = False
-                break
-        if ok:
-            return name
-    return UNMAPPED
+    return _hloaxes.map_pairs_to_axis(
+        pairs, registered_axes() if axes is None else dict(axes))
 
 
 # -- the inventory ------------------------------------------------------------
@@ -364,9 +224,9 @@ def inventory(entries: Optional[List[str]] = None
     programs and compiles text on demand (counted ``profile/
     hlo_compiles``) — call this from explicitly-requested paths (bench
     columns, captures, ``/debug/collectives``), not per-step loops."""
-    from . import hlo_attrib
+    from . import xla_cost
 
-    texts = hlo_attrib.hlo_registry().texts(entries)
+    texts = xla_cost.hlo_texts(entries)
     out: Dict[str, List[CollectiveOp]] = {}
     axes = registered_axes()
     with _inv_lock:

@@ -337,7 +337,7 @@ def _finish_capture(cap: _Capture, wall_ms: float, tel) -> None:
 
     steps = {e: n * xla_cost.cost_registry().steps_per_call(e)
              for e, n in cap.entry_steps.items()}
-    texts = hlo_attrib.hlo_registry().texts()
+    texts = xla_cost.hlo_texts()
     report = hlo_attrib.attribute_trace(
         trace, texts, steps=steps, wall_ms=wall_ms,
         trigger_entry=cap.trigger_entry,

@@ -40,6 +40,7 @@ import re
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis.hlo import parsing as _hloparse
 from .telemetry import get_telemetry
 
 __all__ = [
@@ -101,37 +102,11 @@ class HloOp:
         return categorize_opcode(self.opcode, self.name)
 
 
-_NAME_RE = re.compile(r"^(?:ROOT\s+)?%?([\w.\-]+)\s+=\s+(.*)$")
-_META_BODY_RE = re.compile(r"metadata=\{([^}]*)\}")
-_SRC_FILE_RE = re.compile(r'source_file="([^"]+)"')
-_SRC_LINE_RE = re.compile(r"source_line=(\d+)")
-_OP_NAME_RE = re.compile(r'op_name="([^"]+)"')
-
-
-def _opcode_of(body: str) -> str:
-    """The opcode of one instruction body (everything right of ``= ``):
-    skip the result type — one token, or a parenthesized tuple type —
-    then the next identifier before ``(`` is the opcode."""
-    body = body.lstrip()
-    if body.startswith("("):
-        depth = 0
-        for i, ch in enumerate(body):
-            if ch == "(":
-                depth += 1
-            elif ch == ")":
-                depth -= 1
-                if depth == 0:
-                    body = body[i + 1:].lstrip()
-                    break
-        else:
-            return "?"
-    else:
-        parts = body.split(None, 1)
-        if len(parts) < 2:
-            return "?"
-        body = parts[1]
-    m = re.match(r"([A-Za-z][\w\-]*)\(", body)
-    return m.group(1).lower() if m else "?"
+# the low-level text primitives live in analysis.hlo.parsing (shared
+# with the standalone hlo-lint, which must not import the framework —
+# so the dependency points this way); historic names kept
+_NAME_RE = _hloparse.NAME_RE
+_opcode_of = _hloparse.opcode_of
 
 
 def parse_hlo_text(text: str) -> Dict[str, HloOp]:
@@ -139,25 +114,13 @@ def parse_hlo_text(text: str) -> Dict[str, HloOp]:
     lines without metadata still register (opcode + name only), so trace
     events can at least be categorized and counted."""
     ops: Dict[str, HloOp] = {}
-    for line in text.splitlines():
-        m = _NAME_RE.match(line.strip())
-        if not m:
-            continue
-        name, body = m.group(1), m.group(2)
-        opcode = _opcode_of(body)
-        src, op_name = "?", "?"
-        mm = _META_BODY_RE.search(body)
-        if mm:
-            md = mm.group(1)
-            f = _SRC_FILE_RE.search(md)
-            ln = _SRC_LINE_RE.search(md)
-            o = _OP_NAME_RE.search(md)
-            if f or ln:
-                src = ((f.group(1).split("/")[-1] if f else "?")
-                       + ":" + (ln.group(1) if ln else "?"))
-            if o:
-                op_name = o.group(1)
-        ops[name] = HloOp(name=name, opcode=opcode, src=src, op_name=op_name)
+    for name, body, _lineno in _hloparse.iter_instruction_lines(text):
+        instr = _hloparse.HloInstr(name=name, opcode=_opcode_of(body),
+                                   type_text="", body=body, line=_lineno,
+                                   computation="")
+        src = instr.source_src()
+        ops[name] = HloOp(name=name, opcode=instr.opcode, src=src,
+                          op_name=instr.op_name())
     return ops
 
 

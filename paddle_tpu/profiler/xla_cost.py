@@ -50,7 +50,7 @@ import logging
 import os
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from .telemetry import Telemetry, get_telemetry
 
@@ -271,6 +271,7 @@ def reset() -> None:
     global _peaks_cache
     _registry.reset()
     _mfu_overflow_warned.clear()
+    _lint_warned.clear()
     with _peaks_lock:
         _peaks_cache = None
     try:
@@ -427,6 +428,81 @@ def _stash_hlo(entry: str, compiled=None, lowered=None) -> None:
             hlo_attrib.hlo_registry().put_lowered(entry, lowered)
     except Exception as e:  # noqa: BLE001
         logger.debug("xla_cost: HLO stash failed for %s: %s", entry, e)
+    _maybe_lint(entry)
+
+
+# -- the optimized-HLO-text access path + opt-in compile-time lint ---------
+
+def hlo_text_for(entry: str) -> Optional[str]:
+    """THE access path to an entry's optimized HLO text — full mode
+    returns the text the compile already produced; the default mode
+    compiles the stored Lowered on demand (counted ``profile/
+    hlo_compiles`` — the one place attribution pays a compile). Both
+    ``hlo_attrib`` consumers and the hlo-lint hook/CLI go through here:
+    there is exactly one asymmetry and this is where it lives."""
+    from . import hlo_attrib
+
+    return hlo_attrib.hlo_registry().text_for(entry)
+
+
+def hlo_texts(entries: Optional[List[str]] = None) -> Dict[str, str]:
+    """``{entry: optimized HLO text}`` over the registry (or the given
+    entries) via :func:`hlo_text_for`'s contract."""
+    from . import hlo_attrib
+
+    return hlo_attrib.hlo_registry().texts(entries)
+
+
+def hlo_lint_enabled() -> bool:
+    """Opt-in: ``PADDLE_TPU_HLO_LINT=1`` lints every fresh compile."""
+    v = os.environ.get("PADDLE_TPU_HLO_LINT", "").strip().lower()
+    return v in ("1", "true", "on", "yes")
+
+
+# (entry, rule) pairs already warned about — the log gets ONE line per
+# program/rule, the counters keep counting every finding
+_lint_warned: set = set()
+
+
+def _maybe_lint(entry: str) -> None:
+    """The compile-time hook: when ``PADDLE_TPU_HLO_LINT`` is set, run
+    the H-rules over the program this capture just stashed, publish
+    ``counter/hlolint/findings.<rule>`` per finding, and warn once per
+    (entry, rule). Best-effort like every attribution hook — lint must
+    never break the compile it is judging."""
+    if not hlo_lint_enabled():
+        return
+    try:
+        from ..analysis.hlo import AnalysisContext, analyze_hlo_text
+        from . import collective_attrib
+
+        text = hlo_text_for(entry)
+        if not text:
+            return
+        bf16 = False
+        try:
+            from ..amp.auto_cast import amp_state
+
+            state = amp_state()
+            bf16 = bool(getattr(state, "enabled", False)) and \
+                "bf16" in str(getattr(state, "dtype", "")).replace(
+                    "bfloat16", "bf16")
+        except Exception:  # noqa: BLE001
+            pass
+        ctx = AnalysisContext(entry=entry,
+                              mesh_axes=collective_attrib.registered_axes(),
+                              bf16_policy=bf16)
+        tel = get_telemetry()
+        for f in analyze_hlo_text(text, ctx):
+            tel.counter(f"hlolint/findings.{f.rule}")
+            if (entry, f.rule) not in _lint_warned:
+                _lint_warned.add((entry, f.rule))
+                logger.warning(
+                    "hlo-lint: %s (%s) in compiled entry %r at HLO line "
+                    "%d [%s]: %s", f.rule, f.severity, entry, f.line,
+                    f.context, f.message)
+    except Exception as e:  # noqa: BLE001
+        logger.debug("xla_cost: hlo lint failed for %s: %s", entry, e)
 
 
 # -- MFU / roofline --------------------------------------------------------

@@ -1,0 +1,407 @@
+"""hlo-lint tests: golden per-rule HLO fixtures (positive + clean twin
+per rule), the shared baseline ratchet over HLO findings, the CLI
+contracts (--json/--rules/--mesh/manifest context/note-preserving
+--update-baseline), the injection self-test, the opt-in compile-time
+hook, and the telemetry-schema contract for the hlolint counters."""
+import json
+import logging
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+LINT = os.path.join(REPO, "tools", "hlo_lint.py")
+
+from paddle_tpu.analysis import (  # noqa: E402
+    compare,
+    load_baseline,
+    make_baseline,
+    save_baseline,
+)
+from paddle_tpu.analysis.hlo import (  # noqa: E402
+    HLO_RULES,
+    AnalysisContext,
+    analyze_hlo_text,
+    parse_module,
+)
+
+_FIXTURE_FILES = sorted(
+    f for f in os.listdir(FIXTURES) if f.endswith(".hlo.txt"))
+_POSITIVE = [f for f in _FIXTURE_FILES if not f.endswith("_clean.hlo.txt")]
+_CLEAN = [f for f in _FIXTURE_FILES if f.endswith("_clean.hlo.txt")]
+
+
+def _read(name):
+    with open(os.path.join(FIXTURES, name)) as f:
+        return f.read()
+
+
+def _ctx(src, entry):
+    """AnalysisContext from the fixture's '// CTX: mesh=dp:2,tp:2
+    bf16_policy=1' header (both fields optional)."""
+    mesh, bf16 = {}, False
+    m = re.search(r"^// CTX:(.*)$", src, re.M)
+    if m:
+        for tok in m.group(1).split():
+            if tok.startswith("mesh="):
+                for part in tok[len("mesh="):].split(","):
+                    axis, _, size = part.partition(":")
+                    mesh[axis] = int(size)
+            elif tok.startswith("bf16_policy="):
+                bf16 = tok.partition("=")[2] == "1"
+    return AnalysisContext(entry=entry, mesh_axes=mesh, bf16_policy=bf16)
+
+
+def _expected(src):
+    """{(line, rule)} from '// EXPECT: H3[, H5]' trailing annotations."""
+    out = set()
+    for lineno, line in enumerate(src.splitlines(), 1):
+        m = re.search(r"//\s*EXPECT:\s*([A-Z0-9, ]+)", line)
+        if m:
+            out.update((lineno, r.strip()) for r in m.group(1).split(","))
+    return out
+
+
+def _analyze(name):
+    src = _read(name)
+    return analyze_hlo_text(src, _ctx(src, name))
+
+
+class TestRuleFixtures:
+    """Golden check per rule: every EXPECT-annotated HLO line must flag
+    with exactly that rule under the fixture's declared context, and the
+    clean twin — same program shape, hazard removed — must stay silent."""
+
+    @pytest.mark.parametrize("name", _POSITIVE)
+    def test_positive_golden(self, name):
+        src = _read(name)
+        expected = _expected(src)
+        assert expected, f"fixture {name} has no EXPECT annotations"
+        got = {(f.line, f.rule)
+               for f in analyze_hlo_text(src, _ctx(src, name))}
+        assert got == expected, (
+            f"{name}: missing={sorted(expected - got)} "
+            f"unexpected={sorted(got - expected)}")
+
+    @pytest.mark.parametrize("name", _CLEAN)
+    def test_clean_twin_silent(self, name):
+        src = _read(name)
+        assert not _expected(src), f"clean twin {name} carries EXPECTs"
+        findings = analyze_hlo_text(src, _ctx(src, name))
+        assert findings == [], [f.to_dict() for f in findings]
+
+    def test_every_rule_has_a_fixture_pair(self):
+        covered = set()
+        for name in _POSITIVE:
+            twin = name.replace(".hlo.txt", "_clean.hlo.txt")
+            assert twin in _CLEAN, f"{name} has no clean twin"
+            covered.update(r for _, r in _expected(_read(name)))
+        assert covered == set(HLO_RULES) == {f"H{i}" for i in range(1, 9)}
+
+    def test_findings_carry_rule_metadata_and_source(self):
+        findings = _analyze("h1_pad_waste.hlo.txt")
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.rule == "H1" and f.severity == HLO_RULES["H1"].severity
+        assert f.hint == HLO_RULES["H1"].hint
+        assert f.context == "dot"  # SSA counter stripped from %dot.8
+        assert f.message.endswith("[model.py:10]")  # metadata source
+        assert "padding" in f.message
+
+    def test_h8_contexts_name_each_dead_output(self):
+        ctxs = {f.context for f in _analyze("h8_dead_output.hlo.txt")}
+        assert ctxs == {"tuple#1", "tuple#2", "tuple#3"}
+
+
+class TestKeyStability:
+    def test_ssa_renumbering_keeps_baseline_keys(self):
+        """%dot.3 and %dot.17 are the same program point: a recompile
+        that renumbers SSA counters must not churn the ratchet."""
+        src = _read("h3_layout_copy.hlo.txt")
+        bumped = re.sub(r"\.(\d+)\b", lambda m: f".{int(m.group(1)) + 10}",
+                        src)
+        ctx = AnalysisContext(entry="same-entry")
+        keys = {f.key() for f in analyze_hlo_text(src, ctx)}
+        keys2 = {f.key() for f in analyze_hlo_text(bumped, ctx)}
+        assert keys and keys == keys2
+
+
+class TestBaselineRatchet:
+    """The shared analysis.baseline ratchet over HLO findings — same
+    compare() the AST linter gates on, keyed (entry, rule, name stem)."""
+
+    def _findings(self):
+        return _analyze("h5_collective_antipattern.hlo.txt")
+
+    def test_baselined_findings_pass(self):
+        findings = self._findings()
+        assert len(findings) == 3
+        new, stale, n_base = compare(findings, make_baseline(findings))
+        assert new == [] and stale == [] and n_base == 3
+
+    def test_new_finding_fails(self):
+        findings = self._findings()
+        base = make_baseline(findings)
+        extra = _analyze("h3_layout_copy.hlo.txt")
+        new, _, _ = compare(findings + extra, base)
+        assert {f.rule for f in new} == {"H3"}
+
+    def test_fixed_finding_flags_stale_entry(self):
+        findings = self._findings()
+        base = make_baseline(findings)
+        fixed_key = findings[0].key()
+        remaining = [f for f in findings if f.key() != fixed_key]
+        new, stale, _ = compare(remaining, base)
+        assert new == []
+        assert [(s["file"], s["rule"], s["context"]) for s in stale] == [
+            fixed_key]
+
+    def test_roundtrip_via_disk(self, tmp_path):
+        findings = self._findings()
+        p = tmp_path / "base.json"
+        save_baseline(str(p), make_baseline(findings))
+        new, stale, n = compare(findings, load_baseline(str(p)))
+        assert new == [] and stale == [] and n == len(findings)
+
+
+def _run_lint(*argv):
+    return subprocess.run(
+        [sys.executable, LINT, *argv], cwd=REPO, capture_output=True,
+        text=True, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def _fixture(name):
+    return os.path.join(FIXTURES, name)
+
+
+class TestCLI:
+    def test_list_rules(self):
+        proc = _run_lint("--list-rules")
+        assert proc.returncode == 0
+        for rid in HLO_RULES:
+            assert rid in proc.stdout
+
+    def test_positive_file_fails_clean_file_passes(self):
+        proc = _run_lint(_fixture("h3_layout_copy.hlo.txt"))
+        assert proc.returncode == 1
+        assert "H3" in proc.stderr
+        assert _run_lint(
+            _fixture("h3_layout_copy_clean.hlo.txt")).returncode == 0
+
+    def test_rule_selection_and_json(self):
+        fixture = _fixture("h2_dtype_hazard.hlo.txt")
+        proc = _run_lint(fixture, "--bf16-policy", "--rules", "H2",
+                         "--json")
+        out = json.loads(proc.stdout)
+        assert proc.returncode == 1 and out["status"] == "FAIL"
+        assert out["by_rule"] == {"H2": 2}
+        assert all(f["rule"] == "H2" for f in out["findings"])
+        proc = _run_lint(fixture, "--bf16-policy", "--rules", "H1",
+                         "--json")
+        out = json.loads(proc.stdout)
+        assert proc.returncode == 0 and out["status"] == "OK"
+
+    def test_mesh_flag_arms_mesh_rules(self):
+        fixture = _fixture("h7_replicated_param.hlo.txt")
+        # without a mesh H7 stays silent rather than guess
+        assert _run_lint(fixture).returncode == 0
+        proc = _run_lint(fixture, "--mesh", "dp=2", "--json")
+        out = json.loads(proc.stdout)
+        assert proc.returncode == 1 and out["by_rule"] == {"H7": 1}
+
+    def test_manifest_supplies_context(self, tmp_path):
+        snap = tmp_path / "cfg"
+        snap.mkdir()
+        (snap / "prog.hlo.txt").write_text(
+            _read("h7_replicated_param.hlo.txt"))
+        (snap / "MANIFEST.json").write_text(json.dumps(
+            {"config": "cfg", "mesh": {"dp": 2}, "bf16_policy": False}))
+        proc = _run_lint(str(snap), "--json")
+        out = json.loads(proc.stdout)
+        assert proc.returncode == 1 and out["by_rule"] == {"H7": 1}
+        # --mesh overrides the manifest: a trivial mesh disarms H7
+        proc = _run_lint(str(snap), "--mesh", "dp=1", "--json")
+        assert json.loads(proc.stdout)["status"] == "OK"
+
+    def test_snapshot_dir_walk_counts_programs(self, tmp_path):
+        snap = tmp_path / "snaps"
+        snap.mkdir()
+        (snap / "a.hlo.txt").write_text(_read("h1_pad_waste_clean.hlo.txt"))
+        (snap / "b.hlo.txt").write_text(_read("h8_dead_output_clean.hlo.txt"))
+        (snap / "ignored.txt").write_text("not a snapshot")
+        proc = _run_lint(str(snap))
+        assert proc.returncode == 0
+        assert "2 programs" in proc.stdout
+
+    def test_update_baseline_then_gate_and_stale(self, tmp_path):
+        fixture = _fixture("h8_dead_output.hlo.txt")
+        base = tmp_path / "b.json"
+        assert _run_lint(fixture, "--update-baseline",
+                         str(base)).returncode == 0
+        assert _run_lint(fixture, "--baseline", str(base)).returncode == 0
+        # a clean program against that baseline reports the entries stale
+        proc = _run_lint(_fixture("h8_dead_output_clean.hlo.txt"),
+                         "--baseline", str(base))
+        assert proc.returncode == 0 and "stale" in proc.stderr
+
+    def test_update_baseline_preserves_notes(self, tmp_path):
+        fixture = _fixture("h8_dead_output.hlo.txt")
+        base = tmp_path / "b.json"
+        _run_lint(fixture, "--update-baseline", str(base))
+        data = json.loads(base.read_text())
+        assert data["entries"]
+        data["entries"][0]["note"] = "intentional: echoed for the host"
+        key = (data["entries"][0]["file"], data["entries"][0]["rule"],
+               data["entries"][0]["context"])
+        base.write_text(json.dumps(data))
+        assert _run_lint(fixture, "--update-baseline",
+                         str(base)).returncode == 0
+        regen = json.loads(base.read_text())
+        noted = {(e["file"], e["rule"], e["context"]): e.get("note")
+                 for e in regen["entries"]}
+        assert noted[key] == "intentional: echoed for the host"
+        assert sum(1 for n in noted.values() if n) == 1
+
+    def test_committed_baseline_is_loadable(self):
+        data = load_baseline(
+            os.path.join(REPO, "tools", "hlo_lint_baseline.json"))
+        assert isinstance(data.get("entries"), list)
+
+
+class TestInjectionSelfTest:
+    def test_both_planted_regressions_flagged(self):
+        proc = _run_lint("--verify-injection")
+        assert proc.returncode == 0, proc.stderr
+        assert "FLAGGED H2 in injected.f32_matmul" in proc.stderr
+        assert "FLAGGED H7 in injected.replicated_param" in proc.stderr
+
+    def test_injection_json_payload(self):
+        proc = _run_lint("--verify-injection", "--json")
+        out = json.loads(proc.stdout)
+        assert out["gate"] == "hlo-lint-injection"
+        assert out["status"] == "OK"
+        assert [c["flagged"] for c in out["cases"]] == [True, True]
+        assert {c["rule"] for c in out["cases"]} == {"H2", "H7"}
+
+
+@pytest.fixture
+def tel():
+    from paddle_tpu.profiler import get_telemetry
+
+    t = get_telemetry()
+    t.reset()  # also clears the HLO registry + warned-once lint state
+    yield t
+    t.reset()
+
+
+class TestCompileHook:
+    """The real-compile acceptance path: a jitted program's optimized
+    HLO, captured by xla_cost, flows through hlo_text_for into the
+    analyzer — and with PADDLE_TPU_HLO_LINT=1 publishes counters."""
+
+    def _compile(self, name, shape=(32, 64)):
+        import jax.numpy as jnp
+        from paddle_tpu.profiler import tracked_jit
+
+        f = tracked_jit(lambda a, b: (a @ b, a), name=name)
+        f(jnp.ones(shape, jnp.float32),
+          jnp.ones((shape[1], 16), jnp.float32))
+
+    def test_hlo_text_for_lints_end_to_end(self, tel, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_COST_ANALYSIS", "full")
+        self._compile("lint.e2e")
+        from paddle_tpu.profiler import xla_cost
+
+        text = xla_cost.hlo_text_for("lint.e2e")
+        assert text and "HloModule" in text
+        entry = parse_module(text).entry_computation()
+        assert any(i.opcode == "dot" for i in entry.instrs)
+        findings = analyze_hlo_text(
+            text, AnalysisContext(entry="lint.e2e"))
+        # the program returns parameter a unchanged: H8 must see it
+        assert any(f.rule == "H8" for f in findings)
+
+    def test_hook_publishes_counters_and_warns_once(self, tel,
+                                                    monkeypatch, caplog):
+        monkeypatch.setenv("PADDLE_TPU_COST_ANALYSIS", "full")
+        monkeypatch.setenv("PADDLE_TPU_HLO_LINT", "1")
+        with caplog.at_level(logging.WARNING,
+                             logger="paddle_tpu.profiler.xla_cost"):
+            self._compile("lint.hook")
+            self._compile("lint.hook", shape=(64, 64))  # second bucket
+        scalars = tel.counter_scalars()
+        assert scalars["counter/hlolint/findings.H8"] == 2
+        warned = [r for r in caplog.records if "hlo-lint" in r.message]
+        assert len(warned) == 1  # once per (entry, rule), not per compile
+        assert "H8" in warned[0].message
+        assert "lint.hook" in warned[0].message
+
+    def test_hook_off_by_default(self, tel, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_COST_ANALYSIS", "full")
+        monkeypatch.delenv("PADDLE_TPU_HLO_LINT", raising=False)
+        self._compile("lint.off")
+        # a reset keeps registered counter KEYS at zero — off means no
+        # lint ran, so nothing may have counted up
+        assert not any(v for k, v in tel.counter_scalars().items()
+                       if "hlolint" in k)
+
+
+class TestTelemetrySchemaContract:
+    """Satellite: check_telemetry_schema knows the hlolint counters —
+    closed H1-H8 rule vocabulary, non-negative monotone counts."""
+
+    def _schema(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import check_telemetry_schema as schema
+        finally:
+            sys.path.pop(0)
+        return schema
+
+    def _rec(self, scalars):
+        return {"ts": 1.0, "step": 1, "tag": "bench/x", "scalars": scalars}
+
+    def test_valid_counter_accepted(self):
+        schema = self._schema()
+        for rule in HLO_RULES:
+            rec = self._rec({f"counter/hlolint/findings.{rule}": 2})
+            assert schema.validate_record(rec, 1) is None
+
+    def test_unknown_rule_token_rejected(self):
+        schema = self._schema()
+        err = schema.validate_record(
+            self._rec({"counter/hlolint/findings.H9": 1}), 3)
+        assert err and "H9" in err and "vocabulary" in err
+
+    def test_malformed_and_negative_rejected(self):
+        schema = self._schema()
+        err = schema.validate_record(
+            self._rec({"counter/hlolint/rules.H2": 1}), 4)
+        assert err and "malformed" in err
+        err = schema.validate_record(
+            self._rec({"counter/hlolint/findings.H1": -1}), 5)
+        assert err and "negative" in err
+
+    def test_gate_main_over_jsonl(self, tmp_path, capsys):
+        schema = self._schema()
+        good = tmp_path / "g.jsonl"
+        good.write_text(json.dumps(self._rec(
+            {"counter/hlolint/findings.H2": 3})) + "\n")
+        assert schema.main([str(good)]) == 0
+        capsys.readouterr()
+        bad = tmp_path / "b.jsonl"
+        bad.write_text(json.dumps(self._rec(
+            {"counter/hlolint/findings.R1": 1})) + "\n")
+        assert schema.main([str(bad)]) == 1
+
+    def test_bench_trajectory_tracks_hlolint_mover(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import check_bench_trajectory as traj
+        finally:
+            sys.path.pop(0)
+        assert "hlolint_findings" in traj._ATTRIB_COLUMNS

@@ -210,6 +210,84 @@ class TestSelfRun:
                     "hint", "context"} <= set(f)
 
 
+HAZARD_SRC = ("import jax\n"
+              "@jax.jit\n"
+              "def f(x):\n"
+              "    return float(x)\n")
+
+
+class TestChangedOnly:
+    """--changed-only BASE: lint only files git reports changed vs BASE
+    (plus untracked), and restrict the baseline comparison to the same
+    set so unchanged files' debt neither runs nor reads as stale."""
+
+    def _tpu_lint(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import tpu_lint
+        finally:
+            sys.path.pop(0)
+        return tpu_lint
+
+    def _git(self, cwd, *args):
+        subprocess.run(["git", *args], cwd=cwd, check=True,
+                       capture_output=True)
+
+    @pytest.fixture
+    def repo(self, tmp_path, monkeypatch):
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "config", "user.email", "t@example.com")
+        self._git(tmp_path, "config", "user.name", "t")
+        (tmp_path / "hazard.py").write_text(HAZARD_SRC)
+        (tmp_path / "clean.py").write_text("x = 1\n")
+        self._git(tmp_path, "add", "-A")
+        self._git(tmp_path, "commit", "-qm", "base")
+        lint = self._tpu_lint()
+        lint._load_analysis()  # cache the real analysis package first:
+        # _REPO is about to point at the throwaway git repo
+        monkeypatch.setattr(lint, "_REPO", str(tmp_path))
+        return tmp_path, lint
+
+    def test_only_changed_files_are_linted(self, repo, capsys):
+        tmp, lint = repo
+        # nothing changed vs HEAD: the hazard file is not even read
+        assert lint.main([str(tmp), "--changed-only"]) == 0
+        assert "0 files" in capsys.readouterr().out
+        # touching the hazard file brings its findings back
+        (tmp / "hazard.py").write_text(HAZARD_SRC + "y = 2\n")
+        assert lint.main([str(tmp), "--changed-only"]) == 1
+        assert "1 files" in capsys.readouterr().err  # FAIL goes to stderr
+        # touching only the clean file keeps the run green
+        self._git(tmp, "add", "-A")
+        self._git(tmp, "commit", "-qm", "hazard touched")
+        (tmp / "clean.py").write_text("x = 3\n")
+        assert lint.main([str(tmp), "--changed-only"]) == 0
+
+    def test_untracked_files_are_included(self, repo):
+        tmp, lint = repo
+        (tmp / "fresh.py").write_text(HAZARD_SRC)
+        assert lint.main([str(tmp), "--changed-only"]) == 1
+
+    def test_baseline_restricted_to_changed_files(self, repo, capsys):
+        tmp, lint = repo
+        base = tmp / "baseline.json"
+        assert lint.main([str(tmp), "--update-baseline", str(base)]) == 0
+        capsys.readouterr()
+        # only clean.py changes: hazard.py's baselined debt is neither
+        # linted nor reported as stale burn-down
+        (tmp / "clean.py").write_text("x = 3\n")
+        assert lint.main([str(tmp), "--changed-only", "HEAD",
+                          "--baseline", str(base)]) == 0
+        out = capsys.readouterr()
+        assert "stale" not in out.err
+
+    def test_bad_ref_fails_the_gate(self, repo, capsys):
+        tmp, lint = repo
+        assert lint.main([str(tmp), "--changed-only",
+                          "no-such-ref"]) == 1
+        assert "--changed-only" in capsys.readouterr().err
+
+
 class TestSharedGate:
     def test_finish_conventions(self, capsys):
         sys.path.insert(0, os.path.join(REPO, "tools"))

@@ -55,6 +55,19 @@ python tools/check_bench_trajectory.py \
 # committed baseline and burn down; anything NEW fails the ritual.
 python tools/tpu_lint.py paddle_tpu --baseline tools/tpu_lint_baseline.json
 
+# hlo-lint gate: the COMPILED-artifact twin of tpu-lint — H1-H8 static
+# analysis (MXU padding waste, dtype hazards, layout copies, host
+# round-trips in device loops, collective anti-patterns, unmapped
+# collectives, missed sharding, dead outputs) over every program this
+# very bench run compiled (bench_all.py dumped them to HLO_SNAPSHOTS/
+# with per-config mesh+amp manifests). Same ratchet: committed debt in
+# tools/hlo_lint_baseline.json burns down, anything NEW fails. The
+# injection self-test then proves the gate can still SEE a regression:
+# a forced-f32 matmul under a bf16 policy and a forced-replicated
+# mesh parameter must both be flagged by name, or the ritual fails.
+python tools/hlo_lint.py HLO_SNAPSHOTS --baseline tools/hlo_lint_baseline.json
+python tools/hlo_lint.py --verify-injection
+
 # resilience gate: end-to-end recovery on a tiny CPU run — one injected
 # NaN step (skip + rollback) and one delivered SIGTERM (emergency
 # checkpoint → exit 77 → capped relaunch) must still reach the

@@ -52,6 +52,7 @@ _ATTRIB_COLUMNS = (
     "compile_bytes_accessed", "compile_peak_hbm_bytes", "mfu_pct",
     "profile_compute_frac", "profile_collective_frac",
     "profile_transfer_frac", "profile_host_gap_frac",
+    "hlolint_findings",
 )
 # the per-axis collective columns (collective_<axis>_{bytes,ms,count} —
 # axis names are mesh-dependent, so matched by pattern) are attribution
@@ -185,7 +186,8 @@ def _bench_scalars(path, metric):
                             or k.startswith("gauge/profile/")
                             or k.startswith("gauge/mfu/")
                             or k.startswith("gauge/bottleneck/")
-                            or k.startswith("gauge/collective/")):
+                            or k.startswith("gauge/collective/")
+                            or k.startswith("counter/hlolint/")):
                         if isinstance(v, (int, float)):
                             out[k] = float(v)
     except OSError:

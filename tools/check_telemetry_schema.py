@@ -93,6 +93,13 @@ unchanged) whose entry carries per-axis collective gauges reports
 ``comm_bound:<axis>`` wherever verdicts are strings (telemetry_agg
 rows, ``bench_all.py`` bottleneck columns).
 
+HLO-lint contracts (``analysis.hlo`` via the ``PADDLE_TPU_HLO_LINT``
+compile-time hook): ``counter/hlolint/findings.<rule>`` counts the
+static findings per rule across every program compiled this run; the
+``<rule>`` token must come from the CLOSED H1-H8 vocabulary (keep in
+sync with ``paddle_tpu/analysis/hlo/hlo_rules.py``) and the count is a
+monotone total ≥ 0.
+
 Token-level serving contracts (``inference.serving.decode``):
 ``gauge/serve/kv_occupancy`` ∈ [0, 1] and
 ``gauge/serve/spec_accept_rate`` ∈ [0, 1] (both are fractions by
@@ -124,6 +131,9 @@ _FRAC_CATEGORIES = _PROFILE_CATEGORIES | {"host_gap"}
 _COLLECTIVE_AXIS_TOKENS = {"dp", "mp", "tp", "pp", "sp", "sharding",
                            "world"}
 _COLLECTIVE_FIELDS = {"bytes", "ms", "count"}
+# analysis.hlo's closed rule vocabulary (keep in sync with HLO_RULES
+# there): hlo-lint finding counters are keyed per rule id
+_HLOLINT_RULES = {"H1", "H2", "H3", "H4", "H5", "H6", "H7", "H8"}
 
 
 def _collective_axis_ok(axis):
@@ -297,6 +307,23 @@ def validate_record(rec, lineno):
             if float(value) < 0:
                 return (f"line {lineno}: scalar {name!r} = {value!r} "
                         f"is negative (collective bytes/ms/count)")
+        # hlo-lint finding counters: keyed per rule id from the CLOSED
+        # H1-H8 vocabulary (an invented rule token means a producer and
+        # the analyzer disagree on what exists), and counts of findings
+        # are monotone totals >= 0
+        if name.startswith("counter/hlolint/"):
+            rest = name[len("counter/hlolint/"):]
+            if not rest.startswith("findings."):
+                return (f"line {lineno}: scalar {name!r} malformed — "
+                        f"expected counter/hlolint/findings.<rule>")
+            rule = rest[len("findings."):]
+            if rule not in _HLOLINT_RULES:
+                return (f"line {lineno}: scalar {name!r} rule {rule!r} "
+                        f"outside the hlo-lint rule vocabulary "
+                        f"{sorted(_HLOLINT_RULES)}")
+            if float(value) < 0:
+                return (f"line {lineno}: scalar {name!r} = {value!r} "
+                        f"is negative (finding counts are monotone)")
         # bottleneck verdicts come from a CLOSED vocabulary — any other
         # value means a producer invented a verdict the dashboards and
         # gates cannot name

@@ -1,0 +1,307 @@
+#!/usr/bin/env python
+"""hlo-lint — post-compile static analysis gate over optimized HLO.
+
+The compiled-artifact twin of tools/tpu_lint.py: runs the H1-H8 rules
+in ``paddle_tpu/analysis/hlo`` over HLO text snapshots (the per-config
+``HLO_SNAPSHOTS/`` tree bench_all.py dumps, or any ``*.hlo.txt`` file)
+and gates on the committed baseline with the same Infer-style ratchet
+(baselined findings are tracked debt, NEW findings fail, fixed findings
+flag the baseline stale).
+
+Usage:
+    python tools/hlo_lint.py HLO_SNAPSHOTS --baseline tools/hlo_lint_baseline.json
+    python tools/hlo_lint.py HLO_SNAPSHOTS --update-baseline tools/hlo_lint_baseline.json
+    python tools/hlo_lint.py prog.hlo.txt --mesh dp=2,tp=2 --bf16-policy --rules H6,H7 --json
+    python tools/hlo_lint.py --list-rules
+    python tools/hlo_lint.py --verify-injection
+
+Each snapshot directory may carry a ``MANIFEST.json`` (written by
+bench_all.py) declaring the compile-time context the rules need:
+``{"config": ..., "mesh": {"dp": 2}, "bf16_policy": false}`` — the
+``--mesh`` / ``--bf16-policy`` flags override it. Baseline entries key
+on (snapshot path, rule, instruction-name stem); a baseline entry may
+carry a ``"note"`` field documenting the triage decision — notes are
+preserved across ``--update-baseline``.
+
+``--verify-injection`` is the gate's self-test (the check_resilience
+pattern): two synthetic regressions — a forced-f32 matmul compiled
+under a bf16 policy, and a forced-replicated 8 MiB parameter on a
+dp×tp mesh — MUST be flagged (H2 / H7, named per entry) or the gate
+fails. A linter that cannot see a planted regression is worse than no
+linter.
+
+Exit codes follow tools/_gate.py: 0 clean-vs-baseline, 1 otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import importlib.util
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+
+sys.path.insert(0, _HERE)
+from _gate import add_gate_args, finish  # noqa: E402
+
+
+def _load_analysis():
+    """Import paddle_tpu/analysis (and its hlo subpackage) standalone so
+    a lint run never pays (or requires) the full framework/jax import —
+    same trick as tools/tpu_lint.py."""
+    pkg_dir = os.path.join(_REPO, "paddle_tpu", "analysis")
+    name = "_tpu_lint_analysis"
+    if name not in sys.modules:
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(pkg_dir, "__init__.py"),
+            submodule_search_locations=[pkg_dir])
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    analysis = sys.modules[name]
+    hlo = importlib.import_module(name + ".hlo")
+    return analysis, hlo
+
+
+# -- snapshot collection ------------------------------------------------------
+
+def collect_snapshots(paths):
+    """``[(label, file_path, manifest)]`` over the given files and
+    directories. Directories are walked for ``*.hlo.txt``; explicitly
+    named files are taken as-is. The label — the finding's ``path`` and
+    half of its baseline key — is the repo-relative path minus the
+    ``.hlo.txt`` suffix, so it is stable across runs and readable in
+    the baseline JSON."""
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append((_label(p), p, _manifest_for(os.path.dirname(p))))
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if not d.startswith("."))
+                mf = _manifest_for(root)
+                for f in sorted(files):
+                    if f.endswith(".hlo.txt"):
+                        fp = os.path.join(root, f)
+                        out.append((_label(fp), fp, mf))
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+def _label(path):
+    rp = os.path.relpath(os.path.abspath(path), _REPO).replace(os.sep, "/")
+    return rp[:-len(".hlo.txt")] if rp.endswith(".hlo.txt") else rp
+
+
+def _manifest_for(dirpath):
+    mp = os.path.join(dirpath or ".", "MANIFEST.json")
+    if not os.path.isfile(mp):
+        return {}
+    try:
+        with open(mp) as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def parse_mesh(text):
+    """``"dp=2,tp=4"`` → ordered ``{"dp": 2, "tp": 4}``."""
+    axes = {}
+    for part in (text or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, size = part.partition("=")
+        if not size.isdigit():
+            raise ValueError(f"bad --mesh component {part!r} "
+                             f"(want axis=size)")
+        axes[name.strip()] = int(size)
+    return axes
+
+
+# -- injection self-test ------------------------------------------------------
+
+# a forced-f32 matmul "compiled" while a bf16 autocast policy is active:
+# the regression class H2 exists for — an input that escaped the policy
+_INJECT_F32_MATMUL = """\
+HloModule injected_f32_matmul, entry_computation_layout={(f32[256,512]{1,0}, f32[512,256]{1,0})->f32[256,256]{1,0}}
+
+ENTRY %main.4 (p0.1: f32[256,512], p1.2: f32[512,256]) -> f32[256,256] {
+  %p0.1 = f32[256,512]{1,0} parameter(0), metadata={op_name="acts"}
+  %p1.2 = f32[512,256]{1,0} parameter(1), metadata={op_name="weights"}
+  ROOT %dot.3 = f32[256,256]{1,0} dot(%p0.1, %p1.2), lhs_contracting_dims={1}, rhs_contracting_dims={0}, metadata={op_name="jit(step)/dot_general" source_file="/src/model.py" source_line=42}
+}
+"""
+
+# an 8 MiB parameter materialized replicated on every device of a live
+# dp×tp mesh: the missed-sharding regression H7 exists for
+_INJECT_REPLICATED = """\
+HloModule injected_replicated_param, entry_computation_layout={(f32[2048,1024]{1,0}, f32[2048,1024]{1,0})->f32[2048,1024]{1,0}}
+
+ENTRY %main.4 (p0.1: f32[2048,1024], p1.2: f32[2048,1024]) -> f32[2048,1024] {
+  %p0.1 = f32[2048,1024]{1,0} parameter(0), sharding={replicated}, metadata={op_name="params.embedding"}
+  %p1.2 = f32[2048,1024]{1,0} parameter(1), metadata={op_name="grads"}
+  ROOT %add.3 = f32[2048,1024]{1,0} add(%p0.1, %p1.2), metadata={op_name="jit(step)/add" source_file="/src/opt.py" source_line=7}
+}
+"""
+
+
+def verify_injection(hlo, json_mode=False):
+    """Both planted regressions must be flagged, each naming its entry
+    and rule — exit 1 (gate FAIL) if the linter misses either."""
+    cases = [
+        ("injected.f32_matmul", _INJECT_F32_MATMUL, "H2",
+         hlo.AnalysisContext(entry="injected.f32_matmul",
+                             bf16_policy=True)),
+        ("injected.replicated_param", _INJECT_REPLICATED, "H7",
+         hlo.AnalysisContext(entry="injected.replicated_param",
+                             mesh_axes={"dp": 2, "tp": 2})),
+    ]
+    results = []
+    ok = True
+    for entry, text, want_rule, ctx in cases:
+        findings = hlo.analyze_hlo_text(text, ctx)
+        hits = [f for f in findings if f.rule == want_rule]
+        flagged = bool(hits)
+        ok = ok and flagged
+        results.append({"entry": entry, "rule": want_rule,
+                        "flagged": flagged,
+                        "message": hits[0].message if hits else None})
+        status = "FLAGGED" if flagged else "MISSED"
+        print(f"hlo-lint injection: {status} {want_rule} in {entry}"
+              + (f" — {hits[0].message}" if hits else ""),
+              file=sys.stderr)
+    detail = "; ".join(
+        f"{r['entry']}:{r['rule']}={'flagged' if r['flagged'] else 'MISSED'}"
+        for r in results)
+    return finish("hlo-lint-injection", ok, detail,
+                  payload={"cases": results}, json_mode=json_mode)
+
+
+# -- main ---------------------------------------------------------------------
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="post-compile HLO static analysis gate (H1-H8)")
+    ap.add_argument("paths", nargs="*",
+                    help="*.hlo.txt files or snapshot directories")
+    ap.add_argument("--baseline", help="ratchet baseline JSON to gate against")
+    ap.add_argument("--update-baseline", metavar="PATH",
+                    help="write the current findings as the new baseline "
+                         "(preserving entry notes) and exit 0")
+    ap.add_argument("--rules", help="comma-separated rule subset (e.g. H2,H7)")
+    ap.add_argument("--mesh", help="mesh axes as axis=size,... — overrides "
+                                   "the snapshot MANIFEST.json")
+    ap.add_argument("--bf16-policy", action="store_true",
+                    help="treat every program as compiled under a bf16 "
+                         "autocast policy (arms H2's f32-matmul check)")
+    ap.add_argument("--no-hints", action="store_true",
+                    help="omit fix hints from text output")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--verify-injection", action="store_true",
+                    help="self-test: the two planted synthetic regressions "
+                         "must be flagged")
+    add_gate_args(ap)
+    args = ap.parse_args(argv)
+
+    analysis, hlo = _load_analysis()
+
+    if args.list_rules:
+        for r in hlo.HLO_RULES.values():
+            print(f"{r.id}  {r.severity:<7}  {r.title}")
+        return 0
+    if args.verify_injection:
+        return verify_injection(hlo, json_mode=args.json)
+    if not args.paths:
+        ap.error("no paths given")
+
+    select = None
+    if args.rules:
+        select = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = select - set(hlo.HLO_RULES)
+        if unknown:
+            ap.error(f"unknown rule(s): {sorted(unknown)}")
+
+    try:
+        cli_mesh = parse_mesh(args.mesh) if args.mesh else None
+    except ValueError as e:
+        ap.error(str(e))
+
+    try:
+        snapshots = collect_snapshots(args.paths)
+    except FileNotFoundError as e:
+        return finish("hlo-lint", False, f"no such path: {e}",
+                      json_mode=args.json)
+
+    findings = []
+    for label, path, manifest in snapshots:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        mesh = cli_mesh if cli_mesh is not None \
+            else {str(k): int(v)
+                  for k, v in (manifest.get("mesh") or {}).items()}
+        ctx = hlo.AnalysisContext(
+            entry=label, mesh_axes=mesh,
+            bf16_policy=args.bf16_policy
+            or bool(manifest.get("bf16_policy")))
+        findings.extend(hlo.analyze_hlo_text(text, ctx, select=select))
+
+    if args.update_baseline:
+        base = analysis.make_baseline(findings)
+        # carry triage notes forward: a regenerate must not erase the
+        # WHY recorded against entries that still exist
+        notes = {}
+        if os.path.exists(args.update_baseline):
+            try:
+                old = analysis.load_baseline(args.update_baseline)
+                notes = {(e["file"], e["rule"], e["context"]): e["note"]
+                         for e in old.get("entries", []) if e.get("note")}
+            except (OSError, ValueError, KeyError):
+                pass
+        for e in base["entries"]:
+            note = notes.get((e["file"], e["rule"], e["context"]))
+            if note:
+                e["note"] = note
+        analysis.save_baseline(args.update_baseline, base)
+        return finish(
+            "hlo-lint", True,
+            f"baseline written to {args.update_baseline} "
+            f"({len(findings)} finding(s) over {len(snapshots)} programs)",
+            json_mode=args.json)
+
+    stale, n_baselined = [], 0
+    if args.baseline:
+        try:
+            base = analysis.load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            return finish("hlo-lint", False, f"bad baseline: {e}",
+                          json_mode=args.json)
+        new, stale, n_baselined = analysis.compare(findings, base)
+    else:
+        new = findings
+
+    detail = analysis.summary_line(len(new), n_baselined, len(stale),
+                                   len(snapshots)).replace(
+        " files,", " programs,", 1)
+    if args.json:
+        payload = analysis.render_json(new, stale, n_baselined)
+        return finish("hlo-lint", not new, detail, payload=payload,
+                      json_mode=True)
+    if new:
+        analysis.render_text(new, sys.stderr,
+                             show_hints=not args.no_hints)
+    for e in stale:
+        print(f"hlo-lint: stale baseline entry ({e['file']} {e['rule']} "
+              f"{e['context']}: {e['observed']}/{e['count']} remain) — "
+              f"burned down! regenerate with --update-baseline",
+              file=sys.stderr)
+    return finish("hlo-lint", not new, detail)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,12 @@ Usage:
     python tools/tpu_lint.py paddle_tpu --update-baseline tools/tpu_lint_baseline.json
     python tools/tpu_lint.py some/file.py --rules R1,R4 --json
     python tools/tpu_lint.py --list-rules
+    python tools/tpu_lint.py paddle_tpu --changed-only main --baseline tools/tpu_lint_baseline.json
+
+``--changed-only [BASE]`` (pre-commit mode) restricts the run to files
+reported by ``git diff --name-only BASE`` (default BASE: HEAD) plus
+untracked files — the baseline comparison is likewise restricted, so an
+unchanged file's baselined debt neither runs nor reads as stale.
 
 Suppression: ``# tpu-lint: disable=R1`` on the offending line (or
 ``# tpu-lint: disable-next=R1`` on the line before) with a short
@@ -69,6 +75,21 @@ def relpath(p):
     return rp.replace(os.sep, "/")
 
 
+def changed_files(base):
+    """Repo-relative paths changed vs ``base`` (git diff --name-only)
+    plus untracked files — everything a pre-commit run should look at.
+    Raises CalledProcessError/OSError when git or the ref is unusable."""
+    import subprocess
+
+    def _lines(*cmd):
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             cwd=_REPO, check=True).stdout
+        return {ln.strip() for ln in out.splitlines() if ln.strip()}
+
+    return _lines("git", "diff", "--name-only", base) | _lines(
+        "git", "ls-files", "--others", "--exclude-standard")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="AST tracer-safety / retrace-hazard linter (R1-R8)")
@@ -81,6 +102,11 @@ def main(argv=None):
     ap.add_argument("--no-hints", action="store_true",
                     help="omit fix hints from text output")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--changed-only", nargs="?", const="HEAD",
+                    metavar="BASE",
+                    help="lint only files changed vs BASE (git diff "
+                         "--name-only BASE, default HEAD, plus untracked "
+                         "files) — the cheap pre-commit mode")
     add_gate_args(ap)
     args = ap.parse_args(argv)
 
@@ -105,6 +131,17 @@ def main(argv=None):
     except FileNotFoundError as e:
         return finish("tpu-lint", False, f"no such path: {e}",
                       json_mode=args.json)
+
+    changed = None
+    if args.changed_only:
+        try:
+            changed = changed_files(args.changed_only)
+        except Exception as e:  # noqa: BLE001 — no git, bad ref, ...
+            return finish("tpu-lint", False,
+                          f"--changed-only: git diff vs "
+                          f"{args.changed_only!r} failed: {e}",
+                          json_mode=args.json)
+        files = [p for p in files if relpath(p) in changed]
 
     findings = []
     for path in files:
@@ -134,6 +171,12 @@ def main(argv=None):
         except (OSError, ValueError) as e:
             return finish("tpu-lint", False, f"bad baseline: {e}",
                           json_mode=args.json)
+        if changed is not None:
+            # unchanged files weren't linted: their baselined debt must
+            # not read as burned-down stale entries
+            base = dict(base)
+            base["entries"] = [e for e in base.get("entries", [])
+                               if e.get("file") in changed]
         new, stale, n_baselined = analysis.compare(findings, base)
     else:
         new = findings
