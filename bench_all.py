@@ -851,6 +851,22 @@ def main():
         r["hlolint_findings"] = sum(
             v for k, v in tel.counter_scalars().items()
             if k.startswith("counter/hlolint/findings."))
+        # goodput columns (profiler.goodput): tel.reset() above swapped
+        # in a fresh ledger, so this snapshot attributes ONLY this
+        # config's wall clock — the fraction and per-category seconds
+        # become trajectory movers (a config whose input_wait_s doubled
+        # names its suspect without a profiler run)
+        try:
+            from paddle_tpu.profiler import goodput as _goodput
+
+            gsnap = _goodput.snapshot()
+            if gsnap["wall_s"] > 0:
+                r["goodput_fraction"] = round(gsnap["fraction"], 4)
+                for cat, secs in gsnap["categories"].items():
+                    if secs > 0:
+                        r[f"goodput_{cat}_s"] = round(secs, 3)
+        except Exception:
+            pass  # attribution must never fail a bench record
         _dump_hlo_snapshots(name)
         print(json.dumps(r), flush=True)
         # machine-readable telemetry, one record per config written the

@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.profiler import device_profile as _device_profile
+from paddle_tpu.profiler import goodput as _goodput
 from paddle_tpu.profiler import spans as _spans
 from paddle_tpu.profiler import xla_cost as _xla_cost
 from paddle_tpu.profiler.retrace import tracked_jit
@@ -686,8 +687,11 @@ class ParallelTrainStep:
         # windowed device-profile capture boundary (no-op unless armed)
         _device_profile.step_boundary("fleet.train_step")
         t_enter = time.perf_counter()
-        with _spans.span("step", cat="step",
-                         step=self._optimizer._global_step):
+        # goodput: the step call (h2d + dispatch; a compile inside
+        # claims its own category) is productive_step wall time
+        with _goodput.activity("productive_step"), \
+                _spans.span("step", cat="step",
+                            step=self._optimizer._global_step):
             with _spans.span("h2d", cat="h2d"):
                 # ONE pytree transfer for the whole batch (single
                 # dispatch; an already-sharded array — e.g. from
@@ -785,10 +789,12 @@ class ParallelTrainStep:
         t_enter = time.perf_counter()
 
         # the whole window — h2d, scan compile, LR sampling, dispatch —
-        # lives under one step span; the helper split keeps the long
-        # body at its original indentation
-        with _spans.span("step", cat="step",
-                         step=self._optimizer._global_step):
+        # lives under one step span (and one productive_step goodput
+        # claim; the scan compile inside claims its own category); the
+        # helper split keeps the long body at its original indentation
+        with _goodput.activity("productive_step"), \
+                _spans.span("step", cat="step",
+                            step=self._optimizer._global_step):
             return self._run_steps_in_span(inputs, labels, step_scheduler,
                                            t_enter)
 

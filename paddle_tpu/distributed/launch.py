@@ -302,6 +302,28 @@ def _run_job_once(training_script, script_args, envs, log_dir, backend,
     return rc, events
 
 
+def _death_timestamp(log_dir: str, envs: List[dict]) -> float:
+    """Best-effort date of a dead attempt's death: the newest per-rank
+    heartbeat mtime — ranks touch their heartbeat file every step, so
+    the last beat is the last moment the job was provably making
+    progress (for a hang that is well BEFORE the supervisor's stale
+    detection; for a preemption it is the last step before the spill).
+    Falls back to now when no rank ever beat."""
+    now = time.time()
+    best = None
+    for env in envs:
+        try:
+            m = os.path.getmtime(
+                heartbeat_path(log_dir, env["PADDLE_TRAINER_ID"]))
+        except OSError:
+            continue
+        if best is None or m > best:
+            best = m
+    if best is None or best > now:
+        return now
+    return best
+
+
 def _preempt_exit_code() -> int:
     from paddle_tpu.resilience.preemption import EXIT_PREEMPTED
 
@@ -343,6 +365,7 @@ def launch(training_script: str, script_args: List[str],
     a final record there at exit, and ``tools/telemetry_agg.py`` merges
     the per-rank files into one cluster view with straggler
     detection."""
+    from paddle_tpu.profiler import goodput as _goodput
     from paddle_tpu.profiler.telemetry import get_telemetry
     from paddle_tpu.resilience.retry import backoff_delays
 
@@ -377,7 +400,21 @@ def launch(training_script: str, script_args: List[str],
     tel = get_telemetry()
     attempt = 0
     rank_failures = 0
+    pending_death_ts = None
     while True:
+        if pending_death_ts is not None:
+            # the children respawn NOW: the job was dead from the
+            # (heartbeat-dated) death of the previous attempt to this
+            # instant. The histogram records the relaunch cost; the
+            # launcher's own goodput ledger books the same seconds as
+            # restart_downtime (a transfer out of its base state, so its
+            # ledger still conserves) — that is how the category
+            # survives the worker process that caused it.
+            downtime_s = max(0.0, time.time() - pending_death_ts)
+            tel.observe("resilience/restart_downtime_ms",
+                        downtime_s * 1e3)
+            _goodput.ledger().reattribute("restart_downtime", downtime_s)
+            pending_death_ts = None
         rc, events = _run_job_once(training_script, script_args, envs,
                                    log_dir, backend, extra_env,
                                    log_mode="w" if attempt == 0 else "a",
@@ -415,6 +452,10 @@ def launch(training_script: str, script_args: List[str],
         why = {_preempt_exit_code(): "preempted",
                _watchdog_exit_code(): "hung/self-aborted"}.get(
                    rc, "rank failure")
+        # date the death BEFORE the backoff sleep: heartbeat mtimes are
+        # still fresh from the dead attempt and the stale-file sweep at
+        # job start already removed any previous job's files
+        pending_death_ts = _death_timestamp(log_dir, envs)
         sys.stderr.write(
             f"[launch] job {why} (exit {rc}); relaunching in "
             f"{delays[attempt]:.2f}s (attempt {attempt + 1}/{max_restarts})\n")

@@ -346,6 +346,12 @@ class ServingEngine:
                 return
             self._drain_reason = reason
             self._queue.start_drain()
+        # goodput: from the drain latch until exit, unclaimed wall time
+        # is drain_shutdown, not unattributed (thread-agnostic flip —
+        # the latch may trip from the scheduler thread)
+        from paddle_tpu.profiler import goodput as _goodput
+
+        _goodput.shutdown_begin()
         if self._tel.enabled:
             self._tel.gauge("serve/draining", 1)
             self._tel.counter("serve/drains")

@@ -36,6 +36,7 @@ from typing import Dict, List
 import numpy as np
 
 from ...profiler import device_profile as _device_profile
+from ...profiler import goodput as _goodput
 from ...profiler.retrace import tracked_jit
 from ...profiler.telemetry import get_telemetry
 from ...resilience.inject import active_injector
@@ -128,7 +129,12 @@ class BatchScheduler:
                 # device-profile capture boundary: one serving batch is
                 # one "step" of this loop (no-op unless a capture armed)
                 _device_profile.step_boundary("serve.step")
-                self._run_batch(ready)
+                # goodput: one served batch is one productive step of
+                # this host loop (in a serving-only process the
+                # scheduler thread is the ledger's driver; inside a
+                # trainer it is a background thread and this is a no-op)
+                with _goodput.activity("productive_step"):
+                    self._run_batch(ready)
                 self.batch_index += 1
                 inj = active_injector()
                 if inj is not None:

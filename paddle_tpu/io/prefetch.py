@@ -255,25 +255,33 @@ class DevicePrefetcher:
         if not self._started:
             self._started = True
             self._thread.start()
-        while True:
-            try:
-                item = self._q.get(timeout=0.2)
-                break
-            except queue.Empty:
-                if self._closed.is_set():
-                    raise StopIteration from None
-                if not self._thread.is_alive():
-                    # the worker may have staged its final items BETWEEN our
-                    # timed-out get and this liveness check — its puts all
-                    # happened-before thread exit, so one non-blocking get
-                    # now is race-free; only a truly empty queue means the
-                    # worker died without a sentinel (interpreter teardown)
-                    try:
-                        item = self._q.get_nowait()
-                        break
-                    except queue.Empty:
-                        self._exhausted = True
+        from paddle_tpu.profiler import goodput as _goodput
+
+        # goodput: the consumer-side block on the staging queue is the
+        # input stall the ledger calls input_wait — ONLY this wait, not
+        # the worker's overlapped staging (a background thread; its
+        # claims are no-ops by the ledger's driver-thread rule)
+        with _goodput.activity("input_wait"):
+            while True:
+                try:
+                    item = self._q.get(timeout=0.2)
+                    break
+                except queue.Empty:
+                    if self._closed.is_set():
                         raise StopIteration from None
+                    if not self._thread.is_alive():
+                        # the worker may have staged its final items
+                        # BETWEEN our timed-out get and this liveness
+                        # check — its puts all happened-before thread
+                        # exit, so one non-blocking get now is race-free;
+                        # only a truly empty queue means the worker died
+                        # without a sentinel (interpreter teardown)
+                        try:
+                            item = self._q.get_nowait()
+                            break
+                        except queue.Empty:
+                            self._exhausted = True
+                            raise StopIteration from None
         tel = get_telemetry()
         if tel.enabled:
             tel.gauge("prefetch/queue_depth", self._q.qsize())

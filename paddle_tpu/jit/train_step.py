@@ -21,6 +21,7 @@ from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 from ..optimizer.optimizer import Optimizer
 from ..profiler import device_profile as _device_profile
+from ..profiler import goodput as _goodput
 from ..profiler import spans as _spans
 from ..profiler.retrace import tracked_jit
 from ..profiler.telemetry import get_telemetry
@@ -264,6 +265,13 @@ class TrainStep:
         # on-demand device profiling: a no-op global check unless a
         # windowed capture is armed (env cadence or POST /debug/profile)
         _device_profile.step_boundary("jit.train_step")
+        # goodput: the whole call is productive_step wall time; a
+        # compile triggered inside claims its own category (nested),
+        # and the helper split keeps the body at its original indent
+        with _goodput.activity("productive_step"):
+            return self._call_in_claim(inputs, labels)
+
+    def _call_in_claim(self, inputs, labels):
         with contextlib.ExitStack() as _stk:
             if not _spans.in_category("step"):
                 # hapi fit (or another loop-level owner) may already hold
@@ -407,11 +415,15 @@ class EvalStep:
         return DevicePrefetcher(batches, depth=depth, buckets=buckets)
 
     def __call__(self, *inputs):
-        # one pytree transfer instead of one implicit put per array
-        raw = jax.device_put(tuple(
-            a._value if isinstance(a, Tensor) else jnp.asarray(a)
-            for a in inputs))
-        out = self._jitted(get_params(self._layer), get_buffers(self._layer), *raw)
+        # goodput: eval wall time is its own ledger category (an eval
+        # pass inside a training loop nests under the loop's claims)
+        with _goodput.activity("eval"):
+            # one pytree transfer instead of one implicit put per array
+            raw = jax.device_put(tuple(
+                a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in inputs))
+            out = self._jitted(get_params(self._layer),
+                               get_buffers(self._layer), *raw)
         from .functionalize import _wrap_tree
 
         return _wrap_tree(out)

@@ -40,13 +40,22 @@ ONE timeline with per-rank tracks and names the late rank per collective
 instance (LATE-RANK findings in ``telemetry_agg``, gated by
 ``tools/check_cluster_timeline.py``).
 
+Goodput ledger (``goodput``): process-wide wall-clock attribution —
+every job second lands in exactly one category of a closed vocabulary
+(productive_step / compile / input_wait / checkpoint / rollback /
+restart downtime / …), fed by the instrumentation points above,
+published as ``gauge/goodput/*`` + a structured ``"goodput"`` JSONL
+table, merged cross-rank and cross-restart by ``aggregate``, and gated
+for conservation by ``tools/check_goodput.py``.
+
 The legacy span API (``RecordEvent``, ``Profiler``, ``start_profiler``…)
 stays in ``paddle_tpu.utils.profiler`` and is re-exported here so
 ``paddle.profiler.Profiler``-style code ports unchanged.
 """
 from . import aggregate, bottleneck, device_profile, hlo_attrib  # noqa: F401
 from . import cluster_trace, collective_attrib  # noqa: F401
-from . import spans, xla_cost  # noqa: F401
+from . import goodput, spans, xla_cost  # noqa: F401
+from .goodput import GoodputLedger  # noqa: F401
 from .bottleneck import VERDICT_IDS, VERDICT_NAMES  # noqa: F401
 from .device_profile import request_capture  # noqa: F401
 from .hlo_attrib import attribute_trace, hlo_registry, parse_hlo_text  # noqa: F401
@@ -109,4 +118,5 @@ __all__ = [
     "spans", "xla_cost", "aggregate", "ops_server", "slo",
     "device_profile", "hlo_attrib", "bottleneck",
     "collective_attrib", "cluster_trace",
+    "goodput", "GoodputLedger",
 ]

@@ -46,9 +46,10 @@ __all__ = [
     "cluster_view", "detect_stragglers", "detect_dead_ranks",
     "detect_suspect_chips", "detect_slo_burns", "collect_bottlenecks",
     "detect_late_ranks", "dominant_collective_axis",
+    "goodput_tables", "launch_restart_downtime", "goodput_summary",
     "aggregate", "STEP_HIST_PATTERN", "SDC_REPAIR_PATTERN",
     "ALERT_PATTERN", "BOTTLENECK_PATTERN", "BOTTLENECK_NAMES",
-    "COLLECTIVE_PATTERN",
+    "COLLECTIVE_PATTERN", "GOODPUT_CATEGORIES",
 ]
 
 # any per-rank step-latency p50 qualifies for straggler comparison
@@ -75,6 +76,16 @@ BOTTLENECK_NAMES = {0: "compute_bound", 1: "memory_bound", 2: "comm_bound",
 # gauge/collective/<axis>/<field>.<entry>
 COLLECTIVE_PATTERN = re.compile(
     r"^gauge/collective/([^/]+)/(bytes|ms|count)\.(.+)$")
+
+# the goodput ledger's closed category vocabulary — a LITERAL mirror of
+# profiler.goodput.CATEGORIES (this module is loaded standalone by
+# tools/telemetry_agg.py via spec_from_file_location, so it cannot
+# import the sibling; tests assert the two stay identical)
+GOODPUT_CATEGORIES = (
+    "startup", "productive_step", "compile", "input_wait",
+    "checkpoint_save", "checkpoint_restore", "rollback_recovery",
+    "eval", "drain_shutdown", "restart_downtime", "unattributed",
+)
 
 _RANK_RE = re.compile(r"rank[._-]?(\d+)")
 
@@ -346,6 +357,100 @@ def detect_dead_ranks(paths: Sequence[str],
     return findings
 
 
+def goodput_tables(records: Sequence[dict]) -> Dict[int, dict]:
+    """One rank's per-attempt goodput tables: the LAST structured
+    ``rec["goodput"]`` table per launch attempt wins (each table is
+    cumulative within its attempt, so the last one is the attempt's
+    total). Launcher records (``tag == "launch"``) are skipped — the
+    launcher's own ledger spans the whole job and would double-count
+    every rank second it supervised."""
+    out: Dict[int, dict] = {}
+    for rec in records:
+        if rec.get("tag") == "launch":
+            continue
+        g = rec.get("goodput")
+        if isinstance(g, dict) and isinstance(g.get("categories"), dict):
+            try:
+                attempt = int(g.get("attempt", 0) or 0)
+            except (TypeError, ValueError):
+                attempt = 0
+            out[attempt] = g
+    return out
+
+
+def launch_restart_downtime(rank_records: Dict[int, List[dict]]) -> float:
+    """Job-level restart downtime from the launcher's flushed record
+    (``tag == "launch"``): the dead gap between attempts lives in the
+    LAUNCHER's ledger, because no worker process exists to book it."""
+    best = 0.0
+    for records in rank_records.values():
+        for rec in records:
+            if rec.get("tag") != "launch":
+                continue
+            g = rec.get("goodput") or {}
+            v = (g.get("categories") or {}).get("restart_downtime")
+            if v is None:
+                v = rec.get("scalars", {}).get(
+                    "gauge/goodput/restart_downtime_s")
+            if isinstance(v, (int, float)) and math.isfinite(float(v)):
+                best = max(best, float(v))
+    return best
+
+
+def goodput_summary(rank_records: Dict[int, List[dict]]) -> Optional[dict]:
+    """Cross-rank, cross-restart goodput merge.
+
+    Per rank: attempts SUM (each attempt's last table is its total —
+    that is the cross-restart stitching). Job view: categories and wall
+    are the MEAN across ranks (N ranks run concurrently; one wall second
+    is one job second, not N), then the launcher's ``restart_downtime``
+    is added ONCE to both the wall and its category. Returns None when
+    no record carries a goodput table."""
+    per_rank: Dict[int, dict] = {}
+    for rank, records in sorted(rank_records.items()):
+        tables = goodput_tables(records)
+        if not tables:
+            continue
+        cats = {c: 0.0 for c in GOODPUT_CATEGORIES}
+        wall = 0.0
+        for _attempt, g in sorted(tables.items()):
+            wall += float(g.get("wall_s", 0.0) or 0.0)
+            for c, v in (g.get("categories") or {}).items():
+                if c in cats and isinstance(v, (int, float)):
+                    cats[c] += float(v)
+        per_rank[rank] = {
+            "wall_s": wall,
+            "attempts": len(tables),
+            "fraction": (cats["productive_step"] / wall) if wall > 0 else 0.0,
+            "categories": cats,
+            "conservation_err": (abs(wall - sum(cats.values())) / wall
+                                 if wall > 0 else 0.0),
+        }
+    if not per_rank:
+        return None
+    downtime = launch_restart_downtime(rank_records)
+    n = len(per_rank)
+    job_cats = {c: sum(r["categories"][c] for r in per_rank.values()) / n
+                for c in GOODPUT_CATEGORIES}
+    job_wall = sum(r["wall_s"] for r in per_rank.values()) / n + downtime
+    job_cats["restart_downtime"] += downtime
+    worst = min(per_rank, key=lambda r: per_rank[r]["fraction"])
+    return {
+        "per_rank": per_rank,
+        "job": {
+            "wall_s": job_wall,
+            "fraction": (job_cats["productive_step"] / job_wall
+                         if job_wall > 0 else 0.0),
+            "categories": job_cats,
+            "restart_downtime_s": downtime,
+        },
+        "worst_rank": {"rank": worst,
+                       "fraction": per_rank[worst]["fraction"]},
+        "conservation_err": max(r["conservation_err"]
+                                for r in per_rank.values()),
+    }
+
+
 def aggregate(paths: Sequence[str], threshold: float = 1.25,
               tag: Optional[str] = None,
               expected_ranks: Optional[int] = None,
@@ -355,11 +460,21 @@ def aggregate(paths: Sequence[str], threshold: float = 1.25,
     twice — tag-filtered for the view, unfiltered for liveness — rather
     than re-read."""
     rank_records: Dict[int, List[dict]] = {}
+    launch_records: List[dict] = []
     for i, path in enumerate(sorted(paths)):
         try:
-            rank_records[rank_of_path(path, i)] = read_jsonl(path)
+            records = read_jsonl(path)
         except OSError:
             continue  # a missing/unreadable rank drops out of the view
+        # the launcher's own flushed records (the shared base file, no
+        # rank token in its name) ride along when the whole log dir is
+        # globbed — partition them out so rank_of_path's index fallback
+        # cannot collide them onto (and silently replace) a real rank
+        launch = [r for r in records if r.get("tag") == "launch"]
+        workers = [r for r in records if r.get("tag") != "launch"]
+        launch_records.extend(launch)
+        if workers or not launch:
+            rank_records[rank_of_path(path, i)] = workers
 
     def _fold(fold_tag: Optional[str]) -> Dict[int, Dict[str, float]]:
         out: Dict[int, Dict[str, float]] = {}
@@ -370,6 +485,11 @@ def aggregate(paths: Sequence[str], threshold: float = 1.25,
         return out
 
     rank_scalars = _fold(tag)
+    goodput_view = dict(rank_records)
+    if launch_records:
+        # the launcher's records re-enter under a key no rank uses, so
+        # its restart_downtime is found without shadowing a real rank
+        goodput_view[-1] = launch_records
     result = {
         "ranks": sorted(rank_scalars),
         "n_ranks": len(rank_scalars),
@@ -381,6 +501,7 @@ def aggregate(paths: Sequence[str], threshold: float = 1.25,
         "suspect_repairs": float(suspect_repairs),
         "slo_burns": detect_slo_burns(rank_scalars),
         "bottlenecks": collect_bottlenecks(rank_scalars),
+        "goodput": goodput_summary(goodput_view),
     }
     if expected_ranks is not None:
         # liveness is judged on UNFILTERED records: a healthy rank whose

@@ -1,0 +1,290 @@
+"""Goodput ledger — exhaustive wall-clock attribution of every job second.
+
+Throughput tells you how fast the steps you ran were; **goodput** tells
+you what fraction of the wall-clock you *paid for* became steps at all.
+This module keeps one process-wide ledger in which every second since
+process start lands in exactly ONE category of a closed vocabulary:
+
+    startup             process start until the first productive step
+    productive_step     inside a train-step call (engine/executor/guard)
+    compile             trace + XLA compile of an unseen signature
+    input_wait          the consumer blocked on the prefetch queue
+    checkpoint_save     checkpoint write / emergency spill
+    checkpoint_restore  checkpoint read / manifest-fallback walk / resume
+    rollback_recovery   StepGuard quarantine + snapshot rollback + replay
+    eval                inside an EvalStep call
+    drain_shutdown      preemption / serving drain until exit
+    restart_downtime    dead job gap between attempts (launcher-booked)
+    unattributed        the honest remainder — nothing claimed it
+
+The ledger is NOT a second layer of clocks: the instrumentation points
+that already exist (tracked_jit compile timing, prefetch queue waits,
+checkpoint timers, StepGuard rollback paths, step boundaries) each wrap
+their existing timed region in :func:`activity`, which claims the span
+for its category. Claims nest: an inner claim suspends the outer one, so
+overlapping activities (a compile inside an open step, a spill inside a
+drain) never double-book — each wall second has exactly one owner.
+
+Mechanics — a tiny state machine on the *driver thread* (the first
+thread to claim an activity; claims from other threads are no-ops, so a
+background prefetch stage overlapping a device step books nothing):
+
+- the base state starts at ``startup`` and flips permanently to
+  ``unattributed`` at the first ``productive_step`` claim (everything a
+  claim does not cover after training begins is honestly unaccounted);
+- ``shutdown_begin()`` flips the base to ``drain_shutdown``;
+- every transition books ``perf_counter`` elapsed to the outgoing top of
+  the claim stack. ``snapshot()`` folds the pending span in and computes
+  ``unattributed = wall - sum(claimed)``, so categories sum to measured
+  wall by construction — the conservation contract ``check_goodput.py``
+  gates on.
+
+Cross-restart stitching: each attempt's ledger is stamped with
+``PADDLE_TPU_LAUNCH_ATTEMPT`` and flushed into the rank's JSONL as a
+structured ``"goodput"`` record table; the launcher books the dead gap
+between attempts (heartbeat-dated death -> respawn) into its OWN ledger
+as ``restart_downtime``. ``profiler.aggregate.goodput_summary`` sums a
+rank across attempts and adds the launcher's downtime once, so the
+category survives the process that caused it.
+
+Everything here is host-side (two ``perf_counter`` reads and a dict add
+per transition) — no device syncs, nothing traced, zero retrace impact.
+Disable with ``PADDLE_TPU_GOODPUT=0``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = [
+    "CATEGORIES", "GoodputLedger", "ledger", "activity", "shutdown_begin",
+    "publish", "jsonl_payload", "snapshot", "reset",
+]
+
+# The closed vocabulary. ``unattributed`` is computed, never claimed by
+# instrumentation — claiming it would defeat its honesty.
+CATEGORIES = (
+    "startup",
+    "productive_step",
+    "compile",
+    "input_wait",
+    "checkpoint_save",
+    "checkpoint_restore",
+    "rollback_recovery",
+    "eval",
+    "drain_shutdown",
+    "restart_downtime",
+    "unattributed",
+)
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("PADDLE_TPU_GOODPUT", "1").strip().lower() not in (
+        "0", "false", "off")
+
+
+class _Activity:
+    """Context manager for one claimed span (see ``activity``). Cheap:
+    allocation + two lock/clock pairs; safe to enter per batch."""
+
+    __slots__ = ("_led", "_cat", "_live")
+
+    def __init__(self, led: "GoodputLedger", cat: str):
+        self._led = led
+        self._cat = cat
+        self._live = False
+
+    def __enter__(self):
+        led = self._led
+        if led._enabled:
+            with led._lock:
+                if led._claims_here():
+                    led._book_to_top(time.perf_counter())
+                    if (self._cat == "productive_step"
+                            and led._stack[0] == "startup"):
+                        # training has begun: from here on, unclaimed
+                        # time is honestly unaccounted, not "startup"
+                        led._stack[0] = "unattributed"
+                    led._stack.append(self._cat)
+                    self._live = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._live:
+            led = self._led
+            with led._lock:
+                led._book_to_top(time.perf_counter())
+                if len(led._stack) > 1:
+                    led._stack.pop()
+        return False
+
+
+class GoodputLedger:
+    """Process-wide wall-clock ledger (one per process; see module doc)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self.started_at = time.time()
+        self._mark = self._t0
+        self._totals: Dict[str, float] = {c: 0.0 for c in CATEGORIES}
+        self._stack = ["startup"]
+        self._owner: Optional[int] = None
+        self._enabled = _env_enabled()
+        try:
+            self.attempt = int(
+                os.environ.get("PADDLE_TPU_LAUNCH_ATTEMPT", "0") or 0)
+        except ValueError:
+            self.attempt = 0
+
+    # -- internals (lock held) ---------------------------------------------
+    def _book_to_top(self, now: float) -> None:
+        dt = now - self._mark
+        if dt > 0:
+            self._totals[self._stack[-1]] += dt
+        self._mark = now
+
+    def _claims_here(self) -> bool:
+        # the first claiming thread becomes the driver; a background
+        # stage thread overlapping the step loop must not double-book
+        ident = threading.get_ident()
+        if self._owner is None:
+            self._owner = ident
+        return self._owner == ident
+
+    # -- claiming API -------------------------------------------------------
+    def activity(self, category: str) -> _Activity:
+        if category not in CATEGORIES or category == "unattributed":
+            raise ValueError(f"unknown goodput category: {category!r}")
+        return _Activity(self, category)
+
+    def shutdown_begin(self) -> None:
+        """Flip the base state to ``drain_shutdown`` (preemption exit,
+        serving drain). Thread-agnostic — the latch may be flipped from a
+        scheduler thread; open claims keep booking to themselves and the
+        base change takes effect when they pop."""
+        if not self._enabled:
+            return
+        with self._lock:
+            if self._stack[0] == "drain_shutdown":
+                return
+            if len(self._stack) == 1:
+                # the base IS the running span: close it first so the
+                # pre-drain seconds stay with the old state
+                self._book_to_top(time.perf_counter())
+            self._stack[0] = "drain_shutdown"
+
+    def reattribute(self, category: str, seconds: float,
+                    source: Optional[str] = None) -> float:
+        """Move up to ``seconds`` of already-booked wall time from
+        ``source`` (default: the base state) into ``category`` — the
+        launcher uses this to backdate restart downtime to the
+        heartbeat-dated death, which precedes its own detection of it.
+        Conservation-preserving by construction (a transfer, not an
+        addition). Returns the seconds actually moved."""
+        if category not in CATEGORIES or not self._enabled:
+            return 0.0
+        with self._lock:
+            self._book_to_top(time.perf_counter())
+            src = source or self._stack[0]
+            take = min(max(0.0, float(seconds)), self._totals.get(src, 0.0))
+            if take > 0:
+                self._totals[src] -= take
+                self._totals[category] += take
+            return take
+
+    # -- reading ------------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Current totals including the pending span; ``unattributed`` is
+        recomputed as the wall residual so categories sum to ``wall_s``
+        exactly (the conservation contract)."""
+        with self._lock:
+            now = time.perf_counter()
+            self._book_to_top(now)
+            wall = now - self._t0
+            cats = dict(self._totals)
+            current = self._stack[-1]
+        claimed = sum(v for c, v in cats.items() if c != "unattributed")
+        cats["unattributed"] = max(0.0, wall - claimed)
+        frac = min(1.0, cats["productive_step"] / wall) if wall > 0 else 0.0
+        return {
+            "wall_s": wall,
+            "fraction": frac,
+            "attempt": self.attempt,
+            "current": current,
+            "categories": cats,
+        }
+
+
+# -- module-level singleton ------------------------------------------------
+# Created at import so the startup clock starts as early as the first
+# paddle_tpu import; ``reset()`` swaps in a fresh ledger (bench_all resets
+# telemetry per config — each config then gets its own wall denominator).
+_LEDGER = GoodputLedger()
+
+
+def ledger() -> GoodputLedger:
+    return _LEDGER
+
+
+def activity(category: str) -> _Activity:
+    """Claim the enclosed span for ``category`` on the driver thread.
+    Nested claims suspend the outer one (no double-booking); claims from
+    non-driver threads are no-ops."""
+    return _LEDGER.activity(category)
+
+
+def shutdown_begin() -> None:
+    _LEDGER.shutdown_begin()
+
+
+def snapshot() -> dict:
+    return _LEDGER.snapshot()
+
+
+def reset() -> None:
+    global _LEDGER
+    _LEDGER = GoodputLedger()
+
+
+def publish(tel=None) -> Optional[dict]:
+    """Refresh ``gauge/goodput/*`` from the live ledger (called by
+    ``Telemetry.to_jsonl`` and the ``/metrics`` scrape, same lazy pattern
+    as the MFU/bottleneck publishers). Returns the snapshot."""
+    if not _LEDGER._enabled:
+        return None
+    snap = _LEDGER.snapshot()
+    if tel is None:
+        from .telemetry import get_telemetry
+
+        tel = get_telemetry()
+    if tel.enabled:
+        tel.gauge("goodput/wall_s", round(snap["wall_s"], 3))
+        tel.gauge("goodput/fraction", round(snap["fraction"], 4))
+        for cat, s in snap["categories"].items():
+            # always publish the headline pair; others only once nonzero
+            # (a closed vocabulary, not a mandatory one — a process that
+            # never checkpointed should not advertise checkpoint_save=0)
+            if s > 0 or cat in ("productive_step", "unattributed"):
+                tel.gauge(f"goodput/{cat}_s", round(s, 3))
+    return snap
+
+
+def jsonl_payload() -> Optional[dict]:
+    """Structured ``rec["goodput"]`` table for ``Telemetry.to_jsonl``
+    (``rec["profile"]`` precedent): rounded snapshot keyed for the
+    aggregator's cross-restart stitching."""
+    if not _LEDGER._enabled:
+        return None
+    snap = _LEDGER.snapshot()
+    return {
+        "wall_s": round(snap["wall_s"], 3),
+        "fraction": round(snap["fraction"], 4),
+        "attempt": snap["attempt"],
+        "current": snap["current"],
+        "categories": {c: round(s, 3)
+                       for c, s in snap["categories"].items()
+                       if round(s, 3) > 0},
+    }

@@ -369,13 +369,15 @@ class _Handler(BaseHTTPRequestHandler):
                 tel.counter("ops/scrapes")
                 try:
                     # refresh the derived attribution gauges (MFU,
-                    # bottleneck verdicts) so a live scrape sees current
-                    # values, not the last to_jsonl's — cheap dict math
-                    # over existing snapshots
-                    from . import bottleneck, xla_cost
+                    # bottleneck verdicts, goodput wall-clock ledger) so
+                    # a live scrape sees current values, not the last
+                    # to_jsonl's — cheap dict math over existing
+                    # snapshots
+                    from . import bottleneck, goodput, xla_cost
 
                     xla_cost.publish_mfu(tel)
                     bottleneck.publish(tel)
+                    goodput.publish(tel)
                 except Exception:
                     pass
                 self._send(200, prometheus_text(tel),
@@ -430,6 +432,22 @@ class _Handler(BaseHTTPRequestHandler):
                 except Exception:  # noqa: BLE001 — recorder optional
                     payload["eager_tail"] = []
                 self._send_json(200, payload)
+            elif url.path == "/debug/goodput":
+                # this rank's live wall-clock attribution: the full
+                # category breakdown (zeros included — the closed
+                # vocabulary is the contract), current ledger state and
+                # the conservation identity an operator can check by eye
+                from . import goodput
+
+                snap = goodput.snapshot()
+                self._send_json(200, {
+                    "rank": rank(),
+                    "wall_s": round(snap["wall_s"], 3),
+                    "fraction": round(snap["fraction"], 4),
+                    "attempt": snap["attempt"],
+                    "current": snap["current"],
+                    "categories": {c: round(s, 3) for c, s in
+                                   snap["categories"].items()}})
             else:
                 self._send_json(404, {"error": f"no route {url.path}",
                                       "routes": ["/metrics", "/healthz",
@@ -438,7 +456,8 @@ class _Handler(BaseHTTPRequestHandler):
                                                  "/debug/spans",
                                                  "/debug/telemetry",
                                                  "/debug/profile",
-                                                 "/debug/collectives"]})
+                                                 "/debug/collectives",
+                                                 "/debug/goodput"]})
         except Exception as e:  # noqa: BLE001 — handler must not die
             try:
                 self._send_json(500, {"error": repr(e)})

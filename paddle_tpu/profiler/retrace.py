@@ -173,7 +173,13 @@ def tracked_jit(fn=None, *, name: Optional[str] = None,
         if tracker.seen(sig):
             return jitted(*args, **kwargs)
         t0 = time.perf_counter()
-        out = jitted(*args, **kwargs)  # raises ⇒ signature NOT committed
+        # goodput: the same region compile_ms times — an unseen
+        # signature's triggering call is (re)trace + XLA compile, badput
+        # the wall-clock ledger must own (nested under the step's claim)
+        from . import goodput
+
+        with goodput.activity("compile"):
+            out = jitted(*args, **kwargs)  # raises ⇒ signature NOT committed
         tracker.commit(sig)
         # the triggering call's wall time ≈ trace+compile (+1 run):
         # the honest host-visible cost of the retrace

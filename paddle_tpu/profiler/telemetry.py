@@ -293,6 +293,17 @@ class Telemetry:
             profile_payload = device_profile.jsonl_payload()
         except Exception:
             pass
+        goodput_payload = None
+        try:
+            # refresh the wall-clock ledger gauges (gauge/goodput/*) and
+            # pick up the structured attribution table — every exported
+            # record then carries a current, conserving goodput snapshot
+            from . import goodput
+
+            goodput.publish(self)
+            goodput_payload = goodput.jsonl_payload()
+        except Exception:
+            pass
         scalars = self.scalars()
         for k, v in (extra or {}).items():
             f = _coerce_scalar(v)
@@ -306,6 +317,11 @@ class Telemetry:
             # top-level key (they are tables, not scalars); the schema
             # gate validates their shape when present
             rec["profile"] = profile_payload
+        if goodput_payload:
+            # per-attempt wall-clock attribution rides the same way; the
+            # aggregator stitches these tables across restarts (last
+            # table per attempt wins, attempts sum)
+            rec["goodput"] = goodput_payload
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -345,6 +361,14 @@ class Telemetry:
             from .device_profile import reset as _devprof_reset
 
             _devprof_reset()
+        except Exception:
+            pass
+        try:
+            # restart the goodput wall clock: per-config bench records
+            # (and back-to-back tests) each get their own denominator
+            from .goodput import reset as _goodput_reset
+
+            _goodput_reset()
         except Exception:
             pass
 

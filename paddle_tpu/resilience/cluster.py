@@ -52,6 +52,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ..framework import io as _io
+from ..profiler import goodput as _goodput
 from ..profiler.telemetry import get_telemetry
 from .watchdog import EXIT_WATCHDOG, dump_stacks
 
@@ -366,7 +367,11 @@ class ClusterCheckpoint:
         0) or observed committed (others)."""
         tel = get_telemetry()
         try:
-            with tel.timer("ckpt/commit_ms"):
+            # the commit barrier (host conversion + write + ack wait) is
+            # checkpoint_save wall time in the goodput ledger; _io.save
+            # inside claims the same category (nested: no double-book)
+            with tel.timer("ckpt/commit_ms"), \
+                    _goodput.activity("checkpoint_save"):
                 g = self._save(int(step), state, meta or {})
         except CollectiveTimeout as e:
             if not self.hang_exit:
@@ -559,6 +564,15 @@ class ClusterCheckpoint:
         mismatch) is counted in ``ckpt/manifest_fallbacks`` and leaves
         the rejected generation on disk untouched."""
         tel = get_telemetry()
+        # restore_ms covers the WHOLE walk — every rejected generation's
+        # verify pass included, so a fallback that silently costs minutes
+        # shows up in the histogram (and as checkpoint_restore badput in
+        # the goodput ledger)
+        with tel.timer("ckpt/restore_ms"), \
+                _goodput.activity("checkpoint_restore"):
+            return self._restore_walk(tel)
+
+    def _restore_walk(self, tel) -> Optional[Dict[str, Any]]:
         for g in reversed(self.generations()):
             gen_dir = self._gen_dir(g)
             try:

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.sanitizer import finite_report  # noqa: F401  (engine contract)
+from ..profiler import goodput as _goodput
 from ..profiler.telemetry import get_telemetry
 from . import watchdog as _watchdog
 from .inject import active_injector
@@ -239,14 +240,17 @@ class StepGuard:
 
         if not (os.path.exists(p) or os.path.exists(p + ".tmp-old")):
             return self.step_count
-        # restore_train_state already owns the I/O retry policy
-        payload = restore_train_state(p)
-        self._engine.restore_state(payload["state"])
-        if "opt_meta" in payload:
-            self._apply_opt_meta(
-                json.loads(bytes(np.asarray(payload["opt_meta"],
-                                            dtype=np.uint8)).decode()))
-        self.step_count = int(np.asarray(payload["step"]))
+        # restore_train_state already owns the I/O retry policy; the
+        # whole resume (read + reinstall + meta) is checkpoint_restore
+        # wall time in the goodput ledger
+        with _goodput.activity("checkpoint_restore"):
+            payload = restore_train_state(p)
+            self._engine.restore_state(payload["state"])
+            if "opt_meta" in payload:
+                self._apply_opt_meta(
+                    json.loads(bytes(np.asarray(payload["opt_meta"],
+                                                dtype=np.uint8)).decode()))
+            self.step_count = int(np.asarray(payload["step"]))
         get_telemetry().counter("resilience/resumes")
         self._take_snapshot(self.step_count)
         return self.step_count
@@ -277,28 +281,33 @@ class StepGuard:
             # the load-time state is known-good by definition; every
             # later snapshot is taken only AFTER a verified-good step
             self._take_snapshot(step_i)
-        loss = self._engine(inputs, labels)
-        ok, bad = self._engine.last_step_finite()
-        self.step_count += 1
-        if ok:
-            self._bad_streak = 0
-            self._rollbacks_since_good = 0
-            if (self.step_count - self._snap_step) \
-                    >= self.policy.snapshot_every:
-                # refresh only on a good step: refreshing pre-step could
-                # capture params already poisoned by a finite-but-wrong
-                # update right before a bad streak — exactly the state
-                # rollback exists to escape
-                self._take_snapshot(self.step_count)
-        else:
-            self._handle_bad(step_i, inputs, labels, bad)
-        if self._integrity is not None:
-            # divergence check rides the SAME boundary on every rank
-            # (ranks run the loop in lockstep, so the exchange cannot
-            # deadlock against a peer that skipped it); on bad steps the
-            # fingerprint covers the KEPT state — the in-jit select ran
-            # before the fingerprint fold
-            self._integrity.after_step(self.step_count)
+        # goodput: the guarded step INCLUDING the finite sweep's device
+        # sync is productive wall time; recovery work nests inside and
+        # claims rollback_recovery for itself (a nested claim suspends
+        # this one, so nothing double-books)
+        with _goodput.activity("productive_step"):
+            loss = self._engine(inputs, labels)
+            ok, bad = self._engine.last_step_finite()
+            self.step_count += 1
+            if ok:
+                self._bad_streak = 0
+                self._rollbacks_since_good = 0
+                if (self.step_count - self._snap_step) \
+                        >= self.policy.snapshot_every:
+                    # refresh only on a good step: refreshing pre-step
+                    # could capture params already poisoned by a
+                    # finite-but-wrong update right before a bad streak —
+                    # exactly the state rollback exists to escape
+                    self._take_snapshot(self.step_count)
+            else:
+                self._handle_bad(step_i, inputs, labels, bad)
+            if self._integrity is not None:
+                # divergence check rides the SAME boundary on every rank
+                # (ranks run the loop in lockstep, so the exchange cannot
+                # deadlock against a peer that skipped it); on bad steps
+                # the fingerprint covers the KEPT state — the in-jit
+                # select ran before the fingerprint fold
+                self._integrity.after_step(self.step_count)
         return loss
 
     # -- internals ---------------------------------------------------------
@@ -317,8 +326,11 @@ class StepGuard:
         the remaining delta from the healthy replica (or re-detects)."""
         if self._snap is None:
             return False
-        self._engine.restore_state(self._snap)
-        get_telemetry().counter("resilience/rollbacks")
+        tel = get_telemetry()
+        with _goodput.activity("rollback_recovery"), \
+                tel.timer("resilience/rollback_ms"):
+            self._engine.restore_state(self._snap)
+        tel.counter("resilience/rollbacks")
         return True
 
     def _opt_meta(self):
@@ -364,7 +376,10 @@ class StepGuard:
             # want array leaves, and LR state may hold strings/bools)
             payload["opt_meta"] = np.frombuffer(
                 json.dumps(self._snap_meta).encode(), dtype=np.uint8)
-        save_train_state(payload, self.policy.spill_path)
+        # goodput: both the periodic spill (nested under the step's
+        # claim) and the emergency preemption spill are checkpoint_save
+        with _goodput.activity("checkpoint_save"):
+            save_train_state(payload, self.policy.spill_path)
         get_telemetry().counter("resilience/spills")
 
     def _check_preemption(self) -> None:
@@ -372,6 +387,9 @@ class StepGuard:
             return
         from .preemption import exit_for_relaunch
 
+        # from the latch to the exit, wall time is drain_shutdown (the
+        # emergency spill below still claims checkpoint_save for itself)
+        _goodput.shutdown_begin()
         if self.policy.spill_path:
             # the CURRENT state (not the rolling snapshot): every good
             # step since the last spill survives the preemption
@@ -385,32 +403,41 @@ class StepGuard:
         tel = get_telemetry()
         tel.counter("resilience/nonfinite_steps")
         pol = self.policy
-        if pol.quarantine_dir:
-            quarantine_batch(pol.quarantine_dir, step_i, inputs, labels,
-                             bad_names)
-            tel.counter("resilience/quarantined_batches")
-        if self._scaler is not None and getattr(self._scaler, "is_enable",
-                                                lambda: False)():
-            self._scaler.backoff(pol.scale_backoff, pol.min_loss_scale)
-        self._bad_streak += 1
-        if self._bad_streak < pol.max_consecutive_bad:
-            return  # in-jit select already skipped the update
-        if self._rollbacks_since_good >= pol.max_rollbacks:
-            shown = ", ".join(bad_names[:8])
-            try:
-                from ..profiler.spans import flight_recorder
+        # goodput: everything downstream of a non-finite step — the
+        # quarantine spill, the scale backoff, the snapshot rollback —
+        # is recovery wall time, not productive step time (this nests
+        # inside the step's claim and suspends it)
+        with _goodput.activity("rollback_recovery"):
+            if pol.quarantine_dir:
+                with tel.timer("resilience/quarantine_ms"):
+                    quarantine_batch(pol.quarantine_dir, step_i, inputs,
+                                     labels, bad_names)
+                tel.counter("resilience/quarantined_batches")
+            if self._scaler is not None and getattr(
+                    self._scaler, "is_enable", lambda: False)():
+                self._scaler.backoff(pol.scale_backoff, pol.min_loss_scale)
+            self._bad_streak += 1
+            if self._bad_streak < pol.max_consecutive_bad:
+                return  # in-jit select already skipped the update
+            if self._rollbacks_since_good >= pol.max_rollbacks:
+                shown = ", ".join(bad_names[:8])
+                try:
+                    from ..profiler.spans import flight_recorder
 
-                tail = ("\n-- flight recorder (last span events, newest "
-                        "last) --\n" + flight_recorder().format_tail(20))
-            except Exception:
-                tail = ""
-            raise FloatingPointError(
-                f"StepGuard: giving up after {self._rollbacks_since_good} "
-                f"rollbacks without a finite step (step {step_i}, "
-                f"non-finite: {shown}). Quarantined batches are under "
-                f"{pol.quarantine_dir!r} for repro." + tail)
-        self._engine.restore_state(self._snap)
-        self._apply_opt_meta(self._snap_meta)
-        tel.counter("resilience/rollbacks")
-        self._rollbacks_since_good += 1
-        self._bad_streak = 0
+                    tail = ("\n-- flight recorder (last span events, "
+                            "newest last) --\n"
+                            + flight_recorder().format_tail(20))
+                except Exception:
+                    tail = ""
+                raise FloatingPointError(
+                    f"StepGuard: giving up after "
+                    f"{self._rollbacks_since_good} rollbacks without a "
+                    f"finite step (step {step_i}, non-finite: {shown}). "
+                    f"Quarantined batches are under "
+                    f"{pol.quarantine_dir!r} for repro." + tail)
+            with tel.timer("resilience/rollback_ms"):
+                self._engine.restore_state(self._snap)
+                self._apply_opt_meta(self._snap_meta)
+            tel.counter("resilience/rollbacks")
+            self._rollbacks_since_good += 1
+            self._bad_streak = 0

@@ -18,6 +18,7 @@ import numpy as np
 from ..core.enforce import InvalidArgumentError, enforce
 from ..core.tensor import Parameter, Tensor
 from ..profiler import device_profile as _device_profile
+from ..profiler import goodput as _goodput
 from ..profiler import spans as _spans
 from ..profiler import xla_cost as _xla_cost
 from ..profiler.retrace import tracked_jit
@@ -105,6 +106,15 @@ class Executor:
         _watchdog_heartbeat()  # run boundary feeds the hang watchdog
         # windowed device-profile capture boundary (no-op unless armed)
         _device_profile.step_boundary("executor.train_step")
+        # goodput: the run — feed H2D, dispatch AND the blocking numpy
+        # fetch — is productive_step wall time; a fresh compile inside
+        # claims its own category (nested). Helper split keeps the long
+        # body at its original indentation.
+        with _goodput.activity("productive_step"):
+            return self._run_in_claim(program, feed, fetch_list, scope,
+                                      return_numpy)
+
+    def _run_in_claim(self, program, feed, fetch_list, scope, return_numpy):
         t_enter = time.perf_counter()
         tel = get_telemetry()
         program = program if isinstance(program, Program) else (
@@ -621,6 +631,16 @@ class Executor:
         # one capture boundary per window (steps-per-call registered
         # below divides the attribution back to per-step)
         _device_profile.step_boundary("executor.run_steps")
+        # goodput: the whole window call is productive_step wall time
+        # (the scan compile inside claims its own category); helper
+        # split keeps the body at its original indentation
+        with _goodput.activity("productive_step"):
+            return self._run_steps_in_claim(program, feed, fetch_list,
+                                            n_steps, return_numpy,
+                                            step_scheduler)
+
+    def _run_steps_in_claim(self, program, feed, fetch_list, n_steps,
+                            return_numpy, step_scheduler):
         feed = feed or {}
         if n_steps is None:
             raise InvalidArgumentError("n_steps is required")

@@ -119,6 +119,15 @@ JAX_PLATFORMS=cpu python tools/check_ops_server.py
 # the schema gate — all with zero new retraces.
 JAX_PLATFORMS=cpu python tools/check_cluster_timeline.py
 
+# goodput gate: exhaustive wall-clock attribution — on a clean
+# 2-process run every job second must land in exactly one category of
+# the closed goodput vocabulary (sum == wall within 1%, honest
+# unattributed remainder < 5%), and a fault-injected run
+# (nan@3,sigterm@6 under a relaunch budget) must book REAL
+# rollback_recovery and restart_downtime seconds while the stitched
+# cross-restart job view still conserves.
+JAX_PLATFORMS=cpu python tools/check_goodput.py
+
 # decode gate: the token-level twin — paged-KV greedy decode must be
 # token-identical to the dense recompute-the-prefix reference (logits
 # within tolerance), and a mixed prefill+decode load with injected

@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Goodput-ledger conservation gate — every job second is accounted,
+not estimated.
+
+End-to-end on the CPU backend, against the REAL runtime (guarded
+``TrainStep`` + ``DevicePrefetcher`` input pipeline + coordinated
+``ClusterCheckpoint`` commits under the ``distributed.launch``
+supervisor, no mocks):
+
+1. run a tiny 2-process training job clean → every rank's telemetry
+   JSONL must carry a structured ``"goodput"`` table that CONSERVES:
+   the closed-vocabulary categories sum to the wall clock within 1%,
+   the honest ``unattributed`` remainder stays under 5% of the wall,
+   and the expected categories are populated (``startup``,
+   ``productive_step``, ``input_wait``, ``checkpoint_save`` all > 0)
+   while the failure categories stay exactly zero
+   (``rollback_recovery``, ``restart_downtime``);
+2. run the same job with ``PADDLE_TPU_INJECT="nan@3,sigterm@6"`` under
+   a relaunch budget: the NaN books real ``rollback_recovery`` seconds
+   (quarantine + snapshot rollback), the SIGTERM→exit-77→relaunch cycle
+   books ``restart_downtime`` in the LAUNCHER's ledger (no worker
+   process exists to book the dead gap) — and the stitched cross-restart
+   job view still conserves;
+3. the rank logs themselves pass ``check_telemetry_schema`` with its
+   goodput name/conservation contracts enforced.
+
+Gate conventions per tools/_gate.py (``goodput: OK|FAIL — ...``, exit
+0/1, ``--json``). Wired into tools/bench_ritual.sh after
+check_cluster_timeline.py.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+import textwrap
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_TOOLS)
+sys.path.insert(0, _TOOLS)
+if _REPO not in sys.path:  # runnable from anywhere, not just the repo root
+    sys.path.insert(1, _REPO)
+from _gate import add_gate_args, finish  # noqa: E402
+
+# The demo worker: a guarded train loop fed through the prefetcher (so
+# input_wait books on the consumer thread), committing a coordinated
+# checkpoint every DEMO_CKPT_EVERY steps (so checkpoint_save books), a
+# per-good-step snapshot policy with an aggressive rollback trigger (so
+# one injected NaN forces a REAL quarantine + rollback, not a skip).
+WORKER = textwrap.dedent("""
+    import json, os, time
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.io.prefetch import DevicePrefetcher
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.profiler.telemetry import get_telemetry
+    from paddle_tpu.resilience import RecoveryPolicy, StepGuard
+    from paddle_tpu.resilience.cluster import ClusterCheckpoint
+
+    STEPS = int(os.environ["DEMO_STEPS"])
+    EVERY = int(os.environ["DEMO_CKPT_EVERY"])
+    WORK = os.environ["DEMO_WORK"]
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    paddle.seed(0)
+    net = nn.Linear(8, 4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    step = TrainStep(net, lambda out, y: ((out - y) ** 2).mean(), opt,
+                     guard_updates=True)
+    guard = StepGuard(step, RecoveryPolicy(
+        max_consecutive_bad=1,       # one NaN => a real rollback
+        snapshot_every=1,            # a snapshot always exists to roll to
+        quarantine_dir=os.path.join(WORK, "quarantine"),
+        spill_path=os.path.join(WORK, f"spill.rank{rank}")))
+    guard.install_preemption()
+    ck = ClusterCheckpoint(os.environ["DEMO_CKPT_ROOT"])
+    start = 0
+    restored = ck.restore()
+    if restored is not None:
+        step.restore_state(restored["state"])
+        start = int(restored["step"])
+    guard.step_count = start
+    rng = np.random.RandomState(0)
+    xs = rng.randn(STEPS, 16, 8).astype("float32")
+    ys = rng.randn(STEPS, 16, 4).astype("float32")
+
+    def batches():
+        for i in range(start, STEPS):
+            time.sleep(0.005)   # real producer cost => input_wait books
+            yield xs[i], ys[i]
+
+    loss = None
+    i = start
+    for x, y in DevicePrefetcher(batches(), depth=1):
+        loss = guard((x,), (y,))
+        if (i + 1) % EVERY == 0 and (i + 1) < STEPS:
+            ck.save(i + 1, step.snapshot_state())
+        i += 1
+    if rank == 0:
+        with open(os.environ["DEMO_RESULT"], "w") as f:
+            json.dump({"final_step": guard.step_count,
+                       "resumed_from": start}, f)
+    # deterministic flush (the atexit hook would also fire): the LAST
+    # table per attempt is the attempt's cumulative total
+    get_telemetry().to_jsonl(os.environ["PADDLE_TPU_TELEMETRY_JSONL"],
+                             step=guard.step_count, tag="goodput_demo")
+""")
+
+
+def _run(workdir, tag, steps, ckpt_every, inject=None, max_restarts=0):
+    """One 2-process launch; returns (rc, result, tel_base_path)."""
+    from paddle_tpu.distributed.launch import launch
+
+    worker = os.path.join(workdir, "worker.py")
+    with open(worker, "w") as f:
+        f.write(WORKER)
+    sub = os.path.join(workdir, tag)
+    os.makedirs(sub, exist_ok=True)
+    tel_path = os.path.join(sub, "TELEMETRY.jsonl")
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",  # one CPU device per rank, not the test 8-dev host
+        "PYTHONPATH": _REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "PADDLE_TPU_TELEMETRY": "1",
+        "DEMO_STEPS": str(steps),
+        "DEMO_CKPT_EVERY": str(ckpt_every),
+        "DEMO_CKPT_ROOT": os.path.join(sub, "ckpt"),
+        "DEMO_RESULT": os.path.join(sub, "result.json"),
+        "DEMO_WORK": sub,
+    }
+    if inject:
+        env["PADDLE_TPU_INJECT"] = inject
+        env["PADDLE_TPU_INJECT_STATE"] = os.path.join(sub, "inject-state")
+    rc = launch(worker, [], nproc_per_node=2,
+                log_dir=os.path.join(sub, "logs"), backend="cpu",
+                extra_env=env, max_restarts=max_restarts,
+                restart_backoff=0.05, telemetry_jsonl=tel_path)
+    result = None
+    if os.path.exists(env["DEMO_RESULT"]):
+        with open(env["DEMO_RESULT"]) as f:
+            result = json.load(f)
+    return rc, result, tel_path
+
+
+def _summarize(tel_base):
+    """Cross-rank, cross-restart goodput view of one run's logs. The
+    launcher's own record (tag="launch", in the base file) rides along
+    under a key no rank uses, so its restart_downtime is found without
+    colliding with rank 0's ledger."""
+    from paddle_tpu.profiler import aggregate
+
+    root, ext = os.path.splitext(tel_base)
+    rank_records = {}
+    for path in sorted(glob.glob(f"{root}.rank*{ext}")):
+        m = aggregate.rank_of_path(path, -1)
+        rank_records[m] = aggregate.read_jsonl(path)
+    if os.path.exists(tel_base):
+        rank_records[-1] = aggregate.read_jsonl(tel_base)
+    return aggregate.goodput_summary(rank_records), sorted(
+        glob.glob(f"{root}.rank*{ext}"))
+
+
+def run_demo(workdir, steps=12, ckpt_every=2):
+    """Returns (ok, detail, payload)."""
+    # 1. clean 2-process run: conservation + expected categories
+    rc, result, tel = _run(workdir, "clean", steps, ckpt_every)
+    if rc != 0 or result is None:
+        return False, f"clean run failed rc={rc}", {}
+    summary, rank_paths = _summarize(tel)
+    if summary is None:
+        return False, "clean run left no goodput ledger tables", {}
+    job = summary["job"]
+    payload = {"clean": job}
+    if summary["conservation_err"] > 0.01:
+        return False, (f"clean run does not conserve: worst rank "
+                       f"|wall - sum(categories)| is "
+                       f"{summary['conservation_err']:.1%} of wall "
+                       f"(tolerance 1%)"), payload
+    unattr = job["categories"]["unattributed"]
+    if job["wall_s"] <= 0 or unattr / job["wall_s"] >= 0.05:
+        return False, (f"unattributed = {unattr:.3f}s of "
+                       f"{job['wall_s']:.3f}s wall (>= 5%) — the ledger "
+                       f"is not exhaustive"), payload
+    for cat in ("startup", "productive_step", "input_wait",
+                "checkpoint_save"):
+        if job["categories"][cat] <= 0:
+            return False, (f"clean run booked no {cat} seconds — the "
+                           f"{cat} instrumentation point is dark"), payload
+    for cat in ("rollback_recovery", "restart_downtime"):
+        if job["categories"][cat] != 0:
+            return False, (f"clean run booked {job['categories'][cat]:.3f}s "
+                           f"of {cat} — phantom failure accounting"), payload
+
+    # 2. rank logs pass the schema checker's goodput contracts
+    from check_telemetry_schema import validate_file
+
+    for path in rank_paths:
+        n, err = validate_file(path, require=["gauge/goodput/fraction"])
+        if err:
+            return False, f"telemetry schema: {err}", payload
+
+    # 3. injected run: NaN books rollback_recovery, SIGTERM+relaunch
+    #    books restart_downtime — and the stitched view still conserves
+    rc, result, tel = _run(workdir, "injected", steps, ckpt_every,
+                           inject="nan@3,sigterm@6", max_restarts=2)
+    if rc != 0 or result is None:
+        return False, f"injected run failed rc={rc}", payload
+    inj, _ = _summarize(tel)
+    if inj is None:
+        return False, "injected run left no goodput ledger tables", payload
+    ijob = inj["job"]
+    payload["injected"] = ijob
+    if inj["conservation_err"] > 0.01:
+        return False, (f"injected run does not conserve: worst rank "
+                       f"err {inj['conservation_err']:.1%} of wall "
+                       f"(tolerance 1%)"), payload
+    if ijob["categories"]["rollback_recovery"] <= 0:
+        return False, ("injected NaN booked no rollback_recovery seconds "
+                       "— recovery wall time is invisible"), payload
+    if ijob["categories"]["restart_downtime"] <= 0:
+        return False, ("injected SIGTERM+relaunch booked no "
+                       "restart_downtime seconds — the dead gap between "
+                       "attempts is invisible"), payload
+    return True, (f"clean: {job['fraction']:.1%} goodput of "
+                  f"{job['wall_s']:.1f}s wall, unattributed "
+                  f"{unattr:.3f}s (<5%), conserved to "
+                  f"{summary['conservation_err']:.2%}; injected: "
+                  f"rollback_recovery "
+                  f"{ijob['categories']['rollback_recovery']:.3f}s, "
+                  f"restart_downtime "
+                  f"{ijob['categories']['restart_downtime']:.3f}s, "
+                  f"still conserved to {inj['conservation_err']:.2%}"), \
+        payload
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Goodput-ledger conservation gate (clean + "
+                    "fault-injected 2-process CPU runs; every wall "
+                    "second must land in exactly one category)")
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--ckpt-every", type=int, default=2)
+    ap.add_argument("--workdir", default=None,
+                    help="keep artifacts here instead of a temp dir")
+    add_gate_args(ap)
+    args = ap.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.workdir:
+        os.makedirs(args.workdir, exist_ok=True)
+        ok, detail, payload = run_demo(args.workdir, args.steps,
+                                       args.ckpt_every)
+    else:
+        with tempfile.TemporaryDirectory(prefix="goodput-gate-") as d:
+            ok, detail, payload = run_demo(d, args.steps, args.ckpt_every)
+    return finish("goodput", ok, detail, payload=payload,
+                  json_mode=args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -100,6 +100,21 @@ static findings per rule across every program compiled this run; the
 sync with ``paddle_tpu/analysis/hlo/hlo_rules.py``) and the count is a
 monotone total ≥ 0.
 
+Goodput-ledger contracts (``profiler.goodput``): every
+``gauge/goodput/<name>`` must be ``fraction``, ``wall_s``, or
+``<category>_s`` with the category from the CLOSED goodput vocabulary
+(keep in sync with ``paddle_tpu/profiler/goodput.py``) — an invented
+category means a producer is booking seconds the ledger cannot conserve;
+all ``*_s`` values are seconds ≥ 0 and ``fraction`` ∈ [0, 1].
+Cross-field: a record carrying ``gauge/goodput/wall_s`` must conserve —
+the summed ``<category>_s`` equals the wall within max(1% of wall,
+0.05 s), because the ledger's whole contract is that every second lands
+in exactly one category. A record carrying the structured top-level
+``"goodput"`` table (what ``Telemetry.to_jsonl`` attaches) must be
+well-formed: ``wall_s`` ≥ 0, ``fraction`` ∈ [0, 1], ``attempt`` a
+non-negative integer, ``categories`` keys ⊆ the closed vocabulary with
+values ≥ 0 summing to ``wall_s`` within the same tolerance.
+
 Token-level serving contracts (``inference.serving.decode``):
 ``gauge/serve/kv_occupancy`` ∈ [0, 1] and
 ``gauge/serve/spec_accept_rate`` ∈ [0, 1] (both are fractions by
@@ -134,6 +149,59 @@ _COLLECTIVE_FIELDS = {"bytes", "ms", "count"}
 # analysis.hlo's closed rule vocabulary (keep in sync with HLO_RULES
 # there): hlo-lint finding counters are keyed per rule id
 _HLOLINT_RULES = {"H1", "H2", "H3", "H4", "H5", "H6", "H7", "H8"}
+# profiler.goodput's closed wall-clock vocabulary (keep in sync with
+# CATEGORIES there): every job second lands in exactly one of these
+_GOODPUT_CATEGORIES = (
+    "startup", "productive_step", "compile", "input_wait",
+    "checkpoint_save", "checkpoint_restore", "rollback_recovery",
+    "eval", "drain_shutdown", "restart_downtime", "unattributed",
+)
+_GOODPUT_SCALARS = {"fraction", "wall_s"} | {
+    f"{c}_s" for c in _GOODPUT_CATEGORIES}
+
+
+def _goodput_tolerance(wall):
+    return max(0.01 * wall, 0.05)
+
+
+def _validate_goodput_table(table, lineno):
+    """Shape + conservation check of the structured ``"goodput"`` table."""
+    if not isinstance(table, dict):
+        return f"line {lineno}: 'goodput' must be an object"
+    wall = table.get("wall_s")
+    if not isinstance(wall, (int, float)) or isinstance(wall, bool) \
+            or not math.isfinite(float(wall)) or float(wall) < 0:
+        return (f"line {lineno}: goodput.wall_s = {wall!r} must be a "
+                f"finite number >= 0")
+    frac = table.get("fraction")
+    if frac is not None and (not isinstance(frac, (int, float))
+                             or isinstance(frac, bool)
+                             or not (0 <= float(frac) <= 1)):
+        return f"line {lineno}: goodput.fraction = {frac!r} outside [0, 1]"
+    attempt = table.get("attempt")
+    if attempt is not None and (not isinstance(attempt, int)
+                                or isinstance(attempt, bool)
+                                or attempt < 0):
+        return (f"line {lineno}: goodput.attempt = {attempt!r} must be "
+                f"an integer >= 0")
+    cats = table.get("categories", {})
+    if not isinstance(cats, dict):
+        return f"line {lineno}: goodput.categories must be an object"
+    booked = 0.0
+    for cat, secs in cats.items():
+        if cat not in _GOODPUT_CATEGORIES:
+            return (f"line {lineno}: goodput category {cat!r} outside "
+                    f"the closed vocabulary {list(_GOODPUT_CATEGORIES)}")
+        if isinstance(secs, bool) or not isinstance(secs, (int, float)) \
+                or not math.isfinite(float(secs)) or float(secs) < 0:
+            return (f"line {lineno}: goodput.categories[{cat!r}] = "
+                    f"{secs!r} must be a finite number >= 0")
+        booked += float(secs)
+    if abs(booked - float(wall)) > _goodput_tolerance(float(wall)):
+        return (f"line {lineno}: goodput categories sum to {booked:.3f}s "
+                f"but wall_s = {float(wall):.3f}s — the ledger must "
+                f"conserve (every second in exactly one category)")
+    return None
 
 
 def _collective_axis_ok(axis):
@@ -324,6 +392,24 @@ def validate_record(rec, lineno):
             if float(value) < 0:
                 return (f"line {lineno}: scalar {name!r} = {value!r} "
                         f"is negative (finding counts are monotone)")
+        # goodput ledger: names come from the CLOSED wall-clock
+        # vocabulary (an invented category is seconds the ledger cannot
+        # conserve); seconds are >= 0 and the fraction is in [0, 1]
+        if name.startswith("gauge/goodput/"):
+            rest = name[len("gauge/goodput/"):]
+            if rest not in _GOODPUT_SCALARS:
+                return (f"line {lineno}: scalar {name!r} outside the "
+                        f"goodput vocabulary — expected fraction, "
+                        f"wall_s, or <category>_s with category in "
+                        f"{list(_GOODPUT_CATEGORIES)}")
+            if rest == "fraction":
+                if not (0 <= float(value) <= 1):
+                    return (f"line {lineno}: scalar {name!r} = {value!r} "
+                            f"outside [0, 1] (goodput is a fraction of "
+                            f"job wall-clock)")
+            elif float(value) < 0:
+                return (f"line {lineno}: scalar {name!r} = {value!r} "
+                        f"is negative (wall-clock seconds)")
         # bottleneck verdicts come from a CLOSED vocabulary — any other
         # value means a producer invented a verdict the dashboards and
         # gates cannot name
@@ -429,9 +515,29 @@ def validate_record(rec, lineno):
                         f"{entry!r} sum to {total:.6f} > captured "
                         f"device total {float(device_total):.6f} ms — "
                         f"the per-axis join double-counts the window")
+    # cross-field: a record that reports the goodput wall must conserve
+    # it — the categories partition the wall by construction, so a gap
+    # past tolerance means a producer double-booked or dropped seconds
+    goodput_wall = scalars.get("gauge/goodput/wall_s")
+    if goodput_wall is not None:
+        booked = sum(float(v) for name, v in scalars.items()
+                     if name.startswith("gauge/goodput/")
+                     and name.endswith("_s")
+                     and name != "gauge/goodput/wall_s")
+        if abs(booked - float(goodput_wall)) \
+                > _goodput_tolerance(float(goodput_wall)):
+            return (f"line {lineno}: gauge/goodput/*_s sum to "
+                    f"{booked:.3f}s but wall_s = "
+                    f"{float(goodput_wall):.3f}s — the ledger must "
+                    f"conserve (every second in exactly one category)")
     # structured top-K table (device_profile captures attach it)
     if "profile" in rec:
         err = _validate_profile_table(rec["profile"], lineno)
+        if err:
+            return err
+    # structured goodput ledger table (Telemetry.to_jsonl attaches it)
+    if "goodput" in rec:
+        err = _validate_goodput_table(rec["goodput"], lineno)
         if err:
             return err
     # cross-field: histogram count/sum/mean must agree within one record

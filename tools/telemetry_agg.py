@@ -190,6 +190,29 @@ def format_report(result) -> str:
                      + result["late_rank_analysis_skipped"])
     elif "late_ranks" in result:
         lines.append("late ranks: none")
+    goodput = result.get("goodput")
+    if goodput:
+        job = goodput["job"]
+        worst = goodput["worst_rank"]
+        badput = sorted(
+            ((c, s) for c, s in job["categories"].items()
+             if c != "productive_step" and s > 0.05),
+            key=lambda kv: -kv[1])
+        breakdown = ", ".join(f"{c} {s:.1f}s" for c, s in badput) or "none"
+        lines.append(
+            f"GOODPUT: {job['fraction'] * 100:.1f}% of "
+            f"{job['wall_s']:.1f}s job wall-clock was productive steps; "
+            f"badput: {breakdown}; worst rank {worst['rank']} at "
+            f"{worst['fraction'] * 100:.1f}%"
+            + (f"; restart downtime {job['restart_downtime_s']:.1f}s"
+               if job["restart_downtime_s"] > 0 else ""))
+        if goodput["conservation_err"] > 0.01:
+            lines.append(
+                f"  WARNING: ledger conservation error "
+                f"{goodput['conservation_err'] * 100:.1f}% — categories "
+                f"do not sum to measured wall (instrumentation bug)")
+    else:
+        lines.append("goodput: no ledger tables in these logs")
     stragglers = result["stragglers"]
     if stragglers:
         lines.append(f"stragglers (> {result['threshold']:.2f}x cluster "
@@ -258,6 +281,12 @@ def main(argv=None):
     ap.add_argument("--fail-on-late-rank", action="store_true",
                     help="exit 1 when any rank arrives > --late-ms late "
                          "into any collective instance (gate mode)")
+    ap.add_argument("--min-goodput", type=float, default=None,
+                    help="fail (exit 1) when the job-level goodput "
+                         "fraction — productive-step seconds over total "
+                         "wall-clock including restart downtime — is "
+                         "below this value in [0,1], or when no rank "
+                         "left a goodput ledger to verify (gate mode)")
     args = ap.parse_args(argv)
     paths = _resolve_paths(args.paths)
     if not paths:
@@ -315,6 +344,18 @@ def main(argv=None):
                   f"verify: {late_unverifiable}", file=sys.stderr)
             return 1
         if result.get("late_ranks"):
+            return 1
+    if args.min_goodput is not None:
+        goodput = result.get("goodput")
+        if not goodput:
+            print("telemetry aggregate: --min-goodput could not verify: "
+                  "no goodput ledger tables in these logs",
+                  file=sys.stderr)
+            return 1
+        if goodput["job"]["fraction"] < args.min_goodput:
+            print(f"telemetry aggregate: job goodput "
+                  f"{goodput['job']['fraction']:.3f} < required "
+                  f"{args.min_goodput:.3f}", file=sys.stderr)
             return 1
     if result.get("dead_ranks"):
         return 1
